@@ -1,0 +1,102 @@
+"""Flagship benchmark: Llama train-step MFU on the local accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Baseline: the reference publishes no in-repo ML throughput numbers
+(BASELINE.md) — the north-star target is >=45% MFU, so vs_baseline is
+achieved_MFU / 0.45.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+
+# bf16 peak matmul FLOP/s by device generation.
+PEAK_FLOPS = [
+    ("v5 lite", 197e12),
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6 lite", 918e12),
+    ("v6e", 918e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+]
+
+
+def peak_flops(device) -> float:
+    kind = getattr(device, "device_kind", "cpu").lower()
+    for key, val in PEAK_FLOPS:
+        if key in kind:
+            return val
+    return 1e12  # CPU / unknown: nominal
+
+
+def main():
+    import os
+
+    # Honor an explicit non-TPU platform request (e.g. JAX_PLATFORMS=cpu for
+    # smoke runs) even if a TPU plugin was force-registered at startup.
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want and "axon" not in want and "tpu" not in want:
+        try:
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
+
+    import optax
+
+    from ray_tpu.models import llama
+    from ray_tpu.train.step import TrainState, make_train_step
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    if on_tpu:
+        cfg, B, S, iters = llama.LLAMA_400M, 8, 1024, 10
+    else:  # keep the smoke path fast off-TPU
+        cfg, B, S, iters = llama.LLAMA_TINY, 4, 64, 3
+
+    params = llama.init_params(cfg, jax.random.key(0))
+    opt = optax.adamw(1e-4)
+    state = TrainState.create(params, opt)
+    step = make_train_step(lambda p, b: llama.loss_fn(p, b, cfg), opt)
+
+    tokens = jax.random.randint(jax.random.key(1), (B, S + 1), 0, cfg.vocab_size, jnp.int32)
+    batch = {"tokens": tokens[:, :-1], "targets": tokens[:, 1:]}
+
+    # warmup / compile
+    for _ in range(2):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * S * iters / dt
+    train_flops_per_token = 3.0 * cfg.flops_per_token()  # fwd + 2x bwd
+    achieved = tokens_per_sec * train_flops_per_token
+    mfu = achieved / peak_flops(dev)
+
+    print(
+        json.dumps(
+            {
+                "metric": "llama400m_train_mfu" if on_tpu else "llama_tiny_train_smoke",
+                "value": round(mfu * 100, 2),
+                "unit": "%MFU",
+                "vs_baseline": round(mfu / 0.45, 4),
+                "tokens_per_sec": round(tokens_per_sec, 1),
+                "device": getattr(dev, "device_kind", str(dev)),
+                "model_params": cfg.num_params(),
+                "loss": float(metrics["loss"]),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

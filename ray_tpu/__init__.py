@@ -1,0 +1,25 @@
+"""ray_tpu: a TPU-native distributed compute framework.
+
+Capabilities of the reference system (comaniac/ray, surveyed in
+SURVEY.md): tasks/actors/objects/placement-groups under a cluster
+scheduler — rebuilt TPU-first, with JAX device meshes, XLA/ICI
+collectives, and Pallas kernels as the compute substrate instead of
+CUDA/NCCL.
+"""
+
+__version__ = "0.1.0"
+
+_API_EXPORTS = {}
+
+
+def __getattr__(name):
+    # Public core API (init/remote/get/put/wait/actor/...) is re-exported
+    # lazily from ray_tpu.core.api to keep `import ray_tpu` light for
+    # model-only users (jax imports are heavy already).
+    try:
+        from ray_tpu.core import api
+    except ImportError:
+        raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}") from None
+    if hasattr(api, name):
+        return getattr(api, name)
+    raise AttributeError(f"module 'ray_tpu' has no attribute {name!r}")

@@ -1,0 +1,78 @@
+"""Functional NN building blocks (pure jax, pytree params).
+
+The framework's model layer is deliberately functional: params are plain
+pytrees built next to a parallel pytree of logical-axis annotations
+(see ray_tpu.parallel.sharding). No module objects, no tracing magic —
+everything stays jit/scan/shard_map-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, scale: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """RMSNorm in fp32 regardless of input dtype (numerics on the VPU are cheap)."""
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_frequencies(head_dim: int, max_seq: int, theta: float = 500000.0) -> tuple[jax.Array, jax.Array]:
+    """Precompute RoPE cos/sin tables [max_seq, head_dim//2] in fp32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_seq, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array, positions: jax.Array) -> jax.Array:
+    """Rotate pairs of features. x: [B, S, H, D]; positions: [B, S] or [S]."""
+    c = cos[positions]  # [..., S, D/2]
+    s = sin[positions]
+    if c.ndim == 2:  # positions was [S]
+        c = c[None, :, None, :]
+        s = s[None, :, None, :]
+    else:  # [B, S, D/2]
+        c = c[:, :, None, :]
+        s = s[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)
+
+
+def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array) -> jax.Array:
+    gate = jnp.einsum("bsd,df->bsf", x, w_gate.astype(x.dtype))
+    up = jnp.einsum("bsd,df->bsf", x, w_up.astype(x.dtype))
+    return jnp.einsum("bsf,fd->bsd", jax.nn.silu(gate) * up, w_down.astype(x.dtype))
+
+
+def init_dense(key: jax.Array, shape: tuple[int, ...], dtype, scale: float | None = None) -> jax.Array:
+    """Truncated-normal fan-in init."""
+    fan_in = shape[0]
+    std = scale if scale is not None else 1.0 / jnp.sqrt(fan_in)
+    return (jax.random.truncated_normal(key, -3, 3, shape, jnp.float32) * std).astype(dtype)
+
+
+def cross_entropy_loss(
+    logits: jax.Array,  # [B, S, V] (any float dtype; upcast internally)
+    targets: jax.Array,  # [B, S] int32
+    mask: jax.Array | None = None,  # [B, S] 0/1
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (mean_nll, total_weight). fp32 log-softmax for stability."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    nll = logz - gold
+    if mask is None:
+        mask = jnp.ones_like(nll)
+    mask = mask.astype(jnp.float32)
+    total = jnp.maximum(mask.sum(), 1.0)
+    return (nll * mask).sum() / total, total
+
+
+Initializer = Callable[[jax.Array, tuple[int, ...]], jax.Array]

@@ -1,0 +1,91 @@
+"""Attention ops. XLA reference implementation + dispatch seam for Pallas kernels.
+
+Grouped-query causal attention shaped for the MXU: contractions stay as
+large einsums (bf16 in, fp32 softmax/accumulate) so XLA tiles them onto
+the systolic array. `attention()` is the single entry point; `impl`
+selects between the XLA composite (fused adequately by XLA for moderate
+sequence lengths) and the Pallas flash kernel (ray_tpu.ops.flash).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _repeat_kv_heads(q: jax.Array, k: jax.Array) -> int:
+    n_heads = q.shape[2]
+    n_kv = k.shape[2]
+    if n_heads % n_kv != 0:
+        raise ValueError(f"n_heads {n_heads} not divisible by kv heads {n_kv}")
+    return n_heads // n_kv
+
+
+def xla_attention(
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Sk, K, D]
+    v: jax.Array,  # [B, Sk, K, D]
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,  # [B, S] int, same for q/k when Sq==Sk
+    q_offset: int | jax.Array = 0,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """Reference GQA attention. fp32 softmax, bf16 matmuls."""
+    B, Sq, H, D = q.shape
+    _, Sk, K, _ = k.shape
+    group = _repeat_kv_heads(q, k)
+    scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(D)
+
+    qg = q.reshape(B, Sq, K, group, D)
+    scores = jnp.einsum("bqkgd,bskd->bkgqs", qg, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+
+    mask = None
+    if causal:
+        q_pos = jnp.arange(Sq)[:, None] + q_offset
+        k_pos = jnp.arange(Sk)[None, :]
+        mask = q_pos >= k_pos  # [Sq, Sk]
+        mask = mask[None, None, None, :, :]
+    if segment_ids is not None:
+        seg = segment_ids[:, :, None] == segment_ids[:, None, :]  # [B, Sq, Sk]
+        seg = seg[:, None, None, :, :]
+        mask = seg if mask is None else jnp.logical_and(mask, seg)
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    out = jnp.einsum("bkgqs,bskd->bqkgd", probs, v)
+    return out.reshape(B, Sq, H, D)
+
+
+def attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    q_offset: int | jax.Array = 0,
+    softmax_scale: Optional[float] = None,
+    impl: str = "xla",
+) -> jax.Array:
+    if impl == "xla":
+        return xla_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            q_offset=q_offset, softmax_scale=softmax_scale,
+        )
+    if impl == "flash":
+        from ray_tpu.ops.flash import flash_attention
+
+        return flash_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids,
+            q_offset=q_offset, softmax_scale=softmax_scale,
+        )
+    raise ValueError(f"unknown attention impl {impl!r}")

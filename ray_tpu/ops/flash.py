@@ -1,0 +1,31 @@
+"""Flash attention for TPU (Pallas kernel seam).
+
+The tiled online-softmax Pallas kernel lands with the kernels milestone;
+until then this module keeps the `impl="flash"` path honest by raising a
+clear error on TPU and falling back to the XLA composite elsewhere
+(XLA already fuses the composite well enough for short sequences).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    q_offset: int | jax.Array = 0,
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    from ray_tpu.ops.attention import xla_attention
+
+    return xla_attention(
+        q, k, v, causal=causal, segment_ids=segment_ids,
+        q_offset=q_offset, softmax_scale=softmax_scale,
+    )

@@ -1,0 +1,100 @@
+"""Device-mesh construction: the TPU-native substrate for every parallelism axis.
+
+Where the reference wires parallelism through per-worker process groups
+(reference: python/ray/train/torch/config.py:115 `dist.init_process_group`
+and python/ray/util/collective NCCL groups), a TPU framework expresses all
+of DP/FSDP/PP/TP/SP/EP as axes of a single `jax.sharding.Mesh` over the
+slice's chips; XLA then lowers the program's shardings to ICI collectives.
+This module owns the mesh axis convention used everywhere else:
+
+    ("dp", "pp", "fsdp", "ep", "sp", "tp")
+
+Axis order encodes ICI locality: `tp` is innermost (highest-bandwidth
+neighbors, most latency-sensitive collectives), `dp` outermost (pure
+gradient allreduce, can ride DCN between slices).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+MESH_AXES = ("dp", "pp", "fsdp", "ep", "sp", "tp")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Degrees of each parallelism axis. Product must equal device count
+    (use -1 for one axis to infer it)."""
+
+    dp: int = 1
+    pp: int = 1
+    fsdp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def sizes(self) -> tuple[int, ...]:
+        return (self.dp, self.pp, self.fsdp, self.ep, self.sp, self.tp)
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        """Fill in a single -1 axis so the product matches n_devices."""
+        sizes = list(self.sizes())
+        if sizes.count(-1) > 1:
+            raise ValueError("at most one mesh axis may be -1")
+        if -1 in sizes:
+            known = math.prod(s for s in sizes if s != -1)
+            if n_devices % known != 0:
+                raise ValueError(
+                    f"cannot infer axis: {n_devices} devices not divisible by {known}"
+                )
+            sizes[sizes.index(-1)] = n_devices // known
+        if math.prod(sizes) != n_devices:
+            raise ValueError(
+                f"mesh spec {sizes} (= {math.prod(sizes)}) != device count {n_devices}"
+            )
+        return MeshSpec(*sizes)
+
+    @classmethod
+    def data_parallel(cls, n: int = -1) -> "MeshSpec":
+        return cls(dp=n)
+
+    @classmethod
+    def fsdp_only(cls, n: int = -1) -> "MeshSpec":
+        return cls(fsdp=n)
+
+
+def make_mesh(
+    spec: MeshSpec | None = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build the framework-standard 6-axis mesh.
+
+    `mesh_utils.create_device_mesh` lays physical chips out so that the
+    innermost axes land on ICI-adjacent neighbors (torus-aware on TPU).
+    """
+    if devices is None:
+        devices = jax.devices()
+    spec = (spec or MeshSpec()).resolve(len(devices))
+    if len(devices) == 1:
+        dev_array = np.asarray(devices).reshape(spec.sizes())
+    else:
+        dev_array = mesh_utils.create_device_mesh(
+            spec.sizes(), devices=list(devices), allow_split_physical_axes=True
+        )
+    return Mesh(dev_array, MESH_AXES)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    devs = [device] if device is not None else jax.devices()[:1]
+    return Mesh(np.asarray(devs).reshape((1,) * len(MESH_AXES)), MESH_AXES)
+
+
+def mesh_shape(mesh: Mesh) -> MeshSpec:
+    return MeshSpec(**{a: mesh.shape[a] for a in MESH_AXES})

@@ -1,0 +1,88 @@
+"""Global config/flag registry.
+
+TPU-native analog of the reference's RAY_CONFIG macro registry
+(reference: src/ray/common/ray_config_def.h:20-23 — typed flags with
+defaults, overridable by RAY_<name> env vars). Here flags are declared
+once in _DEFS, resolved lazily from the environment (``RAY_TPU_<name>``),
+and overridable programmatically for tests via `override`.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+from typing import Any
+
+_DEFS: dict[str, tuple[type, Any, str]] = {}
+_OVERRIDES: dict[str, Any] = {}
+_LOCK = threading.Lock()
+
+
+def define(name: str, typ: type, default: Any, doc: str = "") -> None:
+    _DEFS[name] = (typ, default, doc)
+
+
+def get(name: str) -> Any:
+    if name not in _DEFS:
+        raise KeyError(f"unknown config flag: {name}")
+    with _LOCK:
+        if name in _OVERRIDES:
+            return _OVERRIDES[name]
+    typ, default, _ = _DEFS[name]
+    env = os.environ.get(f"RAY_TPU_{name}")
+    if env is None:
+        return default
+    if typ is bool:
+        return env.lower() in ("1", "true", "yes")
+    if typ in (dict, list):
+        return json.loads(env)
+    return typ(env)
+
+
+def set_override(name: str, value: Any) -> None:
+    if name not in _DEFS:
+        raise KeyError(f"unknown config flag: {name}")
+    with _LOCK:
+        _OVERRIDES[name] = value
+
+
+@contextlib.contextmanager
+def override(**kwargs):
+    """Temporarily override flags (test helper)."""
+    for name in kwargs:
+        if name not in _DEFS:
+            raise KeyError(f"unknown config flag: {name}")
+    with _LOCK:
+        saved = dict(_OVERRIDES)
+        _OVERRIDES.update(kwargs)
+    try:
+        yield
+    finally:
+        with _LOCK:
+            _OVERRIDES.clear()
+            _OVERRIDES.update(saved)
+
+
+def all_flags() -> dict[str, Any]:
+    return {name: get(name) for name in _DEFS}
+
+
+# ---------------------------------------------------------------------------
+# Flag definitions (grow as subsystems land).
+# ---------------------------------------------------------------------------
+
+define("object_store_memory_mb", int, 2048, "Host shared-memory object store capacity.")
+define("inline_object_max_bytes", int, 100 * 1024, "Objects smaller than this stay in the in-process memory store.")
+define("worker_pool_size", int, 4, "Default number of task-execution workers per node.")
+define("worker_mode", str, "thread", "Task execution mode: 'thread' (shares the host JAX process, TPU-friendly) or 'process'.")
+define("task_max_retries", int, 3, "Default retries for tasks that die with the worker.")
+define("actor_max_restarts", int, 0, "Default actor restarts on failure.")
+define("health_check_period_s", float, 1.0, "Control-plane node health check interval.")
+define("health_check_timeout_s", float, 5.0, "Node declared dead after this long without heartbeat.")
+define("scheduler_spread_threshold", float, 0.5, "Utilization above which hybrid policy prefers spreading.")
+define("scheduler_top_k_fraction", float, 0.2, "Hybrid policy: random pick among best k = frac * num_nodes.")
+define("gcs_port", int, 0, "Control-plane service port (0 = pick free).")
+define("metrics_export_interval_s", float, 5.0, "Metrics push interval.")
+define("log_level", str, "INFO", "Framework log level.")

@@ -1,0 +1,28 @@
+"""Test fixtures.
+
+Tests run on a virtual 8-device CPU mesh (the analog of the reference's
+in-process fake clusters, python/ray/cluster_utils.py:135) so SPMD
+sharding paths are exercised without TPU hardware.
+"""
+
+import os
+
+# Must be set before jax import anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# Restrict to the cpu backend entirely: never initialize a TPU plugin from
+# tests (a wedged device tunnel must not hang the suite).
+jax.config.update("jax_platforms", "cpu")
+
+
+@pytest.fixture(scope="session")
+def cpu_devices():
+    devs = jax.devices("cpu")
+    assert len(devs) == 8, f"expected 8 virtual cpu devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,30 @@
+import jax
+import pytest
+
+from ray_tpu.parallel.mesh import MESH_AXES, MeshSpec, make_mesh, mesh_shape
+from ray_tpu.parallel.sharding import default_rules
+
+
+def test_mesh_spec_resolve():
+    assert MeshSpec(dp=-1).resolve(8).dp == 8
+    assert MeshSpec(dp=2, tp=-1).resolve(8).tp == 4
+    with pytest.raises(ValueError):
+        MeshSpec(dp=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(dp=-1, tp=-1).resolve(8)
+
+
+def test_make_mesh_axes(cpu_devices):
+    mesh = make_mesh(MeshSpec(dp=2, fsdp=2, tp=2))
+    assert mesh.axis_names == MESH_AXES
+    assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 2
+    spec = mesh_shape(mesh)
+    assert spec.dp == 2 and spec.fsdp == 2 and spec.tp == 2 and spec.pp == 1
+
+
+def test_rules_spec():
+    rules = default_rules()
+    s = rules.spec(("batch", "seq", None))
+    assert s == jax.sharding.PartitionSpec(("dp", "fsdp"), "sp", None)
+    s2 = rules.spec(("embed", "heads"))
+    assert s2 == jax.sharding.PartitionSpec("fsdp", "tp")
