@@ -1,0 +1,199 @@
+"""Host object store: in-process memory store + shared-memory segments.
+
+TPU-native rethink of the reference's two-tier store (in-process memory
+store for small objects + plasma shared memory for large ones —
+reference: src/ray/core_worker/memory_store/ and
+src/ray/object_manager/plasma/object_store.h:74). Key design change:
+because a TPU host runs ONE JAX process (chips are single-owner), the
+default execution mode is threads inside that process, and the fast path
+for objects is a *reference* — zero serialization, zero copy. Shared
+memory (`multiprocessing.shared_memory` today, the C++ slab store when
+built) is used only when crossing a process boundary, with numpy arrays
+carried out-of-band so reconstruction is a zero-copy mmap view (the
+plasma + pickle5-buffers behavior of the reference,
+python/ray/_private/serialization.py).
+"""
+
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional
+
+import cloudpickle
+import numpy as np
+
+from ray_tpu.core.errors import GetTimeoutError, ObjectLostError
+from ray_tpu.utils.ids import ObjectID
+
+
+@dataclass
+class _Entry:
+    value: Any = None
+    serialized: Optional[tuple[bytes, list]] = None  # (payload, oob buffers)
+    ready: threading.Event = field(default_factory=threading.Event)
+    error: Optional[BaseException] = None
+    ref_count: int = 1
+    nbytes: int = 0
+
+
+def serialize(value: Any) -> tuple[bytes, list[np.ndarray]]:
+    """cloudpickle with out-of-band numpy buffers (zero-copy reconstruct)."""
+    buffers: list = []
+    payload = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    return payload, [np.frombuffer(b, dtype=np.uint8) for b in buffers]
+
+
+def deserialize(payload: bytes, buffers: list) -> Any:
+    return pickle.loads(payload, buffers=[b.data if hasattr(b, "data") else b for b in buffers])
+
+
+class ObjectStore:
+    """Per-node store. Thread-safe. Values stored by reference (thread mode
+    fast path); `serialized_get` materializes bytes for process/DCN transport."""
+
+    def __init__(self, capacity_bytes: int = 0):
+        self._entries: dict[ObjectID, _Entry] = {}
+        self._lock = threading.Lock()
+        self._capacity = capacity_bytes  # 0 = unbounded (host RAM)
+        self._used = 0
+        self._on_ready: dict[ObjectID, list[Callable[[ObjectID], None]]] = {}
+
+    # -- write paths ---------------------------------------------------------
+
+    def put(self, obj_id: ObjectID, value: Any) -> None:
+        with self._lock:
+            entry = self._entries.setdefault(obj_id, _Entry(ref_count=1))
+            if entry.ref_count == 0:
+                entry.ref_count = 1  # primary ref for a pre-registered waiter entry
+            entry.value = value
+            entry.nbytes = _estimate_nbytes(value)
+            self._used += entry.nbytes
+            entry.ready.set()
+            callbacks = self._on_ready.pop(obj_id, [])
+        for cb in callbacks:
+            cb(obj_id)
+
+    def put_error(self, obj_id: ObjectID, error: BaseException) -> None:
+        with self._lock:
+            entry = self._entries.setdefault(obj_id, _Entry(ref_count=1))
+            if entry.ref_count == 0:
+                entry.ref_count = 1
+            entry.error = error
+            entry.ready.set()
+            callbacks = self._on_ready.pop(obj_id, [])
+        for cb in callbacks:
+            cb(obj_id)
+
+    def put_serialized(self, obj_id: ObjectID, payload: bytes, buffers: list) -> None:
+        with self._lock:
+            entry = self._entries.setdefault(obj_id, _Entry(ref_count=1))
+            if entry.ref_count == 0:
+                entry.ref_count = 1
+            entry.serialized = (payload, buffers)
+            entry.nbytes = len(payload) + sum(getattr(b, "nbytes", len(b)) for b in buffers)
+            self._used += entry.nbytes
+            entry.ready.set()
+            callbacks = self._on_ready.pop(obj_id, [])
+        for cb in callbacks:
+            cb(obj_id)
+
+    # -- read paths ----------------------------------------------------------
+
+    def contains(self, obj_id: ObjectID) -> bool:
+        with self._lock:
+            e = self._entries.get(obj_id)
+            return e is not None and e.ready.is_set()
+
+    def get(self, obj_id: ObjectID, timeout: Optional[float] = None) -> Any:
+        with self._lock:
+            entry = self._entries.get(obj_id)
+            if entry is None:
+                # object not produced yet (pending task return): wait for it
+                entry = _Entry(ref_count=0)
+                entry.ready.clear()
+                self._entries[obj_id] = entry
+        if not entry.ready.wait(timeout):
+            raise GetTimeoutError(f"timed out waiting for {obj_id}")
+        if entry.error is not None:
+            raise entry.error
+        if entry.value is None and entry.serialized is not None:
+            payload, buffers = entry.serialized
+            entry.value = deserialize(payload, buffers)
+        return entry.value
+
+    def wait_async(self, obj_id: ObjectID, callback: Callable[[ObjectID], None]) -> None:
+        """Invoke callback when the object is ready (immediately if already)."""
+        with self._lock:
+            entry = self._entries.get(obj_id)
+            if entry is None or not entry.ready.is_set():
+                self._on_ready.setdefault(obj_id, []).append(callback)
+                if entry is None:
+                    self._entries[obj_id] = _Entry(ref_count=0)
+                    self._entries[obj_id].ready.clear()
+                return
+        callback(obj_id)
+
+    def cancel_wait(self, obj_id: ObjectID, callback: Callable[[ObjectID], None]) -> None:
+        """Deregister a wait_async callback (polling wait() must not leak)."""
+        with self._lock:
+            cbs = self._on_ready.get(obj_id)
+            if cbs is None:
+                return
+            try:
+                cbs.remove(callback)
+            except ValueError:
+                pass
+            if not cbs:
+                del self._on_ready[obj_id]
+
+    def serialized_get(self, obj_id: ObjectID, timeout: Optional[float] = None) -> tuple[bytes, list]:
+        value = self.get(obj_id, timeout)
+        with self._lock:
+            entry = self._entries[obj_id]
+            if entry.serialized is None:
+                entry.serialized = serialize(value)
+            return entry.serialized
+
+    # -- ref counting --------------------------------------------------------
+
+    def add_ref(self, obj_id: ObjectID, n: int = 1) -> None:
+        with self._lock:
+            entry = self._entries.get(obj_id)
+            if entry is not None:
+                entry.ref_count += n
+
+    def remove_ref(self, obj_id: ObjectID, n: int = 1) -> None:
+        with self._lock:
+            entry = self._entries.get(obj_id)
+            if entry is None:
+                return
+            entry.ref_count -= n
+            if entry.ref_count <= 0 and entry.ready.is_set():
+                self._used -= entry.nbytes
+                del self._entries[obj_id]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "num_objects": len(self._entries),
+                "used_bytes": self._used,
+                "capacity_bytes": self._capacity,
+            }
+
+
+def _estimate_nbytes(value: Any) -> int:
+    if isinstance(value, np.ndarray):
+        return value.nbytes
+    if isinstance(value, (bytes, bytearray)):
+        return len(value)
+    try:  # jax arrays, without assuming jax is importable here
+        import jax
+
+        if isinstance(value, jax.Array):
+            return value.nbytes
+    except Exception:
+        pass
+    return 64  # nominal for small python objects

@@ -1,0 +1,202 @@
+"""Process worker pool: crash-isolated task execution.
+
+Analog of the reference WorkerPool (src/ray/raylet/worker_pool.h:125):
+persistent worker processes leased per task, cached between tasks. Used
+only for `worker_mode="process"` tasks — the TPU-idiomatic default is
+thread execution inside the host's single JAX process (see scheduler.py).
+Worker death surfaces as WorkerCrashedError so the scheduler can retry
+(the reference's max_retries path, src/ray/core_worker/task_manager.h:260).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import pickle
+import threading
+import traceback
+from typing import TYPE_CHECKING, Optional
+
+import cloudpickle
+
+from ray_tpu.core import errors
+from ray_tpu.core.task import TaskSpec
+from ray_tpu.utils.logging import get_logger
+
+if TYPE_CHECKING:
+    from ray_tpu.core.runtime import Runtime
+
+logger = get_logger("ray_tpu.process_pool")
+
+_CTX = mp.get_context("fork")  # cheap startup; workers never touch the TPU
+
+
+class _ValueUnpickler(pickle.Unpickler):
+    """Child side: persistent ids carry already-resolved object values."""
+
+    def persistent_load(self, pid):
+        kind, value = pid
+        if kind == "resolved":
+            return value
+        raise pickle.UnpicklingError(f"unknown persistent id {kind!r}")
+
+
+def _loads_with_values(data: bytes):
+    import io
+
+    return _ValueUnpickler(io.BytesIO(data)).load()
+
+
+def _dumps_resolving_refs(obj, runtime) -> bytes:
+    """Parent side: replace ObjectRefs nested anywhere in the args with
+    their resolved values (the child has its own empty runtime — a pickled
+    ref would rebuild against the wrong store and hang forever)."""
+    import io
+
+    from ray_tpu.core.ref import ObjectRef
+
+    buf = io.BytesIO()
+
+    class _P(cloudpickle.CloudPickler):
+        def persistent_id(self, o):
+            if isinstance(o, ObjectRef):
+                return ("resolved", runtime.object_store.get(o.id))
+            # ActorHandles cannot cross the process boundary (the actor
+            # lives in the host process); fail loudly, not with a hang.
+            from ray_tpu.core.api import ActorHandle
+
+            if isinstance(o, ActorHandle):
+                raise TypeError(
+                    "ActorHandle cannot be passed to a process-mode task: "
+                    "actors live in the host process (use worker_mode='thread' "
+                    "tasks to interact with actors)"
+                )
+            return None
+
+    _P(buf, protocol=5).dump(obj)
+    return buf.getvalue()
+
+
+def _worker_main(conn) -> None:
+    while True:
+        try:
+            msg = conn.recv_bytes()
+        except (EOFError, OSError):
+            return
+        try:
+            func, args, kwargs = _loads_with_values(msg)
+            result = func(*args, **kwargs)
+            payload = cloudpickle.dumps(("ok", result))
+        except BaseException as e:  # noqa: BLE001
+            payload = cloudpickle.dumps(("err", (e, traceback.format_exc())))
+        try:
+            conn.send_bytes(payload)
+        except (BrokenPipeError, OSError):
+            return
+
+
+class _Worker:
+    def __init__(self):
+        self.parent_conn, child_conn = _CTX.Pipe()
+        self.proc = _CTX.Process(target=_worker_main, args=(child_conn,), daemon=True)
+        self.proc.start()
+        child_conn.close()
+
+    @property
+    def pid(self) -> int:
+        return self.proc.pid
+
+    def alive(self) -> bool:
+        return self.proc.is_alive()
+
+    def kill(self) -> None:
+        try:
+            self.proc.kill()
+        except Exception:
+            pass
+
+
+class ProcessPool:
+    def __init__(self, max_workers: int = 8):
+        self._free: list[_Worker] = []
+        self._lock = threading.Lock()
+        self._max = max_workers
+        self._count = 0
+        self._running: dict[bytes, _Worker] = {}  # task_id bytes -> worker
+
+    def run(self, spec: TaskSpec):
+        """Execute the task in a leased worker; blocks until done."""
+        from ray_tpu.core.runtime import get_runtime
+        from ray_tpu.core.scheduler import resolve_args
+
+        runtime = get_runtime()
+        args, kwargs = resolve_args(runtime, spec.args, spec.kwargs)
+        payload_out = _dumps_resolving_refs((spec.func, args, kwargs), runtime)
+        worker = self._lease()
+        tid = spec.task_id.binary()
+        self._running[tid] = worker
+        try:
+            try:
+                worker.parent_conn.send_bytes(payload_out)
+                payload = worker.parent_conn.recv_bytes()
+            except (EOFError, BrokenPipeError, OSError):
+                raise errors.WorkerCrashedError(
+                    f"worker pid={worker.pid} died executing {spec.describe()}"
+                ) from None
+            status, value = pickle.loads(payload)
+            if status == "err":
+                exc, tb = value
+                raise errors.TaskError(exc, tb, spec.describe())
+            self._release(worker)
+            return value
+        except errors.WorkerCrashedError:
+            # never re-pool after a crash signal, even if is_alive() races
+            self._discard(worker)
+            raise
+        except errors.RayTpuError:
+            if not worker.alive():
+                self._discard(worker)
+            else:
+                self._release(worker)
+            raise
+        finally:
+            self._running.pop(tid, None)
+
+    def kill_worker_for(self, task_id_bytes: bytes) -> bool:
+        """Fault injection: kill the worker running the given task."""
+        worker = self._running.get(task_id_bytes)
+        if worker is None:
+            return False
+        worker.kill()
+        return True
+
+    def _lease(self) -> _Worker:
+        with self._lock:
+            while self._free:
+                w = self._free.pop()
+                if w.alive():
+                    return w
+                self._discard_locked(w)
+            self._count += 1
+            return _Worker()
+
+    def _release(self, worker: _Worker) -> None:
+        with self._lock:
+            if worker.alive() and len(self._free) < self._max:
+                self._free.append(worker)
+            else:
+                self._discard_locked(worker)
+
+    def _discard(self, worker: _Worker) -> None:
+        with self._lock:
+            self._discard_locked(worker)
+
+    def _discard_locked(self, worker: _Worker) -> None:
+        self._count -= 1
+        worker.kill()
+
+    def shutdown(self) -> None:
+        with self._lock:
+            for w in self._free:
+                w.kill()
+            self._free.clear()

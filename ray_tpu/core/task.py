@@ -1,0 +1,80 @@
+"""Task specification (analog of reference TaskSpecification,
+src/ray/common/task/task_spec.h, much slimmed: no protobuf on the
+single-host fast path; specs cross process/node boundaries as msgpack/
+cloudpickle only when they must)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+from ray_tpu.core.resources import ResourceSet
+from ray_tpu.utils.ids import ActorID, ObjectID, PlacementGroupID, TaskID
+
+
+@dataclasses.dataclass
+class TaskOptions:
+    num_cpus: float = 1.0
+    num_tpus: float = 0.0
+    resources: dict = dataclasses.field(default_factory=dict)
+    num_returns: int | str = 1  # int or "streaming"
+    max_retries: int = 3
+    retry_exceptions: bool = False
+    name: Optional[str] = None
+    placement_group: Optional[Any] = None  # PlacementGroup
+    placement_group_bundle_index: int = -1
+    scheduling_strategy: Optional[Any] = None
+
+    def resource_set(self) -> ResourceSet:
+        req = dict(self.resources)
+        if self.num_cpus:
+            req["CPU"] = req.get("CPU", 0) + self.num_cpus
+        if self.num_tpus:
+            req["TPU"] = req.get("TPU", 0) + self.num_tpus
+        return ResourceSet(req)
+
+
+@dataclasses.dataclass
+class ActorOptions:
+    num_cpus: float = 1.0
+    num_tpus: float = 0.0
+    resources: dict = dataclasses.field(default_factory=dict)
+    name: Optional[str] = None
+    get_if_exists: bool = False
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    lifetime: Optional[str] = None  # None | "detached"
+    placement_group: Optional[Any] = None
+    placement_group_bundle_index: int = -1
+    scheduling_strategy: Optional[Any] = None
+
+    def resource_set(self) -> ResourceSet:
+        req = dict(self.resources)
+        if self.num_cpus:
+            req["CPU"] = req.get("CPU", 0) + self.num_cpus
+        if self.num_tpus:
+            req["TPU"] = req.get("TPU", 0) + self.num_tpus
+        return ResourceSet(req)
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    task_id: TaskID
+    func: Callable  # already bound/unpickled in-process
+    args: tuple
+    kwargs: dict
+    options: TaskOptions
+    return_ids: list[ObjectID] = dataclasses.field(default_factory=list)
+    # actor tasks
+    actor_id: Optional[ActorID] = None
+    method_name: Optional[str] = None
+    # bookkeeping
+    attempt: int = 0
+    streaming: bool = False
+
+    def describe(self) -> str:
+        name = self.options.name or getattr(self.func, "__name__", "task")
+        if self.method_name:
+            name = f"{name}.{self.method_name}"
+        return f"{name}[{self.task_id.hex()[:8]}]"

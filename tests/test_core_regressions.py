@@ -1,0 +1,141 @@
+"""Regression tests for review findings on the core runtime."""
+
+import asyncio
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import runtime as rt
+
+
+@pytest.fixture
+def ray_start():
+    if rt.is_initialized():
+        rt.shutdown_runtime()
+    ray_tpu.init(num_cpus=4)
+    yield
+    rt.shutdown_runtime()
+
+
+def test_actor_streaming_method(ray_start):
+    @ray_tpu.remote
+    class Gen:
+        def produce(self, n):
+            for i in range(n):
+                yield i * 2
+
+    g = Gen.remote()
+    out = [ray_tpu.get(r) for r in g.produce.options(num_returns="streaming").remote(4)]
+    assert out == [0, 2, 4, 6]
+
+
+def test_async_actor_streaming_method(ray_start):
+    @ray_tpu.remote
+    class AGen:
+        async def produce(self, n):
+            for i in range(n):
+                await asyncio.sleep(0.01)
+                yield i
+
+    g = AGen.remote()
+    out = [ray_tpu.get(r) for r in g.produce.options(num_returns="streaming").remote(3)]
+    assert out == [0, 1, 2]
+
+
+def test_named_collision_does_not_leak_resources(ray_start):
+    @ray_tpu.remote(num_cpus=2)
+    class Svc:
+        def ping(self):
+            return "pong"
+
+    s = Svc.options(name="svc").remote()
+    before = ray_tpu.available_resources().get("CPU", 0)
+    with pytest.raises(ValueError):
+        Svc.options(name="svc").remote()
+    assert ray_tpu.available_resources().get("CPU", 0) == before
+    assert ray_tpu.get(s.ping.remote()) == "pong"
+
+
+def test_streaming_failure_is_visible(ray_start):
+    pg = ray_tpu.placement_group([{"CPU": 1}])
+    assert pg.ready(timeout=5)
+    ray_tpu.remove_placement_group(pg)
+    time.sleep(0.2)
+
+    @ray_tpu.remote(num_returns="streaming")
+    def gen():
+        yield 1
+
+    strategy = ray_tpu.PlacementGroupSchedulingStrategy(pg, 0)
+    stream = gen.options(scheduling_strategy=strategy).remote()
+    refs = list(stream)
+    assert refs, "failed stream must yield an error ref, not terminate clean"
+    with pytest.raises(Exception):
+        ray_tpu.get(refs[0])
+
+
+def test_kill_async_actor_mid_flight(ray_start):
+    @ray_tpu.remote
+    class Slow:
+        async def slow(self):
+            await asyncio.sleep(5)
+            return 1
+
+    s = Slow.remote()
+    ref = s.slow.remote()
+    time.sleep(0.2)
+    ray_tpu.kill(s)
+    with pytest.raises(ray_tpu.ActorDiedError):
+        ray_tpu.get(ref, timeout=10)
+
+
+def test_nested_refs_in_process_mode():
+    if rt.is_initialized():
+        rt.shutdown_runtime()
+    ray_tpu.init(num_cpus=2, worker_mode="process")
+    try:
+        inner = ray_tpu.put({"x": 41})
+
+        @ray_tpu.remote
+        def f(payload):
+            return payload["ref"]["x"] + 1
+
+        assert ray_tpu.get(f.remote({"ref": inner}), timeout=20) == 42
+    finally:
+        rt.shutdown_runtime()
+
+
+def test_pg_remove_waits_for_inflight(ray_start):
+    pg = ray_tpu.placement_group([{"CPU": 2}])
+    assert pg.ready(timeout=5)
+
+    @ray_tpu.remote(num_cpus=2)
+    def busy():
+        time.sleep(1.0)
+        return 1
+
+    strategy = ray_tpu.PlacementGroupSchedulingStrategy(pg, 0)
+    ref = busy.options(scheduling_strategy=strategy).remote()
+    time.sleep(0.2)
+    ray_tpu.remove_placement_group(pg)
+    # node capacity must NOT be released while the bundle task runs
+    assert ray_tpu.available_resources().get("CPU", 0) == 2
+    assert ray_tpu.get(ref, timeout=10) == 1
+    time.sleep(0.3)
+    assert ray_tpu.available_resources().get("CPU", 0) == 4
+
+
+def test_wait_polling_does_not_leak_callbacks(ray_start):
+    @ray_tpu.remote
+    def slow():
+        time.sleep(1.5)
+        return 1
+
+    ref = slow.remote()
+    runtime = rt.get_runtime()
+    for _ in range(20):
+        ray_tpu.wait([ref], num_returns=1, timeout=0.02)
+    pending_cbs = sum(len(v) for v in runtime.object_store._on_ready.values())
+    assert pending_cbs <= 1, f"leaked {pending_cbs} wait callbacks"
+    assert ray_tpu.get(ref, timeout=10) == 1

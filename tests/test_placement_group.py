@@ -1,0 +1,84 @@
+"""Placement group tests (modeled on reference
+python/ray/tests/test_placement_group*.py)."""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import runtime as rt
+from ray_tpu.core.errors import PlacementGroupUnavailableError
+
+
+@pytest.fixture
+def ray_start():
+    if rt.is_initialized():
+        rt.shutdown_runtime()
+    ray_tpu.init(num_cpus=8, resources={"TPU": 4})
+    yield
+    rt.shutdown_runtime()
+
+
+def test_pack_reserves_resources(ray_start):
+    pg = ray_tpu.placement_group([{"CPU": 2}, {"CPU": 2}], strategy="PACK")
+    assert pg.ready(timeout=5)
+    assert ray_tpu.available_resources()["CPU"] == 4
+    ray_tpu.remove_placement_group(pg)
+    assert ray_tpu.available_resources()["CPU"] == 8
+
+
+def test_task_in_bundle(ray_start):
+    pg = ray_tpu.placement_group([{"CPU": 2, "TPU": 2}], strategy="STRICT_PACK")
+    assert pg.ready(timeout=5)
+
+    @ray_tpu.remote(num_cpus=1, num_tpus=1)
+    def on_slice():
+        return "ran"
+
+    strategy = ray_tpu.PlacementGroupSchedulingStrategy(pg, placement_group_bundle_index=0)
+    ref = on_slice.options(scheduling_strategy=strategy).remote()
+    assert ray_tpu.get(ref, timeout=10) == "ran"
+
+
+def test_bundle_capacity_limits(ray_start):
+    pg = ray_tpu.placement_group([{"CPU": 1}], strategy="PACK")
+    assert pg.ready(timeout=5)
+
+    @ray_tpu.remote(num_cpus=2)
+    def too_big():
+        return 1
+
+    strategy = ray_tpu.PlacementGroupSchedulingStrategy(pg, placement_group_bundle_index=0)
+    ref = too_big.options(scheduling_strategy=strategy).remote()
+    # 2 CPUs can never fit in a 1-CPU bundle; task stays pending
+    ready, not_ready = ray_tpu.wait([ref], num_returns=1, timeout=1)
+    assert not_ready == [ref]
+
+
+def test_infeasible_strict_pack(ray_start):
+    pg = ray_tpu.placement_group([{"CPU": 100}], strategy="STRICT_PACK")
+    with pytest.raises(PlacementGroupUnavailableError):
+        pg.ready(timeout=1)
+
+
+def test_strict_spread_single_node_infeasible(ray_start):
+    pg = ray_tpu.placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    with pytest.raises(PlacementGroupUnavailableError):
+        pg.ready(timeout=1)
+
+
+def test_actor_in_placement_group(ray_start):
+    pg = ray_tpu.placement_group([{"CPU": 4}], strategy="PACK")
+    assert pg.ready(timeout=5)
+
+    @ray_tpu.remote(num_cpus=4)
+    class Gang:
+        def rank(self):
+            return 0
+
+    g = Gang.options(
+        scheduling_strategy=ray_tpu.PlacementGroupSchedulingStrategy(pg, 0)
+    ).remote()
+    assert ray_tpu.get(g.rank.remote()) == 0
+    # node-level CPUs were not double-charged: 8 total - 4 reserved = 4
+    assert ray_tpu.available_resources()["CPU"] == 4

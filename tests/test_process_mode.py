@@ -1,0 +1,76 @@
+"""Process-worker execution: crash isolation, retries, fault injection
+(modeled on the reference's worker-failure tests,
+python/ray/tests/test_failure*.py)."""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu.core import runtime as rt
+
+
+@pytest.fixture
+def ray_proc():
+    if rt.is_initialized():
+        rt.shutdown_runtime()
+    ray_tpu.init(num_cpus=4, worker_mode="process")
+    yield
+    rt.shutdown_runtime()
+
+
+def _square(x):
+    return x * x
+
+
+def test_process_task_basic(ray_proc):
+    f = ray_tpu.remote(_square)
+    assert ray_tpu.get(f.remote(7)) == 49
+
+
+def test_process_task_exception(ray_proc):
+    @ray_tpu.remote
+    def boom():
+        raise KeyError("nope")
+
+    with pytest.raises(ray_tpu.TaskError) as ei:
+        ray_tpu.get(boom.remote())
+    assert isinstance(ei.value.cause, KeyError)
+
+
+def test_worker_crash_retries_then_succeeds(ray_proc, tmp_path):
+    marker = tmp_path / "attempts"
+
+    @ray_tpu.remote(max_retries=2)
+    def flaky():
+        # Crash the whole worker process on the first two attempts.
+        n = int(marker.read_text()) if marker.exists() else 0
+        marker.write_text(str(n + 1))
+        if n < 2:
+            os._exit(9)
+        return "survived"
+
+    assert ray_tpu.get(flaky.remote(), timeout=30) == "survived"
+
+
+def test_worker_crash_exhausts_retries(ray_proc):
+    @ray_tpu.remote(max_retries=1)
+    def die():
+        os._exit(9)
+
+    with pytest.raises(ray_tpu.WorkerCrashedError):
+        ray_tpu.get(die.remote(), timeout=30)
+
+
+def test_process_isolation(ray_proc):
+    # state mutated in a worker process must not leak into the driver
+    leak = {"seen": False}
+
+    @ray_tpu.remote
+    def mutate():
+        leak["seen"] = True
+        return leak["seen"]
+
+    assert ray_tpu.get(mutate.remote()) is True
+    assert leak["seen"] is False
