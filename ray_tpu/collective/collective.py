@@ -1,0 +1,273 @@
+"""Collective communication API.
+
+Mirrors the reference's gang-collective surface
+(python/ray/util/collective/collective.py: init_collective_group:123,
+create_collective_group:160, allreduce:268, broadcast:383,
+allgather:433, reducescatter:482, send:541, recv:604) — but where the
+reference wraps NCCL/Gloo communicators, the TPU-native story is
+two-tier:
+
+  * device tier: collectives are NOT a runtime API — they are XLA ops
+    (`jax.lax.psum/all_gather/...`) emitted from jitted SPMD programs
+    over a Mesh. `mesh_for_group` hands a group its Mesh; that is the
+    whole "communicator".
+  * host tier (this module's executable path): control-plane arrays
+    (metrics, rendezvous payloads, RL weights) move through an
+    in-process rendezvous over the gang's ranks — the Gloo-equivalent
+    for thread workers on one host, and the seam where the DCN
+    transport plugs in for multi-host.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+
+class ReduceOp(enum.Enum):
+    SUM = "sum"
+    PRODUCT = "product"
+    MIN = "min"
+    MAX = "max"
+    MEAN = "mean"
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda vals: _tree_reduce(np.add, vals),
+    ReduceOp.PRODUCT: lambda vals: _tree_reduce(np.multiply, vals),
+    ReduceOp.MIN: lambda vals: _tree_reduce(np.minimum, vals),
+    ReduceOp.MAX: lambda vals: _tree_reduce(np.maximum, vals),
+    ReduceOp.MEAN: lambda vals: _tree_reduce(np.add, vals) / len(vals),
+}
+
+
+def _tree_reduce(op, vals):
+    out = vals[0]
+    for v in vals[1:]:
+        out = op(out, v)
+    return out
+
+
+class _HostGroup:
+    """Rank-rendezvous collective group for ranks running as threads of one
+    host process. Every rank must issue collectives in the same order
+    (standard collective contract)."""
+
+    def __init__(self, name: str, world_size: int):
+        self.name = name
+        self.world_size = world_size
+        self._cv = threading.Condition()
+        self._rounds: dict[int, dict] = {}  # round -> {values, result, reads}
+        self._rank_round: dict[int, int] = {}
+        self._p2p: dict[tuple, Any] = {}  # (src, dst, seq) -> value
+        self._p2p_seq: dict[tuple, int] = {}
+
+    def _next_round(self, rank: int) -> int:
+        r = self._rank_round.get(rank, 0)
+        self._rank_round[rank] = r + 1
+        return r
+
+    def rendezvous(self, rank: int, value: Any, compute, timeout: float = 120.0):
+        """Deposit value; when all ranks arrive, compute(list_by_rank) once;
+        everyone returns its output."""
+        with self._cv:
+            rnd = self._next_round(rank)
+            slot = self._rounds.setdefault(rnd, {"values": {}, "result": None, "done": False, "reads": 0})
+            slot["values"][rank] = value
+            if len(slot["values"]) == self.world_size:
+                ordered = [slot["values"][r] for r in range(self.world_size)]
+                slot["result"] = compute(ordered)
+                slot["done"] = True
+                self._cv.notify_all()
+            else:
+                ok = self._cv.wait_for(lambda: slot["done"], timeout)
+                if not ok:
+                    raise TimeoutError(
+                        f"collective group {self.name!r} round {rnd}: only "
+                        f"{len(slot['values'])}/{self.world_size} ranks arrived"
+                    )
+            result = slot["result"]
+            slot["reads"] += 1
+            if slot["reads"] == self.world_size:
+                del self._rounds[rnd]
+            return result
+
+    # p2p ---------------------------------------------------------------
+
+    def send(self, src: int, dst: int, value: Any, timeout: float = 120.0) -> None:
+        with self._cv:
+            seq = self._p2p_seq.get((src, dst, "s"), 0)
+            self._p2p_seq[(src, dst, "s")] = seq + 1
+            self._p2p[(src, dst, seq)] = value
+            self._cv.notify_all()
+
+    def recv(self, src: int, dst: int, timeout: float = 120.0) -> Any:
+        with self._cv:
+            seq = self._p2p_seq.get((src, dst, "r"), 0)
+            self._p2p_seq[(src, dst, "r")] = seq + 1
+            ok = self._cv.wait_for(lambda: (src, dst, seq) in self._p2p, timeout)
+            if not ok:
+                raise TimeoutError(f"recv from rank {src} timed out")
+            return self._p2p.pop((src, dst, seq))
+
+
+_groups: dict[str, _HostGroup] = {}
+_declared: dict[str, dict] = {}
+_lock = threading.Lock()
+_local = threading.local()
+
+
+def init_collective_group(
+    world_size: int,
+    rank: int,
+    backend: str = "host",
+    group_name: str = "default",
+) -> None:
+    """Join (creating if first) a collective group. Called by every rank."""
+    if backend not in ("host", "ici"):
+        raise ValueError(f"unknown backend {backend!r}; 'host' or 'ici'")
+    if not 0 <= rank < world_size:
+        raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    with _lock:
+        group = _groups.get(group_name)
+        if group is None:
+            group = _HostGroup(group_name, world_size)
+            _groups[group_name] = group
+        elif group.world_size != world_size:
+            raise ValueError(
+                f"group {group_name!r} already exists with world_size "
+                f"{group.world_size} != {world_size}"
+            )
+    if not hasattr(_local, "ranks"):
+        _local.ranks = {}
+    # bind the rank to THIS group incarnation: after destroy/recreate, stale
+    # thread-locals from the old group must not leak into the new one
+    _local.ranks[group_name] = (group, rank)
+
+
+def create_collective_group(
+    actors: list,
+    world_size: int,
+    ranks: list[int],
+    backend: str = "host",
+    group_name: str = "default",
+) -> None:
+    """Declarative creation (reference collective.py:160): registers the
+    group, then runs the rank join ON each actor's executor thread (so the
+    actor's subsequent collective calls resolve their rank thread-locally).
+    Blocks until every member joined."""
+    from ray_tpu.core import api as _api
+
+    if len(actors) != len(ranks) or len(actors) != world_size:
+        raise ValueError("actors/ranks/world_size mismatch")
+    with _lock:
+        _declared[group_name] = {"world_size": world_size, "backend": backend}
+        if group_name not in _groups:
+            _groups[group_name] = _HostGroup(group_name, world_size)
+    refs = [
+        actor._invoke(
+            "__ray_tpu_collective_init__",
+            (world_size, rank, backend, group_name),
+            {},
+        )
+        for actor, rank in zip(actors, ranks)
+    ]
+    _api.get(refs, timeout=60)
+
+
+def destroy_collective_group(group_name: str = "default") -> None:
+    with _lock:
+        _groups.pop(group_name, None)
+        _declared.pop(group_name, None)
+    if hasattr(_local, "ranks"):
+        _local.ranks.pop(group_name, None)
+
+
+def _group_and_rank(group_name: str, rank: Optional[int]) -> tuple[_HostGroup, int]:
+    with _lock:
+        group = _groups.get(group_name)
+    if group is None:
+        raise RuntimeError(
+            f"collective group {group_name!r} not initialized; call "
+            f"init_collective_group first"
+        )
+    if rank is None:
+        bound = getattr(_local, "ranks", {}).get(group_name)
+        if bound is not None and bound[0] is group:
+            rank = bound[1]
+        else:
+            raise RuntimeError(
+                f"calling thread has no rank in group {group_name!r}; pass rank= "
+                f"or call init_collective_group on this thread"
+            )
+    return group, rank
+
+
+def get_rank(group_name: str = "default") -> int:
+    _, rank = _group_and_rank(group_name, None)
+    return rank
+
+
+def get_collective_group_size(group_name: str = "default") -> int:
+    group, _ = _group_and_rank(group_name, 0)
+    return group.world_size
+
+
+# -- collectives -------------------------------------------------------------
+
+
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM, rank: Optional[int] = None):
+    group, rank = _group_and_rank(group_name, rank)
+    return group.rendezvous(rank, np.asarray(tensor), _REDUCERS[op])
+
+
+def allgather(tensor, group_name: str = "default", rank: Optional[int] = None) -> list:
+    group, rank = _group_and_rank(group_name, rank)
+    return group.rendezvous(rank, np.asarray(tensor), lambda vals: list(vals))
+
+
+def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM, rank: Optional[int] = None):
+    group, rank = _group_and_rank(group_name, rank)
+    reduced = group.rendezvous(rank, np.asarray(tensor), _REDUCERS[op])
+    shards = np.array_split(reduced, group.world_size, axis=0)
+    return shards[rank]
+
+
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default", rank: Optional[int] = None):
+    group, rank = _group_and_rank(group_name, rank)
+    return group.rendezvous(rank, np.asarray(tensor), lambda vals: vals[src_rank])
+
+
+def barrier(group_name: str = "default", rank: Optional[int] = None) -> None:
+    group, rank = _group_and_rank(group_name, rank)
+    group.rendezvous(rank, None, lambda vals: None)
+
+
+def send(tensor, dst_rank: int, group_name: str = "default", rank: Optional[int] = None) -> None:
+    group, rank = _group_and_rank(group_name, rank)
+    group.send(rank, dst_rank, np.asarray(tensor))
+
+
+def recv(src_rank: int, group_name: str = "default", rank: Optional[int] = None):
+    group, rank = _group_and_rank(group_name, rank)
+    return group.recv(src_rank, rank)
+
+
+# -- device tier -------------------------------------------------------------
+
+
+def mesh_for_group(
+    spec=None,
+    devices=None,
+    group_name: str = "default",
+):
+    """The ICI-tier 'communicator': a jax Mesh over the gang's devices.
+    Collectives inside jitted programs over this mesh ARE the backend
+    (psum/all_gather/reduce_scatter/ppermute over ICI) — there is no
+    NCCL-style call surface to wrap."""
+    from ray_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(spec, devices=devices)

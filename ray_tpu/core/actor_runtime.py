@@ -176,10 +176,23 @@ class Actor:
 
     # -- execution -----------------------------------------------------------
 
+    def _framework_method(self, name: str):
+        """Framework-injected actor methods (run on the actor's own executor
+        thread so thread-local state lands in the right place)."""
+        if name == "__ray_tpu_collective_init__":
+            from ray_tpu.collective.collective import init_collective_group
+
+            return lambda world, rank, backend, group: init_collective_group(
+                world, rank, backend=backend, group_name=group
+            )
+        return None
+
     def _execute(self, spec: TaskSpec) -> None:
         try:
             args, kwargs = resolve_args(self.runtime, spec.args, spec.kwargs)
-            method = getattr(self.instance, spec.method_name)
+            method = self._framework_method(spec.method_name) or getattr(
+                self.instance, spec.method_name
+            )
             if spec.streaming:
                 from ray_tpu.core.scheduler import _execute_streaming
 
@@ -197,7 +210,9 @@ class Actor:
         async with sem:
             try:
                 args, kwargs = resolve_args(self.runtime, spec.args, spec.kwargs)
-                method = getattr(self.instance, spec.method_name)
+                method = self._framework_method(spec.method_name) or getattr(
+                    self.instance, spec.method_name
+                )
                 if spec.streaming:
                     await self._stream_async(spec, method, args, kwargs)
                     return

@@ -31,11 +31,17 @@ from ray_tpu.utils.ids import ObjectID
 
 @dataclass
 class _Entry:
+    """ref_count semantics: starts at 0 for placeholder entries (waiters,
+    tombstones); the producing put() adds the primary reference. A negative
+    count is a tombstone — the owner ObjectRef died before production, so
+    the value is dropped the moment it lands (fire-and-forget tasks must
+    not leak, reference analog: ReferenceCounter ownership release)."""
+
     value: Any = None
     serialized: Optional[tuple[bytes, list]] = None  # (payload, oob buffers)
     ready: threading.Event = field(default_factory=threading.Event)
     error: Optional[BaseException] = None
-    ref_count: int = 1
+    ref_count: int = 0
     nbytes: int = 0
 
 
@@ -65,38 +71,38 @@ class ObjectStore:
 
     def put(self, obj_id: ObjectID, value: Any) -> None:
         with self._lock:
-            entry = self._entries.setdefault(obj_id, _Entry(ref_count=1))
-            if entry.ref_count == 0:
-                entry.ref_count = 1  # primary ref for a pre-registered waiter entry
+            entry = self._entries.setdefault(obj_id, _Entry())
+            entry.ref_count += 1  # the producer's primary reference
             entry.value = value
             entry.nbytes = _estimate_nbytes(value)
             self._used += entry.nbytes
             entry.ready.set()
             callbacks = self._on_ready.pop(obj_id, [])
+            self._maybe_free_locked(obj_id, entry)
         for cb in callbacks:
             cb(obj_id)
 
     def put_error(self, obj_id: ObjectID, error: BaseException) -> None:
         with self._lock:
-            entry = self._entries.setdefault(obj_id, _Entry(ref_count=1))
-            if entry.ref_count == 0:
-                entry.ref_count = 1
+            entry = self._entries.setdefault(obj_id, _Entry())
+            entry.ref_count += 1
             entry.error = error
             entry.ready.set()
             callbacks = self._on_ready.pop(obj_id, [])
+            self._maybe_free_locked(obj_id, entry)
         for cb in callbacks:
             cb(obj_id)
 
     def put_serialized(self, obj_id: ObjectID, payload: bytes, buffers: list) -> None:
         with self._lock:
-            entry = self._entries.setdefault(obj_id, _Entry(ref_count=1))
-            if entry.ref_count == 0:
-                entry.ref_count = 1
+            entry = self._entries.setdefault(obj_id, _Entry())
+            entry.ref_count += 1
             entry.serialized = (payload, buffers)
             entry.nbytes = len(payload) + sum(getattr(b, "nbytes", len(b)) for b in buffers)
             self._used += entry.nbytes
             entry.ready.set()
             callbacks = self._on_ready.pop(obj_id, [])
+            self._maybe_free_locked(obj_id, entry)
         for cb in callbacks:
             cb(obj_id)
 
@@ -161,19 +167,25 @@ class ObjectStore:
 
     def add_ref(self, obj_id: ObjectID, n: int = 1) -> None:
         with self._lock:
-            entry = self._entries.get(obj_id)
-            if entry is not None:
-                entry.ref_count += n
+            entry = self._entries.setdefault(obj_id, _Entry())
+            entry.ref_count += n
 
     def remove_ref(self, obj_id: ObjectID, n: int = 1) -> None:
         with self._lock:
             entry = self._entries.get(obj_id)
             if entry is None:
+                # ref died before the object was produced: tombstone so the
+                # eventual put() frees the value immediately
+                tomb = _Entry(ref_count=-n)
+                self._entries[obj_id] = tomb
                 return
             entry.ref_count -= n
-            if entry.ref_count <= 0 and entry.ready.is_set():
-                self._used -= entry.nbytes
-                del self._entries[obj_id]
+            self._maybe_free_locked(obj_id, entry)
+
+    def _maybe_free_locked(self, obj_id: ObjectID, entry: _Entry) -> None:
+        if entry.ref_count <= 0 and entry.ready.is_set() and not self._on_ready.get(obj_id):
+            self._used -= entry.nbytes
+            self._entries.pop(obj_id, None)
 
     def stats(self) -> dict:
         with self._lock:

@@ -159,6 +159,10 @@ class ProcessPool:
             else:
                 self._release(worker)
             raise
+        except BaseException:
+            # e.g. parent-side unpickling failure: never abandon the lease
+            self._discard(worker)
+            raise
         finally:
             self._running.pop(tid, None)
 

@@ -95,9 +95,7 @@ class Runtime:
             remaining = None if deadline is None else max(0.0, deadline - time.monotonic())
             try:
                 out.append(self.object_store.get(ref.id, remaining))
-            except errors.RayTpuError:
-                raise
-            except TimeoutError:
+            except errors.GetTimeoutError:
                 raise errors.GetTimeoutError(
                     f"get() timed out after {timeout}s waiting for {ref}"
                 ) from None
@@ -111,6 +109,8 @@ class Runtime:
     ) -> tuple[list[ObjectRef], list[ObjectRef]]:
         if num_returns > len(refs):
             raise ValueError("num_returns exceeds number of refs")
+        if len({r.id for r in refs}) != len(refs):
+            raise ValueError("wait() got duplicate ObjectRefs")
         cv = threading.Condition()
         ready_ids: set[ObjectID] = set()
 

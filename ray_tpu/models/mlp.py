@@ -1,0 +1,62 @@
+"""Small MLP classifier (MNIST-class model for trainer tests/benchmarks;
+the reference's analogous role is the torch_fashion_mnist example family
+used by Train docs/tests)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.nn.layers import init_dense
+
+
+@dataclasses.dataclass(frozen=True)
+class MlpConfig:
+    in_dim: int = 784
+    hidden: int = 256
+    n_layers: int = 2
+    n_classes: int = 10
+    dtype: Any = jnp.float32
+
+
+def logical_axes(config: MlpConfig) -> dict:
+    axes = {"out": ("embed", None)}
+    for i in range(config.n_layers):
+        axes[f"w{i}"] = ("embed", "mlp")
+        axes[f"b{i}"] = ("mlp",)
+    return axes
+
+
+def init_params(config: MlpConfig, key: jax.Array) -> dict:
+    params = {}
+    dim = config.in_dim
+    for i in range(config.n_layers):
+        key, sub = jax.random.split(key)
+        params[f"w{i}"] = init_dense(sub, (dim, config.hidden), config.dtype)
+        params[f"b{i}"] = jnp.zeros((config.hidden,), config.dtype)
+        dim = config.hidden
+    key, sub = jax.random.split(key)
+    params["out"] = init_dense(sub, (dim, config.n_classes), config.dtype)
+    return params
+
+
+def forward(params: dict, x: jax.Array, config: MlpConfig) -> jax.Array:
+    h = x
+    for i in range(config.n_layers):
+        h = jax.nn.relu(h @ params[f"w{i}"] + params[f"b{i}"])
+    return h @ params["out"]
+
+
+def loss_fn(params: dict, batch: dict, config: MlpConfig) -> jax.Array:
+    logits = forward(params, batch["x"], config)
+    labels = jax.nn.one_hot(batch["y"], config.n_classes)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+
+
+def accuracy(params: dict, batch: dict, config: MlpConfig) -> jax.Array:
+    logits = forward(params, batch["x"], config)
+    return jnp.mean((jnp.argmax(logits, -1) == batch["y"]).astype(jnp.float32))

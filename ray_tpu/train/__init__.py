@@ -1,0 +1,23 @@
+from ray_tpu.train import session
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager, restore_sharded, save_sharded
+from ray_tpu.train.config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.train.result import Result
+from ray_tpu.train.step import TrainState, init_sharded_params, make_train_step
+from ray_tpu.train.trainer import JaxTrainer
+
+__all__ = [
+    "Checkpoint",
+    "CheckpointConfig",
+    "CheckpointManager",
+    "FailureConfig",
+    "JaxTrainer",
+    "Result",
+    "RunConfig",
+    "ScalingConfig",
+    "TrainState",
+    "init_sharded_params",
+    "make_train_step",
+    "restore_sharded",
+    "save_sharded",
+    "session",
+]

@@ -1,0 +1,152 @@
+"""Checkpoints: directory handles + sharded-array save/restore.
+
+Analog of the reference ray.train.Checkpoint
+(python/ray/train/_checkpoint.py — a directory handle on storage) with
+the TPU-native twist promised in SURVEY.md §5.4: sharded jax arrays are
+written per-shard via orbax (async-capable), so a multi-host gang
+checkpoints without gathering to one host. Plain python state falls back
+to pickle in the same directory.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+import threading
+from typing import Any, Optional
+
+_ORBAX_SUBDIR = "sharded_state"
+_PICKLE_FILE = "state.pkl"
+
+
+class Checkpoint:
+    """A directory handle. Create with `from_directory`, read with
+    `to_directory` / `as_directory` (reference Checkpoint API surface)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    def to_directory(self, dest: Optional[str] = None) -> str:
+        if dest is None or os.path.abspath(dest) == self.path:
+            return self.path
+        shutil.copytree(self.path, dest, dirs_exist_ok=True)
+        return dest
+
+    def as_directory(self):
+        import contextlib
+
+        @contextlib.contextmanager
+        def cm():
+            yield self.path
+
+        return cm()
+
+    # -- typed helpers -------------------------------------------------------
+
+    @classmethod
+    def from_state(cls, state: Any, path: str, sharded: bool = False) -> "Checkpoint":
+        os.makedirs(path, exist_ok=True)
+        if sharded:
+            save_sharded(state, os.path.join(path, _ORBAX_SUBDIR))
+        else:
+            with open(os.path.join(path, _PICKLE_FILE), "wb") as f:
+                pickle.dump(state, f)
+        return cls(path)
+
+    def load_state(self, template: Any = None) -> Any:
+        orbax_dir = os.path.join(self.path, _ORBAX_SUBDIR)
+        if os.path.isdir(orbax_dir):
+            return restore_sharded(orbax_dir, template)
+        with open(os.path.join(self.path, _PICKLE_FILE), "rb") as f:
+            return pickle.load(f)
+
+    def __repr__(self):
+        return f"Checkpoint({self.path})"
+
+
+def save_sharded(state: Any, path: str, wait: bool = True):
+    """Write a pytree of (possibly sharded) jax arrays with orbax. Each host
+    writes only its shards; async unless wait=True."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    if os.path.exists(path):
+        shutil.rmtree(path)
+    ckptr = ocp.AsyncCheckpointer(ocp.StandardCheckpointHandler())
+    ckptr.save(path, args=ocp.args.StandardSave(state))
+    if wait:
+        ckptr.wait_until_finished()
+        ckptr.close()
+        return None
+    return ckptr  # caller must wait_until_finished()/close()
+
+
+def restore_sharded(path: str, template: Any = None) -> Any:
+    """Restore; with a template of jax.ShapeDtypeStructs carrying shardings,
+    arrays come back sharded onto the mesh without a host gather."""
+    import orbax.checkpoint as ocp
+
+    ckptr = ocp.Checkpointer(ocp.StandardCheckpointHandler())
+    try:
+        if template is not None:
+            return ckptr.restore(path, args=ocp.args.StandardRestore(template))
+        return ckptr.restore(path)
+    finally:
+        ckptr.close()
+
+
+class CheckpointManager:
+    """Retention policy over reported checkpoints (reference
+    CheckpointConfig.num_to_keep semantics)."""
+
+    def __init__(self, root: str, num_to_keep: Optional[int] = None,
+                 score_attribute: Optional[str] = None, score_order: str = "max"):
+        self.root = root
+        self.num_to_keep = num_to_keep
+        self.score_attribute = score_attribute
+        self.score_order = score_order
+        self._ckpts: list[tuple[float, int, Checkpoint]] = []
+        self._seq = 0
+        self._lock = threading.Lock()
+        os.makedirs(root, exist_ok=True)
+
+    def register(self, ckpt: Checkpoint, metrics: Optional[dict] = None) -> None:
+        with self._lock:
+            score = 0.0
+            if self.score_attribute and metrics:
+                score = float(metrics.get(self.score_attribute, 0.0))
+                if self.score_order == "min":
+                    score = -score
+            self._seq += 1
+            self._ckpts.append((score, self._seq, ckpt))
+            if self.num_to_keep is not None and len(self._ckpts) > self.num_to_keep:
+                # evict lowest score (or oldest) WITHOUT reordering the
+                # registration-ordered list — latest() must stay the most
+                # recent checkpoint, it drives failure-resume
+                if self.score_attribute:
+                    evicted = min(self._ckpts, key=lambda t: (t[0], t[1]))
+                    self._ckpts.remove(evicted)
+                else:
+                    evicted = self._ckpts.pop(0)
+                shutil.rmtree(evicted[2].path, ignore_errors=True)
+
+    def latest(self) -> Optional[Checkpoint]:
+        with self._lock:
+            return max(self._ckpts, key=lambda t: t[1])[2] if self._ckpts else None
+
+    def best(self) -> Optional[Checkpoint]:
+        with self._lock:
+            if not self._ckpts:
+                return None
+            return max(self._ckpts, key=lambda t: (t[0], t[1]))[2]
+
+    def new_checkpoint_dir(self) -> str:
+        with self._lock:
+            self._seq += 1
+            return os.path.join(self.root, f"checkpoint_{self._seq:06d}")

@@ -1,0 +1,60 @@
+"""Train-tier config dataclasses (analog of reference ray.air.config:
+ScalingConfig air/config.py:102, FailureConfig :397, CheckpointConfig
+:447, RunConfig :596)."""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How many workers and what each one owns.
+
+    TPU-first reading: `num_workers` is the number of HOST processes in the
+    gang (1 per TPU host); `chips_per_worker` pins that host's chips; the
+    in-host parallelism (all 4/8 chips) is expressed by the worker's mesh,
+    not by more workers.
+    """
+
+    num_workers: int = 1
+    use_tpu: bool = False
+    chips_per_worker: int = 0
+    resources_per_worker: dict = dataclasses.field(default_factory=dict)
+    placement_strategy: str = "PACK"
+    pod_type: Optional[str] = None  # e.g. "v5p-16": gang = the slice's hosts
+
+    def worker_resources(self) -> dict:
+        res = dict(self.resources_per_worker)
+        if self.use_tpu and self.chips_per_worker:
+            res["TPU"] = float(self.chips_per_worker)
+        res.setdefault("CPU", 1.0)
+        return res
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    max_failures: int = 0  # worker-group restarts allowed; -1 = unlimited
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    num_to_keep: Optional[int] = None  # None = keep all
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"
+
+
+@dataclasses.dataclass
+class RunConfig:
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+    checkpoint_config: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+
+    def resolved_storage_path(self) -> str:
+        base = self.storage_path or os.path.join(tempfile.gettempdir(), "ray_tpu_results")
+        name = self.name or "train_run"
+        return os.path.join(base, name)

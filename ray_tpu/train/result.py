@@ -1,0 +1,21 @@
+"""Training result (analog of reference python/ray/air/result.py)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+
+@dataclasses.dataclass
+class Result:
+    metrics: dict
+    checkpoint: Optional[Checkpoint]
+    path: str
+    error: Optional[BaseException] = None
+    metrics_history: list = dataclasses.field(default_factory=list)
+
+    @property
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        return self.checkpoint

@@ -1,0 +1,70 @@
+"""In-worker training session (analog of reference
+python/ray/train/_internal/session.py: report:405, get_context).
+
+Worker code calls `session.report(metrics, checkpoint=...)`; the
+controller consumes reports between polls. Thread-local so concurrent
+trainer workers in one host process don't collide.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+from typing import Any, Optional
+
+from ray_tpu.train.checkpoint import Checkpoint
+
+_local = threading.local()
+
+
+@dataclasses.dataclass
+class TrainContext:
+    world_rank: int
+    world_size: int
+    trial_dir: str
+    report_queue: Any  # queue.Queue shared with the controller
+    latest_checkpoint: Optional[Checkpoint] = None
+    group_name: str = "train"
+    stop_event: Optional[threading.Event] = None
+
+
+def _set_session(ctx: TrainContext) -> None:
+    _local.ctx = ctx
+
+
+def _clear_session() -> None:
+    _local.ctx = None
+
+
+def get_context() -> TrainContext:
+    ctx = getattr(_local, "ctx", None)
+    if ctx is None:
+        raise RuntimeError("not inside a train worker (no active session)")
+    return ctx
+
+
+def get_world_rank() -> int:
+    return get_context().world_rank
+
+
+def get_world_size() -> int:
+    return get_context().world_size
+
+
+def get_trial_dir() -> str:
+    return get_context().trial_dir
+
+
+def get_checkpoint() -> Optional[Checkpoint]:
+    """Checkpoint to resume from (set after a failure restart)."""
+    return get_context().latest_checkpoint
+
+
+def report(metrics: dict, checkpoint: Optional[Checkpoint] = None) -> None:
+    ctx = get_context()
+    ctx.report_queue.put(
+        {"rank": ctx.world_rank, "metrics": dict(metrics), "checkpoint": checkpoint}
+    )
+    if ctx.stop_event is not None and ctx.stop_event.is_set():
+        raise StopIteration("controller requested stop")

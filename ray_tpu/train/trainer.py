@@ -1,0 +1,220 @@
+"""JaxTrainer: gang-orchestrated SPMD training.
+
+The DataParallelTrainer equivalent (reference:
+python/ray/train/data_parallel_trainer.py:26 DataParallelTrainer →
+BackendExecutor _internal/backend_executor.py:69 → WorkerGroup
+_internal/worker_group.py:102), built in the Train-v2 controller style
+(train/v2/_internal/execution/controller/controller.py:91: a state
+machine polling the worker gang, consulting failure policy between
+iterations) — with the torch/NCCL bootstrap replaced by the TPU-native
+backend: each worker is one host of the gang; `backend_setup` runs
+jax.distributed-style bootstrap (on one host: nothing — the mesh IS the
+communicator), and in-loop collectives are XLA ops in the user's jitted
+step.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import traceback
+from typing import Any, Callable, Optional
+
+from ray_tpu.core import api, errors
+from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
+from ray_tpu.train.config import FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.train.result import Result
+from ray_tpu.train import session as session_mod
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.train")
+
+
+@api.remote
+class _TrainWorker:
+    """One gang member (1 per host). Runs the user loop under a session."""
+
+    def __init__(self, rank: int, world_size: int, trial_dir: str, report_queue, stop_event):
+        self.ctx = session_mod.TrainContext(
+            world_rank=rank,
+            world_size=world_size,
+            trial_dir=trial_dir,
+            report_queue=report_queue,
+            stop_event=stop_event,
+        )
+
+    def set_resume_checkpoint(self, ckpt) -> bool:
+        self.ctx.latest_checkpoint = ckpt
+        return True
+
+    def run(self, fn: Callable, config: dict) -> str:
+        session_mod._set_session(self.ctx)
+        try:
+            fn(config) if _wants_arg(fn) else fn()
+            return "done"
+        except StopIteration:
+            return "stopped"
+        finally:
+            session_mod._clear_session()
+
+
+def _wants_arg(fn: Callable) -> bool:
+    import inspect
+
+    try:
+        return len(inspect.signature(fn).parameters) > 0
+    except (TypeError, ValueError):
+        return True
+
+
+class JaxTrainer:
+    """Run `train_loop_per_worker` on a gang of workers.
+
+    Inside the loop, user code uses ray_tpu.train.session (report /
+    get_checkpoint / get_world_rank) and builds its mesh over the host's
+    devices (ray_tpu.parallel.make_mesh). For a pod slice, set
+    scaling_config.pod_type and the gang maps 1 worker per slice host via
+    the slice placement group.
+    """
+
+    def __init__(
+        self,
+        train_loop_per_worker: Callable,
+        *,
+        train_loop_config: Optional[dict] = None,
+        scaling_config: Optional[ScalingConfig] = None,
+        run_config: Optional[RunConfig] = None,
+        datasets: Optional[dict] = None,
+    ):
+        self._fn = train_loop_per_worker
+        self._config = train_loop_config or {}
+        self._scaling = scaling_config or ScalingConfig()
+        self._run_config = run_config or RunConfig()
+        self._datasets = datasets or {}
+
+    # -- controller ----------------------------------------------------------
+
+    def fit(self) -> Result:
+        trial_dir = self._run_config.resolved_storage_path()
+        ckpt_cfg = self._run_config.checkpoint_config
+        manager = CheckpointManager(
+            trial_dir,
+            ckpt_cfg.num_to_keep,
+            ckpt_cfg.checkpoint_score_attribute,
+            ckpt_cfg.checkpoint_score_order,
+        )
+        failure_cfg = self._run_config.failure_config
+        failures = 0
+        resume_ckpt: Optional[Checkpoint] = None
+        # metrics/history accumulate ACROSS attempts (a restart continues the
+        # same logical run, reference Train-v2 controller semantics)
+        history: list[dict] = []
+        last_metrics: dict = {}
+
+        while True:
+            try:
+                outcome, error = self._run_attempt(
+                    trial_dir, manager, resume_ckpt, history, last_metrics
+                )
+            except BaseException as e:  # noqa: BLE001 - setup failure (e.g. infeasible gang)
+                outcome, error = "failed", e
+            if outcome == "ok":
+                return Result(
+                    metrics=dict(last_metrics),
+                    checkpoint=manager.latest(),
+                    path=trial_dir,
+                    metrics_history=history,
+                )
+            failures += 1
+            if failure_cfg.max_failures >= 0 and failures > failure_cfg.max_failures:
+                return Result(
+                    metrics=dict(last_metrics),
+                    checkpoint=manager.latest(),
+                    path=trial_dir,
+                    error=error,
+                    metrics_history=history,
+                )
+            resume_ckpt = manager.latest()
+            logger.warning(
+                "train attempt failed (%s); restarting gang (failure %d/%s)",
+                error, failures, failure_cfg.max_failures,
+            )
+
+    def _run_attempt(self, trial_dir, manager, resume_ckpt, history, last_metrics):
+        n = self._scaling.num_workers
+        report_queue: queue.Queue = queue.Queue()
+        stop_event = threading.Event()
+
+        def drain():
+            try:
+                while True:
+                    rep = report_queue.get_nowait()
+                    if rep["rank"] == 0:
+                        history.append(rep["metrics"])
+                        last_metrics.clear()
+                        last_metrics.update(rep["metrics"])
+                        if rep["checkpoint"] is not None:
+                            manager.register(rep["checkpoint"], rep["metrics"])
+            except queue.Empty:
+                pass
+
+        pg = None
+        worker_opts: dict = {"num_cpus": 0}
+        if self._scaling.pod_type:
+            from ray_tpu.core.accelerators import parse_pod_type, slice_placement_group
+
+            topo = parse_pod_type(self._scaling.pod_type)
+            pg = slice_placement_group(self._scaling.pod_type)
+            if not pg.ready(timeout=120):
+                raise errors.PlacementGroupUnavailableError(
+                    f"slice {self._scaling.pod_type} unavailable"
+                )
+            n = topo.num_hosts
+        else:
+            res = self._scaling.worker_resources()
+            bundles = [dict(res) for _ in range(n)]
+            pg = api.placement_group(
+                bundles, strategy=self._scaling.placement_strategy, name="train-gang"
+            )
+            pg.ready(timeout=120)
+
+        workers = []
+        try:
+            for rank in range(n):
+                strategy = api.PlacementGroupSchedulingStrategy(pg, rank)
+                res = self._scaling.worker_resources()
+                workers.append(
+                    _TrainWorker.options(
+                        num_cpus=res.get("CPU", 1.0),
+                        num_tpus=res.get("TPU", 0.0),
+                        resources={k: v for k, v in res.items() if k not in ("CPU", "TPU")},
+                        scheduling_strategy=strategy,
+                    ).remote(rank, n, trial_dir, report_queue, stop_event)
+                )
+            if resume_ckpt is not None:
+                api.get([w.set_resume_checkpoint.remote(resume_ckpt) for w in workers])
+
+            run_refs = [w.run.remote(self._fn, self._config) for w in workers]
+
+            pending = set(run_refs)
+            while pending:
+                drain()
+                ready, _ = api.wait(list(pending), num_returns=1, timeout=0.1)
+                for ref in ready:
+                    pending.discard(ref)
+                    api.get(ref)  # raises on worker failure
+            drain()
+            return "ok", None
+        except BaseException as e:  # noqa: BLE001
+            stop_event.set()
+            drain()  # keep reports/checkpoints that landed before the failure
+            return "failed", e
+        finally:
+            for w in workers:
+                try:
+                    api.kill(w)
+                except Exception:
+                    pass
+            if pg is not None:
+                api.remove_placement_group(pg)
