@@ -88,4 +88,33 @@ def attention(
             q, k, v, causal=causal, segment_ids=segment_ids,
             q_offset=q_offset, softmax_scale=softmax_scale,
         )
+    if impl in ("ring", "ulysses"):
+        # Context-parallel paths: sequence sharded over the mesh `sp` axis
+        # (ray_tpu.ops.ring_attention). Mesh comes from the ambient
+        # parallel_context. A missing context is an error, not a silent
+        # fallback: the mesh is read at trace time and baked into the jit
+        # cache, so "sometimes sharded" would pin whichever variant traced
+        # first. (Enter parallel_context before tracing; sp == 1 meshes
+        # degrade to the XLA composite inside ring_attention itself.)
+        from ray_tpu.ops import ring_attention as ra
+        from ray_tpu.parallel.context import current_mesh
+
+        if not (isinstance(q_offset, int) and q_offset == 0):
+            raise ValueError(
+                f"attention(impl={impl!r}) is a full-sequence training path and "
+                "does not support q_offset (decode with a KV cache uses "
+                "impl='xla' or the paged kernel)"
+            )
+        mesh = current_mesh()
+        if mesh is None:
+            raise RuntimeError(
+                f"attention(impl={impl!r}) needs an ambient mesh: wrap the "
+                "call (before jit tracing) in "
+                "ray_tpu.parallel.context.parallel_context(mesh)"
+            )
+        fn = ra.ring_attention if impl == "ring" else ra.ulysses_attention
+        return fn(
+            q, k, v, mesh=mesh, causal=causal, segment_ids=segment_ids,
+            softmax_scale=softmax_scale,
+        )
     raise ValueError(f"unknown attention impl {impl!r}")

@@ -1,0 +1,242 @@
+"""Ring attention + Ulysses attention: context parallelism over the ICI ring.
+
+The reference has no native sequence/context parallelism (SURVEY.md §5.7 —
+long context is delegated to vLLM/torch inside workers). Here it is a
+first-class op: sequences shard over the mesh `sp` axis and attention runs
+
+  * **ring**: K/V blocks rotate around the `sp` axis with
+    `jax.lax.ppermute` while each device accumulates blockwise
+    softmax(QK^T)V online (flash-attention-style running max/sum, fp32
+    accumulators). One block of K/V is in flight per step, so the
+    `ppermute` rides ICI concurrently with the MXU matmuls of the
+    current block — compute/communication overlap falls out of XLA's
+    async collective scheduling rather than hand-written double
+    buffering.
+  * **ulysses**: `jax.lax.all_to_all` swaps the sharded axis from
+    sequence to heads, runs ordinary full attention locally, and swaps
+    back. Cheaper for moderate sequence lengths when n_heads % sp == 0.
+
+Both are SPMD-inner functions meant to run inside `jax.shard_map`; the
+`ring_attention` / `ulysses_attention` wrappers build the shard_map over
+the framework mesh (batch over (dp, fsdp), heads over tp, sequence over
+sp). Gradients flow through `ppermute`/`all_to_all` transposes, so the
+same code paths serve training.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ray_tpu.ops.attention import NEG_INF, _repeat_kv_heads, xla_attention
+
+
+def ring_attention_spmd(
+    q: jax.Array,  # [B, Sq_local, H, D]  (local sequence shard)
+    k: jax.Array,  # [B, Sk_local, K, D]
+    v: jax.Array,  # [B, Sk_local, K, D]
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    kv_segment_ids: Optional[jax.Array] = None,  # [B, Sk_local]
+    q_segment_ids: Optional[jax.Array] = None,  # [B, Sq_local]
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """Ring attention body. Call inside shard_map with seq sharded on axis_name.
+
+    Sequence is assumed contiguously sharded: device i holds global
+    positions [i*S_local, (i+1)*S_local). Causal masking is applied on
+    global positions, so the result equals full-sequence causal attention.
+    """
+    B, Sq, H, D = q.shape
+    Sk = k.shape[1]
+    group = _repeat_kv_heads(q, k)
+    Kh = k.shape[2]
+    scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(D)
+
+    n = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    # kv arrives from the next-higher rank each step: after t rotations the
+    # local buffer holds block (my + t) mod n.
+    perm = [(i, (i - 1) % n) for i in range(n)]
+
+    qg = (q * scale).reshape(B, Sq, Kh, group, D)
+    q_pos = my * Sq + jnp.arange(Sq)  # global positions of local queries
+
+    def compute_block(o, m, l, k_cur, v_cur, seg_cur, src):
+        # fp32 scores for this block: [B, Kh, G, Sq, Sk]
+        s = jnp.einsum(
+            "bqkgd,bskd->bkgqs", qg, k_cur, preferred_element_type=jnp.float32
+        )
+        k_pos = src * Sk + jnp.arange(Sk)
+        mask = jnp.ones((Sq, Sk), bool)
+        if causal:
+            mask = q_pos[:, None] >= k_pos[None, :]
+        mask = jnp.broadcast_to(mask[None, None, None], s.shape)
+        if seg_cur is not None:
+            seg = q_segment_ids[:, :, None] == seg_cur[:, None, :]  # [B, Sq, Sk]
+            mask = jnp.logical_and(mask, seg[:, None, None, :, :])
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # exp under explicit mask: a fully-masked block must contribute 0,
+        # not exp(NEG_INF - NEG_INF) = 1.
+        p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(axis=-1)
+        pv = jnp.einsum("bkgqs,bskd->bkgqd", p.astype(v_cur.dtype), v_cur)
+        o_new = o * corr[..., None] + pv.astype(jnp.float32)
+        return o_new, m_new, l_new
+
+    def body(carry, t):
+        o, m, l, k_cur, v_cur, seg_cur = carry
+        src = (my + t) % n
+        if causal:
+            # Blocks strictly in the future (src > my under contiguous
+            # sharding) are fully masked — skip their matmuls entirely.
+            # Average saving is ~2x attention FLOPs at large sp; the
+            # remaining rank imbalance (rank i computes i+1 blocks) is a
+            # known cost of contiguous sharding — zigzag/striped layouts
+            # would balance it at the price of position bookkeeping.
+            o, m, l = jax.lax.cond(
+                src > my,
+                lambda *_: (o, m, l),
+                compute_block,
+                o, m, l, k_cur, v_cur, seg_cur, src,
+            )
+        else:
+            o, m, l = compute_block(o, m, l, k_cur, v_cur, seg_cur, src)
+
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        seg_nxt = (
+            jax.lax.ppermute(seg_cur, axis_name, perm) if seg_cur is not None else None
+        )
+        return (o, m, l, k_nxt, v_nxt, seg_nxt), None
+
+    o0 = jnp.zeros((B, Kh, group, Sq, D), jnp.float32)
+    m0 = jnp.full((B, Kh, group, Sq), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, Kh, group, Sq), jnp.float32)
+    (o, _, l, _, _, _), _ = jax.lax.scan(
+        body, (o0, m0, l0, k, v, kv_segment_ids), jnp.arange(n)
+    )
+    o = o / jnp.where(l == 0.0, 1.0, l)[..., None]
+    # [B, Kh, G, Sq, D] -> [B, Sq, H, D]
+    return o.transpose(0, 3, 1, 2, 4).reshape(B, Sq, H, D).astype(q.dtype)
+
+
+def ulysses_attention_spmd(
+    q: jax.Array,  # [B, S_local, H, D]
+    k: jax.Array,  # [B, S_local, K, D]
+    v: jax.Array,
+    *,
+    axis_name: str = "sp",
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,  # [B, S_local]
+    softmax_scale: Optional[float] = None,
+) -> jax.Array:
+    """All-to-all head/sequence swap: full attention runs locally per head group."""
+    n = jax.lax.axis_size(axis_name)
+    H, Kh = q.shape[2], k.shape[2]
+    if H % n or Kh % n:
+        raise ValueError(f"ulysses needs heads ({H}/{Kh}) divisible by axis size {n}")
+    a2a = functools.partial(
+        jax.lax.all_to_all, axis_name=axis_name, split_axis=2, concat_axis=1, tiled=True
+    )
+    qf, kf, vf = a2a(q), a2a(k), a2a(v)  # [B, S_full, H/n, D]
+    seg_full = (
+        jax.lax.all_gather(segment_ids, axis_name, axis=1, tiled=True)
+        if segment_ids is not None
+        else None
+    )
+    o = xla_attention(
+        qf, kf, vf, causal=causal, segment_ids=seg_full, softmax_scale=softmax_scale
+    )
+    return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def _cp_shard_map(inner, mesh: Mesh, axis: str, batch_axes, heads_axis, has_seg):
+    qspec = P(batch_axes, axis, heads_axis, None)
+    seg_spec = P(batch_axes, axis)
+    in_specs = (qspec, qspec, qspec) + ((seg_spec,) if has_seg else ())
+    return jax.shard_map(
+        inner, mesh=mesh, in_specs=in_specs, out_specs=qspec, check_vma=False
+    )
+
+
+def ring_attention(
+    q: jax.Array,  # [B, S, H, D]  (global shapes; sharding via shard_map)
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    softmax_scale: Optional[float] = None,
+    batch_axes=("dp", "fsdp"),
+    heads_axis: str = "tp",
+) -> jax.Array:
+    """Context-parallel causal attention over mesh axis `axis` (default "sp")."""
+    if mesh.shape[axis] == 1:
+        return xla_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids, softmax_scale=softmax_scale
+        )
+
+    if segment_ids is None:
+        inner = functools.partial(
+            ring_attention_spmd, axis_name=axis, causal=causal,
+            softmax_scale=softmax_scale,
+        )
+        return _cp_shard_map(inner, mesh, axis, batch_axes, heads_axis, False)(q, k, v)
+
+    def inner(q, k, v, seg):
+        return ring_attention_spmd(
+            q, k, v, axis_name=axis, causal=causal, kv_segment_ids=seg,
+            q_segment_ids=seg, softmax_scale=softmax_scale,
+        )
+
+    return _cp_shard_map(inner, mesh, axis, batch_axes, heads_axis, True)(
+        q, k, v, segment_ids
+    )
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    mesh: Mesh,
+    axis: str = "sp",
+    causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    softmax_scale: Optional[float] = None,
+    batch_axes=("dp", "fsdp"),
+    heads_axis: str = "tp",
+) -> jax.Array:
+    if mesh.shape[axis] == 1:
+        return xla_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids, softmax_scale=softmax_scale
+        )
+
+    if segment_ids is None:
+        inner = functools.partial(
+            ulysses_attention_spmd, axis_name=axis, causal=causal,
+            softmax_scale=softmax_scale,
+        )
+        return _cp_shard_map(inner, mesh, axis, batch_axes, heads_axis, False)(q, k, v)
+
+    def inner(q, k, v, seg):
+        return ulysses_attention_spmd(
+            q, k, v, axis_name=axis, causal=causal, segment_ids=seg,
+            softmax_scale=softmax_scale,
+        )
+
+    return _cp_shard_map(inner, mesh, axis, batch_axes, heads_axis, True)(
+        q, k, v, segment_ids
+    )

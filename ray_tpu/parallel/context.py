@@ -1,0 +1,42 @@
+"""Ambient parallelism context: the active (mesh, rules) pair.
+
+Model code that needs mesh-aware ops (ring attention over the `sp` axis,
+expert all-to-all over `ep`) reads the ambient context instead of
+threading a Mesh through every function signature. Trainers enter it
+around their jitted step; tests enter it explicitly.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional
+
+from jax.sharding import Mesh
+
+from ray_tpu.parallel.sharding import ShardingRules, default_rules
+
+
+class _Ctx(threading.local):
+    def __init__(self):
+        self.stack: list[tuple[Mesh, ShardingRules]] = []
+
+
+_ctx = _Ctx()
+
+
+@contextlib.contextmanager
+def parallel_context(mesh: Mesh, rules: Optional[ShardingRules] = None):
+    _ctx.stack.append((mesh, rules if rules is not None else default_rules()))
+    try:
+        yield
+    finally:
+        _ctx.stack.pop()
+
+
+def current_mesh() -> Optional[Mesh]:
+    return _ctx.stack[-1][0] if _ctx.stack else None
+
+
+def current_rules() -> Optional[ShardingRules]:
+    return _ctx.stack[-1][1] if _ctx.stack else None

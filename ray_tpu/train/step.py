@@ -73,6 +73,17 @@ def make_train_step(
 
     def compute_grads(params, batch):
         """Returns (loss, weight, grads); weight=1 for scalar loss fns."""
+        from ray_tpu.parallel.context import parallel_context
+
+        if mesh is not None:
+            # Ambient (mesh, rules) so mesh-aware ops inside the model —
+            # ring attention on `sp`, expert all-to-all on `ep` — can build
+            # their shard_maps without signature plumbing.
+            with parallel_context(mesh, rules):
+                return _compute_grads_inner(params, batch)
+        return _compute_grads_inner(params, batch)
+
+    def _compute_grads_inner(params, batch):
         returns_weight = isinstance(
             jax.eval_shape(loss_fn, params, batch), (tuple, list)
         )
