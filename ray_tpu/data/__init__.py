@@ -1,0 +1,52 @@
+"""ray_tpu.data: streaming distributed datasets (reference: python/ray/data/).
+
+Lazy logical plans over columnar numpy blocks, executed by a pull-based
+streaming executor on the task/actor runtime, terminating in
+`iter_jax_batches` — prefetched, sharded device feeds for SPMD training.
+"""
+
+from ray_tpu.data.aggregate import AggregateFn, Count, Max, Mean, Min, Std, Sum
+from ray_tpu.data.block import Block, BlockMetadata
+from ray_tpu.data.dataset import (
+    ActorPoolStrategy,
+    Dataset,
+    from_items,
+    from_numpy,
+    from_pandas,
+    range,
+    read_binary_files,
+    read_csv,
+    read_datasource,
+    read_json,
+    read_parquet,
+    read_text,
+)
+from ray_tpu.data.datasource import Datasource, ReadTask
+from ray_tpu.data.iterator import DataIterator
+
+__all__ = [
+    "ActorPoolStrategy",
+    "AggregateFn",
+    "Block",
+    "BlockMetadata",
+    "Count",
+    "DataIterator",
+    "Dataset",
+    "Datasource",
+    "Max",
+    "Mean",
+    "Min",
+    "ReadTask",
+    "Std",
+    "Sum",
+    "from_items",
+    "from_numpy",
+    "from_pandas",
+    "range",
+    "read_binary_files",
+    "read_csv",
+    "read_datasource",
+    "read_json",
+    "read_parquet",
+    "read_text",
+]

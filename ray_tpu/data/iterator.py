@@ -1,0 +1,192 @@
+"""Batch iteration + device feed: the Dataset → TPU boundary.
+
+Reference: python/ray/data/iterator.py + stream_split_iterator.py. The
+TPU-first piece is `iter_jax_batches`: numpy batches are `jax.device_put`
+one step ahead of consumption (double-buffered host→HBM copies hide
+transfer latency behind the running step), optionally placed with a
+NamedSharding so each step's input is born sharded for the SPMD program.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterable, Iterator, Optional
+
+import numpy as np
+
+from ray_tpu.core import api
+from ray_tpu.data.block import Batch, Block, iter_batches_from_blocks
+
+
+class DataIterator:
+    """One consumer's view of a block stream."""
+
+    def __init__(self, ref_meta_iter_factory):
+        self._factory = ref_meta_iter_factory
+
+    def _iter_blocks(self) -> Iterator[Block]:
+        for ref, _ in self._factory():
+            yield api.get(ref)
+
+    def iter_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        drop_last: bool = False,
+        local_shuffle_buffer_size: Optional[int] = None,
+        local_shuffle_seed: Optional[int] = None,
+    ) -> Iterator[Batch]:
+        blocks = self._iter_blocks()
+        if local_shuffle_buffer_size:
+            blocks = _shuffling_blocks(
+                blocks, local_shuffle_buffer_size, local_shuffle_seed
+            )
+        for b in iter_batches_from_blocks(blocks, batch_size, drop_last=drop_last):
+            yield b.to_batch()
+
+    def iter_rows(self) -> Iterator[Any]:
+        for b in self._iter_blocks():
+            yield from b.iter_rows()
+
+    def iter_jax_batches(
+        self,
+        *,
+        batch_size: Optional[int] = 256,
+        drop_last: bool = False,
+        sharding=None,
+        dtypes: Optional[dict] = None,
+        prefetch: int = 1,
+        local_shuffle_buffer_size: Optional[int] = None,
+    ) -> Iterator[dict]:
+        """Batches as (sharded) jax.Arrays, transferred ahead of consumption."""
+        import jax
+
+        def to_device(batch: Batch) -> dict:
+            out = {}
+            for k, v in batch.items():
+                if dtypes and k in dtypes:
+                    v = v.astype(dtypes[k])
+                out[k] = jax.device_put(v, sharding) if sharding is not None else jax.device_put(v)
+            return out
+
+        it = (
+            to_device(b)
+            for b in self.iter_batches(
+                batch_size=batch_size,
+                drop_last=drop_last,
+                local_shuffle_buffer_size=local_shuffle_buffer_size,
+            )
+        )
+        yield from _prefetched(it, prefetch)
+
+    def iter_torch_batches(
+        self, *, batch_size: Optional[int] = 256, drop_last: bool = False
+    ) -> Iterator[dict]:
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size, drop_last=drop_last):
+            yield {
+                k: torch.from_numpy(np.ascontiguousarray(v))
+                if v.dtype.kind != "O"
+                else list(v)
+                for k, v in batch.items()
+            }
+
+
+def _prefetched(it: Iterator, depth: int) -> Iterator:
+    """Run `it` in a background thread, keeping `depth` items ready."""
+    if depth <= 0:
+        yield from it
+        return
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    DONE, ERR = object(), object()
+
+    def pump():
+        try:
+            for x in it:
+                q.put(x)
+            q.put(DONE)
+        except BaseException as e:  # noqa: BLE001 - must surface to consumer
+            q.put((ERR, e))
+
+    t = threading.Thread(target=pump, daemon=True, name="data-prefetch")
+    t.start()
+    while True:
+        x = q.get()
+        if x is DONE:
+            return
+        if isinstance(x, tuple) and len(x) == 2 and x[0] is ERR:
+            raise x[1]
+        yield x
+
+
+def _shuffling_blocks(
+    blocks: Iterator[Block], buffer_rows: int, seed: Optional[int]
+) -> Iterator[Block]:
+    """Local (non-global) shuffle: maintain a row buffer, emit random samples."""
+    rng = np.random.default_rng(seed)
+    buf: list[Block] = []
+    buffered = 0
+    for b in blocks:
+        buf.append(b)
+        buffered += b.num_rows
+        while buffered >= 2 * buffer_rows:
+            merged = Block.concat(buf)
+            perm = rng.permutation(merged.num_rows)
+            yield merged.take_indices(perm[:buffer_rows])
+            buf = [merged.take_indices(perm[buffer_rows:])]
+            buffered = buf[0].num_rows
+    if buf:
+        merged = Block.concat(buf)
+        yield merged.take_indices(rng.permutation(merged.num_rows))
+
+
+class StreamSplitIterator:
+    """streaming_split(n): one producer thread feeds n consumer queues
+    (reference: stream_split_iterator.py's coordinator actor; thread-mode
+    runtime makes a thread + bounded queues the equivalent construct)."""
+
+    def __init__(self, ref_meta_iter_factory, n: int, equal: bool, maxsize: int = 4):
+        self._factory = ref_meta_iter_factory
+        self._n = n
+        self._equal = equal
+        self._maxsize = maxsize
+        self._lock = threading.Lock()
+        self._queues: Optional[list[queue.Queue]] = None
+
+    def _ensure_started(self):
+        with self._lock:
+            if self._queues is not None:
+                return
+            self._queues = [queue.Queue(maxsize=self._maxsize) for _ in range(self._n)]
+            t = threading.Thread(target=self._pump, daemon=True, name="stream-split")
+            t.start()
+
+    def _pump(self):
+        DONE = None
+        try:
+            i = 0
+            for ref, meta in self._factory():
+                self._queues[i % self._n].put((ref, meta))
+                i += 1
+        except BaseException as e:  # noqa: BLE001
+            for q in self._queues:
+                q.put(("__error__", e))
+            return
+        for q in self._queues:
+            q.put(DONE)
+
+    def split(self, idx: int) -> DataIterator:
+        def factory():
+            self._ensure_started()
+            q = self._queues[idx]
+            while True:
+                item = q.get()
+                if item is None:
+                    return
+                if isinstance(item, tuple) and item[0] == "__error__":
+                    raise item[1]
+                yield item
+
+        return DataIterator(factory)

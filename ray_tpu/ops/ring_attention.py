@@ -53,6 +53,8 @@ def ring_attention_spmd(
     positions [i*S_local, (i+1)*S_local). Causal masking is applied on
     global positions, so the result equals full-sequence causal attention.
     """
+    if q_segment_ids is None and kv_segment_ids is not None:
+        q_segment_ids = kv_segment_ids
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
     group = _repeat_kv_heads(q, k)
@@ -93,25 +95,25 @@ def ring_attention_spmd(
         o_new = o * corr[..., None] + pv.astype(jnp.float32)
         return o_new, m_new, l_new
 
+    def masked_compute(o, m, l, k_cur, v_cur, seg_cur, src):
+        if not causal:
+            return compute_block(o, m, l, k_cur, v_cur, seg_cur, src)
+        # Blocks strictly in the future (src > my under contiguous
+        # sharding) are fully masked — skip their matmuls entirely.
+        # Average saving is ~2x attention FLOPs at large sp; the
+        # remaining rank imbalance (rank i computes i+1 blocks) is a
+        # known cost of contiguous sharding — zigzag/striped layouts
+        # would balance it at the price of position bookkeeping.
+        return jax.lax.cond(
+            src > my,
+            lambda *_: (o, m, l),
+            compute_block,
+            o, m, l, k_cur, v_cur, seg_cur, src,
+        )
+
     def body(carry, t):
         o, m, l, k_cur, v_cur, seg_cur = carry
-        src = (my + t) % n
-        if causal:
-            # Blocks strictly in the future (src > my under contiguous
-            # sharding) are fully masked — skip their matmuls entirely.
-            # Average saving is ~2x attention FLOPs at large sp; the
-            # remaining rank imbalance (rank i computes i+1 blocks) is a
-            # known cost of contiguous sharding — zigzag/striped layouts
-            # would balance it at the price of position bookkeeping.
-            o, m, l = jax.lax.cond(
-                src > my,
-                lambda *_: (o, m, l),
-                compute_block,
-                o, m, l, k_cur, v_cur, seg_cur, src,
-            )
-        else:
-            o, m, l = compute_block(o, m, l, k_cur, v_cur, seg_cur, src)
-
+        o, m, l = masked_compute(o, m, l, k_cur, v_cur, seg_cur, (my + t) % n)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         seg_nxt = (
@@ -122,9 +124,12 @@ def ring_attention_spmd(
     o0 = jnp.zeros((B, Kh, group, Sq, D), jnp.float32)
     m0 = jnp.full((B, Kh, group, Sq), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, Kh, group, Sq), jnp.float32)
-    (o, _, l, _, _, _), _ = jax.lax.scan(
-        body, (o0, m0, l0, k, v, kv_segment_ids), jnp.arange(n)
+    # n-1 rotations in the scan; the last block needs no onward ppermute,
+    # so it is folded in as an epilogue (saves one dead KV rotation).
+    (o, m, l, k_last, v_last, seg_last), _ = jax.lax.scan(
+        body, (o0, m0, l0, k, v, kv_segment_ids), jnp.arange(n - 1)
     )
+    o, _, l = masked_compute(o, m, l, k_last, v_last, seg_last, (my + n - 1) % n)
     o = o / jnp.where(l == 0.0, 1.0, l)[..., None]
     # [B, Kh, G, Sq, D] -> [B, Sq, H, D]
     return o.transpose(0, 3, 1, 2, 4).reshape(B, Sq, H, D).astype(q.dtype)
@@ -160,83 +165,56 @@ def ulysses_attention_spmd(
     return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
 
-def _cp_shard_map(inner, mesh: Mesh, axis: str, batch_axes, heads_axis, has_seg):
-    qspec = P(batch_axes, axis, heads_axis, None)
-    seg_spec = P(batch_axes, axis)
-    in_specs = (qspec, qspec, qspec) + ((seg_spec,) if has_seg else ())
-    return jax.shard_map(
-        inner, mesh=mesh, in_specs=in_specs, out_specs=qspec, check_vma=False
-    )
+def _cp_wrapper(spmd_fn, seg_kwargs):
+    """Shared shard_map wrapper for both context-parallel variants.
+
+    seg_kwargs maps one segment-ids array to the spmd fn's kwarg name(s).
+    """
+
+    def wrapper(
+        q: jax.Array,  # [B, S, H, D]  (global shapes; sharding via shard_map)
+        k: jax.Array,
+        v: jax.Array,
+        *,
+        mesh: Mesh,
+        axis: str = "sp",
+        causal: bool = True,
+        segment_ids: Optional[jax.Array] = None,
+        softmax_scale: Optional[float] = None,
+        batch_axes=("dp", "fsdp"),
+        heads_axis: str = "tp",
+    ) -> jax.Array:
+        if mesh.shape[axis] == 1:
+            return xla_attention(
+                q, k, v, causal=causal, segment_ids=segment_ids,
+                softmax_scale=softmax_scale,
+            )
+        qspec = P(batch_axes, axis, heads_axis, None)
+        in_specs = (qspec, qspec, qspec)
+        args = (q, k, v)
+        if segment_ids is not None:
+            in_specs += (P(batch_axes, axis),)
+            args += (segment_ids,)
+
+        def inner(q, k, v, *maybe_seg):
+            kw = {name: maybe_seg[0] for name in seg_kwargs} if maybe_seg else {}
+            return spmd_fn(
+                q, k, v, axis_name=axis, causal=causal,
+                softmax_scale=softmax_scale, **kw,
+            )
+
+        return jax.shard_map(
+            inner, mesh=mesh, in_specs=in_specs, out_specs=qspec, check_vma=False
+        )(*args)
+
+    return wrapper
 
 
-def ring_attention(
-    q: jax.Array,  # [B, S, H, D]  (global shapes; sharding via shard_map)
-    k: jax.Array,
-    v: jax.Array,
-    *,
-    mesh: Mesh,
-    axis: str = "sp",
-    causal: bool = True,
-    segment_ids: Optional[jax.Array] = None,
-    softmax_scale: Optional[float] = None,
-    batch_axes=("dp", "fsdp"),
-    heads_axis: str = "tp",
-) -> jax.Array:
-    """Context-parallel causal attention over mesh axis `axis` (default "sp")."""
-    if mesh.shape[axis] == 1:
-        return xla_attention(
-            q, k, v, causal=causal, segment_ids=segment_ids, softmax_scale=softmax_scale
-        )
-
-    if segment_ids is None:
-        inner = functools.partial(
-            ring_attention_spmd, axis_name=axis, causal=causal,
-            softmax_scale=softmax_scale,
-        )
-        return _cp_shard_map(inner, mesh, axis, batch_axes, heads_axis, False)(q, k, v)
-
-    def inner(q, k, v, seg):
-        return ring_attention_spmd(
-            q, k, v, axis_name=axis, causal=causal, kv_segment_ids=seg,
-            q_segment_ids=seg, softmax_scale=softmax_scale,
-        )
-
-    return _cp_shard_map(inner, mesh, axis, batch_axes, heads_axis, True)(
-        q, k, v, segment_ids
-    )
-
-
-def ulysses_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    *,
-    mesh: Mesh,
-    axis: str = "sp",
-    causal: bool = True,
-    segment_ids: Optional[jax.Array] = None,
-    softmax_scale: Optional[float] = None,
-    batch_axes=("dp", "fsdp"),
-    heads_axis: str = "tp",
-) -> jax.Array:
-    if mesh.shape[axis] == 1:
-        return xla_attention(
-            q, k, v, causal=causal, segment_ids=segment_ids, softmax_scale=softmax_scale
-        )
-
-    if segment_ids is None:
-        inner = functools.partial(
-            ulysses_attention_spmd, axis_name=axis, causal=causal,
-            softmax_scale=softmax_scale,
-        )
-        return _cp_shard_map(inner, mesh, axis, batch_axes, heads_axis, False)(q, k, v)
-
-    def inner(q, k, v, seg):
-        return ulysses_attention_spmd(
-            q, k, v, axis_name=axis, causal=causal, segment_ids=seg,
-            softmax_scale=softmax_scale,
-        )
-
-    return _cp_shard_map(inner, mesh, axis, batch_axes, heads_axis, True)(
-        q, k, v, segment_ids
-    )
+ring_attention = _cp_wrapper(ring_attention_spmd, ("kv_segment_ids", "q_segment_ids"))
+ring_attention.__name__ = "ring_attention"
+ring_attention.__doc__ = (
+    'Context-parallel causal attention over mesh axis `axis` (default "sp").'
+)
+ulysses_attention = _cp_wrapper(ulysses_attention_spmd, ("segment_ids",))
+ulysses_attention.__name__ = "ulysses_attention"
+ulysses_attention.__doc__ = "All-to-all (Ulysses) context-parallel attention."

@@ -55,10 +55,6 @@ def make_train_step(
     batch_axes: tuple = ("batch", "seq"),
     grad_accum: int = 1,
 ):
-    if mesh is not None and rules is None:
-        from ray_tpu.parallel.sharding import default_rules
-
-        rules = default_rules()
     """Build `step(state, batch) -> (state, metrics)` as one jitted program.
 
     loss_fn(params, batch) -> scalar loss, or (loss, weight) where weight is
@@ -70,6 +66,10 @@ def make_train_step(
     fns get uniform weights (exact only when every microbatch has the same
     number of valid tokens).
     """
+    if mesh is not None and rules is None:
+        from ray_tpu.parallel.sharding import default_rules
+
+        rules = default_rules()
 
     def compute_grads(params, batch):
         """Returns (loss, weight, grads); weight=1 for scalar loss fns."""

@@ -1,0 +1,254 @@
+"""ray_tpu.data tests (modeled on the reference's python/ray/data/tests
+coverage: transforms, shuffles, groupby, iteration, splits)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import data as rd
+from ray_tpu.core import runtime as rt
+
+
+@pytest.fixture(autouse=True)
+def fresh_runtime():
+    if rt.is_initialized():
+        rt.shutdown_runtime()
+    ray_tpu.init(num_cpus=4)
+    yield
+    rt.shutdown_runtime()
+
+
+def test_range_take_count():
+    ds = rd.range(100)
+    assert ds.count() == 100
+    assert ds.take(5) == [0, 1, 2, 3, 4]
+
+
+def test_map_batches_lazy_and_streaming():
+    calls = []
+
+    def double(batch):
+        calls.append(len(batch["item"]))
+        return {"item": batch["item"] * 2}
+
+    ds = rd.range(100, parallelism=10).map_batches(double)
+    assert calls == []  # lazy until consumed
+    assert ds.take(3) == [0, 2, 4]
+    # streaming: take(3) should not have processed all 10 blocks
+    assert sum(calls) < 100
+
+
+def test_map_filter_flatmap():
+    ds = rd.range(20).map(lambda x: x + 1).filter(lambda x: x % 2 == 0)
+    assert ds.take_all() == [x for x in range(1, 21) if x % 2 == 0]
+    ds2 = rd.from_items([1, 2]).flat_map(lambda x: [x, x * 10])
+    assert ds2.take_all() == [1, 10, 2, 20]
+
+
+def test_map_batches_batch_size_rebatching():
+    sizes = []
+
+    def record(batch):
+        sizes.append(len(batch["item"]))
+        return batch
+
+    rd.range(100, parallelism=20).map_batches(record, batch_size=25).materialize()
+    # 20 input blocks of 5 rows bundled into >=25-row batches
+    assert all(s >= 25 for s in sizes[:-1])
+    assert sum(sizes) == 100
+
+
+def test_dict_rows_and_columns():
+    rows = [{"a": i, "b": float(i) * 2} for i in range(10)]
+    ds = rd.from_items(rows)
+    assert ds.schema() == {"a": "int64", "b": "float64"}
+    out = ds.select_columns(["b"]).take(2)
+    assert out == [{"b": 0.0}, {"b": 2.0}]
+    renamed = ds.rename_columns({"a": "x"}).take(1)[0]
+    assert set(renamed) == {"x", "b"}
+
+
+def test_add_drop_columns():
+    ds = rd.from_items([{"a": 1}, {"a": 2}]).add_column("b", lambda b: b["a"] * 10)
+    assert ds.take_all() == [{"a": 1, "b": 10}, {"a": 2, "b": 20}]
+    assert ds.drop_columns(["a"]).take_all() == [{"b": 10}, {"b": 20}]
+
+
+def test_repartition_no_shuffle_preserves_order():
+    ds = rd.range(50, parallelism=7).repartition(3)
+    mat = ds.materialize()
+    assert mat.num_blocks() == 3
+    assert mat.take_all() == list(range(50))
+
+
+def test_repartition_shuffle():
+    ds = rd.range(50, parallelism=5).repartition(4, shuffle=True)
+    assert sorted(ds.take_all()) == list(range(50))
+
+
+def test_random_shuffle():
+    ds = rd.range(100, parallelism=5).random_shuffle(seed=7)
+    out = ds.take_all()
+    assert sorted(out) == list(range(100))
+    assert out != list(range(100))
+
+
+def test_sort():
+    rng = np.random.default_rng(0)
+    vals = rng.permutation(200)
+    ds = rd.from_items([{"v": int(v)} for v in vals]).sort("v")
+    out = [r["v"] for r in ds.take_all()]
+    assert out == sorted(out)
+    desc = rd.from_items([{"v": int(v)} for v in vals]).sort("v", descending=True)
+    out2 = [r["v"] for r in desc.take_all()]
+    assert out2 == sorted(out2, reverse=True)
+
+
+def test_groupby_aggregate():
+    rows = [{"k": i % 3, "v": i} for i in range(30)]
+    ds = rd.from_items(rows).groupby("k").aggregate(rd.Count(), rd.Sum("v"), rd.Mean("v"))
+    out = {r["k"]: r for r in ds.take_all()}
+    assert set(out) == {0, 1, 2}
+    for k in (0, 1, 2):
+        vals = [i for i in range(30) if i % 3 == k]
+        assert out[k]["count()"] == 10
+        assert out[k]["sum(v)"] == sum(vals)
+        assert out[k]["mean(v)"] == pytest.approx(np.mean(vals))
+
+
+def test_global_aggregates():
+    ds = rd.from_items([{"v": float(i)} for i in range(10)])
+    assert ds.sum("v") == 45.0
+    assert ds.min("v") == 0.0
+    assert ds.max("v") == 9.0
+    assert ds.mean("v") == pytest.approx(4.5)
+    assert ds.std("v") == pytest.approx(np.std(np.arange(10.0), ddof=1))
+
+
+def test_limit_short_circuits():
+    calls = []
+
+    def spy(batch):
+        calls.append(1)
+        return batch
+
+    ds = rd.range(1000, parallelism=100).map_batches(spy).limit(5)
+    assert ds.take_all() == [0, 1, 2, 3, 4]
+    assert len(calls) < 100
+
+
+def test_union_zip():
+    a = rd.range(5)
+    b = rd.range(5).map(lambda x: x + 5)
+    assert a.union(b).take_all() == list(range(10))
+    z = rd.from_items([{"a": i} for i in range(6)]).zip(
+        rd.from_items([{"b": i * 2} for i in range(6)])
+    )
+    assert z.take_all() == [{"a": i, "b": i * 2} for i in range(6)]
+
+
+def test_iter_batches_sizes():
+    ds = rd.range(103, parallelism=10)
+    batches = list(ds.iter_batches(batch_size=25))
+    assert [len(b["item"]) for b in batches] == [25, 25, 25, 25, 3]
+    batches = list(ds.iter_batches(batch_size=25, drop_last=True))
+    assert [len(b["item"]) for b in batches] == [25, 25, 25, 25]
+
+
+def test_iter_jax_batches_sharded(cpu_devices):
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.mesh import MeshSpec, make_mesh
+
+    mesh = make_mesh(MeshSpec(dp=8), devices=cpu_devices)
+    sharding = NamedSharding(mesh, P(("dp",)))
+    ds = rd.from_numpy({"x": np.arange(64, dtype=np.float32)})
+    batches = list(ds.iter_jax_batches(batch_size=16, sharding=sharding))
+    assert len(batches) == 4
+    assert isinstance(batches[0]["x"], jax.Array)
+    assert batches[0]["x"].sharding == sharding
+
+
+def test_actor_pool_map_batches():
+    class AddState:
+        def __init__(self, offset):
+            self.offset = offset
+
+        def __call__(self, batch):
+            return {"item": batch["item"] + self.offset}
+
+    ds = rd.range(40, parallelism=8).map_batches(
+        AddState,
+        compute=rd.ActorPoolStrategy(size=2),
+        fn_constructor_args=(100,),
+    )
+    assert ds.take_all() == [i + 100 for i in range(40)]
+
+
+def test_streaming_split_disjoint_and_complete():
+    import threading
+
+    ds = rd.range(100, parallelism=10)
+    its = ds.streaming_split(2)
+    results = [[], []]
+
+    def consume(i):
+        for row in its[i].iter_rows():
+            results[i].append(row)
+
+    ts = [threading.Thread(target=consume, args=(i,)) for i in (0, 1)]
+    [t.start() for t in ts]
+    [t.join(timeout=30) for t in ts]
+    assert sorted(results[0] + results[1]) == list(range(100))
+    assert results[0] and results[1]
+
+
+def test_local_shuffle_buffer():
+    ds = rd.range(100, parallelism=4)
+    out = []
+    for b in ds.iter_batches(batch_size=10, local_shuffle_buffer_size=50):
+        out.extend(b["item"].tolist())
+    assert sorted(out) == list(range(100))
+    assert out != list(range(100))
+
+
+def test_csv_json_roundtrip(tmp_path):
+    rows = [{"a": i, "b": float(i) / 2} for i in range(25)]
+    ds = rd.from_items(rows)
+    csv_dir = str(tmp_path / "csv")
+    ds.write_csv(csv_dir)
+    back = rd.read_csv(csv_dir)
+    got = sorted(back.take_all(), key=lambda r: r["a"])
+    assert [r["a"] for r in got] == [r["a"] for r in rows]
+
+    json_dir = str(tmp_path / "json")
+    ds.write_json(json_dir)
+    back = rd.read_json(json_dir)
+    got = sorted(back.take_all(), key=lambda r: r["a"])
+    assert got == rows
+
+
+def test_read_text(tmp_path):
+    p = tmp_path / "f.txt"
+    p.write_text("hello\nworld\n")
+    ds = rd.read_text(str(p))
+    assert [r["text"] for r in ds.take_all()] == ["hello", "world"]
+
+
+def test_random_split_and_split():
+    parts = rd.range(100).random_split([0.7, 0.3], seed=0)
+    a, b = parts[0].take_all(), parts[1].take_all()
+    assert len(a) == 70 and len(b) == 30
+    assert sorted(a + b) == list(range(100))
+    s = rd.range(10).split(3)
+    assert sorted(len(x.take_all()) for x in s) == [3, 3, 4]
+
+
+def test_schema_and_size():
+    ds = rd.from_items([{"a": 1}]).materialize()
+    assert ds.schema() == {"a": "int64"}
+    assert ds.size_bytes() > 0
