@@ -36,14 +36,13 @@ class BlockMetadata:
 
 
 def _as_column(values: list) -> np.ndarray:
-    arr = np.asarray(values)
-    if arr.dtype.kind in "OUS" and arr.dtype.kind == "O":
+    try:
+        return np.asarray(values)
+    except ValueError:
+        # ragged rows (variable-length lists/arrays): object column
+        arr = np.empty(len(values), object)
+        arr[:] = values
         return arr
-    if arr.ndim > 1:
-        # ragged-safe: keep nested arrays as object column only if ragged;
-        # rectangular nested data stays a single ndarray column.
-        return arr
-    return arr
 
 
 class Block:
@@ -180,18 +179,32 @@ def iter_batches_from_blocks(
             if b.num_rows:
                 yield b
         return
+    # merged-once cursor: emitting a batch slices views out of the current
+    # merged buffer instead of rebuilding the remainder (keeps iteration
+    # linear in total rows, not quadratic per block).
     buf: list[Block] = []
     buffered = 0
+    merged: Optional[Block] = None
+    offset = 0
     for b in blocks:
         if b.num_rows == 0:
             continue
         buf.append(b)
         buffered += b.num_rows
-        while buffered >= batch_size:
-            merged = Block.concat(buf)
-            yield merged.slice(0, batch_size)
-            rest = merged.slice(batch_size, merged.num_rows)
-            buf = [rest] if rest.num_rows else []
-            buffered = rest.num_rows
-    if buffered and not drop_last:
-        yield Block.concat(buf)
+        if buffered < batch_size:
+            continue
+        if merged is not None and offset < merged.num_rows:
+            buf.insert(0, merged.slice(offset, merged.num_rows))
+        merged = buf[0] if len(buf) == 1 else Block.concat(buf)
+        offset = 0
+        buf, buffered = [], 0
+        while merged.num_rows - offset >= batch_size:
+            yield merged.slice(offset, offset + batch_size)
+            offset += batch_size
+        buffered = merged.num_rows - offset
+    tail = []
+    if merged is not None and offset < merged.num_rows:
+        tail.append(merged.slice(offset, merged.num_rows))
+    tail.extend(buf)
+    if tail and not drop_last:
+        yield Block.concat(tail)

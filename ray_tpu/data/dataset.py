@@ -263,8 +263,9 @@ class Dataset:
         refs = []
         for i, (ref, _) in enumerate(self._ref_metas()):
             out = os.path.join(path, f"part-{i:05d}.{ext}")
-            block = api.get(ref)
-            refs.append(write.remote(block, out))
+            # pass the ref: the task resolves it from the object store
+            # (blocks never round-trip through the driver)
+            refs.append(write.remote(ref, out))
         api.get(refs)
 
     def write_csv(self, path: str) -> None:
@@ -316,12 +317,13 @@ class GroupedData:
                 tuple(block.columns[k][i] for k in keys)
                 for i in builtins.range(block.num_rows)
             ]
-            arr = np.empty(len(tags), object)
-            arr[:] = tags
+            by_tag: dict = {}
+            for i, tag in enumerate(tags):
+                by_tag.setdefault(tag, []).append(i)
             outs = []
-            for tag in dict.fromkeys(tags):
-                idx = np.nonzero(arr == tag)[0]
-                outs.append(Block.from_batch(fn(block.take_indices(idx).to_batch())))
+            for idx in by_tag.values():
+                group = block.take_indices(np.asarray(idx))
+                outs.append(Block.from_batch(fn(group.to_batch())))
             return Block.concat(outs).to_batch()
 
         # group rows together first via a sort exchange, then map per group

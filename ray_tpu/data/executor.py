@@ -47,16 +47,16 @@ def _exec_map(fn, *blocks) -> tuple:
     return out, out.metadata()
 
 
-def _exec_split(block, n: int, assign: Callable[[Block], np.ndarray]):
+def _exec_split(block, n: int, assign, block_idx: int):
     """Map side of an exchange: route each row to one of n partitions."""
-    part = assign(block)
+    part = assign(block, block_idx)
     return tuple(block.take_indices(np.nonzero(part == j)[0]) for j in range(n))
 
 
-def _exec_merge(postprocess, *parts) -> tuple:
+def _exec_merge(postprocess, part_idx, *parts) -> tuple:
     out = Block.concat(list(parts))
     if postprocess is not None:
-        out = postprocess(out)
+        out = postprocess(out, part_idx)
     return out, out.metadata()
 
 
@@ -261,16 +261,16 @@ def _exchange(
         return
     split = _remote(_exec_split, num_returns=n_out) if n_out > 1 else None
     parts: list[tuple] = []  # per input: tuple of n_out refs
-    for ref, _ in inputs:
+    for i, (ref, _) in enumerate(inputs):
         if n_out == 1:
             parts.append((ref,))
         else:
-            out = split.remote(ref, n_out, assign)
+            out = split.remote(ref, n_out, assign, i)
             parts.append(tuple(out))
     stats.record(f"{name}.map", n_tasks=len(inputs))
     merge = _remote(_exec_merge, num_returns=2)
     for j in range(n_out):
-        refs = merge.remote(postprocess, *[p[j] for p in parts])
+        refs = merge.remote(postprocess, j, *[p[j] for p in parts])
         stats.record(f"{name}.reduce", n_tasks=1)
         yield _resolve(refs)
 
@@ -280,12 +280,14 @@ def _random_shuffle_op(op, upstream, stats, window):
     n = max(1, len(inputs))
     rng_seed = op.seed if op.seed is not None else int(time.time() * 1e6) % (2**31)
 
-    def assign(block: Block, _n=n, _seed=rng_seed) -> np.ndarray:
-        rng = np.random.default_rng((_seed + block.num_rows * 2654435761) % (2**31))
+    def assign(block: Block, block_idx: int, _n=n, _seed=rng_seed) -> np.ndarray:
+        # distinct stream per input block, or equal-sized blocks would all
+        # draw identical assignment vectors
+        rng = np.random.default_rng([_seed, block_idx])
         return rng.integers(0, _n, block.num_rows)
 
-    def postprocess(block: Block, _seed=rng_seed) -> Block:
-        rng = np.random.default_rng((_seed ^ 0x5EED) % (2**31) + block.num_rows)
+    def postprocess(block: Block, part_idx: int, _seed=rng_seed) -> Block:
+        rng = np.random.default_rng([_seed ^ 0x5EED, part_idx])
         return block.take_indices(rng.permutation(block.num_rows))
 
     yield from _exchange(inputs, n, assign, postprocess, stats, "random_shuffle")
@@ -312,12 +314,12 @@ def _sort_op(op, upstream, stats, window):
         else np.array([])
     )
 
-    def assign(block: Block, _b=bounds, _k=keys[0]) -> np.ndarray:
+    def assign(block: Block, block_idx: int, _b=bounds, _k=keys[0]) -> np.ndarray:
         if not len(_b):
             return np.zeros(block.num_rows, np.int64)
         return np.searchsorted(_b, block.columns[_k], side="right")
 
-    def postprocess(block: Block) -> Block:
+    def postprocess(block: Block, part_idx: int) -> Block:
         return block.sort_by(keys, op.descending)
 
     out = _exchange(inputs, max(1, n), assign, postprocess, stats, "sort")
@@ -332,7 +334,7 @@ def _groupby_op(op, upstream, stats, window):
     aggs = list(op.aggs)
     n = min(len(inputs), 8) or 1
 
-    def assign(block: Block, _k=keys, _n=n) -> np.ndarray:
+    def assign(block: Block, block_idx: int, _k=keys, _n=n) -> np.ndarray:
         h = np.zeros(block.num_rows, np.uint64)
         for k in _k:
             col = block.columns[k]
@@ -341,7 +343,7 @@ def _groupby_op(op, upstream, stats, window):
             )
         return (h % np.uint64(_n)).astype(np.int64)
 
-    def postprocess(block: Block, _k=keys, _aggs=aggs) -> Block:
+    def postprocess(block: Block, part_idx: int, _k=keys, _aggs=aggs) -> Block:
         if block.num_rows == 0:
             return Block({})
         rows = []
@@ -363,8 +365,8 @@ def _repartition_op(op, upstream, stats, window):
     inputs = _materialize(upstream)
     n_out = op.num_blocks
     if op.shuffle:
-        def assign(block: Block, _n=n_out) -> np.ndarray:
-            rng = np.random.default_rng(block.num_rows + 17)
+        def assign(block: Block, block_idx: int, _n=n_out) -> np.ndarray:
+            rng = np.random.default_rng([17, block_idx])
             return rng.integers(0, _n, block.num_rows)
 
         yield from _exchange(inputs, n_out, assign, None, stats, "repartition")

@@ -145,7 +145,14 @@ def _shuffling_blocks(
 class StreamSplitIterator:
     """streaming_split(n): one producer thread feeds n consumer queues
     (reference: stream_split_iterator.py's coordinator actor; thread-mode
-    runtime makes a thread + bounded queues the equivalent construct)."""
+    runtime makes a thread + bounded queues the equivalent construct).
+
+    One streaming pass total: each split is consumable once; a second
+    iteration of an exhausted split yields nothing (instead of blocking).
+    `close()` (called by e.g. JaxTrainer when the gang fails) unblocks the
+    pump so unconsumed splits can't wedge the producer forever."""
+
+    _DONE = object()
 
     def __init__(self, ref_meta_iter_factory, n: int, equal: bool, maxsize: int = 4):
         self._factory = ref_meta_iter_factory
@@ -154,6 +161,12 @@ class StreamSplitIterator:
         self._maxsize = maxsize
         self._lock = threading.Lock()
         self._queues: Optional[list[queue.Queue]] = None
+        self._closed = threading.Event()
+        self._finished = [False] * n
+
+    def close(self) -> None:
+        """Stop the pump; pending/future consumers see end-of-stream."""
+        self._closed.set()
 
     def _ensure_started(self):
         with self._lock:
@@ -163,30 +176,53 @@ class StreamSplitIterator:
             t = threading.Thread(target=self._pump, daemon=True, name="stream-split")
             t.start()
 
+    def _put(self, q: queue.Queue, item) -> bool:
+        """Timed put loop so a stalled consumer can't wedge the pump once
+        close() is called. Returns False if closed."""
+        while not self._closed.is_set():
+            try:
+                q.put(item, timeout=0.2)
+                return True
+            except queue.Full:
+                continue
+        return False
+
     def _pump(self):
-        DONE = None
         try:
             i = 0
             for ref, meta in self._factory():
-                self._queues[i % self._n].put((ref, meta))
+                if not self._put(self._queues[i % self._n], (ref, meta)):
+                    return
                 i += 1
         except BaseException as e:  # noqa: BLE001
             for q in self._queues:
-                q.put(("__error__", e))
+                self._put(q, ("__error__", e))
             return
         for q in self._queues:
-            q.put(DONE)
+            self._put(q, self._DONE)
 
     def split(self, idx: int) -> DataIterator:
         def factory():
             self._ensure_started()
+            if self._finished[idx]:
+                return
             q = self._queues[idx]
             while True:
-                item = q.get()
-                if item is None:
+                try:
+                    item = q.get(timeout=0.2)
+                except queue.Empty:
+                    if self._closed.is_set():
+                        self._finished[idx] = True
+                        return
+                    continue
+                if item is self._DONE:
+                    self._finished[idx] = True
                     return
                 if isinstance(item, tuple) and item[0] == "__error__":
+                    self._finished[idx] = True
                     raise item[1]
                 yield item
 
-        return DataIterator(factory)
+        it = DataIterator(factory)
+        it.splitter = self
+        return it

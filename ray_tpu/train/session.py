@@ -27,6 +27,7 @@ class TrainContext:
     latest_checkpoint: Optional[Checkpoint] = None
     group_name: str = "train"
     stop_event: Optional[threading.Event] = None
+    dataset_shards: dict = dataclasses.field(default_factory=dict)
 
 
 def _set_session(ctx: TrainContext) -> None:
@@ -59,6 +60,19 @@ def get_trial_dir() -> str:
 def get_checkpoint() -> Optional[Checkpoint]:
     """Checkpoint to resume from (set after a failure restart)."""
     return get_context().latest_checkpoint
+
+
+def get_dataset_shard(name: str = "train"):
+    """This worker's split of a Dataset passed to JaxTrainer(datasets=...)
+    (reference: ray.train.get_dataset_shard backed by streaming_split).
+    One streaming pass per attempt; re-create the trainer run for epochs
+    beyond the pipeline's output."""
+    shards = get_context().dataset_shards
+    if name not in shards:
+        raise KeyError(
+            f"no dataset shard {name!r}; pass datasets={{'{name}': ds}} to the trainer"
+        )
+    return shards[name]
 
 
 def report(metrics: dict, checkpoint: Optional[Checkpoint] = None) -> None:

@@ -48,6 +48,10 @@ class _TrainWorker:
         self.ctx.latest_checkpoint = ckpt
         return True
 
+    def set_dataset_shards(self, shards: dict) -> bool:
+        self.ctx.dataset_shards = shards
+        return True
+
     def run(self, fn: Callable, config: dict) -> str:
         session_mod._set_session(self.ctx)
         try:
@@ -180,6 +184,7 @@ class JaxTrainer:
             pg.ready(timeout=120)
 
         workers = []
+        splitters = []
         try:
             for rank in range(n):
                 strategy = api.PlacementGroupSchedulingStrategy(pg, rank)
@@ -194,6 +199,23 @@ class JaxTrainer:
                 )
             if resume_ckpt is not None:
                 api.get([w.set_resume_checkpoint.remote(resume_ckpt) for w in workers])
+            if self._datasets:
+                # one shared streaming execution per dataset, split across the
+                # gang (reference: dataset.py:1598 streaming_split in Train)
+                split_map = {
+                    name: ds.streaming_split(n) for name, ds in self._datasets.items()
+                }
+                splitters = [
+                    it.splitter for splits in split_map.values() for it in splits[:1]
+                ]
+                api.get(
+                    [
+                        w.set_dataset_shards.remote(
+                            {name: splits[rank] for name, splits in split_map.items()}
+                        )
+                        for rank, w in enumerate(workers)
+                    ]
+                )
 
             run_refs = [w.run.remote(self._fn, self._config) for w in workers]
 
@@ -211,6 +233,8 @@ class JaxTrainer:
             drain()  # keep reports/checkpoints that landed before the failure
             return "failed", e
         finally:
+            for sp in splitters:
+                sp.close()  # unwedge the data pump if a worker died mid-stream
             for w in workers:
                 try:
                     api.kill(w)

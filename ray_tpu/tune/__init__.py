@@ -1,0 +1,64 @@
+"""ray_tpu.tune: hyperparameter search (reference: python/ray/tune/).
+
+Tuner drives trials (class or function trainables) as actors on the
+runtime; searchers expand param spaces; schedulers early-stop (ASHA,
+median) or evolve (PBT) trials from streaming results.
+"""
+
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.session import report, get_checkpoint, get_context
+from ray_tpu.tune.schedulers import (
+    AsyncHyperBandScheduler,
+    FIFOScheduler,
+    MedianStoppingRule,
+    PopulationBasedTraining,
+    TrialScheduler,
+)
+from ray_tpu.tune.search import (
+    BasicVariantGenerator,
+    ConcurrencyLimiter,
+    Searcher,
+    choice,
+    grid_search,
+    loguniform,
+    quniform,
+    randint,
+    randn,
+    sample_from,
+    uniform,
+)
+from ray_tpu.tune.trainable import Trainable, with_parameters, with_resources
+from ray_tpu.tune.tuner import ResultGrid, TuneConfig, Tuner, run
+
+ASHAScheduler = AsyncHyperBandScheduler
+
+__all__ = [
+    "ASHAScheduler",
+    "AsyncHyperBandScheduler",
+    "BasicVariantGenerator",
+    "Checkpoint",
+    "ConcurrencyLimiter",
+    "FIFOScheduler",
+    "MedianStoppingRule",
+    "PopulationBasedTraining",
+    "ResultGrid",
+    "Searcher",
+    "Trainable",
+    "TrialScheduler",
+    "TuneConfig",
+    "Tuner",
+    "choice",
+    "get_checkpoint",
+    "get_context",
+    "grid_search",
+    "loguniform",
+    "quniform",
+    "randint",
+    "randn",
+    "report",
+    "run",
+    "sample_from",
+    "uniform",
+    "with_parameters",
+    "with_resources",
+]

@@ -1,0 +1,178 @@
+"""Trial schedulers (reference: python/ray/tune/schedulers/): early
+stopping and population-based training decisions driven by streaming
+trial results."""
+
+from __future__ import annotations
+
+import math
+import random
+from typing import Optional
+
+import numpy as np
+
+CONTINUE = "CONTINUE"
+STOP = "STOP"
+
+
+class TrialScheduler:
+    def on_result(self, trial, result: dict) -> str:
+        return CONTINUE
+
+    def on_complete(self, trial) -> None:
+        pass
+
+
+class FIFOScheduler(TrialScheduler):
+    pass
+
+
+class AsyncHyperBandScheduler(TrialScheduler):
+    """ASHA (reference: tune/schedulers/async_hyperband.py): promote only
+    trials in the top 1/reduction_factor at each rung; stop the rest."""
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        max_t: int = 100,
+        grace_period: int = 1,
+        reduction_factor: float = 4,
+        time_attr: str = "training_iteration",
+    ):
+        self.metric, self.mode = metric, mode
+        self.max_t, self.grace = max_t, grace_period
+        self.rf = reduction_factor
+        self.time_attr = time_attr
+        # rung value -> list of recorded metric values (one per trial:
+        # a trial is judged once per rung, at its first crossing)
+        self.rungs: dict[int, list[float]] = {}
+        self._recorded: set[tuple[str, int]] = set()
+        r = grace_period
+        self._rung_levels = []
+        while r < max_t:
+            self._rung_levels.append(int(r))
+            r *= reduction_factor
+
+    def _better(self, v: float, cutoff: float) -> bool:
+        return v <= cutoff if self.mode == "min" else v >= cutoff
+
+    def on_result(self, trial, result: dict) -> str:
+        t = result.get(self.time_attr, 0)
+        v = result.get(self.metric)
+        if v is None or (isinstance(v, float) and math.isnan(v)):
+            return CONTINUE
+        if t >= self.max_t:
+            return STOP
+        for rung in reversed(self._rung_levels):
+            if t >= rung:
+                if (trial.trial_id, rung) in self._recorded:
+                    return CONTINUE  # already judged at this rung
+                self._recorded.add((trial.trial_id, rung))
+                recorded = self.rungs.setdefault(rung, [])
+                recorded.append(float(v))
+                if len(recorded) < self.rf:
+                    return CONTINUE  # not enough data to cut yet
+                q = (
+                    np.percentile(recorded, 100 / self.rf)
+                    if self.mode == "min"
+                    else np.percentile(recorded, 100 * (1 - 1 / self.rf))
+                )
+                return CONTINUE if self._better(float(v), float(q)) else STOP
+        return CONTINUE
+
+
+class MedianStoppingRule(TrialScheduler):
+    """Stop a trial whose running-average is worse than the median of other
+    trials' averages at the same step (reference: median_stopping_rule.py)."""
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        grace_period: int = 1,
+        min_samples_required: int = 3,
+        time_attr: str = "training_iteration",
+    ):
+        self.metric, self.mode = metric, mode
+        self.grace = grace_period
+        self.min_samples = min_samples_required
+        self.time_attr = time_attr
+        self._avgs: dict[str, list[float]] = {}
+
+    def on_result(self, trial, result: dict) -> str:
+        v = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if v is None:
+            return CONTINUE
+        hist = self._avgs.setdefault(trial.trial_id, [])
+        hist.append(float(v))
+        if t < self.grace or len(self._avgs) < self.min_samples:
+            return CONTINUE
+        my_avg = float(np.mean(hist))
+        others = [float(np.mean(h)) for tid, h in self._avgs.items() if tid != trial.trial_id]
+        if len(others) < self.min_samples - 1:
+            return CONTINUE
+        med = float(np.median(others))
+        ok = my_avg <= med if self.mode == "min" else my_avg >= med
+        return CONTINUE if ok else STOP
+
+
+class PopulationBasedTraining(TrialScheduler):
+    """PBT (reference: tune/schedulers/pbt.py): at each perturbation
+    interval, bottom-quantile trials clone the checkpoint of a
+    top-quantile trial and perturb its hyperparameters. The controller
+    performs the actual exploit (checkpoint copy) — the scheduler returns
+    the decision via `pending_exploits`."""
+
+    def __init__(
+        self,
+        metric: str = "loss",
+        mode: str = "min",
+        perturbation_interval: int = 5,
+        hyperparam_mutations: Optional[dict] = None,
+        quantile_fraction: float = 0.25,
+        resample_probability: float = 0.25,
+        seed: Optional[int] = None,
+        time_attr: str = "training_iteration",
+    ):
+        self.metric, self.mode = metric, mode
+        self.interval = perturbation_interval
+        self.mutations = hyperparam_mutations or {}
+        self.quantile = quantile_fraction
+        self.resample_p = resample_probability
+        self.time_attr = time_attr
+        self.rng = random.Random(seed)
+        self._scores: dict[str, float] = {}
+        self._last_perturb: dict[str, int] = {}
+        # trial_id -> source trial_id to clone from (consumed by controller)
+        self.pending_exploits: dict[str, str] = {}
+
+    def on_result(self, trial, result: dict) -> str:
+        v = result.get(self.metric)
+        t = result.get(self.time_attr, 0)
+        if v is None:
+            return CONTINUE
+        self._scores[trial.trial_id] = float(v)
+        last = self._last_perturb.get(trial.trial_id, 0)
+        if t - last < self.interval or len(self._scores) < 2:
+            return CONTINUE
+        self._last_perturb[trial.trial_id] = t
+        ids = list(self._scores)
+        ranked = sorted(ids, key=self._scores.__getitem__, reverse=(self.mode == "max"))
+        k = max(1, int(len(ranked) * self.quantile))
+        top, bottom = ranked[:k], ranked[-k:]
+        if trial.trial_id in bottom and trial.trial_id not in top:
+            self.pending_exploits[trial.trial_id] = self.rng.choice(top)
+        return CONTINUE
+
+    def perturb(self, config: dict) -> dict:
+        out = dict(config)
+        for key, spec in self.mutations.items():
+            if self.rng.random() < self.resample_p:
+                out[key] = spec() if callable(spec) else self.rng.choice(list(spec))
+            else:
+                cur = out.get(key)
+                if isinstance(cur, (int, float)):
+                    factor = self.rng.choice([0.8, 1.2])
+                    out[key] = type(cur)(cur * factor)
+        return out

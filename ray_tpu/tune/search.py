@@ -1,0 +1,218 @@
+"""Search spaces + search algorithms (reference: python/ray/tune/search/).
+
+`grid_search`/`choice`/`uniform`/... build a param_space dict; the
+BasicVariantGenerator expands grid axes exhaustively and samples the
+distributions `num_samples` times — the reference's default searcher
+(tune/search/basic_variant.py). Custom searchers implement Searcher
+(suggest/on_trial_complete) and can be rate-limited by
+ConcurrencyLimiter.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+
+class Domain:
+    def sample(self, rng: random.Random) -> Any:
+        raise NotImplementedError
+
+
+class GridSearch:
+    """Marker: expand every value as its own trial (cross-product with
+    other grid axes)."""
+
+    def __init__(self, values):
+        self.values = list(values)
+
+
+class Choice(Domain):
+    def __init__(self, values):
+        self.values = list(values)
+
+    def sample(self, rng):
+        return rng.choice(self.values)
+
+
+class Uniform(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.uniform(self.low, self.high)
+
+
+class QUniform(Domain):
+    def __init__(self, low, high, q):
+        self.low, self.high, self.q = low, high, q
+
+    def sample(self, rng):
+        v = rng.uniform(self.low, self.high)
+        return round(v / self.q) * self.q
+
+
+class LogUniform(Domain):
+    def __init__(self, low, high, base=10):
+        if low <= 0:
+            raise ValueError("loguniform requires low > 0")
+        self.low, self.high, self.base = low, high, base
+
+    def sample(self, rng):
+        import math
+
+        lo, hi = math.log(self.low, self.base), math.log(self.high, self.base)
+        return self.base ** rng.uniform(lo, hi)
+
+
+class RandInt(Domain):
+    def __init__(self, low, high):
+        self.low, self.high = low, high
+
+    def sample(self, rng):
+        return rng.randrange(self.low, self.high)
+
+
+class Randn(Domain):
+    def __init__(self, mean=0.0, sd=1.0):
+        self.mean, self.sd = mean, sd
+
+    def sample(self, rng):
+        return rng.gauss(self.mean, self.sd)
+
+
+class SampleFrom(Domain):
+    def __init__(self, fn: Callable[[dict], Any]):
+        self.fn = fn
+
+    def sample(self, rng):  # resolved against the spec later
+        raise NotImplementedError
+
+
+# public constructors (tune.grid_search etc.)
+def grid_search(values) -> GridSearch:
+    return GridSearch(values)
+
+
+def choice(values) -> Choice:
+    return Choice(values)
+
+
+def uniform(low, high) -> Uniform:
+    return Uniform(low, high)
+
+
+def quniform(low, high, q) -> QUniform:
+    return QUniform(low, high, q)
+
+
+def loguniform(low, high, base=10) -> LogUniform:
+    return LogUniform(low, high, base)
+
+
+def randint(low, high) -> RandInt:
+    return RandInt(low, high)
+
+
+def randn(mean=0.0, sd=1.0) -> Randn:
+    return Randn(mean, sd)
+
+
+def sample_from(fn) -> SampleFrom:
+    return SampleFrom(fn)
+
+
+def _resolve(space: dict, rng: random.Random, grid_assignment: dict) -> dict:
+    """One concrete config from a param space + fixed grid choices."""
+    out = {}
+    deferred = []
+    for k, v in space.items():
+        if isinstance(v, GridSearch):
+            out[k] = grid_assignment[k]
+        elif isinstance(v, SampleFrom):
+            deferred.append((k, v))
+        elif isinstance(v, Domain):
+            out[k] = v.sample(rng)
+        elif isinstance(v, dict):
+            out[k] = _resolve(v, rng, grid_assignment.get(k, {}))
+        else:
+            out[k] = v
+    for k, v in deferred:
+        out[k] = v.fn(out)
+    return out
+
+
+def _grid_axes(space: dict, prefix=()) -> list[tuple[tuple, list]]:
+    axes = []
+    for k, v in space.items():
+        if isinstance(v, GridSearch):
+            axes.append(((*prefix, k), v.values))
+        elif isinstance(v, dict):
+            axes.extend(_grid_axes(v, (*prefix, k)))
+    return axes
+
+
+def _nest(flat: dict[tuple, Any]) -> dict:
+    out: dict = {}
+    for path, v in flat.items():
+        d = out
+        for p in path[:-1]:
+            d = d.setdefault(p, {})
+        d[path[-1]] = v
+    return out
+
+
+class Searcher:
+    """ABC for pluggable search algorithms (reference: search/searcher.py)."""
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict]) -> None:
+        pass
+
+
+class BasicVariantGenerator(Searcher):
+    """Grid cross-product × num_samples random draws."""
+
+    def __init__(self, param_space: dict, num_samples: int = 1, seed: Optional[int] = None):
+        self.space = param_space
+        self.rng = random.Random(seed)
+        axes = _grid_axes(param_space)
+        if axes:
+            keys = [a[0] for a in axes]
+            combos = list(itertools.product(*[a[1] for a in axes]))
+        else:
+            keys, combos = [], [()]
+        self._pending = [
+            dict(zip(keys, combo)) for _ in range(num_samples) for combo in combos
+        ]
+        self.total = len(self._pending)
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if not self._pending:
+            return None
+        flat = self._pending.pop(0)
+        return _resolve(self.space, self.rng, _nest(flat))
+
+
+class ConcurrencyLimiter(Searcher):
+    def __init__(self, searcher: Searcher, max_concurrent: int):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._live: set[str] = set()
+
+    def suggest(self, trial_id):
+        if len(self._live) >= self.max_concurrent:
+            return "__pending__"
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is not None and cfg != "__pending__":
+            self._live.add(trial_id)
+        return cfg
+
+    def on_trial_complete(self, trial_id, result):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result)

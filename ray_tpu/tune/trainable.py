@@ -1,0 +1,66 @@
+"""Trainable: the step API driven by the Tune controller (reference:
+python/ray/tune/trainable/trainable.py:289 train())."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+
+class Trainable:
+    """Class trainable: subclass with setup/step/save_checkpoint/
+    load_checkpoint. The controller calls train() repeatedly; PBT uses
+    save/restore/reset_config for exploit steps."""
+
+    def __init__(self, config: Optional[dict] = None):
+        self.config = dict(config or {})
+        self.iteration = 0
+        self.setup(self.config)
+
+    # -- override points ----------------------------------------------------
+
+    def setup(self, config: dict) -> None:
+        pass
+
+    def step(self) -> dict:
+        raise NotImplementedError
+
+    def save_checkpoint(self) -> Any:
+        return None
+
+    def load_checkpoint(self, state: Any) -> None:
+        pass
+
+    def reset_config(self, new_config: dict) -> bool:
+        """Return True if the trainable can adopt new hyperparameters
+        in-place (avoids teardown/setup on PBT explore)."""
+        return False
+
+    def cleanup(self) -> None:
+        pass
+
+    # -- controller-facing --------------------------------------------------
+
+    def train(self) -> dict:
+        metrics = self.step() or {}
+        self.iteration += 1
+        metrics.setdefault("training_iteration", self.iteration)
+        return metrics
+
+
+def with_parameters(fn, **params):
+    """Bind large/system objects to a function trainable without putting
+    them in the param space (reference: tune/trainable/util.py)."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(config):
+        return fn(config, **params)
+
+    wrapped.__ray_tpu_base_fn__ = fn
+    return wrapped
+
+
+def with_resources(trainable, resources: dict):
+    """Attach per-trial resource requests."""
+    trainable.__ray_tpu_resources__ = dict(resources)
+    return trainable

@@ -1,0 +1,385 @@
+"""Tuner + trial controller (reference: python/ray/tune/tuner.py:43 and
+execution/tune_controller.py:68).
+
+The controller is an event loop over trial actors on the task runtime:
+class trainables are driven step-by-step (one in-flight `train()` ref
+per trial), function trainables stream results through a report queue
+(the same session mechanism JaxTrainer workers use). Schedulers see
+every result and can stop trials (ASHA/median) or request
+checkpoint-clone exploits (PBT)."""
+
+from __future__ import annotations
+
+import inspect
+import itertools
+import queue
+import threading
+import time
+import traceback
+from typing import Any, Callable, Optional
+
+from ray_tpu.core import api
+from ray_tpu.train import session as session_mod
+from ray_tpu.train.checkpoint import Checkpoint
+from ray_tpu.train.result import Result
+from ray_tpu.tune import schedulers as sched_mod
+from ray_tpu.tune.search import BasicVariantGenerator, Searcher
+from ray_tpu.tune.schedulers import CONTINUE, STOP, FIFOScheduler, TrialScheduler
+from ray_tpu.tune.trainable import Trainable
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.tune")
+
+
+class TuneConfig:
+    def __init__(
+        self,
+        *,
+        metric: Optional[str] = None,
+        mode: str = "min",
+        num_samples: int = 1,
+        max_concurrent_trials: Optional[int] = None,
+        search_alg: Optional[Searcher] = None,
+        scheduler: Optional[TrialScheduler] = None,
+        seed: Optional[int] = None,
+    ):
+        self.metric = metric
+        self.mode = mode
+        self.num_samples = num_samples
+        self.max_concurrent_trials = max_concurrent_trials
+        self.search_alg = search_alg
+        self.scheduler = scheduler or FIFOScheduler()
+        self.seed = seed
+
+
+class Trial:
+    _ids = itertools.count()
+
+    def __init__(self, config: dict):
+        self.trial_id = f"trial_{next(Trial._ids):05d}"
+        self.config = config
+        self.status = "PENDING"
+        self.history: list[dict] = []
+        self.last_result: dict = {}
+        self.checkpoint: Optional[Checkpoint] = None
+        self.error: Optional[BaseException] = None
+
+    def record(self, metrics: dict):
+        self.history.append(metrics)
+        self.last_result = metrics
+
+    def to_result(self) -> Result:
+        return Result(
+            metrics=dict(self.last_result),
+            checkpoint=self.checkpoint,
+            path=None,
+            error=self.error,
+            metrics_history=self.history,
+        )
+
+
+class ResultGrid:
+    def __init__(self, trials: list[Trial], metric: Optional[str], mode: str):
+        self._trials = trials
+        self._metric = metric
+        self._mode = mode
+
+    def __len__(self):
+        return len(self._trials)
+
+    def __getitem__(self, i) -> Result:
+        return self._trials[i].to_result()
+
+    @property
+    def errors(self) -> list[BaseException]:
+        return [t.error for t in self._trials if t.error is not None]
+
+    @property
+    def num_errors(self) -> int:
+        return len(self.errors)
+
+    def get_best_result(
+        self, metric: Optional[str] = None, mode: Optional[str] = None
+    ) -> Result:
+        metric = metric or self._metric
+        mode = mode or self._mode
+        if metric is None:
+            raise ValueError("metric required (set in TuneConfig or pass here)")
+        scored = [t for t in self._trials if metric in t.last_result]
+        if not scored:
+            raise ValueError(f"no trial reported metric {metric!r}")
+        best = (min if mode == "min" else max)(
+            scored, key=lambda t: t.last_result[metric]
+        )
+        return best.to_result()
+
+    def get_dataframe(self):
+        import pandas as pd
+
+        return pd.DataFrame([{"trial_id": t.trial_id, **t.last_result} for t in self._trials])
+
+
+@api.remote
+class _ClassTrialRunner:
+    def __init__(self, cls, config):
+        self._cls = cls
+        self._t = cls(config)
+
+    def train(self) -> dict:
+        return self._t.train()
+
+    def save(self):
+        return (self._t.save_checkpoint(), self._t.iteration)
+
+    def restore(self, state, new_config: Optional[dict] = None):
+        ckpt, iteration = state
+        if new_config is not None and not self._t.reset_config(new_config):
+            self._t.cleanup()
+            self._t = self._cls(new_config)
+        self._t.load_checkpoint(ckpt)
+        self._t.iteration = iteration
+        return True
+
+    def cleanup(self):
+        self._t.cleanup()
+        return True
+
+
+@api.remote
+class _FnTrialRunner:
+    """Function trainable: runs fn(config) under a train session so
+    tune.report streams results to the controller's queue."""
+
+    def __init__(self, report_queue, stop_event):
+        self._ctx = session_mod.TrainContext(
+            world_rank=0,
+            world_size=1,
+            trial_dir="",
+            report_queue=report_queue,
+            stop_event=stop_event,
+        )
+
+    def run(self, fn, config) -> str:
+        session_mod._set_session(self._ctx)
+        try:
+            fn(config)
+            return "done"
+        except StopIteration:
+            return "stopped"
+        finally:
+            session_mod._clear_session()
+
+
+class _RunningTrial:
+    def __init__(self, trial: Trial, kind: str, actor, *, run_ref=None, q=None, stop=None):
+        self.trial = trial
+        self.kind = kind  # "class" | "fn"
+        self.actor = actor
+        self.step_ref = None  # class: in-flight train() ref
+        self.run_ref = run_ref  # fn: final-status ref
+        self.queue = q
+        self.stop_event = stop
+        self.stopping = False
+
+
+class Tuner:
+    def __init__(
+        self,
+        trainable,
+        *,
+        param_space: Optional[dict] = None,
+        tune_config: Optional[TuneConfig] = None,
+        run_config=None,
+        stop: Optional[dict] = None,
+    ):
+        self._trainable = trainable
+        self._space = param_space or {}
+        self._cfg = tune_config or TuneConfig()
+        self._run_config = run_config
+        self._stop = stop or {}
+
+    # -- trial lifecycle ----------------------------------------------------
+
+    def _launch(self, trial: Trial) -> _RunningTrial:
+        trial.status = "RUNNING"
+        resources = getattr(self._trainable, "__ray_tpu_resources__", None) or {}
+        opts = {"num_cpus": resources.get("CPU", 0)}
+        if isinstance(self._trainable, type) and issubclass(self._trainable, Trainable):
+            actor = _ClassTrialRunner.options(**opts).remote(self._trainable, trial.config)
+            rt = _RunningTrial(trial, "class", actor)
+            rt.step_ref = actor.train.remote()
+            return rt
+        q: queue.Queue = queue.Queue()
+        stop = threading.Event()
+        actor = _FnTrialRunner.options(**opts).remote(q, stop)
+        run_ref = actor.run.remote(self._trainable, trial.config)
+        return _RunningTrial(trial, "fn", actor, run_ref=run_ref, q=q, stop=stop)
+
+    def _finish(self, rt: _RunningTrial, status: str, error=None):
+        rt.trial.status = status
+        rt.trial.error = error
+        try:
+            api.kill(rt.actor)
+        except Exception:
+            pass
+
+    def _should_stop_by_criteria(self, metrics: dict) -> bool:
+        for k, v in self._stop.items():
+            if k in metrics and metrics[k] >= v:
+                return True
+        return False
+
+    def _handle_result(self, rt: _RunningTrial, metrics: dict, scheduler) -> str:
+        rt.trial.record(metrics)
+        decision = scheduler.on_result(rt.trial, metrics)
+        if self._should_stop_by_criteria(metrics):
+            decision = STOP
+        # PBT exploit: clone weights+config from a better trial
+        exploits = getattr(scheduler, "pending_exploits", None)
+        if exploits and rt.trial.trial_id in exploits:
+            src_id = exploits.pop(rt.trial.trial_id)
+            self._exploit(rt, src_id)
+        return decision
+
+    def _exploit(self, rt: _RunningTrial, src_id: str):
+        src = self._running.get(src_id)
+        if src is None or src.kind != "class" or rt.kind != "class":
+            return
+        scheduler = self._cfg.scheduler
+        new_config = scheduler.perturb(src.trial.config)
+        try:
+            state = api.get(src.actor.save.remote())
+            api.get(rt.actor.restore.remote(state, new_config))
+            rt.trial.config = new_config
+            logger.info(
+                "PBT exploit: %s cloned %s with config %s",
+                rt.trial.trial_id, src_id, new_config,
+            )
+        except Exception as e:  # noqa: BLE001 - exploit is best-effort
+            logger.warning("PBT exploit failed: %s", e)
+
+    # -- main loop ----------------------------------------------------------
+
+    def fit(self) -> ResultGrid:
+        cfg = self._cfg
+        searcher = cfg.search_alg or BasicVariantGenerator(
+            self._space, num_samples=cfg.num_samples, seed=cfg.seed
+        )
+        scheduler = cfg.scheduler
+        if hasattr(scheduler, "metric") and scheduler.metric is None and cfg.metric:
+            scheduler.metric = cfg.metric
+        max_conc = cfg.max_concurrent_trials or 8
+
+        trials: list[Trial] = []
+        self._running: dict[str, _RunningTrial] = {}
+        exhausted = False
+
+        while True:
+            # launch up to the concurrency cap
+            while not exhausted and len(self._running) < max_conc:
+                config = searcher.suggest(f"t{len(trials)}")
+                if config is None:
+                    exhausted = True
+                    break
+                if config == "__pending__":
+                    break
+                trial = Trial(config)
+                trials.append(trial)
+                rt = self._launch(trial)
+                self._running[trial.trial_id] = rt
+
+            if not self._running:
+                if exhausted:
+                    break
+                time.sleep(0.01)
+                continue
+
+            progressed = False
+            for tid, rt in list(self._running.items()):
+                if rt.kind == "class":
+                    progressed |= self._poll_class_trial(tid, rt, scheduler, searcher)
+                else:
+                    progressed |= self._poll_fn_trial(tid, rt, scheduler, searcher)
+            if not progressed:
+                time.sleep(0.005)
+
+        return ResultGrid(trials, cfg.metric, cfg.mode)
+
+    def _poll_class_trial(self, tid, rt, scheduler, searcher) -> bool:
+        ready, _ = api.wait([rt.step_ref], num_returns=1, timeout=0)
+        if not ready:
+            return False
+        try:
+            metrics = api.get(rt.step_ref)
+        except Exception as e:  # noqa: BLE001 - trial failure
+            self._finish(rt, "ERROR", e)
+            scheduler.on_complete(rt.trial)
+            searcher.on_trial_complete(tid, None)
+            del self._running[tid]
+            return True
+        decision = self._handle_result(rt, metrics, scheduler)
+        if decision == STOP:
+            self._finish(rt, "TERMINATED")
+            scheduler.on_complete(rt.trial)
+            searcher.on_trial_complete(tid, metrics)
+            del self._running[tid]
+        else:
+            rt.step_ref = rt.actor.train.remote()
+        return True
+
+    def _poll_fn_trial(self, tid, rt, scheduler, searcher) -> bool:
+        progressed = False
+        try:
+            while True:
+                rep = rt.queue.get_nowait()
+                progressed = True
+                metrics = rep["metrics"]
+                if rep.get("checkpoint") is not None:
+                    rt.trial.checkpoint = rep["checkpoint"]
+                metrics.setdefault("training_iteration", len(rt.trial.history) + 1)
+                decision = self._handle_result(rt, metrics, scheduler)
+                if decision == STOP and not rt.stopping:
+                    rt.stopping = True
+                    rt.stop_event.set()
+        except queue.Empty:
+            pass
+        ready, _ = api.wait([rt.run_ref], num_returns=1, timeout=0)
+        if ready:
+            try:
+                api.get(rt.run_ref)
+                self._finish(rt, "TERMINATED")
+            except Exception as e:  # noqa: BLE001
+                self._finish(rt, "ERROR", e)
+            scheduler.on_complete(rt.trial)
+            searcher.on_trial_complete(tid, rt.trial.last_result or None)
+            del self._running[tid]
+            progressed = True
+        return progressed
+
+
+def run(
+    trainable,
+    *,
+    config: Optional[dict] = None,
+    num_samples: int = 1,
+    metric: Optional[str] = None,
+    mode: str = "min",
+    scheduler: Optional[TrialScheduler] = None,
+    search_alg: Optional[Searcher] = None,
+    stop: Optional[dict] = None,
+    max_concurrent_trials: Optional[int] = None,
+) -> ResultGrid:
+    """Functional entry point (reference: tune.run)."""
+    return Tuner(
+        trainable,
+        param_space=config,
+        tune_config=TuneConfig(
+            metric=metric,
+            mode=mode,
+            num_samples=num_samples,
+            scheduler=scheduler,
+            search_alg=search_alg,
+            max_concurrent_trials=max_concurrent_trials,
+        ),
+        stop=stop,
+    ).fit()

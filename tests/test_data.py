@@ -252,3 +252,53 @@ def test_schema_and_size():
     ds = rd.from_items([{"a": 1}]).materialize()
     assert ds.schema() == {"a": "int64"}
     assert ds.size_bytes() > 0
+
+
+def test_ragged_object_columns():
+    rows = [{"x": [1, 2]}, {"x": [1]}, {"x": [5, 6, 7]}]
+    ds = rd.from_items(rows, parallelism=1)
+    got = ds.take_all()
+    assert [list(r["x"]) for r in got] == [[1, 2], [1], [5, 6, 7]]
+
+
+def test_map_groups():
+    rows = [{"k": i % 2, "v": i} for i in range(10)]
+    out = (
+        rd.from_items(rows)
+        .groupby("k")
+        .map_groups(lambda b: {"k": b["k"][:1], "n": [len(b["v"])]})
+        .take_all()
+    )
+    assert sorted((r["k"], r["n"]) for r in out) == [(0, 5), (1, 5)]
+
+
+def test_seeded_shuffle_not_block_correlated():
+    # equal-sized blocks must not get identical assignment/permutation
+    out = rd.range(64, parallelism=4).random_shuffle(seed=3).take_all()
+    assert sorted(out) == list(range(64))
+    # rows from block 0 (0..15) must not all map to the same relative order
+    pos = {v: i for i, v in enumerate(out)}
+    deltas = {pos[v + 16] - pos[v] for v in range(16)}
+    assert len(deltas) > 1, "block-correlated shuffle"
+
+
+def test_streaming_split_close_unblocks():
+    ds = rd.range(1000, parallelism=50)
+    its = ds.streaming_split(2)
+    # consume a bit of split 0, never touch split 1, then close
+    it0 = iter(its[0].iter_rows())
+    next(it0)
+    its[0].splitter.close()
+    # pump must exit; split 1 sees end-of-stream promptly instead of hanging
+    rows = list(its[1].iter_rows())
+    assert isinstance(rows, list)  # terminates
+
+
+def test_iter_batches_large_block_linear():
+    ds = rd.from_numpy({"x": np.arange(200_000)})
+    import time as _t
+    t0 = _t.monotonic()
+    n = sum(len(b["x"]) for b in ds.iter_batches(batch_size=128))
+    dt = _t.monotonic() - t0
+    assert n == 200_000
+    assert dt < 5.0, f"batch iteration too slow ({dt:.1f}s): quadratic copy?"

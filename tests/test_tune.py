@@ -1,0 +1,268 @@
+"""ray_tpu.tune tests (modeled on reference python/ray/tune/tests)."""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu import tune
+from ray_tpu.core import runtime as rt
+
+
+@pytest.fixture(autouse=True)
+def fresh_runtime():
+    if rt.is_initialized():
+        rt.shutdown_runtime()
+    ray_tpu.init(num_cpus=8)
+    yield
+    rt.shutdown_runtime()
+
+
+def test_grid_search_expansion():
+    seen = []
+
+    def train_fn(config):
+        seen.append((config["a"], config["b"]))
+        tune.report({"score": config["a"] * 10 + config["b"]})
+
+    grid = tune.Tuner(
+        train_fn,
+        param_space={"a": tune.grid_search([1, 2, 3]), "b": tune.grid_search([0, 1])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+    ).fit()
+    assert len(grid) == 6
+    assert sorted(seen) == [(a, b) for a in (1, 2, 3) for b in (0, 1)]
+    best = grid.get_best_result()
+    assert best.metrics["score"] == 31
+
+
+def test_random_search_num_samples():
+    def train_fn(config):
+        tune.report({"v": config["lr"]})
+
+    grid = tune.Tuner(
+        train_fn,
+        param_space={"lr": tune.loguniform(1e-5, 1e-1)},
+        tune_config=tune.TuneConfig(num_samples=8, metric="v", mode="min", seed=0),
+    ).fit()
+    assert len(grid) == 8
+    vals = [grid[i].metrics["v"] for i in range(8)]
+    assert all(1e-5 <= v <= 1e-1 for v in vals)
+    assert len(set(vals)) > 1
+
+
+def test_search_space_primitives():
+    import random
+
+    rng = random.Random(0)
+    assert tune.choice([1, 2]).sample(rng) in (1, 2)
+    assert 0 <= tune.uniform(0, 1).sample(rng) <= 1
+    assert tune.randint(0, 10).sample(rng) in range(10)
+    q = tune.quniform(0, 1, 0.25).sample(rng)
+    assert q in (0.0, 0.25, 0.5, 0.75, 1.0)
+
+
+def test_class_trainable_and_stop_criteria():
+    class MyTrainable(tune.Trainable):
+        def setup(self, config):
+            self.x = config["start"]
+
+        def step(self):
+            self.x += 1
+            return {"x": self.x}
+
+    grid = tune.Tuner(
+        MyTrainable,
+        param_space={"start": tune.grid_search([0, 100])},
+        tune_config=tune.TuneConfig(metric="x", mode="max"),
+        stop={"training_iteration": 5},
+    ).fit()
+    assert len(grid) == 2
+    assert {r.metrics["x"] for r in (grid[0], grid[1])} == {5, 105}
+
+
+def test_asha_rung_math():
+    """Scheduler-level: deterministic result feed, bad trial cut at a rung."""
+
+    class T:
+        def __init__(self, tid):
+            self.trial_id = tid
+
+    sched = tune.ASHAScheduler(
+        metric="score", mode="max", max_t=100, grace_period=4, reduction_factor=2
+    )
+    good1, good2, bad = T("g1"), T("g2"), T("bad")
+    # both good trials reach rung 4 first
+    assert sched.on_result(good1, {"score": 4.0, "training_iteration": 4}) == "CONTINUE"
+    assert sched.on_result(good2, {"score": 4.4, "training_iteration": 4}) == "CONTINUE"
+    # bad trial arrives at rung 4 below the cutoff -> stopped
+    assert sched.on_result(bad, {"score": 0.0, "training_iteration": 4}) == "STOP"
+    # a trial is judged once per rung: next report in (4, 8) is a pass-through
+    assert sched.on_result(good1, {"score": 5.0, "training_iteration": 5}) == "CONTINUE"
+    # max_t cap
+    assert sched.on_result(good1, {"score": 9.9, "training_iteration": 100}) == "STOP"
+
+
+def test_asha_stops_bad_trials():
+    # good trials improve quickly; the flat trial reports slowly, reaching
+    # each rung after the good ones have recorded -> cut early
+    steps_run = {}
+
+    def train_fn(config):
+        import time as _time
+
+        for i in range(20):
+            score = i * config["slope"]
+            steps_run[config["slope"]] = i + 1
+            tune.report({"score": score, "training_iteration": i + 1})
+            _time.sleep(0.05 if config["slope"] == 0.0 else 0.005)
+
+    sched = tune.ASHAScheduler(
+        metric="score", mode="max", max_t=20, grace_period=2, reduction_factor=2
+    )
+    grid = tune.Tuner(
+        train_fn,
+        param_space={"slope": tune.grid_search([0.0, 1.0, 1.1, 1.2, 1.3])},
+        tune_config=tune.TuneConfig(scheduler=sched, metric="score", mode="max",
+                                    max_concurrent_trials=5),
+    ).fit()
+    assert len(grid) == 5
+    best = grid.get_best_result()
+    assert best.metrics["score"] >= 19 * 1.1
+    # the zero-slope trial must have been stopped before finishing
+    assert steps_run[0.0] < 20
+
+
+def test_fn_trainable_error_captured():
+    def train_fn(config):
+        tune.report({"v": 1})
+        raise RuntimeError("boom")
+
+    grid = tune.Tuner(train_fn, param_space={}).fit()
+    assert grid.num_errors == 1
+    assert "boom" in str(grid.errors[0])
+
+
+def test_pbt_exploits_weights():
+    class Learner(tune.Trainable):
+        def setup(self, config):
+            self.weight = 0.0
+            self.lr = config["lr"]
+
+        def step(self):
+            self.weight += self.lr
+            return {"score": self.weight}
+
+        def save_checkpoint(self):
+            return {"weight": self.weight}
+
+        def load_checkpoint(self, state):
+            self.weight = state["weight"]
+
+        def reset_config(self, config):
+            self.lr = config["lr"]
+            self.config = config
+            return True
+
+    sched = tune.PopulationBasedTraining(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_mutations={"lr": [0.1, 1.0]}, seed=0,
+    )
+    grid = tune.Tuner(
+        Learner,
+        param_space={"lr": tune.grid_search([0.001, 1.0])},
+        tune_config=tune.TuneConfig(scheduler=sched, metric="score", mode="max"),
+        stop={"training_iteration": 12},
+    ).fit()
+    scores = sorted(r.metrics["score"] for r in (grid[0], grid[1]))
+    # without exploit, slow trial ends at 0.012; with exploit it clones the
+    # fast trial's weights and finishes far higher
+    assert scores[0] > 1.0
+
+
+def test_median_stopping():
+    def train_fn(config):
+        import time as _time
+
+        for i in range(10):
+            tune.report({"loss": config["level"], "training_iteration": i + 1})
+            _time.sleep(0.05 if config["level"] == 50.0 else 0.005)
+
+    sched = tune.MedianStoppingRule(metric="loss", mode="min", grace_period=2,
+                                    min_samples_required=3)
+    grid = tune.Tuner(
+        train_fn,
+        param_space={"level": tune.grid_search([1.0, 1.0, 1.0, 50.0])},
+        tune_config=tune.TuneConfig(scheduler=sched, metric="loss", mode="min",
+                                    max_concurrent_trials=4),
+    ).fit()
+    bad = [t for t in grid._trials if t.config["level"] == 50.0][0]
+    assert len(bad.history) < 10
+
+
+def test_with_parameters():
+    big = np.arange(1000)
+
+    def train_fn(config, data=None):
+        tune.report({"total": float(data.sum()) + config["x"]})
+
+    grid = tune.Tuner(
+        tune.with_parameters(train_fn, data=big),
+        param_space={"x": tune.grid_search([1, 2])},
+        tune_config=tune.TuneConfig(metric="total", mode="max"),
+    ).fit()
+    assert grid.get_best_result().metrics["total"] == big.sum() + 2
+
+
+def test_tune_run_functional_entry():
+    grid = tune.run(
+        lambda config: tune.report({"v": config["x"] ** 2}),
+        config={"x": tune.grid_search([1, 2, 3])},
+        metric="v",
+        mode="min",
+    )
+    assert grid.get_best_result().metrics["v"] == 1
+
+
+def test_concurrency_limiter():
+    inner = tune.BasicVariantGenerator({"x": tune.uniform(0, 1)}, num_samples=4)
+    limited = tune.ConcurrencyLimiter(inner, max_concurrent=2)
+    a = limited.suggest("t1")
+    b = limited.suggest("t2")
+    assert isinstance(a, dict) and isinstance(b, dict)
+    assert limited.suggest("t3") == "__pending__"
+    limited.on_trial_complete("t1", {"v": 1})
+    assert isinstance(limited.suggest("t3"), dict)
+
+
+def test_tuner_with_jax_train_loop():
+    """HPO over a real jitted train step: pick the lr that learns fastest."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    X = np.random.default_rng(0).normal(size=(64, 4)).astype(np.float32)
+    w_true = np.array([1.0, -2.0, 3.0, 0.5], np.float32)
+    y = X @ w_true
+
+    def train_fn(config):
+        w = jnp.zeros(4)
+        opt = optax.sgd(config["lr"])
+        state = opt.init(w)
+
+        @jax.jit
+        def step(w, state):
+            loss, g = jax.value_and_grad(lambda w: jnp.mean((X @ w - y) ** 2))(w)
+            up, state = opt.update(g, state)
+            return optax.apply_updates(w, up), state, loss
+
+        for i in range(30):
+            w, state, loss = step(w, state)
+        tune.report({"loss": float(loss)})
+
+    grid = tune.Tuner(
+        train_fn,
+        param_space={"lr": tune.grid_search([1e-4, 1e-2, 1e-1])},
+        tune_config=tune.TuneConfig(metric="loss", mode="min"),
+    ).fit()
+    best = grid.get_best_result()
+    assert best.metrics["loss"] < 0.1
