@@ -1,0 +1,36 @@
+"""ray_tpu.rl: reinforcement learning (the RLlib-equivalent layer).
+
+Reference analog: rllib/ (188k LoC; Algorithm/EnvRunnerGroup/RLModule/
+LearnerGroup architecture — see SURVEY.md §2.5). TPU-first redesign:
+modules are functional JAX pytrees, learners are single pjit programs
+over the device mesh (no DDP actor tier), and trajectory math (GAE,
+V-trace) compiles into the update as lax.scan.
+"""
+
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.module import MLPModule, RLModule, RLModuleSpec
+from ray_tpu.rl.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
+from ray_tpu.rl.learner import Learner, LearnerGroup
+from ray_tpu.rl.replay import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rl.algorithms import DQN, DQNConfig, IMPALA, IMPALAConfig, PPO, PPOConfig
+
+__all__ = [
+    "Algorithm",
+    "AlgorithmConfig",
+    "RLModule",
+    "RLModuleSpec",
+    "MLPModule",
+    "EnvRunnerGroup",
+    "SingleAgentEnvRunner",
+    "Learner",
+    "LearnerGroup",
+    "ReplayBuffer",
+    "PrioritizedReplayBuffer",
+    "PPO",
+    "PPOConfig",
+    "IMPALA",
+    "IMPALAConfig",
+    "DQN",
+    "DQNConfig",
+]

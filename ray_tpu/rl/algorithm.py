@@ -1,0 +1,135 @@
+"""Algorithm: the trainable RL driver.
+
+Reference analog: rllib/algorithms/algorithm.py:199 (Algorithm extends
+Tune's Trainable; per-algo training_step; Checkpointable save/restore).
+Same shape here: Algorithm IS a ray_tpu.tune Trainable, so
+`Tuner(PPOConfig()...build_algo)` and plain `.train()` loops both work.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.env_runner import EnvRunnerGroup, spec_from_env
+from ray_tpu.rl.module import RLModuleSpec
+from ray_tpu.tune.trainable import Trainable
+
+
+class _EnvFactory:
+    """Picklable `gym.make(id, **kwargs)` closure for remote env runners."""
+
+    def __init__(self, env_id: str, kwargs: dict):
+        self.env_id = env_id
+        self.kwargs = kwargs
+
+    def __call__(self):
+        import gymnasium as gym
+
+        return gym.make(self.env_id, **self.kwargs)
+
+
+class Algorithm(Trainable):
+    """Subclasses define `default_config()`, `build_components()`, and
+    `training_step()`."""
+
+    module_class: "type | None" = None  # override to swap the RLModule impl
+
+    def __init__(self, config: "AlgorithmConfig | dict | None" = None):
+        if isinstance(config, dict):
+            cfg = self.default_config().update_from_dict(config)
+        elif config is None:
+            cfg = self.default_config()
+        else:
+            cfg = config
+        self.config = cfg
+        self.iteration = 0
+        self._timesteps = 0
+        self.setup(cfg)
+
+    @classmethod
+    def default_config(cls) -> AlgorithmConfig:
+        return AlgorithmConfig(algo_class=cls)
+
+    # -- Trainable contract -------------------------------------------------
+
+    def setup(self, config) -> None:
+        cfg = self.config
+        if cfg.env is None:
+            raise ValueError("config.environment(env=...) is required")
+        env = cfg.env
+        if isinstance(env, str) and cfg.env_config:
+            env_id, env_kwargs = env, dict(cfg.env_config)
+            env = _EnvFactory(env_id, env_kwargs)
+        self._env_factory = env
+        spec = spec_from_env(env)
+        self.module_spec = RLModuleSpec(
+            obs_dim=spec.obs_dim,
+            action_dim=spec.action_dim,
+            continuous=spec.continuous,
+            hidden=tuple(cfg.model.get("hidden", (256, 256))),
+            dueling=cfg.model.get("dueling", False),
+            model_cls=self.module_class,
+        )
+        self.env_runner_group = EnvRunnerGroup(
+            env,
+            self.module_spec,
+            num_env_runners=cfg.num_env_runners,
+            num_envs_per_runner=cfg.num_envs_per_env_runner,
+            seed=cfg.seed,
+        )
+        self.build_components()
+
+    def build_components(self) -> None:
+        raise NotImplementedError
+
+    def training_step(self) -> dict:
+        raise NotImplementedError
+
+    def step(self) -> dict:
+        # train() is inherited from Trainable (same controller contract)
+        metrics = self.training_step() or {}
+        metrics.update(self.env_runner_group.metrics())
+        metrics["num_env_steps_sampled_lifetime"] = self._timesteps
+        return metrics
+
+    def save_checkpoint(self) -> dict:
+        return {
+            "learner": self.learner_group.get_state(),
+            "iteration": self.iteration,
+            "timesteps": self._timesteps,
+            "config": self.config.to_dict(),
+        }
+
+    def load_checkpoint(self, state: dict) -> None:
+        self.learner_group.set_state(state["learner"])
+        self.iteration = state["iteration"]
+        self._timesteps = state["timesteps"]
+
+    # reference names (Checkpointable mixin)
+    def get_state(self) -> dict:
+        return self.save_checkpoint()
+
+    def set_state(self, state: dict) -> None:
+        self.load_checkpoint(state)
+
+    def cleanup(self) -> None:
+        self.env_runner_group.stop()
+
+    stop = cleanup
+
+    # -- helpers shared by algorithms --------------------------------------
+
+    @staticmethod
+    def concat_rollouts(rollouts: list[dict]) -> dict:
+        """Merge per-runner [T, B, ...] rollouts along the env axis."""
+        out = {}
+        for k in rollouts[0]:
+            out[k] = (
+                np.concatenate([r[k] for r in rollouts], axis=0)
+                if k == "final_obs"
+                else np.concatenate([r[k] for r in rollouts], axis=1)
+            )
+        return out

@@ -1,0 +1,5 @@
+from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig
+from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig
+
+__all__ = ["PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig"]

@@ -1,0 +1,224 @@
+"""DQN: deep Q-learning with target network + optional double/dueling/PER.
+
+Reference analog: rllib/algorithms/dqn/ (DQN rainbow-lite: double-Q,
+dueling heads, prioritized replay, n-step). The Q update (gather →
+target max → Huber → adam → periodic target sync via lax.cond on the
+step counter) is one jitted program; replay stays in host numpy
+(replay.py) and ships one contiguous batch per step to the device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.replay import PrioritizedReplayBuffer, ReplayBuffer
+from ray_tpu.rl.module import MLPModule, RLModuleSpec
+
+
+class DQNModule(MLPModule):
+    """Q-network: epsilon-greedy exploration driven by an `_epsilon` leaf
+    the algorithm injects into the sampling params each round."""
+
+    def explore(self, params, obs, key):
+        out = self.forward(params, obs)
+        q = out["action_dist_inputs"]
+        greedy = jnp.argmax(q, axis=-1)
+        eps = params["_epsilon"] if "_epsilon" in params else jnp.float32(0.0)
+        k_act, k_mask = jax.random.split(key)
+        rand = jax.random.randint(k_act, greedy.shape, 0, q.shape[-1])
+        acts = jnp.where(jax.random.uniform(k_mask, greedy.shape) < eps, rand, greedy)
+        return acts, jnp.zeros(greedy.shape, jnp.float32), out["vf"]
+
+    def forward(self, params, obs):
+        # drop the exploration leaf before the net sees the tree
+        return super().forward(
+            {k: v for k, v in params.items() if k != "_epsilon"}, obs
+        )
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=DQN)
+        self.lr = 5e-4
+        self.replay_capacity = 50_000
+        self.learning_starts = 1000
+        self.target_update_freq = 500  # in learner steps
+        self.double_q = True
+        self.dueling = False
+        self.prioritized_replay = False
+        self.epsilon_initial = 1.0
+        self.epsilon_final = 0.05
+        self.epsilon_timesteps = 10_000
+        self.n_step = 1
+        self.train_batch_size = 64
+        self.rollout_fragment_length = 4
+        self.train_intensity = 1  # learner steps per sampling round
+
+    def training(self, **kwargs):
+        for k in (
+            "replay_capacity", "learning_starts", "target_update_freq", "double_q",
+            "dueling", "prioritized_replay", "epsilon_initial", "epsilon_final",
+            "epsilon_timesteps", "n_step", "train_intensity",
+        ):
+            if k in kwargs:
+                setattr(self, k, kwargs.pop(k))
+        return super().training(**kwargs)
+
+
+class DQN(Algorithm):
+    module_class = DQNModule
+
+    @classmethod
+    def default_config(cls) -> DQNConfig:
+        return DQNConfig()
+
+    def setup(self, config) -> None:
+        cfg = self.config
+        cfg.model = dict(cfg.model, dueling=cfg.dueling)
+        super().setup(config)
+
+    def build_components(self) -> None:
+        cfg = self.config
+        if self.module_spec.continuous:
+            raise ValueError("DQN requires a discrete action space")
+        module = self.module_spec.build()
+        self.module = module
+        self.optimizer = optax.adam(cfg.lr)
+        self.params = module.init(jax.random.key(cfg.seed))
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self.opt_state = self.optimizer.init(self.params)
+        self.key = jax.random.key(cfg.seed + 17)
+        if cfg.prioritized_replay:
+            self.replay = PrioritizedReplayBuffer(cfg.replay_capacity, seed=cfg.seed)
+        else:
+            self.replay = ReplayBuffer(cfg.replay_capacity, seed=cfg.seed)
+        self._learn_steps = 0
+        self._build_update()
+        self.learner_group = _DQNLearnerShim(self)
+
+    def _build_update(self):
+        cfg = self.config
+        gamma_n = cfg.gamma**cfg.n_step
+        double_q = cfg.double_q
+        module = self.module
+        sync_every = cfg.target_update_freq
+
+        def q_of(params, obs):
+            return module.forward(params, obs)["action_dist_inputs"]
+
+        @jax.jit
+        def update(params, target_params, opt_state, batch, step):
+            def loss_fn(p):
+                q = q_of(p, batch["obs"])
+                q_sa = jnp.take_along_axis(q, batch["actions"][:, None], 1)[:, 0]
+                q_next_t = q_of(target_params, batch["next_obs"])
+                if double_q:
+                    # argmax under online net, value under target net
+                    best = jnp.argmax(q_of(p, batch["next_obs"]), axis=1)
+                    q_next = jnp.take_along_axis(q_next_t, best[:, None], 1)[:, 0]
+                else:
+                    q_next = q_next_t.max(axis=1)
+                target = batch["rewards"] + gamma_n * q_next * (1.0 - batch["terminateds"])
+                td = q_sa - jax.lax.stop_gradient(target)
+                huber = optax.huber_loss(td, delta=1.0)
+                w = batch.get("weights", jnp.ones_like(td))
+                return (w * huber).mean(), td
+
+            (loss, td), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            target_params = jax.lax.cond(
+                (step + 1) % sync_every == 0,
+                lambda: jax.tree.map(jnp.copy, params),
+                lambda: target_params,
+            )
+            return params, target_params, opt_state, loss, td
+
+        self._update = update
+        self._q_fn = jax.jit(q_of)
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(1.0, self._timesteps / max(1, cfg.epsilon_timesteps))
+        return cfg.epsilon_initial + frac * (cfg.epsilon_final - cfg.epsilon_initial)
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        # ---- sample: epsilon-greedy; epsilon rides along in the params tree ----
+        sample_params = dict(self.params, _epsilon=jnp.float32(self._epsilon()))
+        rollouts = self.env_runner_group.sample(sample_params, cfg.rollout_fragment_length)
+        batch = self.concat_rollouts(rollouts)
+        self._add_transitions(batch)
+        metrics = {"epsilon": self._epsilon(), "replay_size": len(self.replay)}
+        if len(self.replay) < cfg.learning_starts:
+            return metrics
+        # ---- learn ----
+        for _ in range(cfg.train_intensity):
+            if cfg.prioritized_replay:
+                mb = self.replay.sample(cfg.train_batch_size)
+                idx = mb.pop("idx")
+            else:
+                mb = self.replay.sample(cfg.train_batch_size)
+                idx = None
+            dev = {k: jnp.asarray(v) for k, v in mb.items()}
+            self.params, self.target_params, self.opt_state, loss, td = self._update(
+                self.params, self.target_params, self.opt_state, dev, self._learn_steps
+            )
+            self._learn_steps += 1
+            if idx is not None:
+                self.replay.update_priorities(idx, np.asarray(td))
+            metrics["loss"] = float(loss)
+        metrics["learn_steps"] = self._learn_steps
+        return metrics
+
+    def _add_transitions(self, batch: dict) -> None:
+        """Flatten [T, B] rollouts to n-step transitions in the replay buffer."""
+        cfg = self.config
+        T, B = batch["rewards"].shape
+        n = cfg.n_step
+        obs_seq = np.concatenate([batch["obs"], batch["final_obs"][None]], axis=0)
+        self._timesteps += T * B
+        rows = []
+        for t in range(T - n + 1):
+            rew = np.zeros(B, np.float32)
+            done = np.zeros(B, bool)
+            for k in range(n):
+                rew += (cfg.gamma**k) * batch["rewards"][t + k] * ~done
+                done |= batch["terminateds"][t + k] | batch["truncateds"][t + k]
+            rows.append(
+                {
+                    "obs": batch["obs"][t].reshape(B, -1),
+                    "actions": batch["actions"][t],
+                    "rewards": rew,
+                    "next_obs": obs_seq[t + n].reshape(B, -1),
+                    "terminateds": batch["terminateds"][t + n - 1].astype(np.float32),
+                }
+            )
+        flat = {k: np.concatenate([r[k] for r in rows]) for k in rows[0]}
+        self.replay.add_batch(flat)
+
+
+class _DQNLearnerShim:
+    def __init__(self, algo: DQN):
+        self.algo = algo
+
+    def get_state(self) -> dict:
+        a = self.algo
+        return {
+            "params": jax.device_get(a.params),
+            "target_params": jax.device_get(a.target_params),
+            "opt_state": jax.device_get(a.opt_state),
+            "steps": a._learn_steps,
+        }
+
+    def set_state(self, state: dict) -> None:
+        a = self.algo
+        a.params = jax.device_put(state["params"])
+        a.target_params = jax.device_put(state["target_params"])
+        a.opt_state = jax.device_put(state["opt_state"])
+        a._learn_steps = state["steps"]

@@ -1,0 +1,124 @@
+"""IMPALA: asynchronous actor-learner with V-trace correction.
+
+Reference analog: rllib/algorithms/impala/impala.py:568 training_step
+(async EnvRunner sampling → aggregator actors → learner group; V-trace
+in vtrace.py). TPU-first shape: env runners sample asynchronously with
+slightly stale weights (the off-policy gap V-trace corrects); the
+learner consumes whatever rollout refs have landed each step, and the
+V-trace recurrence + update is one jitted program. Aggregation is the
+object-store `wait` loop — no dedicated aggregator actor tier needed at
+this scale because batches stage in host RAM, not GPU.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.core import api
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.learner import LearnerGroup
+from ray_tpu.rl.postprocessing import compute_vtrace
+from ray_tpu.rl.module import RLModuleSpec
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=IMPALA)
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.clip_rho_threshold = 1.0
+        self.clip_c_threshold = 1.0
+        self.rollout_fragment_length = 32
+        self.num_epochs = 1
+
+    def training(self, **kwargs):
+        for k in ("vf_loss_coeff", "entropy_coeff", "clip_rho_threshold", "clip_c_threshold"):
+            if k in kwargs:
+                setattr(self, k, kwargs.pop(k))
+        return super().training(**kwargs)
+
+
+class IMPALA(Algorithm):
+    @classmethod
+    def default_config(cls) -> IMPALAConfig:
+        return IMPALAConfig()
+
+    def build_components(self) -> None:
+        cfg = self.config
+        module = self.module_spec.build()
+        self.module = module
+        gamma = cfg.gamma
+        rho, c = cfg.clip_rho_threshold, cfg.clip_c_threshold
+        vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
+
+        def loss_fn(params, batch, _key):
+            # batch is time-major [T, B, ...]
+            out = module.forward(params, batch["obs"])
+            target_logp = module.dist.logp(out["action_dist_inputs"], batch["actions"])
+            # targets must be gradient-free (reference vtrace computes them
+            # outside the tape) — stop final_vf too, not just values/logp
+            final_vf = jax.lax.stop_gradient(
+                module.forward(params, batch["final_obs"])["vf"]
+            )
+            vs, pg_adv = compute_vtrace(
+                batch["logp"],
+                jax.lax.stop_gradient(target_logp),
+                batch["rewards"],
+                jax.lax.stop_gradient(out["vf"]),
+                final_vf,
+                batch["terminateds"],
+                gamma=gamma,
+                clip_rho=rho,
+                clip_c=c,
+            )
+            pg_loss = -(target_logp * pg_adv).mean()
+            vf_loss = 0.5 * jnp.square(out["vf"] - vs).mean()
+            entropy = module.dist.entropy(out["action_dist_inputs"]).mean()
+            loss = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            return loss, {"policy_loss": pg_loss, "vf_loss": vf_loss, "entropy": entropy}
+
+        self.learner_group = LearnerGroup(
+            self.module_spec,
+            loss_fn,
+            num_learners=cfg.num_learners,
+            lr=cfg.lr,
+            grad_clip=cfg.grad_clip,
+            seed=cfg.seed,
+            # time-major batches: shard the env axis (1), keep T local for scans
+            batch_axis=lambda name, leaf: 0 if name == "final_obs" else min(1, leaf.ndim - 1),
+        )
+        self._inflight: list = []
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        # Host snapshot: the learner's device buffers get donated each update,
+        # and runner actors are CPU-side anyway (multi-host ships bytes too).
+        params = jax.device_get(self.learner_group.params)
+        # Keep every runner busy: top up the in-flight sample set, then
+        # consume whichever rollouts are ready (async actor-learner loop).
+        want = max(1, cfg.num_env_runners)
+        while len(self._inflight) < want:
+            self._inflight.extend(
+                self.env_runner_group.sample_async(params, cfg.rollout_fragment_length)
+            )
+        ready, self._inflight = api.wait(
+            self._inflight, num_returns=max(1, len(self._inflight) // 2), timeout=30.0
+        )
+        rollouts = api.get(list(ready))
+        metrics = {}
+        for r in rollouts:
+            T, B = r["rewards"].shape
+            self._timesteps += T * B
+            batch = {
+                "obs": r["obs"],
+                "actions": r["actions"],
+                "logp": r["logp"],
+                "rewards": r["rewards"],
+                "terminateds": r["terminateds"].astype(np.float32),
+                "final_obs": r["final_obs"],
+            }
+            metrics = self.learner_group.update(batch)
+        return metrics

@@ -1,0 +1,178 @@
+"""PPO: clipped-surrogate policy optimization.
+
+Reference analog: rllib/algorithms/ppo/ppo.py:388 (training_step:
+sample → GAE connector → minibatch-epochs learner update). TPU-first
+shape: GAE runs as a jitted scan (postprocessing.py); the epoch/
+minibatch sweep is ONE compiled program — `lax.scan` over shuffled
+minibatch slices inside jit — so a whole PPO update is a single device
+dispatch instead of epochs×minibatches separate steps.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.postprocessing import compute_gae
+from ray_tpu.rl.module import RLModuleSpec
+
+
+class PPOConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=PPO)
+        self.lam = 0.95
+        self.clip_param = 0.2
+        self.vf_clip_param = 10.0
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.0
+        self.rollout_fragment_length = 64
+
+    def training(self, **kwargs):
+        for k in ("lam", "clip_param", "vf_clip_param", "vf_loss_coeff", "entropy_coeff"):
+            if k in kwargs:
+                setattr(self, k, kwargs.pop(k))
+        return super().training(**kwargs)
+
+
+class PPO(Algorithm):
+    @classmethod
+    def default_config(cls) -> PPOConfig:
+        return PPOConfig()
+
+    def build_components(self) -> None:
+        cfg = self.config
+        module = self.module_spec.build()
+        self.module = module
+        self._value_fn = jax.jit(lambda p, o: module.forward(p, o)["vf"])
+
+        clip, vf_clip = cfg.clip_param, cfg.vf_clip_param
+        vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
+
+        def loss_fn(params, mb, _key):
+            out = module.forward(params, mb["obs"])
+            logp = module.dist.logp(out["action_dist_inputs"], mb["actions"])
+            ratio = jnp.exp(logp - mb["logp"])
+            adv = mb["advantages"]
+            adv = (adv - adv.mean()) / (adv.std() + 1e-8)
+            surr = jnp.minimum(
+                ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv
+            )
+            # clipped value loss (reference ppo_torch_learner vf clipping)
+            vf = out["vf"]
+            vf_err = jnp.square(vf - mb["value_targets"])
+            vf_clipped = mb["vf_old"] + jnp.clip(vf - mb["vf_old"], -vf_clip, vf_clip)
+            vf_err = jnp.maximum(vf_err, jnp.square(vf_clipped - mb["value_targets"]))
+            entropy = module.dist.entropy(out["action_dist_inputs"])
+            loss = (
+                -surr.mean() + vf_coeff * 0.5 * vf_err.mean() - ent_coeff * entropy.mean()
+            )
+            return loss, {
+                "policy_loss": -surr.mean(),
+                "vf_loss": vf_err.mean(),
+                "entropy": entropy.mean(),
+                "kl": (mb["logp"] - logp).mean(),
+            }
+
+        self.optimizer = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip), optax.adam(cfg.lr)
+        )
+        self.params = module.init(jax.random.key(cfg.seed))
+        self.opt_state = self.optimizer.init(self.params)
+        self.key = jax.random.key(cfg.seed + 17)
+        self._update = self._compile_update(loss_fn)
+        # the Algorithm checkpoint contract expects a learner_group-shaped state
+        self.learner_group = _PPOLearnerShim(self)
+
+    def _compile_update(self, loss_fn):
+        cfg = self.config
+        epochs = cfg.num_epochs
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def epoch_body(carry, key_e):
+            params, opt_state, batch = carry
+            n = batch["obs"].shape[0]  # static at trace time
+            # honor the configured minibatch size against the ACTUAL batch
+            # (rollout_fragment_length * total envs), not train_batch_size
+            n_mb = max(1, n // cfg.minibatch_size)
+            perm = jax.random.permutation(key_e, n)
+            shuffled = jax.tree.map(lambda x: x[perm], batch)
+            mbs = jax.tree.map(
+                lambda x: x[: (n // n_mb) * n_mb].reshape(n_mb, n // n_mb, *x.shape[1:]),
+                shuffled,
+            )
+
+            def mb_body(c, mb):
+                params, opt_state = c
+                (loss, aux), grads = grad_fn(params, mb, None)
+                updates, opt_state = self.optimizer.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
+                return (params, opt_state), dict(aux, total_loss=loss)
+
+            (params, opt_state), metrics = jax.lax.scan(mb_body, (params, opt_state), mbs)
+            return (params, opt_state, batch), metrics
+
+        @jax.jit
+        def update(params, opt_state, batch, key):
+            keys = jax.random.split(key, epochs)
+            (params, opt_state, _), metrics = jax.lax.scan(
+                epoch_body, (params, opt_state, batch), keys
+            )
+            return params, opt_state, jax.tree.map(lambda m: m.mean(), metrics)
+
+        return update
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        rollouts = self.env_runner_group.sample(self.params, cfg.rollout_fragment_length)
+        batch = self.concat_rollouts(rollouts)
+        T, B = batch["rewards"].shape
+        self._timesteps += T * B
+
+        final_vf = self._value_fn(self.params, batch["final_obs"])
+        advs, targets = compute_gae(
+            jnp.asarray(batch["rewards"]),
+            jnp.asarray(batch["vf"]),
+            final_vf,
+            jnp.asarray(batch["terminateds"]),
+            jnp.asarray(batch["truncateds"]),
+            gamma=cfg.gamma,
+            lam=cfg.lam,
+        )
+        flat = {
+            "obs": batch["obs"].reshape(T * B, -1),
+            "actions": batch["actions"].reshape(T * B, *batch["actions"].shape[2:]),
+            "logp": batch["logp"].reshape(T * B),
+            "vf_old": batch["vf"].reshape(T * B),
+            "advantages": np.asarray(advs).reshape(T * B),
+            "value_targets": np.asarray(targets).reshape(T * B),
+        }
+        flat = {k: jnp.asarray(v) for k, v in flat.items()}
+        self.key, k = jax.random.split(self.key)
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, flat, k
+        )
+        return {k2: float(v) for k2, v in metrics.items()}
+
+
+class _PPOLearnerShim:
+    """Adapts PPO's inlined learner state to the Algorithm checkpoint seam."""
+
+    def __init__(self, algo: PPO):
+        self.algo = algo
+
+    def get_state(self) -> dict:
+        a = self.algo
+        return {
+            "params": jax.device_get(a.params),
+            "opt_state": jax.device_get(a.opt_state),
+            "steps": a.iteration,
+        }
+
+    def set_state(self, state: dict) -> None:
+        a = self.algo
+        a.params = jax.device_put(state["params"])
+        a.opt_state = jax.device_put(state["opt_state"])

@@ -1,0 +1,111 @@
+"""AlgorithmConfig: fluent builder for RL algorithms.
+
+Reference analog: rllib/algorithms/algorithm_config.py (the
+.environment().env_runners().training().build_algo() chain). Kept the
+same surface so reference users can port configs 1:1; fields not
+meaningful on TPU (framework selection, torch compile flags) are gone —
+there is one framework here.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Callable, Optional
+
+
+class AlgorithmConfig:
+    def __init__(self, algo_class: Optional[type] = None):
+        self.algo_class = algo_class
+        # environment
+        self.env: "str | Callable | None" = None
+        self.env_config: dict = {}
+        # env runners
+        self.num_env_runners = 0
+        self.num_envs_per_env_runner = 8
+        self.rollout_fragment_length = 64
+        self.explore = True
+        # training (common)
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.train_batch_size = 512
+        self.minibatch_size = 128
+        self.num_epochs = 4
+        self.grad_clip = 0.5
+        self.model: dict = {"hidden": (256, 256)}
+        # learners
+        self.num_learners = 0
+        # algo-specific knobs land here via .training(**kwargs)
+        self.extra: dict = {}
+        self.seed = 0
+
+    # -- fluent sections (each returns self, reference-style) ---------------
+
+    def environment(self, env=None, *, env_config: Optional[dict] = None):
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = env_config
+        return self
+
+    def env_runners(
+        self,
+        *,
+        num_env_runners: Optional[int] = None,
+        num_envs_per_env_runner: Optional[int] = None,
+        rollout_fragment_length: Optional[int] = None,
+        explore: Optional[bool] = None,
+    ):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        if explore is not None:
+            self.explore = explore
+        return self
+
+    def training(self, **kwargs):
+        for k, v in kwargs.items():
+            if hasattr(self, k) and k != "extra":
+                setattr(self, k, v)
+            else:
+                self.extra[k] = v
+        return self
+
+    def learners(self, *, num_learners: Optional[int] = None):
+        if num_learners is not None:
+            self.num_learners = num_learners
+        return self
+
+    def debugging(self, *, seed: Optional[int] = None):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    # -- build --------------------------------------------------------------
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def to_dict(self) -> dict:
+        d = {k: v for k, v in self.__dict__.items() if k != "algo_class"}
+        return copy.deepcopy(d)
+
+    def update_from_dict(self, d: dict) -> "AlgorithmConfig":
+        for k, v in d.items():
+            if k == "extra":
+                self.extra.update(v)  # round-trips to_dict() output
+            elif hasattr(self, k):
+                setattr(self, k, v)
+            else:
+                self.extra[k] = v
+        return self
+
+    def build_algo(self):
+        if self.algo_class is None:
+            raise ValueError("config has no algo_class; use PPOConfig() etc.")
+        return self.algo_class(config=self)
+
+    # legacy alias (reference keeps both)
+    build = build_algo

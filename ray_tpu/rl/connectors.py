@@ -1,0 +1,96 @@
+"""ConnectorV2-style data pipelines between env, module, and learner.
+
+Reference analog: rllib/connectors/ (env-to-module, module-to-env,
+learner pipelines of ConnectorV2 pieces). Same composition idea, but a
+connector here is a plain callable `batch -> batch` over numpy/jax
+pytrees, and anything numeric enough to matter runs *inside* the
+learner's jitted update instead (e.g. GAE lives in algorithms/, not in
+a Python pipeline) — Python-side connectors only do what must stay
+dynamic: casting, flattening, normalization bookkeeping.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, List
+
+import numpy as np
+
+
+class Connector:
+    """One pipeline piece. Override __call__; state (if any) is instance attrs."""
+
+    def __call__(self, batch: dict) -> dict:
+        raise NotImplementedError
+
+    def state(self) -> dict:
+        return {}
+
+    def set_state(self, state: dict) -> None:
+        pass
+
+
+class ConnectorPipeline(Connector):
+    def __init__(self, pieces: Iterable[Connector] = ()):
+        self.pieces: List[Connector] = list(pieces)
+
+    def __call__(self, batch: dict) -> dict:
+        for p in self.pieces:
+            batch = p(batch)
+        return batch
+
+    def append(self, piece: Connector) -> "ConnectorPipeline":
+        self.pieces.append(piece)
+        return self
+
+    def state(self) -> dict:
+        return {i: p.state() for i, p in enumerate(self.pieces)}
+
+    def set_state(self, state: dict) -> None:
+        for i, p in enumerate(self.pieces):
+            if i in state:
+                p.set_state(state[i])
+
+
+class FlattenObs(Connector):
+    """Flatten [..., *obs_shape] observations to [..., obs_dim] float32."""
+
+    def __call__(self, batch: dict) -> dict:
+        obs = np.asarray(batch["obs"], np.float32)
+        batch["obs"] = obs.reshape(*obs.shape[:1], -1) if obs.ndim > 2 else obs
+        return batch
+
+
+class NormalizeObs(Connector):
+    """Running mean/std observation filter (reference: MeanStdFilter
+    connector, rllib/connectors/env_to_module/mean_std_filter.py)."""
+
+    def __init__(self, eps: float = 1e-8, clip: float = 10.0):
+        self.count = eps
+        self.mean = 0.0
+        self.m2 = 0.0
+        self.eps = eps
+        self.clip = clip
+
+    def __call__(self, batch: dict) -> dict:
+        obs = np.asarray(batch["obs"], np.float32)
+        flat = obs.reshape(-1, obs.shape[-1])
+        # Chan et al. parallel update of running moments.
+        n, mean = flat.shape[0], flat.mean(0)
+        delta = mean - self.mean
+        tot = self.count + n
+        self.m2 = self.m2 + flat.var(0) * n + delta**2 * self.count * n / tot
+        self.mean = self.mean + delta * n / tot
+        self.count = tot
+        std = np.sqrt(self.m2 / self.count) + self.eps
+        batch["obs"] = np.clip((obs - self.mean) / std, -self.clip, self.clip)
+        return batch
+
+    def state(self) -> dict:
+        return {"count": self.count, "mean": self.mean, "m2": self.m2}
+
+    def set_state(self, state: dict) -> None:
+        self.count, self.mean, self.m2 = state["count"], state["mean"], state["m2"]
+
+
+def default_env_to_module() -> ConnectorPipeline:
+    return ConnectorPipeline([FlattenObs()])

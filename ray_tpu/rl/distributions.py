@@ -1,0 +1,83 @@
+"""Action distributions as pure-JAX functions.
+
+Reference analog: rllib/models/distributions.py + torch distribution
+wrappers (rllib/models/torch/torch_distributions.py). Here every
+distribution is a stateless namespace of jittable functions over the
+module's raw outputs (logits / mean+logstd) so the whole sample/logp/
+entropy path stays inside one XLA program on TPU — no framework
+objects cross the jit boundary.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class Categorical:
+    """Distribution over discrete actions, parameterized by logits [..., A]."""
+
+    @staticmethod
+    def sample(key: jax.Array, logits: jax.Array) -> jax.Array:
+        return jax.random.categorical(key, logits, axis=-1)
+
+    @staticmethod
+    def mode(logits: jax.Array) -> jax.Array:
+        return jnp.argmax(logits, axis=-1)
+
+    @staticmethod
+    def logp(logits: jax.Array, actions: jax.Array) -> jax.Array:
+        logp_all = jax.nn.log_softmax(logits, axis=-1)
+        return jnp.take_along_axis(logp_all, actions[..., None], axis=-1)[..., 0]
+
+    @staticmethod
+    def entropy(logits: jax.Array) -> jax.Array:
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+    @staticmethod
+    def kl(logits_p: jax.Array, logits_q: jax.Array) -> jax.Array:
+        logp = jax.nn.log_softmax(logits_p, axis=-1)
+        logq = jax.nn.log_softmax(logits_q, axis=-1)
+        return jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1)
+
+
+class DiagGaussian:
+    """Factored normal over continuous actions; params [..., 2*D] = mean|logstd."""
+
+    @staticmethod
+    def _split(params: jax.Array):
+        mean, log_std = jnp.split(params, 2, axis=-1)
+        return mean, jnp.clip(log_std, -20.0, 2.0)
+
+    @staticmethod
+    def sample(key: jax.Array, params: jax.Array) -> jax.Array:
+        mean, log_std = DiagGaussian._split(params)
+        return mean + jnp.exp(log_std) * jax.random.normal(key, mean.shape)
+
+    @staticmethod
+    def mode(params: jax.Array) -> jax.Array:
+        return DiagGaussian._split(params)[0]
+
+    @staticmethod
+    def logp(params: jax.Array, actions: jax.Array) -> jax.Array:
+        mean, log_std = DiagGaussian._split(params)
+        var = jnp.exp(2 * log_std)
+        ll = -0.5 * ((actions - mean) ** 2 / var + 2 * log_std + jnp.log(2 * jnp.pi))
+        return jnp.sum(ll, axis=-1)
+
+    @staticmethod
+    def entropy(params: jax.Array) -> jax.Array:
+        _, log_std = DiagGaussian._split(params)
+        return jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+
+    @staticmethod
+    def kl(params_p: jax.Array, params_q: jax.Array) -> jax.Array:
+        mp, lp = DiagGaussian._split(params_p)
+        mq, lq = DiagGaussian._split(params_q)
+        vp, vq = jnp.exp(2 * lp), jnp.exp(2 * lq)
+        return jnp.sum(lq - lp + (vp + (mp - mq) ** 2) / (2 * vq) - 0.5, axis=-1)
+
+
+def get_distribution(name: str):
+    return {"categorical": Categorical, "diag_gaussian": DiagGaussian}[name]

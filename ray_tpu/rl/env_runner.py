@@ -1,0 +1,231 @@
+"""EnvRunner: vectorized rollout collection actors.
+
+Reference analog: rllib/env/single_agent_env_runner.py:66
+(SingleAgentEnvRunner over gym vector envs) and env_runner_group.py:71
+(EnvRunnerGroup of remote actors). TPU-first notes: the policy step is
+one jitted `explore` program — obs batch in, actions/logp/vf out — so a
+runner does exactly one device dispatch per env step regardless of
+num_envs; rollouts are returned time-major [T, B, ...] numpy so the
+learner can reshape/shard them straight onto the mesh batch axis.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+from ray_tpu.core import api
+from ray_tpu.rl.connectors import ConnectorPipeline, default_env_to_module
+from ray_tpu.rl.module import RLModuleSpec
+
+
+def make_env(env: "str | Callable", num_envs: int, seed: int):
+    import gymnasium as gym
+    from gymnasium.vector import AutoresetMode
+
+    # SAME_STEP autoreset: the step that reports done also returns the new
+    # episode's first obs, so every stored transition is a real one (gymnasium
+    # 1.x defaults to NEXT_STEP, which burns one garbage step per episode —
+    # action ignored, reward 0 — and would poison rollouts and replay).
+    if callable(env):
+        return gym.vector.SyncVectorEnv(
+            [lambda i=i: env() for i in range(num_envs)],
+            autoreset_mode=AutoresetMode.SAME_STEP,
+        )
+    return gym.make_vec(
+        env,
+        num_envs=num_envs,
+        vectorization_mode="sync",
+        vector_kwargs={"autoreset_mode": AutoresetMode.SAME_STEP},
+    )
+
+
+def spec_from_env(env: "str | Callable") -> RLModuleSpec:
+    """Derive obs/action dims by constructing one throwaway env instance."""
+    import gymnasium as gym
+
+    e = env() if callable(env) else gym.make(env)
+    try:
+        obs_dim = int(np.prod(e.observation_space.shape))
+        if hasattr(e.action_space, "n"):
+            return RLModuleSpec(obs_dim=obs_dim, action_dim=int(e.action_space.n))
+        return RLModuleSpec(
+            obs_dim=obs_dim,
+            action_dim=int(np.prod(e.action_space.shape)),
+            continuous=True,
+        )
+    finally:
+        e.close()
+
+
+class SingleAgentEnvRunner:
+    """Collects rollouts from a vector env with the current policy weights.
+
+    Used directly (local mode) or wrapped in an actor by EnvRunnerGroup.
+    """
+
+    def __init__(
+        self,
+        env: "str | Callable",
+        module_spec: RLModuleSpec,
+        *,
+        num_envs: int = 8,
+        seed: int = 0,
+        explore: bool = True,
+        connector: Optional[ConnectorPipeline] = None,
+    ):
+        self.envs = make_env(env, num_envs, seed)
+        self.num_envs = num_envs
+        self.module = module_spec.build()
+        self.connector = connector or default_env_to_module()
+        self.explore = explore
+        self.key = jax.random.key(seed + 1)
+        # One compiled program services every env step this runner takes.
+        self._explore_fn = jax.jit(self.module.explore)
+        self._infer_fn = jax.jit(self.module.inference)
+        obs, _ = self.envs.reset(seed=seed)
+        self.obs = self.connector({"obs": obs})["obs"]
+        self._ep_ret = np.zeros(num_envs)
+        self._ep_len = np.zeros(num_envs, np.int64)
+        self._num_episodes = 0
+        self._done_returns: list[float] = []
+        self._done_lengths: list[int] = []
+
+    def sample(self, params, rollout_len: int) -> dict:
+        """Collect [T=rollout_len, B=num_envs] transitions, time-major."""
+        T, B = rollout_len, self.num_envs
+        cols = {
+            "obs": np.empty((T, B) + self.obs.shape[1:], np.float32),
+            "actions": None,
+            "logp": np.empty((T, B), np.float32),
+            "vf": np.empty((T, B), np.float32),
+            "rewards": np.empty((T, B), np.float32),
+            # terminated: true episode end (bootstrap 0); truncated: time limit
+            "terminateds": np.empty((T, B), bool),
+            "truncateds": np.empty((T, B), bool),
+        }
+        for t in range(T):
+            self.key, k = jax.random.split(self.key)
+            if self.explore:
+                acts, logp, vf = self._explore_fn(params, self.obs, k)
+            else:
+                acts = self._infer_fn(params, self.obs)
+                logp = vf = np.zeros((B,), np.float32)
+            acts = np.asarray(acts)
+            nxt, rew, term, trunc, _ = self.envs.step(acts)
+            nxt = self.connector({"obs": nxt})["obs"]
+            if cols["actions"] is None:
+                cols["actions"] = np.empty((T,) + acts.shape, acts.dtype)
+            cols["obs"][t] = self.obs
+            cols["actions"][t] = acts
+            cols["logp"][t] = np.asarray(logp)
+            cols["vf"][t] = np.asarray(vf)
+            cols["rewards"][t] = rew
+            cols["terminateds"][t] = term
+            cols["truncateds"][t] = trunc
+            self._track_episodes(rew, term | trunc)
+            self.obs = nxt
+        cols["final_obs"] = self.obs.copy()  # bootstrap value at rollout end
+        return cols
+
+    def _track_episodes(self, rew, done):
+        self._ep_ret += rew
+        self._ep_len += 1
+        for i in np.flatnonzero(done):
+            self._num_episodes += 1
+            self._done_returns.append(float(self._ep_ret[i]))
+            self._done_lengths.append(int(self._ep_len[i]))
+            self._ep_ret[i] = 0.0
+            self._ep_len[i] = 0
+        # bounded window (long runs finish millions of episodes)
+        if len(self._done_returns) > 500:
+            del self._done_returns[:-100]
+            del self._done_lengths[:-100]
+
+    def metrics(self) -> dict:
+        """Windowed per-episode stats (reference: MetricsLogger episode returns).
+        num_episodes is the lifetime count; means are over the last <=100."""
+        rets, lens = self._done_returns[-100:], self._done_lengths[-100:]
+        out = {
+            "num_episodes": self._num_episodes,
+            "episode_return_mean": float(np.mean(rets)) if rets else float("nan"),
+            "episode_len_mean": float(np.mean(lens)) if lens else float("nan"),
+        }
+        return out
+
+    def get_connector_state(self) -> dict:
+        return self.connector.state()
+
+    def set_connector_state(self, state: dict) -> bool:
+        self.connector.set_state(state)
+        return True
+
+    def stop(self):
+        self.envs.close()
+        return True
+
+
+class EnvRunnerGroup:
+    """N env-runner actors + a sync/sample fan-out API (reference:
+    rllib/env/env_runner_group.py:71)."""
+
+    def __init__(
+        self,
+        env: "str | Callable",
+        module_spec: RLModuleSpec,
+        *,
+        num_env_runners: int = 0,
+        num_envs_per_runner: int = 8,
+        seed: int = 0,
+    ):
+        self.num_env_runners = num_env_runners
+        if num_env_runners == 0:
+            self.local = SingleAgentEnvRunner(
+                env, module_spec, num_envs=num_envs_per_runner, seed=seed
+            )
+            self.remotes = []
+        else:
+            self.local = None
+            runner_cls = api.remote(SingleAgentEnvRunner)
+            self.remotes = [
+                runner_cls.remote(
+                    env,
+                    module_spec,
+                    num_envs=num_envs_per_runner,
+                    seed=seed + 1000 * (i + 1),
+                )
+                for i in range(num_env_runners)
+            ]
+
+    def sample(self, params, rollout_len: int) -> list[dict]:
+        if self.local is not None:
+            return [self.local.sample(params, rollout_len)]
+        return api.get([r.sample.remote(params, rollout_len) for r in self.remotes])
+
+    def sample_async(self, params, rollout_len: int):
+        """Fire sample() on every remote runner, return refs (IMPALA path)."""
+        if self.local is not None:
+            return [api.put(self.local.sample(params, rollout_len))]
+        return [r.sample.remote(params, rollout_len) for r in self.remotes]
+
+    def metrics(self) -> dict:
+        if self.local is not None:
+            per = [self.local.metrics()]
+        else:
+            per = api.get([r.metrics.remote() for r in self.remotes])
+        vals = [m["episode_return_mean"] for m in per if m["num_episodes"] > 0]
+        lens = [m["episode_len_mean"] for m in per if m["num_episodes"] > 0]
+        return {
+            "episode_return_mean": float(np.mean(vals)) if vals else float("nan"),
+            "episode_len_mean": float(np.mean(lens)) if lens else float("nan"),
+            "num_episodes": sum(m["num_episodes"] for m in per),
+        }
+
+    def stop(self):
+        if self.local is not None:
+            self.local.stop()
+        else:
+            api.get([r.stop.remote() for r in self.remotes])

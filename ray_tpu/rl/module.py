@@ -1,0 +1,130 @@
+"""RLModule: the neural-net abstraction for RL algorithms.
+
+Reference analog: rllib/core/rl_module/rl_module.py (812 LoC, torch).
+TPU-first redesign: a module is a *functional spec* — `init(key)` builds
+a params pytree, and `forward_*` are pure jittable functions — so env
+runners, learners, and target networks all share one set of weights as
+a pytree that can be donated, sharded with pjit, or shipped across
+hosts without framework object baggage.
+
+Forward has the reference's three entry points (rl_module.py
+forward_inference / forward_exploration / forward_train) collapsed into
+`forward` (deterministic heads) + distribution helpers; algorithms pick
+sampling vs. mode at their call site inside jit.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.nn.layers import init_dense
+from ray_tpu.rl.distributions import get_distribution
+
+
+@dataclass(frozen=True)
+class RLModuleSpec:
+    """Static description of a module; `build()` yields the functional module."""
+
+    obs_dim: int
+    action_dim: int  # num discrete actions, or continuous action size
+    continuous: bool = False
+    hidden: Sequence[int] = (256, 256)
+    dueling: bool = False  # DQN-style value/advantage split of the Q head
+    model_cls: "type[RLModule] | None" = None
+
+    def build(self) -> "RLModule":
+        cls = self.model_cls or MLPModule
+        return cls(self)
+
+
+class RLModule:
+    """Functional policy+value module. Subclass to swap architectures."""
+
+    def __init__(self, spec: RLModuleSpec):
+        self.spec = spec
+        self.dist = get_distribution(
+            "diag_gaussian" if spec.continuous else "categorical"
+        )
+        # Output head width: logits for discrete, mean|logstd for continuous.
+        self.out_dim = spec.action_dim * (2 if spec.continuous else 1)
+
+    # -- override points ----------------------------------------------------
+
+    def init(self, key: jax.Array):
+        raise NotImplementedError
+
+    def forward(self, params, obs: jax.Array) -> dict:
+        """Returns {"action_dist_inputs": [..., out_dim], "vf": [...]}"""
+        raise NotImplementedError
+
+    # -- shared jittable helpers --------------------------------------------
+
+    def explore(self, params, obs, key):
+        """Sample actions + logp for rollout collection (one jit program)."""
+        out = self.forward(params, obs)
+        acts = self.dist.sample(key, out["action_dist_inputs"])
+        logp = self.dist.logp(out["action_dist_inputs"], acts)
+        return acts, logp, out["vf"]
+
+    def inference(self, params, obs):
+        out = self.forward(params, obs)
+        return self.dist.mode(out["action_dist_inputs"])
+
+
+def _mlp_init(key, dims: Sequence[int], dtype=jnp.float32):
+    layers = []
+    for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+        key, k = jax.random.split(key)
+        layers.append(
+            {
+                "w": init_dense(k, (d_in, d_out), dtype),
+                "b": jnp.zeros((d_out,), dtype),
+            }
+        )
+    return layers
+
+
+def _mlp_apply(layers, x, final_activation=False):
+    for i, lyr in enumerate(layers):
+        x = x @ lyr["w"] + lyr["b"]
+        if final_activation or i < len(layers) - 1:
+            x = jax.nn.tanh(x)
+    return x
+
+
+class MLPModule(RLModule):
+    """Default fully-connected torso with separate policy / value heads.
+
+    Mirrors rllib's default MLP encoder+heads catalog output; the value
+    head is always present (costs one extra column of matmul on the MXU,
+    avoids a second spec for value-free algorithms).
+    """
+
+    def init(self, key: jax.Array):
+        s = self.spec
+        k_pi, k_vf = jax.random.split(key)
+        pi_dims = [s.obs_dim, *s.hidden, self.out_dim]
+        vf_dims = [s.obs_dim, *s.hidden, 1]
+        params = {
+            "pi": _mlp_init(k_pi, pi_dims),
+            "vf": _mlp_init(k_vf, vf_dims),
+        }
+        if s.dueling:
+            key, k_adv = jax.random.split(key)
+            params["adv"] = _mlp_init(k_adv, pi_dims)
+        return params
+
+    def forward(self, params, obs: jax.Array) -> dict:
+        out = _mlp_apply(params["pi"], obs)
+        if self.spec.dueling:
+            # Q(s,a) = V(s) + A(s,a) - mean_a A(s,a)
+            adv = _mlp_apply(params["adv"], obs)
+            v = _mlp_apply(params["vf"], obs)
+            out = v + adv - jnp.mean(adv, axis=-1, keepdims=True)
+            return {"action_dist_inputs": out, "vf": v[..., 0]}
+        vf = _mlp_apply(params["vf"], obs)[..., 0]
+        return {"action_dist_inputs": out, "vf": vf}

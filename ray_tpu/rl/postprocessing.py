@@ -1,0 +1,91 @@
+"""Trajectory postprocessing math: GAE and V-trace as jitted scans.
+
+Reference analogs: GAE in rllib (general_advantage_estimation learner
+connector, rllib/connectors/learner/...) and V-trace
+(rllib/algorithms/impala/vtrace.py, from IMPALA, Espeholt et al. 2018).
+Both are reverse-time recurrences — expressed here as `lax.scan` over
+the time axis so they compile into the learner's XLA program instead of
+running as Python/numpy loops on the host.
+
+All inputs are time-major [T, B].
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def compute_gae(
+    rewards: jax.Array,       # [T, B]
+    values: jax.Array,        # [T, B] V(s_t)
+    final_values: jax.Array,  # [B]    V(s_T) bootstrap
+    terminateds: jax.Array,   # [T, B] true episode ends (no bootstrap)
+    truncateds: jax.Array,    # [T, B] time-limit ends (bootstrap through)
+    gamma: float = 0.99,
+    lam: float = 0.95,
+):
+    """Returns (advantages [T, B], value_targets [T, B]).
+
+    delta_t = r_t + gamma * V(s_{t+1}) * (1 - term) - V(s_t)
+    A_t     = delta_t + gamma * lam * (1 - done) * A_{t+1}
+    Truncation cuts the advantage recurrence but keeps the bootstrap.
+    """
+    next_values = jnp.concatenate([values[1:], final_values[None]], axis=0)
+    nonterminal = 1.0 - terminateds.astype(jnp.float32)
+    # At a truncation boundary the stored next_value belongs to the *new*
+    # episode's first obs — without the true final obs per step we stop the
+    # recurrence there (standard practice; bias vanishes as T >> episodes).
+    cut = 1.0 - (terminateds | truncateds).astype(jnp.float32)
+    deltas = rewards + gamma * next_values * nonterminal - values
+
+    def scan_fn(carry, xs):
+        delta, c = xs
+        adv = delta + gamma * lam * c * carry
+        return adv, adv
+
+    _, advs = lax.scan(scan_fn, jnp.zeros_like(final_values), (deltas, cut), reverse=True)
+    return advs, advs + values
+
+
+@jax.jit
+def compute_vtrace(
+    behaviour_logp: jax.Array,  # [T, B] logp of actions under the actor policy
+    target_logp: jax.Array,     # [T, B] logp under the learner policy
+    rewards: jax.Array,         # [T, B]
+    values: jax.Array,          # [T, B] V(s_t) under learner
+    final_values: jax.Array,    # [B]
+    terminateds: jax.Array,     # [T, B]
+    gamma: float = 0.99,
+    clip_rho: float = 1.0,
+    clip_c: float = 1.0,
+):
+    """V-trace targets (IMPALA). Returns (vs [T,B], pg_advantages [T,B]).
+
+    vs_t = V(s_t) + sum_k gamma^k (prod c) rho_k delta_k  via reverse scan:
+    vs_t = V_t + delta_t*rho_t + gamma*c_t*(vs_{t+1} - V_{t+1})
+    """
+    rhos = jnp.exp(target_logp - behaviour_logp)
+    clipped_rhos = jnp.minimum(clip_rho, rhos)
+    cs = jnp.minimum(clip_c, rhos)
+    nonterminal = 1.0 - terminateds.astype(jnp.float32)
+    next_values = jnp.concatenate([values[1:], final_values[None]], axis=0)
+    deltas = clipped_rhos * (rewards + gamma * next_values * nonterminal - values)
+
+    def scan_fn(acc, xs):
+        delta, c, nt = xs
+        acc = delta + gamma * nt * c * acc
+        return acc, acc
+
+    _, vs_minus_v = lax.scan(
+        scan_fn,
+        jnp.zeros_like(final_values),
+        (deltas, cs, nonterminal),
+        reverse=True,
+    )
+    vs = vs_minus_v + values
+    next_vs = jnp.concatenate([vs[1:], final_values[None]], axis=0)
+    pg_adv = clipped_rhos * (rewards + gamma * next_vs * nonterminal - values)
+    return vs, pg_adv

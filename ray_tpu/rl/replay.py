@@ -1,0 +1,79 @@
+"""Replay buffers for off-policy algorithms.
+
+Reference analog: rllib/utils/replay_buffers/ (EpisodeReplayBuffer,
+PrioritizedEpisodeReplayBuffer). Flat numpy ring buffers here — the
+buffer lives on host RAM (HBM is for the learner), and sampling
+produces contiguous batches ready to ship to the device in one
+transfer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+class ReplayBuffer:
+    """Uniform transition ring buffer."""
+
+    def __init__(self, capacity: int, seed: int = 0):
+        self.capacity = capacity
+        self.rng = np.random.default_rng(seed)
+        self._store: Optional[dict] = None
+        self.size = 0
+        self._next = 0
+
+    def add_batch(self, batch: dict) -> None:
+        """Add flat [N, ...] transitions (obs/actions/rewards/next_obs/terminateds)."""
+        n = len(batch["obs"])
+        if self._store is None:
+            self._store = {
+                k: np.empty((self.capacity,) + v.shape[1:], v.dtype)
+                for k, v in batch.items()
+            }
+        idx = (self._next + np.arange(n)) % self.capacity
+        for k, v in batch.items():
+            self._store[k][idx] = v
+        self._next = (self._next + n) % self.capacity
+        self.size = min(self.size + n, self.capacity)
+
+    def sample(self, batch_size: int) -> dict:
+        idx = self.rng.integers(0, self.size, batch_size)
+        return {k: v[idx] for k, v in self._store.items()}
+
+    def __len__(self) -> int:
+        return self.size
+
+
+class PrioritizedReplayBuffer(ReplayBuffer):
+    """Proportional prioritized replay (Schaul et al. 2015) with a flat
+    priority array; O(n) sampling via cumsum — fine for host-side buffers
+    at DQN scales, no sum-tree needed."""
+
+    def __init__(self, capacity: int, alpha: float = 0.6, seed: int = 0):
+        super().__init__(capacity, seed)
+        self.alpha = alpha
+        self._prio = np.zeros(capacity, np.float64)
+        self._max_prio = 1.0
+
+    def add_batch(self, batch: dict) -> None:
+        n = len(batch["obs"])
+        idx = (self._next + np.arange(n)) % self.capacity
+        super().add_batch(batch)
+        self._prio[idx] = self._max_prio**self.alpha
+
+    def sample(self, batch_size: int, beta: float = 0.4) -> dict:
+        p = self._prio[: self.size]
+        probs = p / p.sum()
+        idx = self.rng.choice(self.size, batch_size, p=probs)
+        weights = (self.size * probs[idx]) ** (-beta)
+        out = {k: v[idx] for k, v in self._store.items()}
+        out["weights"] = (weights / weights.max()).astype(np.float32)
+        out["idx"] = idx
+        return out
+
+    def update_priorities(self, idx: np.ndarray, td_errors: np.ndarray) -> None:
+        prio = np.abs(td_errors) + 1e-6
+        self._prio[idx] = prio**self.alpha
+        self._max_prio = max(self._max_prio, float(prio.max()))

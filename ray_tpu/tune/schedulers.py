@@ -32,8 +32,8 @@ class AsyncHyperBandScheduler(TrialScheduler):
 
     def __init__(
         self,
-        metric: str = "loss",
-        mode: str = "min",
+        metric: "str | None" = None,
+        mode: "str | None" = None,
         max_t: int = 100,
         grace_period: int = 1,
         reduction_factor: float = 4,
@@ -54,7 +54,7 @@ class AsyncHyperBandScheduler(TrialScheduler):
             r *= reduction_factor
 
     def _better(self, v: float, cutoff: float) -> bool:
-        return v <= cutoff if self.mode == "min" else v >= cutoff
+        return v <= cutoff if self.mode != "max" else v >= cutoff
 
     def on_result(self, trial, result: dict) -> str:
         t = result.get(self.time_attr, 0)
@@ -74,7 +74,7 @@ class AsyncHyperBandScheduler(TrialScheduler):
                     return CONTINUE  # not enough data to cut yet
                 q = (
                     np.percentile(recorded, 100 / self.rf)
-                    if self.mode == "min"
+                    if self.mode != "max"  # same predicate as _better
                     else np.percentile(recorded, 100 * (1 - 1 / self.rf))
                 )
                 return CONTINUE if self._better(float(v), float(q)) else STOP
@@ -87,8 +87,8 @@ class MedianStoppingRule(TrialScheduler):
 
     def __init__(
         self,
-        metric: str = "loss",
-        mode: str = "min",
+        metric: "str | None" = None,
+        mode: "str | None" = None,
         grace_period: int = 1,
         min_samples_required: int = 3,
         time_attr: str = "training_iteration",
@@ -113,7 +113,7 @@ class MedianStoppingRule(TrialScheduler):
         if len(others) < self.min_samples - 1:
             return CONTINUE
         med = float(np.median(others))
-        ok = my_avg <= med if self.mode == "min" else my_avg >= med
+        ok = my_avg <= med if self.mode != "max" else my_avg >= med
         return CONTINUE if ok else STOP
 
 
@@ -126,8 +126,8 @@ class PopulationBasedTraining(TrialScheduler):
 
     def __init__(
         self,
-        metric: str = "loss",
-        mode: str = "min",
+        metric: "str | None" = None,
+        mode: "str | None" = None,
         perturbation_interval: int = 5,
         hyperparam_mutations: Optional[dict] = None,
         quantile_fraction: float = 0.25,

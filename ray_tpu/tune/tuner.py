@@ -202,8 +202,13 @@ class Tuner:
 
     def _launch(self, trial: Trial) -> _RunningTrial:
         trial.status = "RUNNING"
-        resources = getattr(self._trainable, "__ray_tpu_resources__", None) or {}
-        opts = {"num_cpus": resources.get("CPU", 0)}
+        resources = dict(getattr(self._trainable, "__ray_tpu_resources__", None) or {})
+        opts = {
+            "num_cpus": resources.pop("CPU", 0),
+            "num_tpus": resources.pop("TPU", 0),
+        }
+        if resources:
+            opts["resources"] = resources  # custom resources pass through
         if isinstance(self._trainable, type) and issubclass(self._trainable, Trainable):
             actor = _ClassTrialRunner.options(**opts).remote(self._trainable, trial.config)
             rt = _RunningTrial(trial, "class", actor)
@@ -244,6 +249,11 @@ class Tuner:
     def _exploit(self, rt: _RunningTrial, src_id: str):
         src = self._running.get(src_id)
         if src is None or src.kind != "class" or rt.kind != "class":
+            logger.warning(
+                "PBT exploit dropped for %s (src=%s): exploits need class "
+                "trainables with the source trial still running",
+                rt.trial.trial_id, src_id,
+            )
             return
         scheduler = self._cfg.scheduler
         new_config = scheduler.perturb(src.trial.config)
@@ -266,8 +276,10 @@ class Tuner:
             self._space, num_samples=cfg.num_samples, seed=cfg.seed
         )
         scheduler = cfg.scheduler
-        if hasattr(scheduler, "metric") and scheduler.metric is None and cfg.metric:
-            scheduler.metric = cfg.metric
+        if hasattr(scheduler, "metric") and scheduler.metric is None:
+            scheduler.metric = cfg.metric or "loss"
+        if hasattr(scheduler, "mode") and scheduler.mode is None:
+            scheduler.mode = cfg.mode or "min"
         max_conc = cfg.max_concurrent_trials or 8
 
         trials: list[Trial] = []
@@ -277,7 +289,8 @@ class Tuner:
         while True:
             # launch up to the concurrency cap
             while not exhausted and len(self._running) < max_conc:
-                config = searcher.suggest(f"t{len(trials)}")
+                sid = f"t{len(trials)}"
+                config = searcher.suggest(sid)
                 if config is None:
                     exhausted = True
                     break
@@ -286,6 +299,9 @@ class Tuner:
                 trial = Trial(config)
                 trials.append(trial)
                 rt = self._launch(trial)
+                # the id the searcher knows this trial by (ConcurrencyLimiter
+                # tracks liveness per suggest id)
+                rt.search_id = sid
                 self._running[trial.trial_id] = rt
 
             if not self._running:
@@ -314,20 +330,20 @@ class Tuner:
         except Exception as e:  # noqa: BLE001 - trial failure
             self._finish(rt, "ERROR", e)
             scheduler.on_complete(rt.trial)
-            searcher.on_trial_complete(tid, None)
+            searcher.on_trial_complete(getattr(rt, "search_id", tid), None)
             del self._running[tid]
             return True
         decision = self._handle_result(rt, metrics, scheduler)
         if decision == STOP:
             self._finish(rt, "TERMINATED")
             scheduler.on_complete(rt.trial)
-            searcher.on_trial_complete(tid, metrics)
+            searcher.on_trial_complete(getattr(rt, "search_id", tid), metrics)
             del self._running[tid]
         else:
             rt.step_ref = rt.actor.train.remote()
         return True
 
-    def _poll_fn_trial(self, tid, rt, scheduler, searcher) -> bool:
+    def _drain_reports(self, rt: _RunningTrial, scheduler) -> bool:
         progressed = False
         try:
             while True:
@@ -343,15 +359,22 @@ class Tuner:
                     rt.stop_event.set()
         except queue.Empty:
             pass
+        return progressed
+
+    def _poll_fn_trial(self, tid, rt, scheduler, searcher) -> bool:
+        progressed = self._drain_reports(rt, scheduler)
         ready, _ = api.wait([rt.run_ref], num_returns=1, timeout=0)
         if ready:
+            # re-drain: reports enqueued between the drain above and the
+            # run finishing would otherwise be lost with the trial
+            self._drain_reports(rt, scheduler)
             try:
                 api.get(rt.run_ref)
                 self._finish(rt, "TERMINATED")
             except Exception as e:  # noqa: BLE001
                 self._finish(rt, "ERROR", e)
             scheduler.on_complete(rt.trial)
-            searcher.on_trial_complete(tid, rt.trial.last_result or None)
+            searcher.on_trial_complete(getattr(rt, "search_id", tid), rt.trial.last_result or None)
             del self._running[tid]
             progressed = True
         return progressed

@@ -266,3 +266,47 @@ def test_tuner_with_jax_train_loop():
     ).fit()
     best = grid.get_best_result()
     assert best.metrics["loss"] < 0.1
+
+
+def test_concurrency_limiter_completes():
+    """Regression: searcher completion must use the suggest id, or the
+    limiter's live-set never drains and fit() spins forever."""
+    from ray_tpu.tune.search import BasicVariantGenerator, ConcurrencyLimiter
+
+    def train_fn(config):
+        tune.report({"loss": config["x"]})
+
+    searcher = ConcurrencyLimiter(
+        BasicVariantGenerator({"x": tune.grid_search([1.0, 2.0, 3.0, 4.0])}),
+        max_concurrent=2,
+    )
+    grid = tune.Tuner(
+        train_fn,
+        tune_config=tune.TuneConfig(metric="loss", mode="min", search_alg=searcher),
+    ).fit()
+    assert len(grid) == 4
+    assert not searcher._live
+
+
+def test_scheduler_inherits_tuneconfig_metric():
+    """Regression: ASHA built without an explicit metric must judge on
+    TuneConfig's metric/mode, not a hardwired 'loss'/'min'."""
+    from ray_tpu.tune.schedulers import AsyncHyperBandScheduler
+
+    def train_fn(config):
+        import time as _time
+
+        for i in range(8):
+            tune.report({"score": config["s"] * (i + 1)})
+            _time.sleep(0.01)
+
+    sched = AsyncHyperBandScheduler(max_t=8, grace_period=1, reduction_factor=2)
+    grid = tune.Tuner(
+        train_fn,
+        param_space={"s": tune.grid_search([1.0, 10.0, 0.1, 0.2])},
+        tune_config=tune.TuneConfig(metric="score", mode="max", scheduler=sched),
+    ).fit()
+    assert sched.metric == "score" and sched.mode == "max"
+    # the top trial (s=10) must survive to the last rung
+    best = grid.get_best_result()
+    assert best.metrics["score"] == pytest.approx(80.0)
