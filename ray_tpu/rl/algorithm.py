@@ -79,6 +79,7 @@ class Algorithm(Trainable):
             num_env_runners=cfg.num_env_runners,
             num_envs_per_runner=cfg.num_envs_per_env_runner,
             seed=cfg.seed,
+            explore=cfg.explore,
         )
         self.build_components()
 

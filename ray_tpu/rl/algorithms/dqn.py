@@ -196,7 +196,11 @@ class DQN(Algorithm):
                     "actions": batch["actions"][t],
                     "rewards": rew,
                     "next_obs": obs_seq[t + n].reshape(B, -1),
-                    "terminateds": batch["terminateds"][t + n - 1].astype(np.float32),
+                    # Any episode boundary inside the n-step window kills the
+                    # bootstrap: next_obs at t+n belongs to a later episode
+                    # then (autoreset), so bootstrapping through it would leak
+                    # cross-episode values into the TD target.
+                    "terminateds": done.astype(np.float32),
                 }
             )
         flat = {k: np.concatenate([r[k] for r in rows]) for k in rows[0]}

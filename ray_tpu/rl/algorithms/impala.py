@@ -70,6 +70,7 @@ class IMPALA(Algorithm):
                 jax.lax.stop_gradient(out["vf"]),
                 final_vf,
                 batch["terminateds"],
+                batch["truncateds"],
                 gamma=gamma,
                 clip_rho=rho,
                 clip_c=c,
@@ -118,6 +119,7 @@ class IMPALA(Algorithm):
                 "logp": r["logp"],
                 "rewards": r["rewards"],
                 "terminateds": r["terminateds"].astype(np.float32),
+                "truncateds": r["truncateds"].astype(np.float32),
                 "final_obs": r["final_obs"],
             }
             metrics = self.learner_group.update(batch)

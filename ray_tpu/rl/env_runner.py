@@ -180,11 +180,13 @@ class EnvRunnerGroup:
         num_env_runners: int = 0,
         num_envs_per_runner: int = 8,
         seed: int = 0,
+        explore: bool = True,
     ):
         self.num_env_runners = num_env_runners
         if num_env_runners == 0:
             self.local = SingleAgentEnvRunner(
-                env, module_spec, num_envs=num_envs_per_runner, seed=seed
+                env, module_spec, num_envs=num_envs_per_runner, seed=seed,
+                explore=explore,
             )
             self.remotes = []
         else:
@@ -196,6 +198,7 @@ class EnvRunnerGroup:
                     module_spec,
                     num_envs=num_envs_per_runner,
                     seed=seed + 1000 * (i + 1),
+                    explore=explore,
                 )
                 for i in range(num_env_runners)
             ]

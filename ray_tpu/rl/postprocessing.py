@@ -29,17 +29,20 @@ def compute_gae(
 ):
     """Returns (advantages [T, B], value_targets [T, B]).
 
-    delta_t = r_t + gamma * V(s_{t+1}) * (1 - term) - V(s_t)
+    delta_t = r_t + gamma * V(s_{t+1}) * (1 - done) - V(s_t)
     A_t     = delta_t + gamma * lam * (1 - done) * A_{t+1}
-    Truncation cuts the advantage recurrence but keeps the bootstrap.
     """
     next_values = jnp.concatenate([values[1:], final_values[None]], axis=0)
-    nonterminal = 1.0 - terminateds.astype(jnp.float32)
-    # At a truncation boundary the stored next_value belongs to the *new*
-    # episode's first obs — without the true final obs per step we stop the
-    # recurrence there (standard practice; bias vanishes as T >> episodes).
-    cut = 1.0 - (terminateds | truncateds).astype(jnp.float32)
-    deltas = rewards + gamma * next_values * nonterminal - values
+    # At an episode boundary (termination OR truncation) the stored
+    # next_value belongs to the *new* episode's first obs (autoreset), so
+    # the bootstrap is zeroed and the recurrence cut at both. For
+    # truncations this under-bootstraps the final step (the unbiased fix
+    # needs V(final_obs), which autoreset discards); zero is the standard
+    # bounded-bias choice.
+    cut = 1.0 - (
+        terminateds.astype(bool) | truncateds.astype(bool)
+    ).astype(jnp.float32)
+    deltas = rewards + gamma * next_values * cut - values
 
     def scan_fn(carry, xs):
         delta, c = xs
@@ -58,6 +61,7 @@ def compute_vtrace(
     values: jax.Array,          # [T, B] V(s_t) under learner
     final_values: jax.Array,    # [B]
     terminateds: jax.Array,     # [T, B]
+    truncateds: jax.Array = None,  # [T, B] time-limit ends
     gamma: float = 0.99,
     clip_rho: float = 1.0,
     clip_c: float = 1.0,
@@ -66,11 +70,20 @@ def compute_vtrace(
 
     vs_t = V(s_t) + sum_k gamma^k (prod c) rho_k delta_k  via reverse scan:
     vs_t = V_t + delta_t*rho_t + gamma*c_t*(vs_{t+1} - V_{t+1})
+
+    Truncations are treated like terminations (zero bootstrap + cut the
+    recurrence) — same bounded-bias choice as compute_gae; the stored next
+    value at a boundary belongs to the next episode and must not leak in.
     """
     rhos = jnp.exp(target_logp - behaviour_logp)
     clipped_rhos = jnp.minimum(clip_rho, rhos)
     cs = jnp.minimum(clip_c, rhos)
-    nonterminal = 1.0 - terminateds.astype(jnp.float32)
+    done = (
+        terminateds.astype(bool)
+        if truncateds is None
+        else (terminateds.astype(bool) | truncateds.astype(bool))
+    )
+    nonterminal = 1.0 - done.astype(jnp.float32)
     next_values = jnp.concatenate([values[1:], final_values[None]], axis=0)
     deltas = clipped_rhos * (rewards + gamma * next_values * nonterminal - values)
 

@@ -1,0 +1,42 @@
+"""ray_tpu.serve: online model serving.
+
+TPU-first re-design of the reference's Ray Serve (python/ray/serve/):
+controller reconciliation loop, power-of-two-choices routing,
+deployment handles with dataflow composition, queue-depth autoscaling,
+and an aiohttp ingress proxy. See SURVEY.md §2.5 / §3.5.
+"""
+
+from ray_tpu.serve.api import (
+    Application,
+    Deployment,
+    delete,
+    deployment,
+    get_app_handle,
+    get_deployment_handle,
+    run,
+    shutdown,
+    start,
+    status,
+)
+from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
+from ray_tpu.serve.handle import DeploymentHandle, DeploymentResponse
+from ray_tpu.serve.proxy import Request
+
+__all__ = [
+    "Application",
+    "AutoscalingConfig",
+    "Deployment",
+    "DeploymentConfig",
+    "DeploymentHandle",
+    "DeploymentResponse",
+    "HTTPOptions",
+    "Request",
+    "delete",
+    "deployment",
+    "get_app_handle",
+    "get_deployment_handle",
+    "run",
+    "shutdown",
+    "start",
+    "status",
+]

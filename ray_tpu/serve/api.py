@@ -1,0 +1,348 @@
+"""Serve public API: @deployment, bind, run, status, shutdown.
+
+Reference analog: python/ray/serve/api.py (serve.run :591, @serve.deployment,
+serve.start, serve.status, serve.delete) and deployment graph binding
+(Deployment.bind → Application). The controller is a detached named actor
+(CONTROLLER_NAME), found/created on demand — same singleton pattern as the
+reference's get_or_create controller path (_private/api.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, replace
+from typing import Any, Callable, Optional, Union
+
+from ray_tpu.serve.config import (
+    AutoscalingConfig,
+    DeploymentConfig,
+    HTTPOptions,
+    ReplicaConfig,
+)
+from ray_tpu.serve.controller import CONTROLLER_NAME, ServeController
+from ray_tpu.serve.handle import DeploymentHandle
+
+_lock = threading.Lock()
+_controller_handle = None
+_proxy = None
+
+
+# ---------------------------------------------------------------------------
+# deployment + application graph
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Deployment:
+    """The decorated, configurable unit (reference: serve.Deployment)."""
+
+    func_or_class: Union[type, Callable]
+    name: str
+    deployment_config: DeploymentConfig
+    num_cpus: float = 1.0
+    num_tpus: float = 0.0
+    resources: Optional[dict] = None
+
+    def options(self, **kwargs) -> "Deployment":
+        dc_fields = {
+            "num_replicas",
+            "max_ongoing_requests",
+            "max_queued_requests",
+            "user_config",
+            "autoscaling_config",
+            "health_check_period_s",
+            "health_check_timeout_s",
+            "graceful_shutdown_timeout_s",
+        }
+        dc_updates = {k: v for k, v in kwargs.items() if k in dc_fields}
+        rest = {k: v for k, v in kwargs.items() if k not in dc_fields}
+        actor_opts = rest.pop("ray_actor_options", None)
+        if isinstance(dc_updates.get("autoscaling_config"), dict):
+            dc_updates["autoscaling_config"] = AutoscalingConfig(
+                **dc_updates["autoscaling_config"]
+            )
+        if dc_updates.get("num_replicas") == "auto":
+            dc_updates["num_replicas"] = 1
+            dc_updates.setdefault(
+                "autoscaling_config", AutoscalingConfig(min_replicas=1, max_replicas=100)
+            )
+        new = replace(self, deployment_config=replace(self.deployment_config, **dc_updates))
+        if actor_opts:
+            new.num_cpus = actor_opts.get("num_cpus", new.num_cpus)
+            new.num_tpus = actor_opts.get("num_tpus", new.num_tpus)
+            new.resources = actor_opts.get("resources", new.resources)
+        for k, v in rest.items():
+            if not hasattr(new, k):
+                raise TypeError(f"unknown deployment option {k!r}")
+            setattr(new, k, v)
+        return new
+
+    def bind(self, *args, **kwargs) -> "Application":
+        return Application(self, args, kwargs)
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"deployment {self.name} cannot be called directly; deploy it with "
+            f"serve.run(dep.bind(...)) and call the returned handle"
+        )
+
+
+class Application:
+    """A bound deployment node; init args may contain other Applications
+    (composition DAG, reference: serve built-app graph)."""
+
+    def __init__(self, deployment: Deployment, args: tuple, kwargs: dict):
+        self._deployment = deployment
+        self._args = args
+        self._kwargs = kwargs
+
+
+def deployment(
+    _func_or_class=None,
+    *,
+    name: Optional[str] = None,
+    num_replicas: Union[int, str, None] = None,
+    max_ongoing_requests: int = 100,
+    max_queued_requests: int = -1,
+    user_config: Any = None,
+    autoscaling_config: Union[AutoscalingConfig, dict, None] = None,
+    health_check_period_s: float = 2.0,
+    health_check_timeout_s: float = 30.0,
+    graceful_shutdown_timeout_s: float = 10.0,
+    ray_actor_options: Optional[dict] = None,
+):
+    """@serve.deployment decorator."""
+
+    def build(target) -> Deployment:
+        nonlocal autoscaling_config, num_replicas
+        if isinstance(autoscaling_config, dict):
+            autoscaling_config = AutoscalingConfig(**autoscaling_config)
+        if num_replicas == "auto":
+            num_replicas = 1
+            if autoscaling_config is None:
+                autoscaling_config = AutoscalingConfig(min_replicas=1, max_replicas=100)
+        dcfg = DeploymentConfig(
+            num_replicas=int(num_replicas or 1),
+            max_ongoing_requests=max_ongoing_requests,
+            max_queued_requests=max_queued_requests,
+            user_config=user_config,
+            autoscaling_config=autoscaling_config,
+            health_check_period_s=health_check_period_s,
+            health_check_timeout_s=health_check_timeout_s,
+            graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
+        )
+        opts = ray_actor_options or {}
+        return Deployment(
+            func_or_class=target,
+            name=name or target.__name__,
+            deployment_config=dcfg,
+            num_cpus=opts.get("num_cpus", 1.0),
+            num_tpus=opts.get("num_tpus", 0.0),
+            resources=opts.get("resources"),
+        )
+
+    if _func_or_class is not None:
+        return build(_func_or_class)
+    return build
+
+
+# ---------------------------------------------------------------------------
+# controller / proxy lifecycle
+# ---------------------------------------------------------------------------
+
+
+def _get_controller_handle():
+    global _controller_handle
+    import ray_tpu
+
+    with _lock:
+        if _controller_handle is not None:
+            from ray_tpu.core.actor_runtime import ActorState
+
+            if _controller_handle.state != ActorState.DEAD:
+                return _controller_handle
+            _controller_handle = None
+        try:
+            _controller_handle = ray_tpu.get_actor(CONTROLLER_NAME)
+        except ValueError:
+            _controller_handle = (
+                ray_tpu.remote(ServeController)
+                .options(name=CONTROLLER_NAME, lifetime="detached", num_cpus=0)
+                .remote()
+            )
+        return _controller_handle
+
+
+def start(http_options: Optional[HTTPOptions] = None, **kwargs) -> None:
+    """Start the Serve control plane + HTTP proxy (reference: serve.start)."""
+    global _proxy
+    if http_options is None:
+        http_options = HTTPOptions(**kwargs) if kwargs else HTTPOptions()
+    controller = _get_controller_handle()
+    with _lock:
+        if _proxy is None:
+            from ray_tpu.serve.proxy import HTTPProxy
+
+            _proxy = HTTPProxy(http_options.host, http_options.port, controller)
+
+
+def _collect_deployments(app: Application):
+    """Walk the bound-argument DAG; return ({name: (Deployment, args, kwargs)},
+    ingress_name) with nested Applications replaced by handle placeholders."""
+    seen: dict[str, tuple] = {}
+
+    def visit(node: Application) -> "_HandlePlaceholder":
+        dep = node._deployment
+        args = tuple(visit(a) if isinstance(a, Application) else a for a in node._args)
+        kwargs = {
+            k: visit(v) if isinstance(v, Application) else v
+            for k, v in node._kwargs.items()
+        }
+        if dep.name in seen and seen[dep.name][0].func_or_class is not dep.func_or_class:
+            raise ValueError(f"duplicate deployment name {dep.name!r} in application")
+        seen[dep.name] = (dep, args, kwargs)
+        return _HandlePlaceholder(dep.name)
+
+    ingress = visit(app).name
+    return seen, ingress
+
+
+@dataclass
+class _HandlePlaceholder:
+    name: str
+
+
+def _materialize(value, app_name: str):
+    if isinstance(value, _HandlePlaceholder):
+        return DeploymentHandle(value.name, app_name)
+    return value
+
+
+def run(
+    target: Application,
+    *,
+    name: str = "default",
+    route_prefix: Optional[str] = "/",
+    blocking: bool = False,
+    _start_proxy: bool = True,
+    wait_for_ingress_timeout_s: float = 60.0,
+) -> DeploymentHandle:
+    """Deploy an application; returns a handle to its ingress deployment."""
+    import ray_tpu
+
+    if isinstance(target, Deployment):
+        target = target.bind()
+    if not isinstance(target, Application):
+        raise TypeError(f"serve.run expects an Application, got {type(target)}")
+
+    controller = _get_controller_handle()
+    if _start_proxy and route_prefix is not None:
+        start()
+
+    deployments, ingress = _collect_deployments(target)
+    payload = []
+    for dep_name, (dep, args, kwargs) in deployments.items():
+        import inspect
+
+        is_function = not inspect.isclass(dep.func_or_class)
+        args = tuple(_materialize(a, name) for a in args)
+        kwargs = {k: _materialize(v, name) for k, v in kwargs.items()}
+        rcfg = ReplicaConfig(
+            callable_factory=dep.func_or_class,
+            init_args=args,
+            init_kwargs=kwargs,
+            num_cpus=dep.num_cpus,
+            num_tpus=dep.num_tpus,
+            resources=dep.resources or {},
+            is_function=is_function,
+        )
+        payload.append((dep_name, dep.deployment_config, rcfg))
+
+    ray_tpu.get(
+        controller.deploy_application.remote(name, route_prefix, ingress, payload)
+    )
+    _wait_healthy(controller, name, wait_for_ingress_timeout_s)
+    handle = DeploymentHandle(ingress, name)
+    if blocking:
+        try:
+            while True:
+                time.sleep(1)
+        except KeyboardInterrupt:
+            pass
+    return handle
+
+
+def _wait_healthy(controller, app_name: str, timeout_s: float) -> None:
+    import ray_tpu
+
+    deadline = time.time() + timeout_s
+    while time.time() < deadline:
+        st = ray_tpu.get(controller.status.remote())
+        app = st["applications"].get(app_name)
+        if app and app["status"] == "RUNNING":
+            return
+        if app and app["status"] in ("DEPLOY_FAILED", "UNHEALTHY"):
+            raise RuntimeError(f"application {app_name} failed to deploy: {app}")
+        time.sleep(0.05)
+    raise TimeoutError(f"application {app_name} not healthy after {timeout_s}s")
+
+
+# ---------------------------------------------------------------------------
+# status / handles / teardown
+# ---------------------------------------------------------------------------
+
+
+def status() -> dict:
+    import ray_tpu
+
+    return ray_tpu.get(_get_controller_handle().status.remote())
+
+
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    import ray_tpu
+
+    controller = _get_controller_handle()
+    st = ray_tpu.get(controller.status.remote())
+    if name not in st["applications"]:
+        raise ValueError(f"application {name!r} not found")
+    routes = ray_tpu.get(controller.list_routes.remote())
+    for _prefix, (app, ingress) in routes.items():
+        if app == name:
+            return DeploymentHandle(ingress, name)
+    # route-less app: ingress lookup via status deployments (first dep)
+    deps = list(st["applications"][name]["deployments"])
+    return DeploymentHandle(deps[0], name)
+
+
+def get_deployment_handle(deployment_name: str, app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name)
+
+
+def delete(name: str) -> None:
+    import ray_tpu
+    from ray_tpu.serve import handle as _handle_mod
+
+    ray_tpu.get(_get_controller_handle().delete_application.remote(name))
+    with _handle_mod._ROUTERS_LOCK:
+        for key in [k for k in _handle_mod._ROUTERS if k[0] == name]:
+            del _handle_mod._ROUTERS[key]
+
+
+def shutdown() -> None:
+    global _controller_handle, _proxy
+    import ray_tpu
+    from ray_tpu.serve.handle import _drop_routers
+
+    _drop_routers()
+    with _lock:
+        proxy, _proxy = _proxy, None
+        controller, _controller_handle = _controller_handle, None
+    if proxy is not None:
+        proxy.shutdown()
+    if controller is not None:
+        try:
+            ray_tpu.get(controller.shutdown.remote(), timeout=10)
+            ray_tpu.kill(controller)
+        except Exception:
+            pass

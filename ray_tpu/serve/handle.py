@@ -1,0 +1,193 @@
+"""DeploymentHandle / DeploymentResponse — the composition API.
+
+Reference analog: python/ray/serve/handle.py (DeploymentHandle.remote
+:625,701, DeploymentResponse, DeploymentResponseGenerator). A response
+can be passed directly as an argument to another handle call — the
+underlying ObjectRef is substituted so the downstream replica receives
+the resolved value (same dataflow composition the reference supports).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from ray_tpu.serve.router import Router
+
+
+class DeploymentResponse:
+    """Future for one unary handle call."""
+
+    def __init__(self, router: Router, rid: str, ref):
+        self._router = router
+        self._rid = rid
+        self._ref = ref
+        self._done = False
+        self._lock = threading.Lock()
+
+    def _complete(self):
+        with self._lock:
+            if not self._done:
+                self._done = True
+                self._router.complete(self._rid)
+
+    def result(self, timeout_s: Optional[float] = None) -> Any:
+        import ray_tpu
+
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout_s)
+        finally:
+            self._complete()
+
+    def __await__(self):
+        import asyncio
+
+        def _get():
+            return self.result()
+
+        loop = asyncio.get_event_loop()
+        return loop.run_in_executor(None, _get).__await__()
+
+    def _to_object_ref(self):
+        """Expose the raw ref (for composition / ray_tpu.get interop).
+        Marks routing complete — the caller owns the ref from here."""
+        self._complete()
+        return self._ref
+
+
+class DeploymentResponseGenerator:
+    """Iterator over a streaming handle call."""
+
+    def __init__(self, router: Router, rid: str, gen):
+        self._router = router
+        self._rid = rid
+        self._gen = gen
+        self._done = False
+
+    def __iter__(self):
+        import ray_tpu
+
+        try:
+            for item_ref in self._gen:
+                yield ray_tpu.get(item_ref)
+        finally:
+            if not self._done:
+                self._done = True
+                self._router.complete(self._rid)
+
+    async def __aiter__(self):
+        import asyncio
+
+        loop = asyncio.get_event_loop()
+        it = iter(self)
+        while True:
+            try:
+                item = await loop.run_in_executor(None, lambda: next(it, _SENTINEL))
+            except StopIteration:
+                return
+            if item is _SENTINEL:
+                return
+            yield item
+
+
+_SENTINEL = object()
+
+
+def _substitute_responses(args: tuple, kwargs: dict) -> tuple[tuple, dict]:
+    def sub(x):
+        if isinstance(x, DeploymentResponse):
+            return x._to_object_ref()
+        return x
+
+    return tuple(sub(a) for a in args), {k: sub(v) for k, v in kwargs.items()}
+
+
+# One Router per (app, deployment) process-wide: every handle copy —
+# including the throwaway handles created by attribute access — shares the
+# same in-flight accounting, so power-of-two-choices and max_queued
+# backpressure see the true load.
+_ROUTERS: dict[tuple, Router] = {}
+_ROUTERS_LOCK = threading.Lock()
+
+
+def _shared_router(app_name: str, deployment_name: str) -> Router:
+    key = (app_name, deployment_name)
+    with _ROUTERS_LOCK:
+        router = _ROUTERS.get(key)
+        if router is None:
+            from ray_tpu.serve.api import _get_controller_handle
+            import ray_tpu
+
+            controller = _get_controller_handle()
+            max_queued = ray_tpu.get(
+                controller.get_max_queued_requests.remote(app_name, deployment_name)
+            )
+            router = Router(deployment_name, app_name, controller, max_queued)
+            _ROUTERS[key] = router
+        return router
+
+
+def _drop_routers() -> None:
+    """Called by serve.shutdown: routers hold dead controller/replica handles."""
+    with _ROUTERS_LOCK:
+        _ROUTERS.clear()
+
+
+class DeploymentHandle:
+    """Client-side handle to a deployment; cheap to copy; safe to pass into
+    other deployments' constructors (model composition)."""
+
+    def __init__(
+        self,
+        deployment_name: str,
+        app_name: str,
+        method_name: Optional[str] = None,
+        streaming: bool = False,
+    ):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._method_name = method_name
+        self._streaming = streaming
+
+    # Handles carry no live state — the router is process-local, looked up
+    # on each dispatch — so pickling is trivially safe.
+    def __getstate__(self):
+        return {
+            "deployment_name": self.deployment_name,
+            "app_name": self.app_name,
+            "_method_name": self._method_name,
+            "_streaming": self._streaming,
+        }
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+
+    def _get_router(self) -> Router:
+        return _shared_router(self.app_name, self.deployment_name)
+
+    def options(
+        self,
+        *,
+        method_name: Optional[str] = None,
+        stream: Optional[bool] = None,
+        use_new_handle_api: bool = True,  # accepted for reference parity
+    ) -> "DeploymentHandle":
+        return DeploymentHandle(
+            self.deployment_name,
+            self.app_name,
+            method_name if method_name is not None else self._method_name,
+            stream if stream is not None else self._streaming,
+        )
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return self.options(method_name=name)
+
+    def remote(self, *args, **kwargs):
+        args, kwargs = _substitute_responses(args, kwargs)
+        router = self._get_router()
+        rid, ref = router.dispatch(self._method_name, args, kwargs, self._streaming)
+        if self._streaming:
+            return DeploymentResponseGenerator(router, rid, ref)
+        return DeploymentResponse(router, rid, ref)

@@ -1,0 +1,174 @@
+"""HTTP proxy: ingress for Serve applications.
+
+Reference analog: python/ray/serve/_private/proxy.py (ProxyActor:1129,
+HTTPProxy:752) — uvicorn/starlette there; aiohttp here (what this image
+ships). One proxy per host, routing by longest route-prefix match to the
+app's ingress deployment handle, mirroring the reference's proxy router
+(_private/proxy_router.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.serve.proxy")
+
+
+@dataclass
+class Request:
+    """What an HTTP ingress callable receives (stand-in for the reference's
+    starlette.Request; carries the same essentials)."""
+
+    method: str
+    path: str  # path below the route prefix
+    query: dict
+    headers: dict
+    body: bytes = b""
+    route_prefix: str = "/"
+
+    def json(self) -> Any:
+        return json.loads(self.body or b"null")
+
+    @property
+    def text(self) -> str:
+        return self.body.decode()
+
+
+class HTTPProxy:
+    """aiohttp server in a daemon thread with its own event loop."""
+
+    def __init__(self, host: str, port: int, controller_handle):
+        self._host = host
+        self._port = port
+        self._controller = controller_handle
+        self._handles: dict[str, Any] = {}  # app_name -> DeploymentHandle
+        self._routes: dict[str, tuple] = {}
+        self._routes_stamp = 0.0
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._started = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve_forever, name="serve-http-proxy", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError(f"HTTP proxy failed to bind {host}:{port} within 10s")
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def _refresh_routes(self) -> None:
+        import time
+
+        import ray_tpu
+
+        if time.time() - self._routes_stamp < 0.5 and self._routes:
+            return
+        self._routes = ray_tpu.get(self._controller.list_routes.remote())
+        self._routes_stamp = time.time()
+
+    def _match(self, path: str):
+        """Longest-prefix route match."""
+        self._refresh_routes()
+        best = None
+        for prefix, (app, ingress) in self._routes.items():
+            norm = prefix.rstrip("/") or "/"
+            if path == norm or path.startswith(norm + "/") or norm == "/":
+                if best is None or len(norm) > len(best[0]):
+                    best = (norm, prefix, app, ingress)
+        return best
+
+    def _get_handle(self, app: str, ingress: str):
+        h = self._handles.get(app)
+        if h is None or h.deployment_name != ingress:
+            from ray_tpu.serve.handle import DeploymentHandle
+
+            h = DeploymentHandle(ingress, app)
+            self._handles[app] = h
+        return h
+
+    async def _handle(self, request):
+        from aiohttp import web
+
+        path = request.path
+        if path == "/-/healthz":
+            return web.Response(text="success")
+        if path == "/-/routes":
+            self._refresh_routes()
+            return web.json_response({p: a for p, (a, _) in self._routes.items()})
+        match = await asyncio.get_running_loop().run_in_executor(
+            None, self._match, path
+        )
+        if match is None:
+            return web.Response(status=404, text=f"no route for {path}")
+        norm, prefix, app, ingress = match
+        sub_path = path[len(norm):] if norm != "/" else path
+        body = await request.read()
+        req = Request(
+            method=request.method,
+            path=sub_path or "/",
+            query=dict(request.query),
+            headers=dict(request.headers),
+            body=body,
+            route_prefix=prefix,
+        )
+        handle = self._get_handle(app, ingress)
+        loop = asyncio.get_running_loop()
+        try:
+            result = await loop.run_in_executor(
+                None, lambda: handle.remote(req).result(timeout_s=300)
+            )
+        except Exception as e:  # surface replica errors as 500s
+            logger.exception("request to %s failed", path)
+            return web.Response(status=500, text=f"{type(e).__name__}: {e}")
+        return self._to_response(result)
+
+    @staticmethod
+    def _to_response(result):
+        from aiohttp import web
+
+        if isinstance(result, web.Response):
+            return result
+        if isinstance(result, bytes):
+            return web.Response(body=result)
+        if isinstance(result, str):
+            return web.Response(text=result)
+        return web.json_response(result)
+
+    def _serve_forever(self) -> None:
+        from aiohttp import web
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+        self._loop = loop
+
+        app = web.Application(client_max_size=1 << 30)
+        app.router.add_route("*", "/{tail:.*}", self._handle)
+        runner = web.AppRunner(app, access_log=None)
+
+        async def _run():
+            await runner.setup()
+            site = web.TCPSite(runner, self._host, self._port)
+            await site.start()
+            self._started.set()
+            while not self._stop.is_set():
+                await asyncio.sleep(0.1)
+            await runner.cleanup()
+
+        try:
+            loop.run_until_complete(_run())
+        except Exception:
+            logger.exception("proxy loop crashed")
+        finally:
+            loop.close()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)

@@ -1,0 +1,186 @@
+"""Serve replica: the actor that hosts one copy of a user callable.
+
+Reference analog: python/ray/serve/_private/replica.py (ReplicaActor
+:883, handle_request/handle_request_streaming :988-1016). Differences
+from the reference are deliberate: replicas here are async actors in the
+host process (threads), so sync user callables are pushed onto an
+executor to keep the replica's event loop responsive for health checks
+and metrics queries.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import time
+from typing import Any, Optional
+
+
+class Replica:
+    """User-code host. Instantiated as an async actor (max_concurrency
+    bounds in-flight requests, matching max_ongoing_requests)."""
+
+    def __init__(
+        self,
+        deployment_name: str,
+        app_name: str,
+        callable_factory,
+        init_args: tuple,
+        init_kwargs: dict,
+        is_function: bool,
+        user_config: Any = None,
+        max_ongoing_requests: int = 100,
+    ):
+        self._deployment_name = deployment_name
+        self._app_name = app_name
+        self._is_function = is_function
+        # Data-plane concurrency cap. The actor itself runs with a high
+        # max_concurrency so control-plane calls (metrics, health checks,
+        # reconfigure) never queue behind user requests.
+        self._request_sem = asyncio.Semaphore(max(1, max_ongoing_requests))
+        self._num_ongoing = 0
+        self._num_processed = 0
+        self._started_at = time.time()
+        cls_or_fn = callable_factory
+        if is_function:
+            self._callable = cls_or_fn
+        else:
+            self._callable = cls_or_fn(*init_args, **init_kwargs)
+        if user_config is not None:
+            self._apply_user_config(user_config)
+
+    # -- control-plane surface ------------------------------------------------
+
+    def _apply_user_config(self, user_config) -> None:
+        reconfigure = getattr(self._callable, "reconfigure", None)
+        if reconfigure is None:
+            raise ValueError(
+                f"user_config was set but {type(self._callable).__name__} "
+                f"defines no reconfigure() method"
+            )
+        reconfigure(user_config)
+
+    async def reconfigure(self, user_config) -> None:
+        self._apply_user_config(user_config)
+
+    async def check_health(self) -> bool:
+        check = getattr(self._callable, "check_health", None)
+        if check is not None:
+            out = check()
+            if inspect.isawaitable(out):
+                await out
+        return True
+
+    async def metrics(self) -> dict:
+        return {
+            "num_ongoing_requests": self._num_ongoing,
+            "num_processed": self._num_processed,
+            "uptime_s": time.time() - self._started_at,
+        }
+
+    async def prepare_shutdown(self, timeout_s: float) -> None:
+        """Drain in-flight requests, then run the user's cleanup hook
+        (graceful_shutdown_timeout_s)."""
+        deadline = time.time() + timeout_s
+        while self._num_ongoing > 0 and time.time() < deadline:
+            await asyncio.sleep(0.01)
+        # user-defined __del__ only (every object responds to getattr on a
+        # slot that object itself lacks, so look it up on the class)
+        hook = getattr(type(self._callable), "__del__", None)
+        if hook is not None and not self._is_function:
+            try:
+                out = hook(self._callable)
+                if inspect.isawaitable(out):
+                    await out
+            except Exception:
+                pass  # cleanup errors must not block teardown
+
+    # -- data plane -----------------------------------------------------------
+
+    def _resolve_target(self, method_name: Optional[str]):
+        if self._is_function:
+            return self._callable
+        if method_name:
+            target = getattr(self._callable, method_name, None)
+            if target is None or not callable(target):
+                raise AttributeError(
+                    f"deployment {self._deployment_name} has no method {method_name!r}"
+                )
+            return target
+        target = getattr(self._callable, "__call__", None)
+        if target is None:
+            raise AttributeError(
+                f"deployment {self._deployment_name} is not callable; "
+                f"specify a method name"
+            )
+        return target
+
+    @staticmethod
+    async def _resolve_refs(args, kwargs):
+        """Upstream DeploymentResponses arrive as ObjectRefs nested in the
+        args tuple (core only resolves top-level task args); fetch them here,
+        off-loop so pending upstream calls don't block the replica."""
+        from ray_tpu.core.ref import ObjectRef
+
+        if not any(isinstance(a, ObjectRef) for a in args) and not any(
+            isinstance(v, ObjectRef) for v in kwargs.values()
+        ):
+            return args, kwargs
+        import ray_tpu
+
+        loop = asyncio.get_running_loop()
+
+        async def get(ref):
+            return await loop.run_in_executor(None, lambda: ray_tpu.get(ref))
+
+        args = tuple(
+            [(await get(a)) if isinstance(a, ObjectRef) else a for a in args]
+        )
+        kwargs = {
+            k: (await get(v)) if isinstance(v, ObjectRef) else v
+            for k, v in kwargs.items()
+        }
+        return args, kwargs
+
+    async def handle_request(self, method_name: Optional[str], args, kwargs):
+        """Unary request path. _num_ongoing counts queued + executing — the
+        autoscaling signal wants in-replica load, not just active slots."""
+        self._num_ongoing += 1
+        try:
+            async with self._request_sem:
+                args, kwargs = await self._resolve_refs(args, kwargs)
+                target = self._resolve_target(method_name)
+                if inspect.iscoroutinefunction(target):
+                    return await target(*args, **kwargs)
+                # Sync callable: run off-loop so long computations don't
+                # starve the replica's event loop.
+                loop = asyncio.get_running_loop()
+                out = await loop.run_in_executor(None, lambda: target(*args, **kwargs))
+                if inspect.isawaitable(out):
+                    out = await out
+                return out
+        finally:
+            self._num_ongoing -= 1
+            self._num_processed += 1
+
+    async def handle_request_streaming(self, method_name: Optional[str], args, kwargs):
+        """Streaming path: the target must return an (a)sync generator;
+        items are yielded through the framework's ObjectRefGenerator."""
+        self._num_ongoing += 1
+        try:
+            args, kwargs = await self._resolve_refs(args, kwargs)
+            target = self._resolve_target(method_name)
+            out = target(*args, **kwargs)
+            if inspect.isawaitable(out):
+                out = await out
+            if hasattr(out, "__aiter__"):
+                async for item in out:
+                    yield item
+            elif hasattr(out, "__iter__"):
+                for item in out:
+                    yield item
+            else:
+                yield out
+        finally:
+            self._num_ongoing -= 1
+            self._num_processed += 1

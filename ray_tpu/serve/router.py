@@ -1,0 +1,133 @@
+"""Request router: picks a replica per request.
+
+Reference analog: python/ray/serve/_private/router.py:321 +
+replica_scheduler/pow_2_scheduler.py — power-of-two-choices over replica
+queue lengths. This router keeps its own in-flight count per replica
+(incremented on dispatch, decremented on completion) instead of the
+reference's cached queue-length RPCs: all routers live in the host
+process, so local counts are exact for a single router and a cheap,
+contention-free approximation across several.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import Optional
+
+
+class PendingRequestQueue(Exception):
+    pass
+
+
+class BackpressureError(Exception):
+    """max_queued_requests exceeded at the router (reference:
+    serve._private.router queue-length backpressure)."""
+
+
+class Router:
+    def __init__(
+        self,
+        deployment_name: str,
+        app_name: str,
+        controller_handle,
+        max_queued_requests: int = -1,
+    ):
+        self._deployment = deployment_name
+        self._app = app_name
+        self._controller = controller_handle
+        self._max_queued = max_queued_requests
+        self._lock = threading.Lock()
+        self._replicas: list = []  # list[(replica_id, ActorHandle, max_ongoing)]
+        self._version = -1
+        self._inflight: dict[str, int] = {}
+        self._last_refresh = 0.0
+
+    # -- replica-set maintenance ---------------------------------------------
+
+    def _refresh(self, block: bool = False) -> None:
+        """Pull the running replica set from the controller if stale.
+        (The reference pushes via long-poll; a pull with a version check
+        is equivalent single-host and far simpler.)"""
+        import ray_tpu
+
+        now = time.time()
+        if not block and self._replicas and now - self._last_refresh < 0.25:
+            return
+        info = ray_tpu.get(
+            self._controller.get_running_replicas.remote(self._app, self._deployment)
+        )
+        with self._lock:
+            self._last_refresh = now
+            if info["version"] != self._version:
+                self._version = info["version"]
+                self._replicas = info["replicas"]
+                live = {rid for rid, _, _ in self._replicas}
+                self._inflight = {
+                    rid: n for rid, n in self._inflight.items() if rid in live
+                }
+
+    def _wait_for_replicas(self, timeout: float = 30.0):
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            self._refresh(block=True)
+            if self._replicas:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"no running replicas for deployment "
+            f"{self._app}/{self._deployment} after {timeout}s"
+        )
+
+    # -- scheduling -----------------------------------------------------------
+
+    def _pick(self):
+        """Power-of-two-choices on local in-flight counts; skips replicas at
+        max_ongoing_requests when an alternative exists."""
+        with self._lock:
+            replicas = list(self._replicas)
+        if not replicas:
+            self._wait_for_replicas()
+            with self._lock:
+                replicas = list(self._replicas)
+        if len(replicas) == 1:
+            return replicas[0]
+        a, b = random.sample(replicas, 2)
+        na = self._inflight.get(a[0], 0)
+        nb = self._inflight.get(b[0], 0)
+        return a if na <= nb else b
+
+    def total_inflight(self) -> int:
+        with self._lock:
+            return sum(self._inflight.values())
+
+    def dispatch(self, method_name: Optional[str], args, kwargs, streaming: bool):
+        """Route one request; returns (replica_id, ObjectRef-or-generator)."""
+        self._refresh()
+        if self._max_queued >= 0 and self.total_inflight() >= self._max_queued + len(
+            self._replicas
+        ):
+            raise BackpressureError(
+                f"deployment {self._app}/{self._deployment}: "
+                f"max_queued_requests={self._max_queued} exceeded"
+            )
+        rid, handle, _max_ongoing = self._pick()
+        with self._lock:
+            self._inflight[rid] = self._inflight.get(rid, 0) + 1
+        try:
+            if streaming:
+                ref = handle.handle_request_streaming.options(
+                    num_returns="streaming"
+                ).remote(method_name, args, kwargs)
+            else:
+                ref = handle.handle_request.remote(method_name, args, kwargs)
+        except Exception:
+            with self._lock:
+                self._inflight[rid] = max(0, self._inflight.get(rid, 1) - 1)
+            raise
+        return rid, ref
+
+    def complete(self, rid: str) -> None:
+        with self._lock:
+            self._inflight[rid] = max(0, self._inflight.get(rid, 1) - 1)

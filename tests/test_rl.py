@@ -40,8 +40,10 @@ def _gae_numpy(rew, vf, final_vf, term, trunc, gamma, lam):
     adv = np.zeros((T, B))
     last = np.zeros(B)
     for t in reversed(range(T)):
-        delta = rew[t] + gamma * nxt[t] * (1 - term[t]) - vf[t]
+        # bootstrap zeroed at BOTH termination and truncation: the stored
+        # next value at any boundary belongs to the next episode (autoreset)
         cut = 1.0 - np.maximum(term[t], trunc[t])
+        delta = rew[t] + gamma * nxt[t] * cut - vf[t]
         last = delta + gamma * lam * cut * last
         adv[t] = last
     return adv, adv + vf
