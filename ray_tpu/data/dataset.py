@@ -76,8 +76,14 @@ class Dataset:
         fn_constructor_args: tuple = (),
         fn_constructor_kwargs: Optional[dict] = None,
         num_cpus: Optional[float] = None,
+        concurrency: Optional[int] = None,
         **_ignored,
     ) -> "Dataset":
+        # a callable CLASS is stateful per-worker by definition: default it
+        # onto an actor pool (reference: map_batches requires concurrency/
+        # ActorPoolStrategy for classes) instead of constructing per batch
+        if isinstance(fn, type) and compute is None:
+            compute = L.ActorPoolStrategy(size=concurrency or 1)
         return self._with(
             L.MapBatches(
                 fn,

@@ -1,0 +1,30 @@
+"""ray_tpu.llm: TPU-native LLM inference.
+
+Where the reference delegates model execution to vLLM inside placement
+groups (python/ray/llm/_internal/serve/deployments/llm/vllm/), this is a
+native engine: paged KV cache with prefix reuse, continuous batching,
+jitted sampling, an OpenAI-compatible Serve app, and Ray-Data-style
+batch inference. See SURVEY.md §2.5 (Ray LLM) and §7 L4.
+"""
+
+from ray_tpu.llm.batch import ProcessorConfig, build_processor
+from ray_tpu.llm.engine import EngineConfig, LLMEngine, Request, RequestOutput
+from ray_tpu.llm.kv_cache import BlockAllocator, KVCacheConfig
+from ray_tpu.llm.openai_api import ByteTokenizer, LLMConfig, LLMServer, build_openai_app
+from ray_tpu.llm.sampling import SamplingParams
+
+__all__ = [
+    "BlockAllocator",
+    "ByteTokenizer",
+    "EngineConfig",
+    "KVCacheConfig",
+    "LLMConfig",
+    "LLMEngine",
+    "LLMServer",
+    "ProcessorConfig",
+    "Request",
+    "RequestOutput",
+    "SamplingParams",
+    "build_openai_app",
+    "build_processor",
+]

@@ -1,0 +1,429 @@
+"""Continuous-batching LLM engine.
+
+The reference's engine is vLLM behind a Ray actor
+(python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py);
+this one is native and TPU-shaped:
+
+ * static-shape buckets everywhere (prefill lengths, decode batch
+   sizes) so XLA compiles a handful of programs once and the MXU sees
+   fixed tiles — the TPU analog of CUDA-graph capture;
+ * paged KV cache (llm/kv_cache.py) with prefix reuse;
+ * scheduler: admit-prefill-then-decode with preemption by recompute,
+   the vLLM v0 policy shape, host-side and O(batch);
+ * sampling as one jitted vectorized program (llm/sampling.py).
+
+Engine API mirrors vLLM's LLMEngine (add_request / step / generate) so
+the serving layer (llm/openai_api.py) and batch processor sit on top
+unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from collections import deque
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.llm.kv_cache import (
+    BlockAllocator,
+    NoFreeBlocksError,
+    SequenceBlocks,
+)
+from ray_tpu.llm.sampling import SamplingParams, sample_tokens
+from ray_tpu.models import llama
+from ray_tpu.models.llama_decode import decode_step, init_cache, prefill
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.llm.engine")
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    model: llama.LlamaConfig = dataclasses.field(default_factory=lambda: llama.LLAMA_TINY)
+    num_blocks: int = 512
+    block_size: int = 16
+    max_num_seqs: int = 16          # decode batch ceiling
+    max_prefill_len: int = 1024     # longest admitted prompt suffix
+    attn_impl: str = "auto"
+    cache_dtype: Any = None          # default: model dtype
+    enable_prefix_caching: bool = True
+    eos_token_id: int = 2
+
+    def prefill_buckets(self) -> list[int]:
+        out, b = [], 16
+        while b < self.max_prefill_len:
+            out.append(b)
+            b *= 2
+        out.append(self.max_prefill_len)
+        return out
+
+    def decode_buckets(self) -> list[int]:
+        out, b = [], 1
+        while b < self.max_num_seqs:
+            out.append(b)
+            b *= 2
+        out.append(self.max_num_seqs)
+        return out
+
+    @property
+    def max_blocks_per_seq(self) -> int:
+        return -(-self.model.max_seq // self.block_size)
+
+
+class RequestStatus:
+    WAITING = "waiting"
+    RUNNING = "running"
+    FINISHED = "finished"
+    ABORTED = "aborted"
+
+
+@dataclasses.dataclass
+class Request:
+    request_id: str
+    prompt_token_ids: list
+    sampling_params: SamplingParams
+    output_token_ids: list = dataclasses.field(default_factory=list)
+    status: str = RequestStatus.WAITING
+    seq: Optional[SequenceBlocks] = None
+    arrival: float = dataclasses.field(default_factory=time.time)
+    finish_reason: Optional[str] = None
+    num_preemptions: int = 0
+    cumulative_logprob: float = 0.0
+    token_logprobs: list = dataclasses.field(default_factory=list)
+    _key: Any = None
+
+    @property
+    def num_tokens(self) -> int:
+        return len(self.prompt_token_ids) + len(self.output_token_ids)
+
+
+@dataclasses.dataclass
+class RequestOutput:
+    request_id: str
+    new_token_ids: list
+    output_token_ids: list
+    finished: bool
+    finish_reason: Optional[str] = None
+    num_cached_tokens: int = 0
+
+
+class LLMEngine:
+    def __init__(
+        self,
+        config: EngineConfig,
+        params: Optional[llama.Params] = None,
+        seed: int = 0,
+    ):
+        self.config = config
+        c = config
+        self.params = (
+            params
+            if params is not None
+            else llama.init_params(c.model, jax.random.key(seed))
+        )
+        self.allocator = BlockAllocator(c.num_blocks, c.block_size)
+        self.cache = init_cache(
+            c.model, c.num_blocks * c.block_size, dtype=c.cache_dtype
+        )
+        self.waiting: deque[Request] = deque()
+        self.running: list[Request] = []
+        self.requests: dict[str, Request] = {}
+        self._counter = itertools.count()
+        self._root_key = jax.random.key(seed ^ 0x5EED)
+
+        # jitted entry points; cache buffers are donated so XLA updates pages
+        # in place instead of copying the whole cache every step
+        self._prefill = jax.jit(
+            lambda params, t, p, sl, sm, bt, cl, cache: prefill(
+                params, t, p, sl, sm, bt, cl, cache, c.model,
+                block_size=c.block_size,
+            ),
+            donate_argnums=(7,),
+        )
+        self._decode = jax.jit(
+            lambda params, t, p, sm, bt, cl, cache: decode_step(
+                params, t, p, sm, bt, cl, cache, c.model,
+                block_size=c.block_size, attn_impl=c.attn_impl,
+            ),
+            donate_argnums=(6,),
+        )
+
+    # -- public API -----------------------------------------------------------
+
+    def add_request(
+        self,
+        prompt_token_ids: list,
+        sampling_params: Optional[SamplingParams] = None,
+        request_id: Optional[str] = None,
+    ) -> str:
+        sp = sampling_params or SamplingParams()
+        rid = request_id or f"req-{next(self._counter)}"
+        if len(prompt_token_ids) > self.config.max_prefill_len:
+            raise ValueError(
+                f"prompt length {len(prompt_token_ids)} exceeds "
+                f"max_prefill_len={self.config.max_prefill_len}"
+            )
+        req = Request(rid, list(map(int, prompt_token_ids)), sp)
+        key = self._root_key if sp.seed is None else jax.random.key(sp.seed)
+        req._key = jax.random.fold_in(key, hash(rid) & 0x7FFFFFFF)
+        self.requests[rid] = req
+        self.waiting.append(req)
+        return rid
+
+    def abort_request(self, request_id: str) -> None:
+        req = self.requests.get(request_id)
+        if req is None or req.status in (RequestStatus.FINISHED, RequestStatus.ABORTED):
+            return
+        if req in self.running:
+            self.running.remove(req)
+        if req in self.waiting:
+            self.waiting.remove(req)
+        if req.seq is not None:
+            req.seq.release()
+        req.status = RequestStatus.ABORTED
+        req.finish_reason = "abort"
+
+    def has_unfinished(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def step(self) -> list[RequestOutput]:
+        """One engine iteration: admit + prefill one request, else decode."""
+        if self.waiting and len(self.running) < self.config.max_num_seqs:
+            admitted = self._try_prefill()
+            if admitted:
+                return admitted
+        if self.running:
+            return self._decode_step()
+        return []
+
+    def generate(
+        self,
+        prompts: list,
+        sampling_params: "SamplingParams | list[SamplingParams] | None" = None,
+    ) -> list:
+        """Blocking batch generation; returns output token lists in order."""
+        if sampling_params is None or isinstance(sampling_params, SamplingParams):
+            sampling_params = [sampling_params or SamplingParams()] * len(prompts)
+        rids = [
+            self.add_request(p, sp) for p, sp in zip(prompts, sampling_params)
+        ]
+        while self.has_unfinished():
+            self.step()
+        return [self.requests[r].output_token_ids for r in rids]
+
+    def stats(self) -> dict:
+        return {
+            "num_waiting": len(self.waiting),
+            "num_running": len(self.running),
+            "free_blocks": self.allocator.num_free,
+            "total_blocks": self.config.num_blocks,
+        }
+
+    # -- scheduling internals -------------------------------------------------
+
+    def _pad_to_bucket(self, n: int, buckets: list) -> int:
+        for b in buckets:
+            if n <= b:
+                return b
+        return buckets[-1]
+
+    def _try_prefill(self) -> list[RequestOutput]:
+        c = self.config
+        req = self.waiting[0]
+        seq = SequenceBlocks(self.allocator)
+        # after a preemption the recompute covers prompt + already-generated
+        # tokens; outputs stay in output_token_ids so callers see them all
+        prompt = req.prompt_token_ids + req.output_token_ids
+
+        # prefix-cache hit: skip recomputing matched full blocks (always
+        # leave >=1 token to prefill so we get next-token logits)
+        matched_blocks: list = []
+        matched = 0
+        if c.enable_prefix_caching:
+            blocks, matched, chain = self.allocator.match_prefix(prompt)
+            if matched >= len(prompt):
+                # whole prompt cached — we still need last-token logits, so
+                # re-match against prompt[:-1] to leave >=1 token to prefill
+                self.allocator.free(blocks)
+                blocks, matched, chain = self.allocator.match_prefix(prompt[:-1])
+            if blocks:
+                seq.adopt_prefix(blocks, chain, matched)
+                matched_blocks = blocks
+
+        suffix = prompt[matched:]
+        try:
+            seq.ensure_capacity(len(prompt))
+        except NoFreeBlocksError:
+            if matched_blocks:
+                seq.release()
+            return []  # no room: fall through to decode; retry later
+        self.waiting.popleft()
+
+        num_slots = c.num_blocks * c.block_size
+        bt = np.zeros((1, c.max_blocks_per_seq), np.int32)
+        bt[0, : len(seq.blocks)] = seq.blocks
+        bt = jnp.asarray(bt)
+
+        # chunked prefill: preemption recompute can exceed max_prefill_len;
+        # each chunk extends context_lens, only the last chunk's logits count
+        logits = None
+        for start in range(matched, len(prompt), c.max_prefill_len):
+            chunk = prompt[start : start + c.max_prefill_len]
+            S_pad = self._pad_to_bucket(len(chunk), c.prefill_buckets())
+            tokens = np.zeros((1, S_pad), np.int32)
+            tokens[0, : len(chunk)] = chunk
+            positions = np.zeros((1, S_pad), np.int32)
+            positions[0, : len(chunk)] = np.arange(start, start + len(chunk))
+            slots = np.full((1, S_pad), num_slots, np.int32)  # trash by default
+            for i, p in enumerate(range(start, start + len(chunk))):
+                slots[0, i] = seq.slot(p)
+            logits, self.cache = self._prefill(
+                self.params,
+                jnp.asarray(tokens),
+                jnp.asarray(positions),
+                jnp.asarray([len(chunk)], jnp.int32),
+                jnp.asarray(slots),
+                bt,
+                jnp.asarray([start + len(chunk)], jnp.int32),
+                self.cache,
+            )
+        seq.num_tokens = len(prompt)
+        if c.enable_prefix_caching:
+            seq.seal_full_blocks(prompt)
+        req.seq = seq
+        req.status = RequestStatus.RUNNING
+        self.running.append(req)
+
+        tok, logprob = self._sample_batch(logits, [req])
+        return self._append_tokens([req], tok, logprob)
+
+    def _preempt_one(self) -> bool:
+        """Kick the newest running request back to waiting (recompute)."""
+        if len(self.running) <= 1:
+            return False
+        victim = max(self.running, key=lambda r: r.arrival)
+        self.running.remove(victim)
+        victim.seq.release()
+        victim.seq = None
+        # outputs are kept; re-admission prefills prompt+outputs (recompute)
+        victim.status = RequestStatus.WAITING
+        victim.num_preemptions += 1
+        self.waiting.appendleft(victim)
+        logger.info("preempted %s (recompute)", victim.request_id)
+        return True
+
+    def _decode_step(self) -> list[RequestOutput]:
+        c = self.config
+        # grow each sequence by one slot; preempt on cache pressure
+        while True:
+            try:
+                for r in self.running:
+                    r.seq.ensure_capacity(r.num_tokens + 1)
+                break
+            except NoFreeBlocksError:
+                if not self._preempt_one():
+                    raise  # single running request can't fit: cache too small
+        batch = list(self.running)
+        B = len(batch)
+        B_pad = self._pad_to_bucket(B, c.decode_buckets())
+        num_slots = c.num_blocks * c.block_size
+
+        tokens = np.zeros(B_pad, np.int32)
+        positions = np.zeros(B_pad, np.int32)
+        slot_mapping = np.full(B_pad, num_slots, np.int32)
+        context_lens = np.zeros(B_pad, np.int32)
+        bt = np.zeros((B_pad, c.max_blocks_per_seq), np.int32)
+        for i, r in enumerate(batch):
+            last_tok = (
+                r.output_token_ids[-1] if r.output_token_ids else r.prompt_token_ids[-1]
+            )
+            pos = r.num_tokens - 1  # position of the token being fed
+            tokens[i] = last_tok
+            positions[i] = pos
+            slot_mapping[i] = r.seq.slot(pos)
+            context_lens[i] = r.num_tokens
+            bt[i, : len(r.seq.blocks)] = r.seq.blocks
+
+        logits, self.cache = self._decode(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray(slot_mapping),
+            jnp.asarray(bt),
+            jnp.asarray(context_lens),
+            self.cache,
+        )
+        tok, logprob = self._sample_batch(logits[:B], batch)
+        return self._append_tokens(batch, tok, logprob)
+
+    # -- sampling + bookkeeping ----------------------------------------------
+
+    def _sample_batch(self, logits, batch: list) -> tuple[np.ndarray, np.ndarray]:
+        B = len(batch)
+        temps = np.array([r.sampling_params.temperature for r in batch], np.float32)
+        top_ks = np.array([r.sampling_params.top_k for r in batch], np.int32)
+        top_ps = np.array([r.sampling_params.top_p for r in batch], np.float32)
+        keys = []
+        for r in batch:
+            r._key, sub = jax.random.split(r._key)
+            keys.append(sub)
+        toks, logprobs = sample_tokens(
+            logits[:B],
+            jnp.asarray(temps),
+            jnp.asarray(top_ks),
+            jnp.asarray(top_ps),
+            jnp.stack(keys),
+        )
+        return np.asarray(toks), np.asarray(logprobs)
+
+    def _append_tokens(self, batch: list, toks, logprobs) -> list[RequestOutput]:
+        c = self.config
+        outputs = []
+        for i, r in enumerate(batch):
+            t = int(toks[i])
+            r.output_token_ids.append(t)
+            r.cumulative_logprob += float(logprobs[i])
+            if r.sampling_params.logprobs:
+                r.token_logprobs.append(float(logprobs[i]))
+            sp = r.sampling_params
+            finished = False
+            if not sp.ignore_eos and t == c.eos_token_id:
+                finished, r.finish_reason = True, "stop"
+            elif t in sp.stop_token_ids:
+                finished, r.finish_reason = True, "stop"
+            elif len(r.output_token_ids) >= sp.max_tokens:
+                finished, r.finish_reason = True, "length"
+            elif r.num_tokens >= c.model.max_seq:
+                finished, r.finish_reason = True, "length"
+            num_cached = r.seq.num_cached_tokens if r.seq else 0
+            # KV written so far = prompt + all outputs except the token just
+            # sampled (its KV lands when it is fed next step) — only blocks
+            # fully inside that range may be sealed for prefix reuse
+            written = r.prompt_token_ids + r.output_token_ids[:-1]
+            if finished:
+                r.status = RequestStatus.FINISHED
+                self.running.remove(r)
+                if c.enable_prefix_caching:
+                    # full written blocks stay reusable; the tail is freed
+                    r.seq.seal_full_blocks(written)
+                r.seq.release()
+            else:
+                if c.enable_prefix_caching and len(written) % c.block_size == 0:
+                    r.seq.seal_full_blocks(written)
+                r.seq.num_tokens = r.num_tokens
+            outputs.append(
+                RequestOutput(
+                    request_id=r.request_id,
+                    new_token_ids=[t],
+                    output_token_ids=list(r.output_token_ids),
+                    finished=finished,
+                    finish_reason=r.finish_reason,
+                    num_cached_tokens=num_cached,
+                )
+            )
+        return outputs

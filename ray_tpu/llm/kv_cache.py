@@ -1,0 +1,213 @@
+"""Paged KV cache: host-side block allocator + device cache layout.
+
+The reference's serving path gets paged attention from vLLM
+(python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py);
+here the block manager is native. Design follows the paged-attention
+idea (and the TPU ragged-paged-attention lineage, see PAPERS.md):
+
+ * device cache = two arrays per model: K and V, each
+   [n_layers, num_blocks * block_size, n_kv_heads, head_dim] — flat
+   "slot" addressing (slot = block_id * block_size + offset) so prefill
+   scatter and decode gather are single-index ops;
+ * host-side BlockAllocator hands out blocks, refcounts them, and
+   reuses full blocks across requests via content hashing (prefix
+   caching — hash chains over block token contents).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class KVCacheConfig:
+    num_blocks: int = 256
+    block_size: int = 16  # tokens per block
+    n_layers: int = 2
+    n_kv_heads: int = 2
+    head_dim: int = 16
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def num_slots(self) -> int:
+        return self.num_blocks * self.block_size
+
+
+def init_kv_cache(cfg: KVCacheConfig) -> dict[str, jax.Array]:
+    shape = (cfg.n_layers, cfg.num_slots, cfg.n_kv_heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros(shape, cfg.dtype),
+        "v": jnp.zeros(shape, cfg.dtype),
+    }
+
+
+class NoFreeBlocksError(Exception):
+    pass
+
+
+class BlockAllocator:
+    """Refcounted block allocator with prefix caching.
+
+    Full blocks are immutable once written and keyed by
+    hash((parent_hash, tuple(block_tokens))); a request's trailing
+    partial block is always private. Freed blocks with a hash linger in
+    a reuse pool (LRU) until evicted by allocation pressure — a cache
+    hit resurrects them without recompute.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._free: list[int] = list(range(num_blocks - 1, -1, -1))
+        self._refcount: dict[int, int] = {}
+        # content hash -> block_id for REUSABLE blocks (ref >= 0; 0 means
+        # only the cache holds it)
+        self._hash_to_block: dict[int, int] = {}
+        self._block_hash: dict[int, int] = {}
+        # LRU order of zero-ref cached blocks (eviction candidates)
+        self._zero_ref_lru: list[int] = []
+
+    # -- stats ---------------------------------------------------------------
+
+    @property
+    def num_free(self) -> int:
+        return len(self._free) + len(self._zero_ref_lru)
+
+    def blocks_needed(self, num_tokens: int) -> int:
+        return -(-num_tokens // self.block_size)
+
+    # -- core ops ------------------------------------------------------------
+
+    def _pop_block(self) -> int:
+        if self._free:
+            return self._free.pop()
+        if self._zero_ref_lru:
+            victim = self._zero_ref_lru.pop(0)  # oldest cached block
+            h = self._block_hash.pop(victim, None)
+            if h is not None:
+                self._hash_to_block.pop(h, None)
+            return victim
+        raise NoFreeBlocksError("KV cache exhausted")
+
+    def allocate(self, n: int) -> list[int]:
+        """n fresh private blocks (no hash)."""
+        if self.num_free < n:
+            raise NoFreeBlocksError(
+                f"need {n} KV blocks, only {self.num_free} free"
+            )
+        out = []
+        for _ in range(n):
+            b = self._pop_block()
+            self._refcount[b] = 1
+            out.append(b)
+        return out
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            rc = self._refcount.get(b, 0) - 1
+            if rc > 0:
+                self._refcount[b] = rc
+                continue
+            self._refcount.pop(b, None)
+            if b in self._block_hash:
+                # keep contents around for prefix reuse until evicted
+                self._zero_ref_lru.append(b)
+            else:
+                self._free.append(b)
+
+    # -- prefix caching -------------------------------------------------------
+
+    @staticmethod
+    def chain_hash(parent_hash: int, block_tokens: tuple) -> int:
+        return hash((parent_hash, block_tokens))
+
+    def register_full_block(self, block_id: int, content_hash: int) -> None:
+        """Mark a just-written full block reusable under its content hash."""
+        existing = self._hash_to_block.get(content_hash)
+        if existing is not None and existing != block_id:
+            return  # another copy already canonical; keep ours private
+        self._hash_to_block[content_hash] = block_id
+        self._block_hash[block_id] = content_hash
+
+    def lookup(self, content_hash: int) -> Optional[int]:
+        """Take a reference on a cached block if present."""
+        b = self._hash_to_block.get(content_hash)
+        if b is None:
+            return None
+        if b in self._zero_ref_lru:
+            self._zero_ref_lru.remove(b)
+        self._refcount[b] = self._refcount.get(b, 0) + 1
+        return b
+
+    def match_prefix(self, tokens: list[int]) -> tuple[list[int], int, int]:
+        """Longest cached chain of FULL blocks prefixing `tokens`.
+        Returns (block_ids_with_refs_taken, num_tokens_matched, chain_hash)."""
+        matched: list[int] = []
+        h = chain = 0
+        n_full = len(tokens) // self.block_size
+        for i in range(n_full):
+            blk = tuple(tokens[i * self.block_size : (i + 1) * self.block_size])
+            h = self.chain_hash(h, blk)
+            b = self.lookup(h)
+            if b is None:
+                break
+            matched.append(b)
+            chain = h
+        return matched, len(matched) * self.block_size, chain
+
+
+@dataclasses.dataclass
+class SequenceBlocks:
+    """Per-request block bookkeeping (maps a token stream onto blocks)."""
+
+    allocator: BlockAllocator
+    blocks: list[int] = dataclasses.field(default_factory=list)
+    num_tokens: int = 0
+    # hash of the chain of sealed (hashed) full blocks (prefix-cache key)
+    chain: int = 0
+    num_sealed_tokens: int = 0  # tokens covered by sealed full blocks
+    num_cached_tokens: int = 0  # prefix tokens reused from the cache
+
+    def slot(self, pos: int) -> int:
+        bs = self.allocator.block_size
+        return self.blocks[pos // bs] * bs + pos % bs
+
+    def slots_for_range(self, start: int, end: int) -> list[int]:
+        return [self.slot(p) for p in range(start, end)]
+
+    def ensure_capacity(self, num_tokens: int) -> None:
+        need = self.allocator.blocks_needed(num_tokens) - len(self.blocks)
+        if need > 0:
+            self.blocks.extend(self.allocator.allocate(need))
+
+    def seal_full_blocks(self, tokens: list[int]) -> None:
+        """Register hashes for newly-completed full blocks. `tokens` is the
+        COMPLETE token stream of the sequence so far."""
+        bs = self.allocator.block_size
+        n_full = len(tokens) // bs
+        h = self.chain
+        for i in range(self.num_sealed_tokens // bs, n_full):
+            blk = tuple(tokens[i * bs : (i + 1) * bs])
+            h = self.allocator.chain_hash(h, blk)
+            self.allocator.register_full_block(self.blocks[i], h)
+        self.chain = h
+        self.num_sealed_tokens = n_full * bs
+
+    def adopt_prefix(self, blocks: list[int], chain: int, num_tokens: int) -> None:
+        """Start from a prefix-cache hit (refs already taken by match_prefix)."""
+        self.blocks = list(blocks)
+        self.chain = chain
+        self.num_sealed_tokens = num_tokens
+        self.num_cached_tokens = num_tokens
+
+    def release(self) -> None:
+        self.allocator.free(self.blocks)
+        self.blocks = []
+        self.num_tokens = 0
+        self.chain = 0
+        self.num_sealed_tokens = 0
+        self.num_cached_tokens = 0

@@ -1,0 +1,329 @@
+"""OpenAI-compatible LLM serving on ray_tpu.serve.
+
+Reference analogs: python/ray/llm/_internal/serve/builders/
+application_builders.py (build_openai_app), configs/openai_api_models.py
+(request/response schemas), deployments/llm/vllm/vllm_deployment.py.
+Here the deployment hosts the native engine (llm/engine.py) with a
+dedicated engine-loop thread doing continuous batching; requests are
+asyncio futures resolved as the loop emits tokens.
+
+Endpoints: /v1/models, /v1/completions, /v1/chat/completions
+(stream=true returns a complete SSE transcript; token-level streaming
+is available via serve handles — get_app_handle(...).options(stream=True)).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import queue
+import threading
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ray_tpu.llm.engine import EngineConfig, LLMEngine, RequestOutput
+from ray_tpu.llm.sampling import SamplingParams
+
+
+# ---------------------------------------------------------------------------
+# tokenizer
+# ---------------------------------------------------------------------------
+
+
+class ByteTokenizer:
+    """Self-contained fallback tokenizer: UTF-8 bytes + specials. Lets the
+    stack run hermetically (no downloaded vocabulary); swap in any object
+    with encode/decode/eos_token_id for a real model."""
+
+    PAD, BOS, EOS = 0, 1, 2
+    OFFSET = 3
+
+    def __init__(self, vocab_size: int = 512):
+        self.vocab_size = vocab_size
+        self.eos_token_id = self.EOS
+
+    def encode(self, text: str) -> list:
+        return [self.BOS] + [
+            min(b + self.OFFSET, self.vocab_size - 1) for b in text.encode()
+        ]
+
+    def decode(self, ids: list) -> str:
+        bs = bytes(
+            i - self.OFFSET for i in ids if self.OFFSET <= i < 256 + self.OFFSET
+        )
+        return bs.decode(errors="replace")
+
+
+def default_chat_template(messages: list) -> str:
+    """Minimal chat rendering (role-tagged turns + assistant cue)."""
+    parts = []
+    for m in messages:
+        parts.append(f"<|{m['role']}|>\n{m['content']}\n")
+    parts.append("<|assistant|>\n")
+    return "".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# engine runner: continuous-batching loop + per-request output queues
+# ---------------------------------------------------------------------------
+
+
+class _EngineRunner:
+    def __init__(self, engine: LLMEngine):
+        self.engine = engine
+        self.lock = threading.Lock()
+        self._queues: dict[str, queue.Queue] = {}
+        self._wake = threading.Event()
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, name="llm-engine-loop", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, prompt_ids: list, sp: SamplingParams) -> tuple[str, queue.Queue]:
+        q: queue.Queue = queue.Queue()
+        with self.lock:
+            rid = self.engine.add_request(prompt_ids, sp)
+            self._queues[rid] = q
+        self._wake.set()
+        return rid, q
+
+    def abort(self, rid: str) -> None:
+        with self.lock:
+            self.engine.abort_request(rid)
+            q = self._queues.pop(rid, None)
+        if q is not None:
+            q.put(None)
+
+    def _loop(self) -> None:
+        while not self._stop:
+            with self.lock:
+                busy = self.engine.has_unfinished()
+            if not busy:
+                self._wake.wait(timeout=0.2)
+                self._wake.clear()
+                continue
+            with self.lock:
+                outputs = self.engine.step()
+                for out in outputs:
+                    q = self._queues.get(out.request_id)
+                    if q is not None:
+                        q.put(out)
+                        if out.finished:
+                            del self._queues[out.request_id]
+
+    def shutdown(self) -> None:
+        self._stop = True
+        self._wake.set()
+
+
+# ---------------------------------------------------------------------------
+# the deployment
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class LLMConfig:
+    """Reference analog: ray.llm LLMConfig (server_models.py)."""
+
+    model_id: str = "llama-tiny"
+    engine: EngineConfig = field(default_factory=EngineConfig)
+    tokenizer: Any = None  # encode/decode/eos_token_id; ByteTokenizer default
+    params: Any = None     # model weights pytree; random-init if None
+    seed: int = 0
+
+
+class LLMServer:
+    """Serve deployment hosting one engine (reference: VLLMDeployment)."""
+
+    def __init__(self, config: LLMConfig):
+        self.config = config
+        self.tokenizer = config.tokenizer or ByteTokenizer(
+            config.engine.model.vocab_size
+        )
+        config.engine.eos_token_id = getattr(self.tokenizer, "eos_token_id", 2)
+        self.engine = LLMEngine(config.engine, params=config.params, seed=config.seed)
+        self.runner = _EngineRunner(self.engine)
+
+    def __del__(self):
+        try:
+            self.runner.shutdown()
+        except Exception:
+            pass
+
+    # -- request plumbing -----------------------------------------------------
+
+    def _sampling_from_body(self, body: dict) -> SamplingParams:
+        return SamplingParams(
+            max_tokens=int(body.get("max_tokens", 64)),
+            temperature=float(body.get("temperature", 1.0)),
+            top_k=int(body.get("top_k", 0)),
+            top_p=float(body.get("top_p", 1.0)),
+            seed=body.get("seed"),
+            logprobs=bool(body.get("logprobs", False)),
+        )
+
+    async def _run(self, prompt_ids: list, sp: SamplingParams):
+        """Async generator of RequestOutput."""
+        loop = asyncio.get_running_loop()
+        rid, q = self.runner.submit(prompt_ids, sp)
+        try:
+            while True:
+                out: Optional[RequestOutput] = await loop.run_in_executor(None, q.get)
+                if out is None:
+                    return
+                yield out
+                if out.finished:
+                    return
+        finally:
+            self.runner.abort(rid)
+
+    async def _generate_text(self, prompt_ids: list, sp: SamplingParams):
+        toks, reason = [], None
+        async for out in self._run(prompt_ids, sp):
+            toks = out.output_token_ids
+            reason = out.finish_reason
+        # strip eos token from the visible text
+        if toks and toks[-1] == self.engine.config.eos_token_id:
+            toks = toks[:-1]
+        return self.tokenizer.decode(toks), toks, reason
+
+    # -- handle-level streaming (token deltas) --------------------------------
+
+    async def generate_stream(self, prompt: str, **kwargs):
+        """Async generator of text deltas (serve streaming handles)."""
+        sp = self._sampling_from_body(kwargs)
+        ids = self.tokenizer.encode(prompt)
+        sent = 0
+        async for out in self._run(ids, sp):
+            toks = out.output_token_ids
+            if toks and toks[-1] == self.engine.config.eos_token_id:
+                toks = toks[:-1]
+            text = self.tokenizer.decode(toks)
+            if len(text) > sent:
+                yield text[sent:]
+                sent = len(text)
+
+    # -- HTTP surface ---------------------------------------------------------
+
+    async def __call__(self, request):
+        path, method = request.path, request.method
+        if path.rstrip("/") == "/v1/models" and method == "GET":
+            return self.models()
+        if path.rstrip("/") == "/v1/completions" and method == "POST":
+            return await self.completions(request.json())
+        if path.rstrip("/") == "/v1/chat/completions" and method == "POST":
+            return await self.chat_completions(request.json())
+        return {"error": {"message": f"no route {method} {path}", "code": 404}}
+
+    def models(self) -> dict:
+        return {
+            "object": "list",
+            "data": [
+                {
+                    "id": self.config.model_id,
+                    "object": "model",
+                    "owned_by": "ray_tpu",
+                    "max_model_len": self.engine.config.model.max_seq,
+                }
+            ],
+        }
+
+    async def completions(self, body: dict) -> Any:
+        sp = self._sampling_from_body(body)
+        prompt = body.get("prompt", "")
+        if isinstance(prompt, list):
+            prompt = prompt[0] if prompt else ""
+        ids = self.tokenizer.encode(prompt)
+        text, toks, reason = await self._generate_text(ids, sp)
+        rid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        payload = {
+            "id": rid,
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": body.get("model", self.config.model_id),
+            "choices": [
+                {
+                    "index": 0,
+                    "text": text,
+                    "finish_reason": reason,
+                    "logprobs": None,
+                }
+            ],
+            "usage": {
+                "prompt_tokens": len(ids),
+                "completion_tokens": len(toks),
+                "total_tokens": len(ids) + len(toks),
+            },
+        }
+        if body.get("stream"):
+            return _sse_transcript(payload, "text_completion")
+        return payload
+
+    async def chat_completions(self, body: dict) -> Any:
+        sp = self._sampling_from_body(body)
+        messages = body.get("messages", [])
+        prompt = default_chat_template(messages)
+        ids = self.tokenizer.encode(prompt)
+        text, toks, reason = await self._generate_text(ids, sp)
+        payload = {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": body.get("model", self.config.model_id),
+            "choices": [
+                {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": reason,
+                }
+            ],
+            "usage": {
+                "prompt_tokens": len(ids),
+                "completion_tokens": len(toks),
+                "total_tokens": len(ids) + len(toks),
+            },
+        }
+        if body.get("stream"):
+            return _sse_transcript(payload, "chat.completion.chunk")
+        return payload
+
+
+def _sse_transcript(payload: dict, obj: str) -> str:
+    """Full-assembly SSE body (incremental HTTP streaming: see module doc)."""
+    choice = payload["choices"][0]
+    text = choice.get("text", choice.get("message", {}).get("content", ""))
+    events = []
+    chunk = dict(payload, object=obj)
+    if obj.startswith("chat"):
+        chunk = dict(chunk)
+        chunk["choices"] = [
+            {"index": 0, "delta": {"role": "assistant", "content": text},
+             "finish_reason": choice["finish_reason"]}
+        ]
+    events.append(f"data: {json.dumps(chunk)}")
+    events.append("data: [DONE]")
+    return "\n\n".join(events) + "\n\n"
+
+
+def build_openai_app(
+    llm_config: LLMConfig,
+    *,
+    name: str = "llm",
+    route_prefix: str = "/",
+    num_replicas: int = 1,
+    max_ongoing_requests: int = 64,
+):
+    """Deploy an OpenAI-compatible app; returns the ingress handle
+    (reference: build_openai_app, application_builders.py)."""
+    from ray_tpu import serve
+
+    dep = serve.deployment(
+        LLMServer,
+        name=f"LLMServer:{llm_config.model_id}",
+        num_replicas=num_replicas,
+        max_ongoing_requests=max_ongoing_requests,
+    )
+    return serve.run(dep.bind(llm_config), name=name, route_prefix=route_prefix)

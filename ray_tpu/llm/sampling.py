@@ -1,0 +1,68 @@
+"""Sampling: per-request params + one jitted batched sampler.
+
+Reference analog: the OpenAI-style sampling knobs in
+python/ray/llm/_internal/serve/configs/openai_api_models.py (vLLM does
+the actual sampling). Here sampling is a single jitted program over the
+decode batch — temperature, top-k, top-p, greedy — driven by per-row
+parameter vectors so mixed batches need no recompile.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    max_tokens: int = 64
+    temperature: float = 1.0
+    top_k: int = 0          # 0 = off
+    top_p: float = 1.0      # 1.0 = off
+    stop_token_ids: tuple = ()
+    ignore_eos: bool = False
+    seed: Optional[int] = None
+    logprobs: bool = False
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+
+@jax.jit
+def sample_tokens(
+    logits: jax.Array,        # [B, V] fp32
+    temperatures: jax.Array,  # [B] (0 = greedy)
+    top_ks: jax.Array,        # [B] int32 (0 = off)
+    top_ps: jax.Array,        # [B] (1.0 = off)
+    keys: jax.Array,          # [B] PRNG keys
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (tokens [B], logprobs [B]). All knobs vectorized per row."""
+    V = logits.shape[-1]
+
+    def one(logit, temp, k, p, key):
+        greedy_tok = jnp.argmax(logit)
+        # temperature
+        t = jnp.where(temp <= 0.0, 1.0, temp)
+        scaled = logit / t
+        # top-k: mask everything below the k-th largest
+        sorted_desc = jnp.sort(scaled)[::-1]
+        kth = sorted_desc[jnp.clip(k - 1, 0, V - 1)]
+        scaled = jnp.where((k > 0) & (scaled < kth), -jnp.inf, scaled)
+        # top-p (nucleus): smallest prefix of sorted probs with mass >= p
+        probs_sorted = jax.nn.softmax(jnp.sort(scaled)[::-1])
+        cum = jnp.cumsum(probs_sorted)
+        # keep tokens whose prob >= the cutoff prob at the nucleus boundary
+        idx = jnp.searchsorted(cum, p)
+        cutoff = jax.nn.softmax(scaled)[jnp.argsort(scaled)[::-1][jnp.clip(idx, 0, V - 1)]]
+        probs = jax.nn.softmax(scaled)
+        scaled = jnp.where((p < 1.0) & (probs < cutoff), -jnp.inf, scaled)
+        sampled = jax.random.categorical(key, scaled)
+        tok = jnp.where(temp <= 0.0, greedy_tok, sampled)
+        logprob = jax.nn.log_softmax(logit)[tok]
+        return tok.astype(jnp.int32), logprob
+
+    return jax.vmap(one)(logits, temperatures, top_ks, top_ps, keys)

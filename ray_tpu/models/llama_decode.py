@@ -1,0 +1,208 @@
+"""Llama inference paths over the paged KV cache.
+
+The training forward (models/llama.py) recomputes all positions; these
+entry points are the serving-engine counterparts (reference delegates
+both to vLLM — vllm_engine.py):
+
+ * `prefill` — run a batch of prompt suffixes, scatter their K/V into
+   cache pages, attend over (cached prefix + suffix) via page gather,
+   return last-position logits.
+ * `decode_step` — one token per running sequence, scatter K/V to each
+   sequence's next slot, paged attention over its pages.
+
+Cache layout: k/v [n_layers, num_slots + 1, n_kv_heads, head_dim];
+the extra final slot is the trash row padding writes land in.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.llama import LlamaConfig, Params
+from ray_tpu.nn.layers import apply_rope, rms_norm, rope_frequencies, swiglu
+from ray_tpu.ops.paged_attention import paged_attention
+
+Cache = dict[str, jax.Array]
+
+
+def init_cache(config: LlamaConfig, num_slots: int, dtype=None) -> Cache:
+    """num_slots = num_blocks * block_size; one trash row appended."""
+    c = config
+    shape = (c.n_layers, num_slots + 1, c.n_kv_heads, c.head_dim)
+    dt = dtype or c.dtype
+    return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+
+def _qkv(x, lp, c: LlamaConfig):
+    B, S, _ = x.shape
+    hd = c.head_dim
+    q = jnp.einsum("bsd,dh->bsh", x, lp["wq"].astype(x.dtype)).reshape(B, S, c.n_heads, hd)
+    k = jnp.einsum("bsd,dh->bsh", x, lp["wk"].astype(x.dtype)).reshape(B, S, c.n_kv_heads, hd)
+    v = jnp.einsum("bsd,dh->bsh", x, lp["wv"].astype(x.dtype)).reshape(B, S, c.n_kv_heads, hd)
+    return q, k, v
+
+
+def _out_proj(o, lp, B, S, c: LlamaConfig):
+    return jnp.einsum(
+        "bsh,hd->bsd", o.reshape(B, S, c.n_heads * c.head_dim), lp["wo"].astype(o.dtype)
+    )
+
+
+def _unstack_layer(params_layers: Params, i) -> Params:
+    return jax.tree.map(lambda x: x[i], params_layers)
+
+
+def prefill(
+    params: Params,
+    tokens: jax.Array,       # [B, S_pad] suffix tokens (right-padded)
+    positions: jax.Array,    # [B, S_pad] absolute positions (pad = 0)
+    suffix_lens: jax.Array,  # [B] valid suffix tokens per row
+    slot_mapping: jax.Array, # [B, S_pad] cache slots (pad -> trash slot)
+    block_tables: jax.Array, # [B, MB]
+    context_lens: jax.Array, # [B] prefix + suffix length
+    cache: Cache,
+    config: LlamaConfig,
+    *,
+    block_size: int,
+) -> tuple[jax.Array, Cache]:
+    """Returns (last-valid-token logits [B, V], updated cache)."""
+    c = config
+    B, S = tokens.shape
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+    h = params["embed"].astype(c.dtype)[tokens]
+    flat_slots = slot_mapping.reshape(-1)  # [B*S]
+
+    def layer_step(carry, xs):
+        h, = carry
+        lp, k_cache_l, v_cache_l = xs
+        x = rms_norm(h, lp["ln1"], c.rms_eps)
+        q, k, v = _qkv(x, lp, c)
+        q = apply_rope(q, cos, sin, positions)
+        k = apply_rope(k, cos, sin, positions)
+        # scatter suffix K/V into this layer's pages (pad rows -> trash slot)
+        k_cache_l = k_cache_l.at[flat_slots].set(
+            k.reshape(B * S, c.n_kv_heads, c.head_dim).astype(k_cache_l.dtype)
+        )
+        v_cache_l = v_cache_l.at[flat_slots].set(
+            v.reshape(B * S, c.n_kv_heads, c.head_dim).astype(v_cache_l.dtype)
+        )
+        o = _page_attend_prefill(
+            q, k_cache_l, v_cache_l, block_tables, context_lens, positions, c,
+            block_size=block_size,
+        )
+        h = h + _out_proj(o, lp, B, S, c)
+        x = rms_norm(h, lp["ln2"], c.rms_eps)
+        h = h + swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return (h,), (k_cache_l, v_cache_l)
+
+    (h,), (new_k, new_v) = jax.lax.scan(
+        layer_step, (h,), (params["layers"], cache["k"], cache["v"])
+    )
+    h = rms_norm(h, params["final_norm"], c.rms_eps)
+    # only the last valid suffix position's logits matter per row
+    last = jnp.clip(suffix_lens - 1, 0, S - 1)  # [B]
+    h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]  # [B, D]
+    w_out = params.get("lm_head", None)
+    if w_out is None:
+        w_out = params["embed"].T
+    logits = jnp.einsum("bd,dv->bv", h_last, w_out.astype(c.dtype))
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+
+
+def _page_attend_prefill(
+    q: jax.Array,            # [B, S, H, D] (rope'd)
+    k_cache_l: jax.Array,    # [num_slots+1, KVH, D]
+    v_cache_l: jax.Array,
+    block_tables: jax.Array, # [B, MB]
+    context_lens: jax.Array, # [B]
+    positions: jax.Array,    # [B, S] absolute query positions
+    c: LlamaConfig,
+    *,
+    block_size: int,
+) -> jax.Array:
+    """Gather the full paged context and run masked attention.
+    mask: kv_pos <= q_pos (causal, absolute) AND kv_pos < context_len."""
+    B, S, H, D = q.shape
+    KVH = c.n_kv_heads
+    G = H // KVH
+    MB = block_tables.shape[1]
+    S_kv = MB * block_size
+
+    offs = jnp.arange(S_kv, dtype=jnp.int32)
+    slots = block_tables[:, offs // block_size] * block_size + offs % block_size
+    k = k_cache_l[slots]  # [B, S_kv, KVH, D]
+    v = v_cache_l[slots]
+
+    qg = q.reshape(B, S, KVH, G, D).astype(jnp.float32)
+    scores = jnp.einsum("bshgd,bthd->bhgst", qg, k.astype(jnp.float32))
+    scores *= 1.0 / jnp.sqrt(D).astype(jnp.float32)
+    kv_pos = offs[None, :]  # [1, S_kv]
+    valid = kv_pos < context_lens[:, None]  # [B, S_kv]
+    causal = kv_pos[:, None, :] <= positions[:, :, None]  # [B, S, S_kv]
+    mask = (valid[:, None, :] & causal)[:, None, None, :, :]  # [B,1,1,S,S_kv]
+    scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    probs = jnp.where(jnp.isnan(probs), 0.0, probs)  # fully-masked pad rows
+    out = jnp.einsum("bhgst,bthd->bshgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, S, H, D).astype(q.dtype)
+
+
+def decode_step(
+    params: Params,
+    tokens: jax.Array,       # [B] int32 current tokens
+    positions: jax.Array,    # [B] absolute positions
+    slot_mapping: jax.Array, # [B] slot for the new K/V
+    block_tables: jax.Array, # [B, MB]
+    context_lens: jax.Array, # [B] length INCLUDING current token
+    cache: Cache,
+    config: LlamaConfig,
+    *,
+    block_size: int,
+    attn_impl: str = "auto",
+) -> tuple[jax.Array, Cache]:
+    """One decode step for the running batch -> (logits [B, V], cache)."""
+    c = config
+    B = tokens.shape[0]
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+    h = params["embed"].astype(c.dtype)[tokens][:, None]  # [B, 1, D]
+    pos2 = positions[:, None]  # [B, 1]
+
+    def layer_step(carry, xs):
+        h, = carry
+        lp, k_cache_l, v_cache_l = xs
+        x = rms_norm(h, lp["ln1"], c.rms_eps)
+        q, k, v = _qkv(x, lp, c)
+        q = apply_rope(q, cos, sin, pos2)
+        k = apply_rope(k, cos, sin, pos2)
+        k_cache_l = k_cache_l.at[slot_mapping].set(
+            k[:, 0].astype(k_cache_l.dtype)
+        )
+        v_cache_l = v_cache_l.at[slot_mapping].set(
+            v[:, 0].astype(v_cache_l.dtype)
+        )
+        o = paged_attention(
+            q[:, 0],
+            k_cache_l,
+            v_cache_l,
+            block_tables,
+            context_lens,
+            block_size=block_size,
+            impl=attn_impl,
+        )[:, None]  # [B, 1, H*D grouped]
+        h = h + _out_proj(o, lp, B, 1, c)
+        x = rms_norm(h, lp["ln2"], c.rms_eps)
+        h = h + swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return (h,), (k_cache_l, v_cache_l)
+
+    (h,), (new_k, new_v) = jax.lax.scan(
+        layer_step, (h,), (params["layers"], cache["k"], cache["v"])
+    )
+    h = rms_norm(h[:, 0], params["final_norm"], c.rms_eps)  # [B, D]
+    w_out = params.get("lm_head", None)
+    if w_out is None:
+        w_out = params["embed"].T
+    logits = jnp.einsum("bd,dv->bv", h, w_out.astype(c.dtype))
+    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}

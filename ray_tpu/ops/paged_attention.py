@@ -1,0 +1,220 @@
+"""Paged decode attention over a flat-slot KV cache.
+
+TPU-native replacement for the paged attention the reference borrows
+from vLLM's CUDA kernels (reference delegates serving to vLLM —
+python/ray/llm/_internal/serve/deployments/llm/vllm/vllm_engine.py).
+Two implementations:
+
+ * `paged_attention_xla` — gather + masked softmax, pure XLA. Portable
+   (CPU tests, interpreter), and a solid TPU baseline: the gather is a
+   dynamic-slice stream XLA pipelines well at decode batch sizes.
+ * `paged_attention_pallas` — Pallas kernel, one grid step per (request,
+   kv-head): block table rows are scalar-prefetched (SMEM) so the
+   kernel DMAs exactly the pages it needs from the HBM-resident cache
+   into VMEM, fp32 online softmax, GQA by grouping query heads per
+   kv-head. This is the kernel shape recommended by the TPU kernel
+   playbook (ragged paged attention lineage, PAPERS.md).
+
+Layout (see llm/kv_cache.py): k_cache/v_cache are
+[num_slots, n_kv_heads, head_dim] PER LAYER (the caller scans layers);
+slot = block_id * block_size + offset.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+def paged_attention_xla(
+    q: jax.Array,            # [B, n_heads, head_dim]
+    k_cache: jax.Array,      # [num_slots, n_kv_heads, head_dim]
+    v_cache: jax.Array,      # [num_slots, n_kv_heads, head_dim]
+    block_tables: jax.Array, # [B, max_blocks] int32 block ids (padded w/ 0)
+    context_lens: jax.Array, # [B] int32 valid tokens per sequence
+    *,
+    block_size: int,
+) -> jax.Array:              # [B, n_heads, head_dim]
+    B, H, D = q.shape
+    KVH = k_cache.shape[1]
+    G = H // KVH  # query heads per kv head (GQA group)
+    MB = block_tables.shape[1]
+    S = MB * block_size  # padded kv length
+
+    # slot indices for every (batch, position): [B, S]
+    offs = jnp.arange(S, dtype=jnp.int32)
+    slots = block_tables[:, offs // block_size] * block_size + offs % block_size
+
+    k = k_cache[slots]  # [B, S, KVH, D]
+    v = v_cache[slots]
+    qg = q.reshape(B, KVH, G, D).astype(jnp.float32)
+    scores = jnp.einsum("bhgd,bshd->bhgs", qg, k.astype(jnp.float32))
+    scores *= 1.0 / jnp.sqrt(D).astype(jnp.float32)
+    mask = offs[None, :] < context_lens[:, None]  # [B, S]
+    scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgs,bshd->bhgd", probs, v.astype(jnp.float32))
+    return out.reshape(B, H, D).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Pallas TPU kernel
+# ---------------------------------------------------------------------------
+
+
+def _paged_attn_kernel(
+    # scalar-prefetch
+    block_tables_ref,  # [B, MB] SMEM
+    context_lens_ref,  # [B] SMEM
+    # inputs (blocked by grid)
+    q_ref,       # [1, 1, G, D] VMEM — this (b, kvh)'s query group
+    k_hbm,       # [num_slots, KVH, D] stays in HBM (ANY)
+    v_hbm,
+    # output
+    o_ref,       # [1, 1, G, D] VMEM
+    # scratch
+    k_vmem,      # [block_size, D]
+    v_vmem,
+    acc_ref,     # [G, D] fp32
+    m_ref,       # [G, 128] running max
+    l_ref,       # [G, 128] running denom
+    sem,
+    *,
+    block_size: int,
+    max_blocks: int,
+):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b = pl.program_id(0)
+    h = pl.program_id(1)  # kv head
+
+    G, D = acc_ref.shape
+    acc_ref[...] = jnp.zeros_like(acc_ref)
+    m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
+    l_ref[...] = jnp.zeros_like(l_ref)
+
+    ctx = context_lens_ref[b]
+    n_blocks = pl.cdiv(ctx, block_size)
+    q = q_ref[0, 0].astype(jnp.float32) * (1.0 / (D ** 0.5))  # [G, D]
+
+    def body(i, _):
+        blk = block_tables_ref[b, i]
+        start = blk * block_size
+        # DMA this page's K/V for our kv head: [block_size, D]
+        copy_k = pltpu.make_async_copy(
+            k_hbm.at[pl.ds(start, block_size), h], k_vmem, sem
+        )
+        copy_k.start()
+        copy_k.wait()
+        copy_v = pltpu.make_async_copy(
+            v_hbm.at[pl.ds(start, block_size), h], v_vmem, sem
+        )
+        copy_v.start()
+        copy_v.wait()
+
+        k = k_vmem[...].astype(jnp.float32)  # [bs, D]
+        v = v_vmem[...].astype(jnp.float32)
+        s = jax.lax.dot_general(  # [G, bs]
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        pos = i * block_size + jax.lax.broadcasted_iota(jnp.int32, (1, block_size), 1)
+        s = jnp.where(pos < ctx, s, -jnp.inf)
+
+        # online softmax update
+        m_prev = m_ref[:, :1]                      # [G, 1]
+        m_new = jnp.maximum(m_prev, s.max(axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)                     # [G, bs]
+        alpha = jnp.exp(m_prev - m_new)            # [G, 1]
+        l_new = alpha * l_ref[:, :1] + p.sum(axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+        return 0
+
+    jax.lax.fori_loop(0, n_blocks, body, 0)
+    l = l_ref[:, :1]
+    safe_l = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0, 0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+
+
+def paged_attention_pallas(
+    q: jax.Array,            # [B, n_heads, head_dim]
+    k_cache: jax.Array,      # [num_slots, n_kv_heads, head_dim]
+    v_cache: jax.Array,
+    block_tables: jax.Array, # [B, max_blocks]
+    context_lens: jax.Array, # [B]
+    *,
+    block_size: int,
+    interpret: bool = False,
+) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, H, D = q.shape
+    KVH = k_cache.shape[1]
+    G = H // KVH
+    MB = block_tables.shape[1]
+
+    # [B, KVH, G, D] query layout: one grid cell per (request, kv head)
+    qg = q.reshape(B, KVH, G, D)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, KVH),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, D), lambda b, h, *_: (b, h, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, D), lambda b, h, *_: (b, h, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_size, D), k_cache.dtype),
+            pltpu.VMEM((block_size, D), v_cache.dtype),
+            pltpu.VMEM((G, D), jnp.float32),
+            pltpu.VMEM((G, 128), jnp.float32),
+            pltpu.VMEM((G, 128), jnp.float32),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    kernel = pl.pallas_call(
+        functools.partial(
+            _paged_attn_kernel, block_size=block_size, max_blocks=MB
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KVH, G, D), q.dtype),
+        interpret=interpret,
+    )
+    out = kernel(
+        block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
+        qg, k_cache, v_cache,
+    )
+    return out.reshape(B, H, D)
+
+
+def paged_attention(
+    q, k_cache, v_cache, block_tables, context_lens, *, block_size: int,
+    impl: str = "auto",
+):
+    """impl: auto (pallas on TPU, xla elsewhere) | xla | pallas | pallas_interpret."""
+    if impl == "auto":
+        # resolved by backend, not by q.devices(): q may be a tracer here
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "xla":
+        return paged_attention_xla(
+            q, k_cache, v_cache, block_tables, context_lens, block_size=block_size
+        )
+    if impl == "pallas":
+        return paged_attention_pallas(
+            q, k_cache, v_cache, block_tables, context_lens, block_size=block_size
+        )
+    if impl == "pallas_interpret":
+        return paged_attention_pallas(
+            q, k_cache, v_cache, block_tables, context_lens,
+            block_size=block_size, interpret=True,
+        )
+    raise ValueError(f"unknown paged attention impl {impl!r}")

@@ -303,16 +303,10 @@ def get_app_handle(name: str = "default") -> DeploymentHandle:
     import ray_tpu
 
     controller = _get_controller_handle()
-    st = ray_tpu.get(controller.status.remote())
-    if name not in st["applications"]:
+    ingress = ray_tpu.get(controller.get_ingress.remote(name))
+    if ingress is None:
         raise ValueError(f"application {name!r} not found")
-    routes = ray_tpu.get(controller.list_routes.remote())
-    for _prefix, (app, ingress) in routes.items():
-        if app == name:
-            return DeploymentHandle(ingress, name)
-    # route-less app: ingress lookup via status deployments (first dep)
-    deps = list(st["applications"][name]["deployments"])
-    return DeploymentHandle(deps[0], name)
+    return DeploymentHandle(ingress, name)
 
 
 def get_deployment_handle(deployment_name: str, app_name: str = "default") -> DeploymentHandle:

@@ -186,7 +186,7 @@ class ServeController:
         with self._lock:
             ds = self._get_ds(app_name, dep_name)
             if ds is None:
-                return {"version": -1, "replicas": []}
+                return {"version": -1, "replicas": [], "max_queued_requests": -1}
             reps = [
                 (
                     r.replica_id,
@@ -196,12 +196,17 @@ class ServeController:
                 for r in ds.replicas
                 if r.state == ReplicaState.RUNNING
             ]
-            return {"version": ds.version, "replicas": reps}
+            return {
+                "version": ds.version,
+                "replicas": reps,
+                # shipped with every refresh so routers track config updates
+                "max_queued_requests": ds.deployment_config.max_queued_requests,
+            }
 
-    def get_max_queued_requests(self, app_name: str, dep_name: str) -> int:
+    def get_ingress(self, app_name: str):
         with self._lock:
-            ds = self._get_ds(app_name, dep_name)
-            return ds.deployment_config.max_queued_requests if ds else -1
+            app = self._apps.get(app_name)
+            return app.ingress if app else None
 
     def get_app_route(self, app_name: str) -> Optional[str]:
         with self._lock:
@@ -361,7 +366,9 @@ class ServeController:
         threading.Thread(target=_drain, daemon=True).start()
 
     def _poll_replicas(self) -> None:
-        """Health-check + metrics sweep (outside the lock for the RPCs)."""
+        """Health-check + metrics sweep (outside the lock for the RPCs).
+        Fan out all pings first, then collect — one wedged replica must not
+        stall checks for every other deployment."""
         import ray_tpu
 
         with self._lock:
@@ -371,10 +378,18 @@ class ServeController:
                 for ds in app.deployments.values()
                 for r in list(ds.replicas)
             ]
+        pings = []
         for ds, r in targets:
             try:
+                pings.append(r.handle.ping.remote())
+            except Exception:
+                pings.append(None)
+        for (ds, r), ref in zip(targets, pings):
+            try:
+                if ref is None:
+                    raise RuntimeError("ping dispatch failed")
                 metrics = ray_tpu.get(
-                    r.handle.metrics.remote(),
+                    ref,
                     timeout=ds.deployment_config.health_check_timeout_s,
                 )
                 with self._lock:

@@ -15,21 +15,37 @@ from typing import Any, Optional
 from ray_tpu.serve.router import Router
 
 
+class _RouteSlot:
+    """One dispatch's inflight accounting; shared with a GC finalizer so
+    fire-and-forget calls (response dropped without .result()) still
+    decrement the router's count exactly once."""
+
+    def __init__(self, router: Router, rid: str):
+        self._router = router
+        self._rid = rid
+        self._done = False
+        self._lock = threading.Lock()
+
+    def complete(self):
+        with self._lock:
+            if self._done:
+                return
+            self._done = True
+        self._router.complete(self._rid)
+
+
 class DeploymentResponse:
     """Future for one unary handle call."""
 
     def __init__(self, router: Router, rid: str, ref):
-        self._router = router
-        self._rid = rid
+        import weakref
+
+        self._slot = _RouteSlot(router, rid)
         self._ref = ref
-        self._done = False
-        self._lock = threading.Lock()
+        weakref.finalize(self, self._slot.complete)
 
     def _complete(self):
-        with self._lock:
-            if not self._done:
-                self._done = True
-                self._router.complete(self._rid)
+        self._slot.complete()
 
     def result(self, timeout_s: Optional[float] = None) -> Any:
         import ray_tpu
@@ -59,10 +75,11 @@ class DeploymentResponseGenerator:
     """Iterator over a streaming handle call."""
 
     def __init__(self, router: Router, rid: str, gen):
-        self._router = router
-        self._rid = rid
+        import weakref
+
+        self._slot = _RouteSlot(router, rid)
         self._gen = gen
-        self._done = False
+        weakref.finalize(self, self._slot.complete)
 
     def __iter__(self):
         import ray_tpu
@@ -71,9 +88,7 @@ class DeploymentResponseGenerator:
             for item_ref in self._gen:
                 yield ray_tpu.get(item_ref)
         finally:
-            if not self._done:
-                self._done = True
-                self._router.complete(self._rid)
+            self._slot.complete()
 
     async def __aiter__(self):
         import asyncio
@@ -116,13 +131,10 @@ def _shared_router(app_name: str, deployment_name: str) -> Router:
         router = _ROUTERS.get(key)
         if router is None:
             from ray_tpu.serve.api import _get_controller_handle
-            import ray_tpu
 
-            controller = _get_controller_handle()
-            max_queued = ray_tpu.get(
-                controller.get_max_queued_requests.remote(app_name, deployment_name)
-            )
-            router = Router(deployment_name, app_name, controller, max_queued)
+            # max_queued_requests arrives with the first replica-set refresh
+            # (and tracks redeploys) — no snapshot RPC here
+            router = Router(deployment_name, app_name, _get_controller_handle())
             _ROUTERS[key] = router
         return router
 

@@ -101,7 +101,10 @@ class HTTPProxy:
         if path == "/-/healthz":
             return web.Response(text="success")
         if path == "/-/routes":
-            self._refresh_routes()
+            # controller RPC off-loop, like the data path
+            await asyncio.get_running_loop().run_in_executor(
+                None, self._refresh_routes
+            )
             return web.json_response({p: a for p, (a, _) in self._routes.items()})
         match = await asyncio.get_running_loop().run_in_executor(
             None, self._match, path

@@ -78,22 +78,33 @@ class Replica:
             "uptime_s": time.time() - self._started_at,
         }
 
+    async def ping(self) -> dict:
+        """Controller health sweep: run the user's check_health hook, then
+        report metrics. A raising hook fails the ping → replica replaced."""
+        await self.check_health()
+        return await self.metrics()
+
     async def prepare_shutdown(self, timeout_s: float) -> None:
         """Drain in-flight requests, then run the user's cleanup hook
         (graceful_shutdown_timeout_s)."""
         deadline = time.time() + timeout_s
         while self._num_ongoing > 0 and time.time() < deadline:
             await asyncio.sleep(0.01)
-        # user-defined __del__ only (every object responds to getattr on a
-        # slot that object itself lacks, so look it up on the class)
-        hook = getattr(type(self._callable), "__del__", None)
-        if hook is not None and not self._is_function:
+        if self._is_function:
+            return
+        # Prefer a dedicated shutdown() hook. For a user __del__, DROP our
+        # reference instead of calling it — CPython refcounting then invokes
+        # __del__ exactly once, here, rather than twice (explicit call + GC).
+        hook = getattr(self._callable, "shutdown", None)
+        if hook is not None and callable(hook):
             try:
-                out = hook(self._callable)
+                out = hook()
                 if inspect.isawaitable(out):
                     await out
             except Exception:
                 pass  # cleanup errors must not block teardown
+        elif getattr(type(self._callable), "__del__", None) is not None:
+            self._callable = None
 
     # -- data plane -----------------------------------------------------------
 
@@ -168,19 +179,20 @@ class Replica:
         items are yielded through the framework's ObjectRefGenerator."""
         self._num_ongoing += 1
         try:
-            args, kwargs = await self._resolve_refs(args, kwargs)
-            target = self._resolve_target(method_name)
-            out = target(*args, **kwargs)
-            if inspect.isawaitable(out):
-                out = await out
-            if hasattr(out, "__aiter__"):
-                async for item in out:
-                    yield item
-            elif hasattr(out, "__iter__"):
-                for item in out:
-                    yield item
-            else:
-                yield out
+            async with self._request_sem:  # same cap as the unary path
+                args, kwargs = await self._resolve_refs(args, kwargs)
+                target = self._resolve_target(method_name)
+                out = target(*args, **kwargs)
+                if inspect.isawaitable(out):
+                    out = await out
+                if hasattr(out, "__aiter__"):
+                    async for item in out:
+                        yield item
+                elif hasattr(out, "__iter__"):
+                    for item in out:
+                        yield item
+                else:
+                    yield out
         finally:
             self._num_ongoing -= 1
             self._num_processed += 1

@@ -32,12 +32,11 @@ class Router:
         deployment_name: str,
         app_name: str,
         controller_handle,
-        max_queued_requests: int = -1,
     ):
         self._deployment = deployment_name
         self._app = app_name
         self._controller = controller_handle
-        self._max_queued = max_queued_requests
+        self._max_queued = -1  # refreshed with the replica set
         self._lock = threading.Lock()
         self._replicas: list = []  # list[(replica_id, ActorHandle, max_ongoing)]
         self._version = -1
@@ -60,6 +59,7 @@ class Router:
         )
         with self._lock:
             self._last_refresh = now
+            self._max_queued = info.get("max_queued_requests", -1)
             if info["version"] != self._version:
                 self._version = info["version"]
                 self._replicas = info["replicas"]
