@@ -185,6 +185,12 @@ class Actor:
             return lambda world, rank, backend, group: init_collective_group(
                 world, rank, backend=backend, group_name=group
             )
+        if name == "__ray_tpu_dag_exec_loop__":
+            from ray_tpu.dag.compiled import _actor_exec_loop
+
+            return lambda plan, input_source: _actor_exec_loop(
+                self.instance, plan, input_source
+            )
         return None
 
     def _execute(self, spec: TaskSpec) -> None:

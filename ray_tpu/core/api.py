@@ -173,6 +173,13 @@ class RemoteFunction:
         merged.update(opts)
         return RemoteFunction(self._func, _split_task_options(merged))
 
+    def bind(self, *args, **kwargs):
+        """Record a DAG node for workflows/compiled graphs (reference:
+        FunctionNode via ray.dag)."""
+        from ray_tpu.dag.nodes import FunctionNode
+
+        return FunctionNode(self, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(
             f"remote function {self._func.__name__} cannot be called directly; "
@@ -196,6 +203,13 @@ class ActorMethod:
 
     def options(self, num_returns: Union[int, str] = 1) -> "ActorMethod":
         return ActorMethod(self._handle, self._name, num_returns)
+
+    def bind(self, *args, **kwargs):
+        """Record a compiled-graph node instead of submitting (reference:
+        python/ray/dag — actor.method.bind)."""
+        from ray_tpu.dag.nodes import ClassMethodNode
+
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
 
     def __call__(self, *args, **kwargs):
         raise TypeError(

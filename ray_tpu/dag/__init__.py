@@ -1,0 +1,37 @@
+"""ray_tpu.dag: compiled static graphs of actor method calls.
+
+Reference analog: python/ray/dag/ (CompiledDAG, compiled_dag_node.py:795)
++ python/ray/experimental/channel/. A DAG of actor-method calls is
+compiled once into per-actor execution loops wired with reusable
+channels, bypassing per-call task submission — the reference's
+µs-latency substrate for vLLM pipeline parallelism. TPU-first delta:
+device tensors should move via jitted collectives inside SPMD programs
+(parallel/ + collective/), so these channels carry HOST objects
+(control data, activations staged host-side, DCN hops); in one process
+they are queue-backed, mirroring the reference's mutable-plasma
+single-slot semantics.
+"""
+
+from ray_tpu.dag.channels import Channel, ChannelClosedError
+from ray_tpu.dag.compiled import CompiledDAG, CompiledDAGRef
+from ray_tpu.dag.nodes import (
+    ClassMethodNode,
+    CollectiveOutputNode,
+    DAGNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+
+__all__ = [
+    "Channel",
+    "ChannelClosedError",
+    "ClassMethodNode",
+    "CollectiveOutputNode",
+    "CompiledDAG",
+    "CompiledDAGRef",
+    "DAGNode",
+    "InputAttributeNode",
+    "InputNode",
+    "MultiOutputNode",
+]

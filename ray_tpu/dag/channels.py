@@ -1,0 +1,70 @@
+"""Channels: reusable single-slot pipes between compiled-DAG actors.
+
+Reference analog: python/ray/experimental/channel/shared_memory_channel.py
+(Channel over mutable plasma objects — single writer, registered readers,
+slot reused every iteration) and intra_process_channel.py. The C++
+substrate there is MutableObjectManager spin-wait buffers
+(src/ray/core_worker/experimental_mutable_object_manager.h:49); in one
+host process a bounded queue per reader gives the same semantics
+(backpressure at capacity, ordered delivery, N-reader fan-out) without
+shared-memory ceremony.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Optional
+
+
+class ChannelClosedError(Exception):
+    pass
+
+
+_CLOSED = object()
+
+
+class Channel:
+    """Single-writer, N-reader channel. Each reader gets every value
+    (fan-out duplicates the reference's reader-registration model)."""
+
+    def __init__(self, num_readers: int = 1, maxsize: int = 2):
+        if num_readers < 1:
+            raise ValueError("channel needs at least one reader")
+        self._queues = [queue.Queue(maxsize=maxsize) for _ in range(num_readers)]
+        self._closed = threading.Event()
+
+    def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        if self._closed.is_set():
+            raise ChannelClosedError("channel closed")
+        for q in self._queues:
+            q.put(value, timeout=timeout)
+
+    def read(self, reader_idx: int = 0, timeout: Optional[float] = None) -> Any:
+        try:
+            v = self._queues[reader_idx].get(timeout=timeout)
+        except queue.Empty:
+            if self._closed.is_set():
+                raise ChannelClosedError("channel closed") from None
+            raise
+        if v is _CLOSED:
+            raise ChannelClosedError("channel closed")
+        return v
+
+    def close(self) -> None:
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        for q in self._queues:
+            try:
+                q.put_nowait(_CLOSED)
+            except queue.Full:
+                # drain one slot so the sentinel always fits
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    pass
+                try:
+                    q.put_nowait(_CLOSED)
+                except queue.Full:
+                    pass

@@ -1,0 +1,372 @@
+"""CompiledDAG: lower a bound DAG to per-actor exec loops + channels.
+
+Reference analog: python/ray/dag/compiled_dag_node.py (CompiledDAG:795,
+execute:2535, _execute_until:2464) and dag_node_operation.py (per-actor
+op schedules). Compile-time work: group method nodes by actor, allocate
+one channel per cross-loop edge, precompute every op's argument sources
+(const / input / input-field / local cache / channel read). Runtime
+work per execute(): ONE input-channel write, loops stream values
+through channels — no task submission, no object store, no
+serialization (host objects move by reference between loop threads).
+
+Execution runs inside each actor's own executor thread (framework
+method __ray_tpu_dag_exec_loop__) so user state stays thread-confined,
+exactly like the reference's per-actor exec loop tasks.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from ray_tpu.dag.channels import Channel, ChannelClosedError
+from ray_tpu.dag.nodes import (
+    ClassMethodNode,
+    CollectiveOutputNode,
+    DAGNode,
+    InputAttributeNode,
+    InputNode,
+    MultiOutputNode,
+)
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.dag")
+
+_INPUT = "__input__"
+
+
+class _Op:
+    """One step of a loop's per-iteration schedule."""
+
+    def __init__(self, node_id: int, method_name: Optional[str], arg_sources,
+                 kwarg_sources, out_channel: Optional[Channel]):
+        self.node_id = node_id
+        self.method_name = method_name  # None for pure routing ops
+        self.arg_sources = arg_sources
+        self.kwarg_sources = kwarg_sources
+        self.out_channel = out_channel
+
+
+def _resolve_source(src, input_value, local: dict):
+    kind = src[0]
+    if kind == "const":
+        return src[1]
+    if kind == "input":
+        return input_value
+    if kind == "input_attr":
+        return src[1].extract(input_value)
+    if kind == "local":
+        return local[src[1]]
+    if kind == "chan":
+        return src[1].read(src[2])
+    raise AssertionError(src)
+
+
+def _run_loop_iteration(instance, plan, input_value, local: dict):
+    for op in plan:
+        args = [_resolve_source(s, input_value, local) for s in op.arg_sources]
+        kwargs = {
+            k: _resolve_source(s, input_value, local)
+            for k, s in op.kwarg_sources.items()
+        }
+        out = getattr(instance, op.method_name)(*args, **kwargs)
+        local[op.node_id] = out
+        if op.out_channel is not None:
+            op.out_channel.write(out)
+
+
+def _actor_exec_loop(instance, plan, input_source):
+    """Runs on the actor's executor thread until channels close.
+    input_source: None | ("chan", channel, reader_idx)."""
+    while True:
+        try:
+            input_value = (
+                input_source[1].read(input_source[2])
+                if input_source is not None
+                else None
+            )
+            _run_loop_iteration(instance, plan, input_value, {})
+        except ChannelClosedError:
+            # propagate the poison downstream: close OUR out channels too,
+            # else a mid-pipeline failure only unblocks immediate consumers
+            for op in plan:
+                if op.out_channel is not None:
+                    op.out_channel.close()
+            return "dag-loop-exit"
+        except Exception:
+            # poison the pipeline: close our out channels so peers unblock
+            logger.exception("compiled DAG actor loop failed")
+            for op in plan:
+                if op.out_channel is not None:
+                    op.out_channel.close()
+            raise
+
+
+class CompiledDAGRef:
+    """Future for one execute() call (reference: CompiledDAGRef)."""
+
+    def __init__(self, dag: "CompiledDAG", seq: int):
+        self._dag = dag
+        self._seq = seq
+        self._value = None
+        self._have = False
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._have:
+            self._value = self._dag._fetch(self._seq, timeout)
+            self._have = True
+        return self._value
+
+
+class CompiledDAG:
+    def __init__(self, root: DAGNode, max_in_flight: int = 8):
+        import ray_tpu  # noqa: F401  (runtime must be up for actor calls)
+
+        self._lock = threading.Lock()
+        self._max_in_flight = max_in_flight
+        self._seq = 0
+        self._fetched = -1
+        self._results: dict[int, Any] = {}
+        self._torn_down = False
+
+        nodes = root.walk()
+        input_nodes = [n for n in nodes if isinstance(n, InputNode)]
+        if len(input_nodes) > 1:
+            raise ValueError("compiled DAG supports exactly one InputNode")
+        self._input_node = input_nodes[0] if input_nodes else None
+
+        outputs = root.outputs if isinstance(root, MultiOutputNode) else [root]
+        self._outputs = outputs
+        self._single = not isinstance(root, MultiOutputNode)
+
+        # group executable nodes by loop: one loop per actor, one per
+        # collective node (driver-side thread)
+        actor_loops: dict[int, dict] = {}  # id(actor) -> {handle, nodes}
+        collectives: list[CollectiveOutputNode] = []
+        for n in nodes:
+            if isinstance(n, ClassMethodNode):
+                key = id(n.actor_handle._actor)
+                loop = actor_loops.setdefault(
+                    key, {"handle": n.actor_handle, "nodes": []}
+                )
+                loop["nodes"].append(n)
+            elif isinstance(n, CollectiveOutputNode):
+                collectives.append(n)
+
+        loop_of: dict[int, Any] = {}  # node_id -> loop key ('driver' for none)
+        for key, loop in actor_loops.items():
+            for n in loop["nodes"]:
+                loop_of[n.id] = key
+        for cn in collectives:
+            loop_of[cn.id] = ("coll", cn.id)
+
+        # --- channel allocation -------------------------------------------
+        # consumers of node n = downstream executable nodes in OTHER loops
+        # (+ the driver if n is an output). readers are indexed per channel.
+        def consumers_of(n: DAGNode):
+            cons = []
+            for d in n.downstream:
+                if isinstance(d, (ClassMethodNode, CollectiveOutputNode)):
+                    if loop_of[d.id] != loop_of.get(n.id):
+                        cons.append(loop_of[d.id])
+            # dedupe, keep order
+            seen, out = set(), []
+            for c in cons:
+                if c not in seen:
+                    seen.add(c)
+                    out.append(c)
+            return out
+
+        self._channels: list[Channel] = []
+        chan_for: dict[int, Channel] = {}
+        reader_idx: dict[tuple, int] = {}  # (node_id, consumer_loop) -> idx
+
+        def alloc_channel(n: DAGNode, extra_driver_reads: int):
+            cons = consumers_of(n)
+            total = len(cons) + extra_driver_reads
+            if total == 0:
+                return None
+            ch = Channel(num_readers=total, maxsize=max_in_flight)
+            self._channels.append(ch)
+            chan_for[n.id] = ch
+            for i, c in enumerate(cons):
+                reader_idx[(n.id, c)] = i
+            if extra_driver_reads:
+                reader_idx[(n.id, "driver")] = len(cons)
+            return ch
+
+        output_ids = {n.id for n in outputs}
+        for n in nodes:
+            if isinstance(n, (ClassMethodNode, CollectiveOutputNode)):
+                alloc_channel(n, 1 if n.id in output_ids else 0)
+
+        # input channel: read by every loop that consumes the input
+        self._input_channel = None
+        if self._input_node is not None:
+            consuming_loops = []
+            for n in nodes:
+                if isinstance(n, ClassMethodNode):
+                    for a in list(n.args) + list(n.kwargs.values()):
+                        if isinstance(a, (InputNode, InputAttributeNode)):
+                            lk = loop_of[n.id]
+                            if lk not in consuming_loops:
+                                consuming_loops.append(lk)
+            if any(isinstance(o, (InputNode, InputAttributeNode)) for o in outputs):
+                raise ValueError("DAG output cannot be the input itself")
+            self._input_consumers = consuming_loops
+            if consuming_loops:
+                self._input_channel = Channel(
+                    num_readers=len(consuming_loops), maxsize=max_in_flight
+                )
+                self._channels.append(self._input_channel)
+
+        # --- build per-loop plans ------------------------------------------
+        def arg_source(loop_key, a):
+            if isinstance(a, InputNode):
+                return ("input",)
+            if isinstance(a, InputAttributeNode):
+                return ("input_attr", a)
+            if isinstance(a, DAGNode):
+                if loop_of.get(a.id) == loop_key:
+                    return ("local", a.id)
+                return ("chan", chan_for[a.id], reader_idx[(a.id, loop_key)])
+            return ("const", a)
+
+        self._loop_handles = []
+        for key, loop in actor_loops.items():
+            plan = []
+            for n in loop["nodes"]:  # creation order == topo order per actor
+                plan.append(
+                    _Op(
+                        n.id,
+                        n.method_name,
+                        [arg_source(key, a) for a in n.args],
+                        {k: arg_source(key, v) for k, v in n.kwargs.items()},
+                        chan_for.get(n.id),
+                    )
+                )
+            if self._input_channel is not None and key in self._input_consumers:
+                in_src = ("chan", self._input_channel, self._input_consumers.index(key))
+            else:
+                in_src = None
+            self._loop_handles.append(
+                _submit_exec_loop(loop["handle"], plan, in_src)
+            )
+
+        # collective loops run as driver-side threads
+        self._coll_threads = []
+        for cn in collectives:
+            key = ("coll", cn.id)
+            srcs = [arg_source(key, a) for a in cn.inputs]
+            out_ch = chan_for.get(cn.id)
+            t = threading.Thread(
+                target=_collective_loop,
+                args=(cn.op, srcs, out_ch),
+                daemon=True,
+                name=f"dag-collective-{cn.id}",
+            )
+            t.start()
+            self._coll_threads.append(t)
+
+        # driver-side output readers
+        self._output_sources = []
+        for o in outputs:
+            self._output_sources.append(
+                ("chan", chan_for[o.id], reader_idx[(o.id, "driver")])
+            )
+
+    # -- execution ------------------------------------------------------------
+
+    def execute(self, *args, **kwargs) -> CompiledDAGRef:
+        if self._torn_down:
+            raise RuntimeError("compiled DAG has been torn down")
+        with self._lock:
+            # a full pipeline must fail loudly, not self-deadlock: draining
+            # requires _fetch, which a blocked write (holding _lock) starves
+            if self._seq - self._fetched - 1 >= self._max_in_flight:
+                raise RuntimeError(
+                    f"compiled DAG has {self._max_in_flight} executions in "
+                    f"flight; call .get() on earlier refs before execute()"
+                )
+            seq = self._seq
+            self._seq += 1
+            if self._input_channel is not None:
+                if kwargs and not args:
+                    value = kwargs
+                elif len(args) == 1 and not kwargs:
+                    value = args[0]
+                else:
+                    value = args
+                self._input_channel.write(value)
+        return CompiledDAGRef(self, seq)
+
+    def _fetch(self, seq: int, timeout: Optional[float]):
+        import queue as _queue
+
+        with self._lock:
+            while self._fetched < seq:
+                try:
+                    vals = [
+                        src[1].read(src[2], timeout=timeout)
+                        for src in self._output_sources
+                    ]
+                except _queue.Empty:
+                    raise TimeoutError(
+                        f"compiled DAG output {seq} not ready after {timeout}s"
+                    ) from None
+                self._fetched += 1
+                self._results[self._fetched] = (
+                    vals[0] if self._single else list(vals)
+                )
+            out = self._results.pop(seq)
+        return out
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def teardown(self) -> None:
+        if self._torn_down:
+            return
+        self._torn_down = True
+        for ch in self._channels:
+            ch.close()
+        import ray_tpu
+
+        for ref in self._loop_handles:
+            try:
+                ray_tpu.get(ref, timeout=5)
+            except Exception:
+                pass
+
+    def __del__(self):
+        try:
+            self.teardown()
+        except Exception:
+            pass
+
+
+def _submit_exec_loop(handle, plan, input_source):
+    """Kick off the framework exec-loop task on the actor; returns its ref."""
+    from ray_tpu.core.api import ActorMethod
+
+    method = ActorMethod(handle, "__ray_tpu_dag_exec_loop__")
+    return method.remote(plan, input_source)
+
+
+def _collective_loop(op, srcs, out_ch):
+    while True:
+        try:
+            vals = [_resolve_source(s, None, {}) for s in srcs]
+            acc = vals[0]
+            for v in vals[1:]:
+                acc = op(acc, v)
+            if out_ch is not None:
+                out_ch.write(acc)
+        except ChannelClosedError:
+            if out_ch is not None:
+                out_ch.close()  # propagate poison downstream
+            return
+        except Exception:
+            logger.exception("collective loop failed")
+            if out_ch is not None:
+                out_ch.close()
+            return

@@ -1,0 +1,149 @@
+"""DAG node types + .bind() graph construction.
+
+Reference analog: python/ray/dag/dag_node.py, class_node.py,
+input_node.py, collective_node.py. `actor.method.bind(args)` records a
+ClassMethodNode; InputNode is the per-execute input; MultiOutputNode
+fans multiple leaves into one result tuple; CollectiveOutputNode binds
+an allreduce across N actors' intermediate values (reference:
+collective_node.py:18 _CollectiveOperation).
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Any, Callable, Optional
+
+_node_counter = itertools.count()
+
+
+class DAGNode:
+    def __init__(self, upstream: list["DAGNode"]):
+        self.id = next(_node_counter)
+        self.upstream = upstream
+        self.downstream: list[DAGNode] = []
+        for u in upstream:
+            u.downstream.append(self)
+
+    def experimental_compile(self, **kwargs):
+        from ray_tpu.dag.compiled import CompiledDAG
+
+        return CompiledDAG(self, **kwargs)
+
+    def walk(self, seen: Optional[set] = None) -> list["DAGNode"]:
+        """All ancestors + self, topologically ordered (ids are creation-
+        ordered, and bind() can only reference existing nodes)."""
+        seen = set()
+        order: list[DAGNode] = []
+
+        def visit(n: DAGNode):
+            if n.id in seen:
+                return
+            seen.add(n.id)
+            for u in n.upstream:
+                visit(u)
+            order.append(n)
+
+        visit(self)
+        return order
+
+
+class InputNode(DAGNode):
+    """Placeholder for the value passed to compiled_dag.execute().
+    Context-manager form mirrors the reference (`with InputNode() as inp`)."""
+
+    def __init__(self):
+        super().__init__([])
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __getattr__(self, key: str):
+        if key.startswith("_") or key in ("id", "upstream", "downstream"):
+            raise AttributeError(key)
+        return InputAttributeNode(self, key)
+
+    def __getitem__(self, key) -> "InputAttributeNode":
+        return InputAttributeNode(self, key)
+
+
+class InputAttributeNode(DAGNode):
+    """inp.x / inp[k]: extracts a field of the execute() input."""
+
+    def __init__(self, parent: InputNode, key: Any):
+        super().__init__([parent])
+        self.key = key
+
+    def extract(self, value: Any) -> Any:
+        if isinstance(self.key, str) and hasattr(value, self.key) and not isinstance(value, dict):
+            return getattr(value, self.key)
+        return value[self.key]
+
+
+class ClassMethodNode(DAGNode):
+    """One actor method call per execution (reference: class_node.py)."""
+
+    def __init__(self, actor_handle, method_name: str, args: tuple, kwargs: dict):
+        deps = [a for a in args if isinstance(a, DAGNode)]
+        deps += [v for v in kwargs.values() if isinstance(v, DAGNode)]
+        super().__init__(deps)
+        self.actor_handle = actor_handle
+        self.method_name = method_name
+        self.args = args
+        self.kwargs = kwargs
+
+
+class FunctionNode(DAGNode):
+    """One remote-function invocation (reference: function_node.py).
+    Used by workflows; compiled graphs use ClassMethodNode."""
+
+    def __init__(self, remote_fn, args: tuple, kwargs: dict):
+        deps = [a for a in args if isinstance(a, DAGNode)]
+        deps += [v for v in kwargs.values() if isinstance(v, DAGNode)]
+        super().__init__(deps)
+        self.remote_fn = remote_fn
+        self.args = args
+        self.kwargs = kwargs
+        # workflow-specific options (set via .options on the task)
+        self.task_name = getattr(remote_fn, "__name__", "task")
+
+
+class MultiOutputNode(DAGNode):
+    """Tuple of leaves -> one result list (reference: output_node.py)."""
+
+    def __init__(self, outputs: list[DAGNode]):
+        super().__init__(list(outputs))
+        self.outputs = list(outputs)
+
+
+class CollectiveOutputNode(DAGNode):
+    """Elementwise reduction across N actors' values. The reference
+    (collective_node.py) lowers this to NCCL allreduce between GPU
+    actors; host-side here (DCN-style control reductions). Device-tensor
+    allreduce belongs inside an SPMD jitted program (ray_tpu.collective)."""
+
+    def __init__(self, inputs: list[DAGNode], op: Callable[[Any, Any], Any]):
+        super().__init__(list(inputs))
+        self.inputs = list(inputs)
+        self.op = op
+
+
+def allreduce_bind(inputs: list[DAGNode], op: Callable[[Any, Any], Any] = None):
+    """reference: ray.experimental.collective.allreduce.bind(...)"""
+    import operator
+
+    node = CollectiveOutputNode(inputs, op or operator.add)
+    # each contributing actor observes the reduced value: downstream methods
+    # bound to this node receive the same reduction
+    return [node] * len(inputs)
+
+
+def bind_actor_method(actor_handle, method_name: str):
+    """Install-time helper: returns a .bind()-capable callable."""
+
+    def bind(*args, **kwargs) -> ClassMethodNode:
+        return ClassMethodNode(actor_handle, method_name, args, kwargs)
+
+    return bind

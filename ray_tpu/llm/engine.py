@@ -132,7 +132,8 @@ class LLMEngine:
         )
         self.waiting: deque[Request] = deque()
         self.running: list[Request] = []
-        self.requests: dict[str, Request] = {}
+        self.requests: dict[str, Request] = {}  # unfinished only
+        self.num_preemptions = 0
         self._counter = itertools.count()
         self._root_key = jax.random.key(seed ^ 0x5EED)
 
@@ -168,6 +169,14 @@ class LLMEngine:
                 f"prompt length {len(prompt_token_ids)} exceeds "
                 f"max_prefill_len={self.config.max_prefill_len}"
             )
+        # a prompt the cache can NEVER hold would wedge the queue head:
+        # _try_prefill would return [] forever while the engine spins
+        need = self.allocator.blocks_needed(len(prompt_token_ids) + 1)
+        if need > self.config.num_blocks:
+            raise ValueError(
+                f"prompt needs {need} KV blocks but the cache has only "
+                f"{self.config.num_blocks}; raise num_blocks or shorten it"
+            )
         req = Request(rid, list(map(int, prompt_token_ids)), sp)
         key = self._root_key if sp.seed is None else jax.random.key(sp.seed)
         req._key = jax.random.fold_in(key, hash(rid) & 0x7FFFFFFF)
@@ -187,6 +196,7 @@ class LLMEngine:
             req.seq.release()
         req.status = RequestStatus.ABORTED
         req.finish_reason = "abort"
+        self.requests.pop(request_id, None)
 
     def has_unfinished(self) -> bool:
         return bool(self.waiting or self.running)
@@ -212,9 +222,12 @@ class LLMEngine:
         rids = [
             self.add_request(p, sp) for p, sp in zip(prompts, sampling_params)
         ]
+        finals: dict[str, list] = {}
         while self.has_unfinished():
-            self.step()
-        return [self.requests[r].output_token_ids for r in rids]
+            for out in self.step():
+                if out.finished:
+                    finals[out.request_id] = out.output_token_ids
+        return [finals[r] for r in rids]
 
     def stats(self) -> dict:
         return {
@@ -313,6 +326,7 @@ class LLMEngine:
         # outputs are kept; re-admission prefills prompt+outputs (recompute)
         victim.status = RequestStatus.WAITING
         victim.num_preemptions += 1
+        self.num_preemptions += 1
         self.waiting.appendleft(victim)
         logger.info("preempted %s (recompute)", victim.request_id)
         return True
@@ -412,6 +426,9 @@ class LLMEngine:
                     # full written blocks stay reusable; the tail is freed
                     r.seq.seal_full_blocks(written)
                 r.seq.release()
+                # finished requests are dropped — a long-lived engine must
+                # not retain every token list it ever produced
+                self.requests.pop(r.request_id, None)
             else:
                 if c.enable_prefix_caching and len(written) % c.block_size == 0:
                     r.seq.seal_full_blocks(written)

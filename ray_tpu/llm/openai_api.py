@@ -196,15 +196,19 @@ class LLMServer:
         """Async generator of text deltas (serve streaming handles)."""
         sp = self._sampling_from_body(kwargs)
         ids = self.tokenizer.encode(prompt)
-        sent = 0
+        sent = ""
         async for out in self._run(ids, sp):
             toks = out.output_token_ids
             if toks and toks[-1] == self.engine.config.eos_token_id:
                 toks = toks[:-1]
             text = self.tokenizer.decode(toks)
-            if len(text) > sent:
-                yield text[sent:]
-                sent = len(text)
+            # hold back a trailing replacement char: it's usually half of a
+            # multi-byte sequence whose tail arrives with the next token
+            if not out.finished:
+                text = text.rstrip("�")
+            if text.startswith(sent) and len(text) > len(sent):
+                yield text[len(sent):]
+                sent = text
 
     # -- HTTP surface ---------------------------------------------------------
 
@@ -233,12 +237,17 @@ class LLMServer:
 
     async def completions(self, body: dict) -> Any:
         sp = self._sampling_from_body(body)
-        prompt = body.get("prompt", "")
-        if isinstance(prompt, list):
-            prompt = prompt[0] if prompt else ""
-        ids = self.tokenizer.encode(prompt)
-        text, toks, reason = await self._generate_text(ids, sp)
+        prompts = body.get("prompt", "")
+        if not isinstance(prompts, list):
+            prompts = [prompts]
+        id_lists = [self.tokenizer.encode(str(p)) for p in prompts]
+        # one choice per prompt, generated concurrently through the engine
+        results = await asyncio.gather(
+            *[self._generate_text(ids, sp) for ids in id_lists]
+        )
         rid = f"cmpl-{uuid.uuid4().hex[:24]}"
+        n_prompt = sum(len(ids) for ids in id_lists)
+        n_out = sum(len(toks) for _, toks, _ in results)
         payload = {
             "id": rid,
             "object": "text_completion",
@@ -246,16 +255,17 @@ class LLMServer:
             "model": body.get("model", self.config.model_id),
             "choices": [
                 {
-                    "index": 0,
+                    "index": i,
                     "text": text,
                     "finish_reason": reason,
                     "logprobs": None,
                 }
+                for i, (text, _toks, reason) in enumerate(results)
             ],
             "usage": {
-                "prompt_tokens": len(ids),
-                "completion_tokens": len(toks),
-                "total_tokens": len(ids) + len(toks),
+                "prompt_tokens": n_prompt,
+                "completion_tokens": n_out,
+                "total_tokens": n_prompt + n_out,
             },
         }
         if body.get("stream"):

@@ -1,0 +1,30 @@
+"""ray_tpu.workflow: durable task DAGs with exactly-once steps.
+
+Reference analog: python/ray/workflow/ (api.py:123 run, workflow
+executor + storage). Steps checkpoint to storage atomically; resume
+skips completed steps; a step returning a DAG continues into it.
+"""
+
+from ray_tpu.workflow.api import (
+    delete,
+    get_output,
+    get_status,
+    init,
+    list_all,
+    resume,
+    run,
+    run_async,
+)
+from ray_tpu.workflow.execution import WorkflowStatus
+
+__all__ = [
+    "WorkflowStatus",
+    "delete",
+    "get_output",
+    "get_status",
+    "init",
+    "list_all",
+    "resume",
+    "run",
+    "run_async",
+]

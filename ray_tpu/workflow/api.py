@@ -1,0 +1,105 @@
+"""Workflow public API (reference: python/ray/workflow/api.py —
+run:123, run_async:177, resume, get_status, list_all, get_output)."""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Optional
+
+from ray_tpu.dag.nodes import DAGNode
+from ray_tpu.workflow.execution import WorkflowExecutor, WorkflowStatus
+from ray_tpu.workflow.storage import WorkflowStorage
+
+_storage: Optional[WorkflowStorage] = None
+_lock = threading.Lock()
+_counter = [0]
+
+
+def init(storage_dir: Optional[str] = None) -> None:
+    """Configure workflow storage (default: RAY_TPU_WORKFLOW_DIR or
+    ~/.ray_tpu/workflows)."""
+    global _storage
+    root = (
+        storage_dir
+        or os.environ.get("RAY_TPU_WORKFLOW_DIR")
+        or os.path.expanduser("~/.ray_tpu/workflows")
+    )
+    _storage = WorkflowStorage(root)
+
+
+def _get_storage() -> WorkflowStorage:
+    with _lock:
+        if _storage is None:
+            init()
+        return _storage
+
+
+def _new_id() -> str:
+    import time
+
+    with _lock:
+        _counter[0] += 1
+        return f"workflow-{int(time.time())}-{_counter[0]}"
+
+
+def run(dag: DAGNode, *, workflow_id: Optional[str] = None) -> Any:
+    """Execute a task DAG durably; blocks until done. Re-running an
+    interrupted workflow_id resumes the STORED dag (step identity is
+    node-based, so a freshly rebuilt graph would re-execute everything)."""
+    storage = _get_storage()
+    wid = workflow_id or _new_id()
+    meta = storage.load_meta(wid)
+    if meta is not None and meta.get("status") != "SUCCESSFUL":
+        dag = storage.load_dag(wid)
+    else:
+        storage.save_dag(wid, dag)
+    return WorkflowExecutor(storage, wid).run(dag)
+
+
+def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None):
+    """Submit a workflow; returns an ObjectRef for its output."""
+    import ray_tpu
+
+    storage = _get_storage()
+    wid = workflow_id or _new_id()
+    storage.save_dag(wid, dag)
+
+    @ray_tpu.remote
+    def _drive(workflow_id: str):
+        return WorkflowExecutor(_get_storage(), workflow_id).run(
+            _get_storage().load_dag(workflow_id)
+        )
+
+    return _drive.options(name=f"workflow:{wid}").remote(wid)
+
+
+def resume(workflow_id: str) -> Any:
+    """Re-run a failed/interrupted workflow; completed steps are skipped."""
+    storage = _get_storage()
+    dag = storage.load_dag(workflow_id)
+    return WorkflowExecutor(storage, workflow_id).run(dag)
+
+
+def get_status(workflow_id: str) -> Optional[str]:
+    meta = _get_storage().load_meta(workflow_id)
+    return meta["status"] if meta else None
+
+
+def get_output(workflow_id: str) -> Any:
+    storage = _get_storage()
+    if not storage.has_step(workflow_id, "__output__"):
+        raise ValueError(f"workflow {workflow_id!r} has no stored output")
+    return storage.load_step(workflow_id, "__output__")
+
+
+def list_all(status_filter: Optional[str] = None) -> list:
+    out = []
+    for wid, meta in _get_storage().list_workflows():
+        if status_filter is None or meta.get("status") == status_filter:
+            out.append((wid, meta.get("status")))
+    return out
+
+
+def delete(workflow_id: str) -> None:
+    _get_storage().delete(workflow_id)

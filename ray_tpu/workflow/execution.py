@@ -1,0 +1,97 @@
+"""Workflow executor: run a task DAG with durable, exactly-once steps.
+
+Reference analog: python/ray/workflow/workflow_executor.py:32 +
+workflow_context.py. Steps whose results exist in storage are skipped
+on resume; a step returning another DAG is a continuation
+(reference: workflow.continuation) executed in its place.
+"""
+
+from __future__ import annotations
+
+import traceback
+from typing import Any, Optional
+
+from ray_tpu.dag.nodes import DAGNode, FunctionNode, InputNode, MultiOutputNode
+from ray_tpu.utils.logging import get_logger
+from ray_tpu.workflow.storage import WorkflowStorage
+
+logger = get_logger("ray_tpu.workflow")
+
+
+class WorkflowStatus:
+    RUNNING = "RUNNING"
+    SUCCESSFUL = "SUCCESSFUL"
+    FAILED = "FAILED"
+    RESUMABLE = "RESUMABLE"
+
+
+def _step_key(node: FunctionNode) -> str:
+    """Stable key: node id (creation-ordered, preserved by DAG pickling) +
+    task name — one key per NODE, so a diamond-shared upstream step runs
+    once, not once per consuming path."""
+    return f"n{node.id}-{node.task_name}"
+
+
+class WorkflowExecutor:
+    def __init__(self, storage: WorkflowStorage, workflow_id: str):
+        self.storage = storage
+        self.workflow_id = workflow_id
+        self._memo: dict[int, Any] = {}  # node.id -> result (this run)
+
+    def run(self, dag: DAGNode) -> Any:
+        meta = self.storage.load_meta(self.workflow_id) or {}
+        meta.update(status=WorkflowStatus.RUNNING)
+        self.storage.save_meta(self.workflow_id, meta)
+        try:
+            result = self._exec_node(dag, "root")
+        except BaseException as e:
+            self.storage.save_meta(
+                self.workflow_id,
+                {
+                    "status": WorkflowStatus.RESUMABLE,
+                    "error": f"{type(e).__name__}: {e}",
+                    "traceback": traceback.format_exc(),
+                },
+            )
+            raise
+        self.storage.save_step(self.workflow_id, "__output__", result)
+        self.storage.save_meta(self.workflow_id, {"status": WorkflowStatus.SUCCESSFUL})
+        return result
+
+    def _exec_node(self, node: Any, path: str) -> Any:
+        if isinstance(node, MultiOutputNode):
+            return [
+                self._exec_node(o, f"{path}.{i}") for i, o in enumerate(node.outputs)
+            ]
+        if isinstance(node, FunctionNode):
+            return self._exec_step(node, path)
+        if isinstance(node, InputNode):
+            raise ValueError("workflows take no InputNode; bind concrete args")
+        if isinstance(node, DAGNode):
+            raise TypeError(f"workflows support task nodes only, got {type(node)}")
+        return node  # plain value
+
+    def _exec_step(self, node: FunctionNode, path: str) -> Any:
+        if node.id in self._memo:
+            return self._memo[node.id]
+        key = _step_key(node)
+        if self.storage.has_step(self.workflow_id, key):
+            result = self.storage.load_step(self.workflow_id, key)
+            self._memo[node.id] = result
+            return result
+
+        args = [
+            self._exec_node(a, f"{path}.a{i}") for i, a in enumerate(node.args)
+        ]
+        kwargs = {
+            k: self._exec_node(v, f"{path}.k{k}") for k, v in node.kwargs.items()
+        }
+        import ray_tpu
+
+        result = ray_tpu.get(node.remote_fn.remote(*args, **kwargs))
+        if isinstance(result, DAGNode):
+            # continuation: the step expanded into a sub-DAG
+            result = self._exec_node(result, f"{path}.c")
+        self.storage.save_step(self.workflow_id, key, result)
+        self._memo[node.id] = result
+        return result

@@ -1,0 +1,104 @@
+"""Workflow storage: durable per-step results + workflow metadata.
+
+Reference analog: python/ray/workflow/workflow_storage.py:229
+(WorkflowStorage over a filesystem/S3 store). Exactly-once comes from
+atomic write-then-rename of step results: a step whose result file
+exists is never re-executed on resume.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from typing import Any, Optional
+
+# cloudpickle: DAGs close over locally-defined task functions (same choice
+# as the reference's vendored cloudpickle for task serialization)
+import cloudpickle as pickle
+
+
+class WorkflowStorage:
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    # -- paths ---------------------------------------------------------------
+
+    def _wf_dir(self, workflow_id: str) -> str:
+        return os.path.join(self.root, workflow_id)
+
+    def _step_path(self, workflow_id: str, step_key: str) -> str:
+        return os.path.join(self._wf_dir(workflow_id), "steps", f"{step_key}.pkl")
+
+    def _meta_path(self, workflow_id: str) -> str:
+        return os.path.join(self._wf_dir(workflow_id), "meta.json")
+
+    # -- atomic writes ---------------------------------------------------------
+
+    @staticmethod
+    def _atomic_write(path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)  # atomic on POSIX
+        except BaseException:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+            raise
+
+    # -- step results -----------------------------------------------------------
+
+    def has_step(self, workflow_id: str, step_key: str) -> bool:
+        return os.path.exists(self._step_path(workflow_id, step_key))
+
+    def save_step(self, workflow_id: str, step_key: str, result: Any) -> None:
+        self._atomic_write(
+            self._step_path(workflow_id, step_key), pickle.dumps(result)
+        )
+
+    def load_step(self, workflow_id: str, step_key: str) -> Any:
+        with open(self._step_path(workflow_id, step_key), "rb") as f:
+            return pickle.load(f)
+
+    # -- workflow metadata -------------------------------------------------------
+
+    def save_meta(self, workflow_id: str, meta: dict) -> None:
+        meta = dict(meta, updated_at=time.time())
+        self._atomic_write(
+            self._meta_path(workflow_id), json.dumps(meta).encode()
+        )
+
+    def load_meta(self, workflow_id: str) -> Optional[dict]:
+        p = self._meta_path(workflow_id)
+        if not os.path.exists(p):
+            return None
+        with open(p) as f:
+            return json.load(f)
+
+    def save_dag(self, workflow_id: str, dag) -> None:
+        self._atomic_write(
+            os.path.join(self._wf_dir(workflow_id), "dag.pkl"), pickle.dumps(dag)
+        )
+
+    def load_dag(self, workflow_id: str):
+        with open(os.path.join(self._wf_dir(workflow_id), "dag.pkl"), "rb") as f:
+            return pickle.load(f)
+
+    def list_workflows(self) -> list:
+        if not os.path.isdir(self.root):
+            return []
+        out = []
+        for wid in sorted(os.listdir(self.root)):
+            meta = self.load_meta(wid)
+            if meta is not None:
+                out.append((wid, meta))
+        return out
+
+    def delete(self, workflow_id: str) -> None:
+        import shutil
+
+        shutil.rmtree(self._wf_dir(workflow_id), ignore_errors=True)

@@ -175,16 +175,19 @@ def test_engine_prefix_cache_hit():
     sp = SamplingParams(max_tokens=4, temperature=0.0, ignore_eos=True)
     eng.generate([shared], sp)
     rid = eng.add_request(shared + [7, 8, 9], sp)
-    cached = None
+    cached, final = None, None
     while eng.has_unfinished():
         for out in eng.step():
-            if out.request_id == rid and cached is None:
-                cached = out.num_cached_tokens
+            if out.request_id == rid:
+                if cached is None:
+                    cached = out.num_cached_tokens
+                if out.finished:
+                    final = out.output_token_ids
     assert cached == 24
     # cache hit must not change results
     eng2 = _engine(enable_prefix_caching=False)
     outs_nc = eng2.generate([shared + [7, 8, 9]], sp)
-    assert eng.requests[rid].output_token_ids == outs_nc[0]
+    assert final == outs_nc[0]
 
 
 def test_engine_preemption_under_pressure():
@@ -194,7 +197,7 @@ def test_engine_preemption_under_pressure():
     sp = SamplingParams(max_tokens=20, temperature=0.0, ignore_eos=True)
     outs = eng.generate(prompts, sp)
     assert all(len(o) == 20 for o in outs)
-    assert sum(r.num_preemptions for r in eng.requests.values()) > 0
+    assert eng.num_preemptions > 0
     assert eng.allocator.num_free == 10
     # preemption-by-recompute must be deterministic for greedy sampling
     big = _engine(num_blocks=64)

@@ -1,0 +1,119 @@
+"""Workflow tests (reference strategy: python/ray/workflow/tests/):
+durability, resume-after-failure, exactly-once, continuations."""
+
+import pytest
+
+import ray_tpu
+from ray_tpu import workflow
+
+
+@pytest.fixture(autouse=True)
+def wf_storage(tmp_path):
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=16)
+    workflow.init(str(tmp_path))
+    yield
+
+
+EXEC_COUNT = {"n": 0}
+
+
+def test_run_simple_dag():
+    @ray_tpu.remote
+    def add(a, b):
+        return a + b
+
+    @ray_tpu.remote
+    def mul(a, b):
+        return a * b
+
+    out = workflow.run(mul.bind(add.bind(1, 2), add.bind(3, 4)), workflow_id="w1")
+    assert out == 21
+    assert workflow.get_status("w1") == workflow.WorkflowStatus.SUCCESSFUL
+    assert workflow.get_output("w1") == 21
+
+
+def test_resume_skips_completed_steps():
+    EXEC_COUNT["n"] = 0
+
+    @ray_tpu.remote
+    def counted(x):
+        EXEC_COUNT["n"] += 1
+        return x + 100
+
+    @ray_tpu.remote
+    def flaky(x, fail_marker):
+        import os
+
+        if os.path.exists(fail_marker):
+            raise RuntimeError("injected failure")
+        return x * 2
+
+    import tempfile, os
+
+    marker = tempfile.mktemp()
+    open(marker, "w").close()
+    dag = flaky.bind(counted.bind(1), marker)
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id="w2")
+    assert workflow.get_status("w2") == workflow.WorkflowStatus.RESUMABLE
+    assert EXEC_COUNT["n"] == 1
+
+    os.unlink(marker)  # heal the failure
+    out = workflow.resume("w2")
+    assert out == 202
+    # exactly-once: the completed upstream step did NOT re-execute
+    assert EXEC_COUNT["n"] == 1
+    assert workflow.get_status("w2") == workflow.WorkflowStatus.SUCCESSFUL
+
+
+def test_diamond_step_runs_once():
+    EXEC_COUNT["n"] = 0
+
+    @ray_tpu.remote
+    def base():
+        EXEC_COUNT["n"] += 1
+        return 5
+
+    @ray_tpu.remote
+    def double(x):
+        return 2 * x
+
+    @ray_tpu.remote
+    def triple(x):
+        return 3 * x
+
+    @ray_tpu.remote
+    def join(a, b):
+        return a + b
+
+    shared = base.bind()
+    out = workflow.run(join.bind(double.bind(shared), triple.bind(shared)),
+                       workflow_id="wdiamond")
+    assert out == 25
+    assert EXEC_COUNT["n"] == 1  # diamond-shared step executed once
+
+
+def test_continuation():
+    @ray_tpu.remote
+    def final(x):
+        return x + 1
+
+    @ray_tpu.remote
+    def outer(x):
+        return final.bind(x * 10)  # step expands into a sub-DAG
+
+    assert workflow.run(outer.bind(4), workflow_id="w3") == 41
+
+
+def test_run_async_and_list():
+    @ray_tpu.remote
+    def work():
+        return "done"
+
+    ref = workflow.run_async(work.bind(), workflow_id="w4")
+    assert ray_tpu.get(ref, timeout=30) == "done"
+    wids = dict(workflow.list_all())
+    assert wids.get("w4") == workflow.WorkflowStatus.SUCCESSFUL
+    workflow.delete("w4")
+    assert "w4" not in dict(workflow.list_all())
