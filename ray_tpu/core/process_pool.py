@@ -30,6 +30,108 @@ logger = get_logger("ray_tpu.process_pool")
 
 _CTX = mp.get_context("fork")  # cheap startup; workers never touch the TPU
 
+# Buffers above this ride the C++ shared-memory store (zero-copy mmap views
+# in the peer process) instead of being copied through the pipe. The store
+# is the plasma-equivalent (ray_tpu/native/src/shm_store.cc).
+_SHM_THRESHOLD = 32 * 1024
+_shm_counter = threading.Lock(), [0]
+
+
+def _next_shm_id(prefix: int) -> bytes:
+    lock, counter = _shm_counter
+    with lock:
+        counter[0] += 1
+        n = counter[0]
+    return prefix.to_bytes(4, "little") + os.getpid().to_bytes(4, "little") + n.to_bytes(8, "little")
+
+
+class _BufferChannel:
+    """Pickle-5 out-of-band buffer transport: big buffers via the shm
+    store, small ones inline. Symmetric for both directions."""
+
+    def __init__(self, store):
+        self.store = store  # ShmObjectStore or None (inline-only fallback)
+
+    def encode(self, buffers: list) -> tuple[list, list[bytes]]:
+        """Returns (meta list, shm ids to delete after the peer is done)."""
+        meta, owned = [], []
+        for b in buffers:
+            try:
+                raw = b.raw() if hasattr(b, "raw") else memoryview(b)
+            except BufferError:  # non-contiguous pickle buffer
+                meta.append(("inline", bytes(b)))
+                continue
+            if self.store is not None and raw.nbytes >= _SHM_THRESHOLD:
+                oid = _next_shm_id(0xB0F)
+                try:
+                    # keep the producer ref until the peer is done: put()
+                    # would release it and expose the buffer to eviction
+                    # before the peer's get(). Single copy: source view ->
+                    # mapping, no intermediate bytes materialization.
+                    buf, _ = self.store.create_buffer(oid, raw.nbytes)
+                    memoryview(buf).cast("B")[:] = raw.cast("B")
+                    self.store.seal(oid)
+                    meta.append(("shm", oid, raw.nbytes))
+                    owned.append(oid)
+                    continue
+                except MemoryError:
+                    pass  # store full: fall through to inline
+            meta.append(("inline", raw.tobytes()))
+        return meta, owned
+
+    def decode(self, meta: list) -> tuple[list, list[bytes]]:
+        """Returns (buffer views, shm ids to release after use)."""
+        views, held = [], []
+        for m in meta:
+            if m[0] == "shm":
+                view = self.store.get(m[1])
+                if view is None:
+                    raise errors.ObjectLostError(
+                        f"shm buffer {m[1]!r} missing (evicted?)"
+                    )
+                views.append(view[: m[2]])
+                held.append(m[1])
+            else:
+                views.append(memoryview(m[1]))
+        return views, held
+
+    def release(self, ids: list[bytes]) -> None:
+        for oid in ids:
+            try:
+                self.store.release(oid)
+            except Exception:
+                pass
+
+    def delete(self, ids: list[bytes]) -> None:
+        for oid in ids:
+            try:
+                self.store.delete(oid)
+            except Exception:
+                pass
+
+    def producer_done(self, ids: list[bytes]) -> None:
+        """Drop the encode()-held refs and free the objects."""
+        self.release(ids)
+        self.delete(ids)
+
+    def consumer_done_and_free(self, ids: list[bytes]) -> None:
+        """Consumer drops its get() ref AND the remote producer's encode
+        ref (the producer moved on — cross-process handoff), then frees."""
+        self.release(ids)
+        self.release(ids)
+        self.delete(ids)
+
+    def reclaim_dead_peer(self, ids: list[bytes]) -> None:
+        """A peer died holding refs (crash mid-task): refcounts are stuck,
+        so reclaim unconditionally or the capacity leaks forever."""
+        if self.store is None:
+            return
+        for oid in ids:
+            try:
+                self.store.force_delete(oid)
+            except Exception:
+                pass
+
 
 class _ValueUnpickler(pickle.Unpickler):
     """Child side: persistent ids carry already-resolved object values."""
@@ -41,21 +143,23 @@ class _ValueUnpickler(pickle.Unpickler):
         raise pickle.UnpicklingError(f"unknown persistent id {kind!r}")
 
 
-def _loads_with_values(data: bytes):
+def _loads_with_values_buffers(data: bytes, buffers: list):
     import io
 
-    return _ValueUnpickler(io.BytesIO(data)).load()
+    return _ValueUnpickler(io.BytesIO(data), buffers=buffers or None).load()
 
 
-def _dumps_resolving_refs(obj, runtime) -> bytes:
+def _dumps_resolving_refs(obj, runtime) -> tuple[bytes, list]:
     """Parent side: replace ObjectRefs nested anywhere in the args with
     their resolved values (the child has its own empty runtime — a pickled
-    ref would rebuild against the wrong store and hang forever)."""
+    ref would rebuild against the wrong store and hang forever).
+    Returns (payload, out-of-band pickle-5 buffers)."""
     import io
 
     from ray_tpu.core.ref import ObjectRef
 
     buf = io.BytesIO()
+    buffers: list = []
 
     class _P(cloudpickle.CloudPickler):
         def persistent_id(self, o):
@@ -73,22 +177,53 @@ def _dumps_resolving_refs(obj, runtime) -> bytes:
                 )
             return None
 
-    _P(buf, protocol=5).dump(obj)
-    return buf.getvalue()
+    _P(buf, protocol=5, buffer_callback=buffers.append).dump(obj)
+    return buf.getvalue(), buffers
 
 
-def _worker_main(conn) -> None:
+def _worker_main(conn, shm_path: Optional[str]) -> None:
+    channel = None
+
+    def get_channel():
+        nonlocal channel
+        if channel is None:
+            store = None
+            if shm_path is not None and os.path.exists(shm_path):
+                from ray_tpu.native.shm import ShmObjectStore
+
+                store = ShmObjectStore.open(shm_path)
+            channel = _BufferChannel(store)
+        return channel
+
     while True:
         try:
             msg = conn.recv_bytes()
         except (EOFError, OSError):
             return
+        held: list = []
         try:
-            func, args, kwargs = _loads_with_values(msg)
+            envelope, meta = pickle.loads(msg)
+            if meta:
+                views, held = get_channel().decode(meta)
+            else:
+                views = []
+            func, args, kwargs = _loads_with_values_buffers(envelope, views)
             result = func(*args, **kwargs)
-            payload = cloudpickle.dumps(("ok", result))
+            out_buffers: list = []
+            out_payload = cloudpickle.dumps(
+                ("ok", result), protocol=5, buffer_callback=out_buffers.append
+            )
+            out_meta, _owned = (
+                get_channel().encode(out_buffers) if out_buffers else ([], [])
+            )
+            payload = pickle.dumps((out_payload, out_meta))
         except BaseException as e:  # noqa: BLE001
-            payload = cloudpickle.dumps(("err", (e, traceback.format_exc())))
+            payload = pickle.dumps(
+                (cloudpickle.dumps(("err", (e, traceback.format_exc()))), [])
+            )
+        finally:
+            if held and channel is not None:
+                channel.release(held)
         try:
             conn.send_bytes(payload)
         except (BrokenPipeError, OSError):
@@ -96,9 +231,11 @@ def _worker_main(conn) -> None:
 
 
 class _Worker:
-    def __init__(self):
+    def __init__(self, shm_path: Optional[str] = None):
         self.parent_conn, child_conn = _CTX.Pipe()
-        self.proc = _CTX.Process(target=_worker_main, args=(child_conn,), daemon=True)
+        self.proc = _CTX.Process(
+            target=_worker_main, args=(child_conn, shm_path), daemon=True
+        )
         self.proc.start()
         child_conn.close()
 
@@ -117,12 +254,39 @@ class _Worker:
 
 
 class ProcessPool:
-    def __init__(self, max_workers: int = 8):
+    def __init__(self, max_workers: int = 8, shm_capacity: int = 256 << 20):
         self._free: list[_Worker] = []
         self._lock = threading.Lock()
         self._max = max_workers
         self._count = 0
         self._running: dict[bytes, _Worker] = {}  # task_id bytes -> worker
+        self._shm_capacity = shm_capacity
+        self._shm_path = f"/dev/shm/ray_tpu_store_{os.getpid()}.shm"
+        self._channel: Optional[_BufferChannel] = None
+        # eager: workers fork knowing whether the store exists (tmpfs files
+        # are sparse, so unused capacity costs nothing)
+        self._get_channel()
+
+    def _get_channel(self) -> _BufferChannel:
+        """Lazily create the shared store (plasma-equivalent); fall back to
+        inline pipe transport if the native lib can't build."""
+        with self._lock:
+            if self._channel is None:
+                store = None
+                try:
+                    from ray_tpu.native.shm import ShmObjectStore
+
+                    store = ShmObjectStore.create(
+                        self._shm_path, self._shm_capacity
+                    )
+                except Exception:
+                    logger.warning(
+                        "native shm store unavailable; using inline transport",
+                        exc_info=True,
+                    )
+                    self._shm_path = None
+                self._channel = _BufferChannel(store)
+            return self._channel
 
     def run(self, spec: TaskSpec):
         """Execute the task in a leased worker; blocks until done."""
@@ -131,19 +295,44 @@ class ProcessPool:
 
         runtime = get_runtime()
         args, kwargs = resolve_args(runtime, spec.args, spec.kwargs)
-        payload_out = _dumps_resolving_refs((spec.func, args, kwargs), runtime)
+        envelope, out_buffers = _dumps_resolving_refs(
+            (spec.func, args, kwargs), runtime
+        )
+        channel = self._get_channel()
+        arg_meta, owned = channel.encode(out_buffers) if out_buffers else ([], [])
+        payload_out = pickle.dumps((envelope, arg_meta))
         worker = self._lease()
         tid = spec.task_id.binary()
         self._running[tid] = worker
         try:
             try:
-                worker.parent_conn.send_bytes(payload_out)
-                payload = worker.parent_conn.recv_bytes()
-            except (EOFError, BrokenPipeError, OSError):
-                raise errors.WorkerCrashedError(
-                    f"worker pid={worker.pid} died executing {spec.describe()}"
-                ) from None
-            status, value = pickle.loads(payload)
+                try:
+                    worker.parent_conn.send_bytes(payload_out)
+                    payload = worker.parent_conn.recv_bytes()
+                except (EOFError, BrokenPipeError, OSError):
+                    # the dead worker may hold refs on the arg objects:
+                    # normal delete would fail, leaking store capacity
+                    if owned:
+                        channel.reclaim_dead_peer(owned)
+                        owned = []
+                    raise errors.WorkerCrashedError(
+                        f"worker pid={worker.pid} died executing {spec.describe()}"
+                    ) from None
+            finally:
+                if owned and channel.store is not None:
+                    channel.producer_done(owned)
+            result_payload, result_meta = pickle.loads(payload)
+            held: list = []
+            views = []
+            if result_meta:
+                raw_views, held = channel.decode(result_meta)
+                # own the data BEFORE unpickling: reconstructed objects of
+                # ANY container shape then never alias soon-freed shm pages
+                views = [bytearray(v) for v in raw_views]
+                del raw_views
+                channel.consumer_done_and_free(held)
+                held = []
+            status, value = pickle.loads(result_payload, buffers=views or None)
             if status == "err":
                 exc, tb = value
                 raise errors.TaskError(exc, tb, spec.describe())
@@ -182,7 +371,7 @@ class ProcessPool:
                     return w
                 self._discard_locked(w)
             self._count += 1
-            return _Worker()
+            return _Worker(self._shm_path)
 
     def _release(self, worker: _Worker) -> None:
         with self._lock:
@@ -200,7 +389,15 @@ class ProcessPool:
         worker.kill()
 
     def shutdown(self) -> None:
+        # kill running workers first so blocked run() calls fail fast via
+        # the crash path, then close the store (its Python guard turns any
+        # straggler access into OSError instead of a native SIGSEGV)
+        for w in list(self._running.values()):
+            w.kill()
         with self._lock:
             for w in self._free:
                 w.kill()
             self._free.clear()
+            if self._channel is not None and self._channel.store is not None:
+                self._channel.store.close()
+                self._channel = None

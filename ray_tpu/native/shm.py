@@ -1,0 +1,208 @@
+"""ctypes binding for the C++ shared-memory object store.
+
+Reference analog: the plasma client (src/ray/object_manager/plasma/
+client.cc) — but there is no broker socket: every process maps the same
+tmpfs file and calls into libshm_store directly; sealed objects are
+zero-copy numpy/memoryview slices of the mapping.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+_LIB_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+
+
+def _build_dir() -> str:
+    return os.path.dirname(os.path.abspath(__file__))
+
+
+def load_library(build: bool = True) -> ctypes.CDLL:
+    """Load (building if needed) libshm_store.so."""
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        d = _build_dir()
+        so = os.path.join(d, "libshm_store.so")
+        src = os.path.join(d, "src", "shm_store.cc")
+        if build and (
+            not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)
+        ):
+            subprocess.run(
+                ["make", "-s", "-C", d], check=True, capture_output=True
+            )
+        lib = ctypes.CDLL(so)
+        lib.shm_store_create.restype = ctypes.c_void_p
+        lib.shm_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.shm_store_open.restype = ctypes.c_void_p
+        lib.shm_store_open.argtypes = [ctypes.c_char_p]
+        lib.shm_store_close.argtypes = [ctypes.c_void_p]
+        lib.shm_create.restype = ctypes.c_uint64
+        lib.shm_create.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+        lib.shm_seal.restype = ctypes.c_int
+        lib.shm_seal.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_get.restype = ctypes.c_uint64
+        lib.shm_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_uint64)
+        ]
+        lib.shm_release.restype = ctypes.c_int
+        lib.shm_release.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_delete.restype = ctypes.c_int
+        lib.shm_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_force_delete.restype = ctypes.c_int
+        lib.shm_force_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_contains.restype = ctypes.c_int
+        lib.shm_contains.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.shm_base.restype = ctypes.c_void_p
+        lib.shm_base.argtypes = [ctypes.c_void_p]
+        lib.shm_stats.argtypes = [ctypes.c_void_p] + [
+            ctypes.POINTER(ctypes.c_uint64)
+        ] * 4
+        _LIB = lib
+        return lib
+
+
+class ShmObjectStore:
+    """One store = one tmpfs file. The creating process owns the file's
+    lifetime; other processes attach with open()."""
+
+    def __init__(self, lib: ctypes.CDLL, handle: int, path: str, owner: bool):
+        self._lib = lib
+        self._h = handle
+        self.path = path
+        self._owner = owner
+        self._closed = False
+
+    # -- lifecycle ------------------------------------------------------------
+
+    @classmethod
+    def create(cls, path: str, capacity: int) -> "ShmObjectStore":
+        lib = load_library()
+        h = lib.shm_store_create(path.encode(), capacity)
+        if not h:
+            raise OSError(f"failed to create shm store at {path}")
+        return cls(lib, h, path, owner=True)
+
+    @classmethod
+    def open(cls, path: str) -> "ShmObjectStore":
+        lib = load_library()
+        h = lib.shm_store_open(path.encode())
+        if not h:
+            raise OSError(f"failed to open shm store at {path}")
+        return cls(lib, h, path, owner=False)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._lib.shm_store_close(self._h)
+        if self._owner:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    # -- object API -----------------------------------------------------------
+
+    @staticmethod
+    def _id16(object_id: bytes) -> bytes:
+        if len(object_id) != 16:
+            object_id = (object_id + b"\x00" * 16)[:16]
+        return object_id
+
+    def _check_open(self) -> None:
+        # a dangling handle would be a SIGSEGV in C; fail in Python instead
+        if self._closed:
+            raise OSError(f"shm store {self.path} is closed")
+
+    def create_buffer(self, object_id: bytes, size: int) -> memoryview:
+        """Allocate an unsealed object; returns a writable view."""
+        self._check_open()
+        oid = self._id16(object_id)
+        off = self._lib.shm_create(self._h, oid, size)
+        if off == 0:
+            raise MemoryError(
+                f"shm store cannot allocate {size} bytes (exists or full)"
+            )
+        base = self._lib.shm_base(self._h)
+        return (ctypes.c_uint8 * size).from_address(base + off), off
+
+    def put(self, object_id: bytes, data: bytes) -> None:
+        """create + write + seal + release in one call."""
+        buf, _ = self.create_buffer(object_id, max(1, len(data)))
+        ctypes.memmove(buf, data, len(data))
+        self.seal(object_id)
+        self.release(object_id)
+
+    def seal(self, object_id: bytes) -> None:
+        self._check_open()
+        if self._lib.shm_seal(self._h, self._id16(object_id)) != 0:
+            raise KeyError(f"cannot seal {object_id!r} (missing or sealed)")
+
+    def get(self, object_id: bytes) -> Optional[memoryview]:
+        """Zero-copy read view of a sealed object (takes a reference —
+        call release() when done)."""
+        self._check_open()
+        size = ctypes.c_uint64()
+        off = self._lib.shm_get(self._h, self._id16(object_id), ctypes.byref(size))
+        if off == 0:
+            return None
+        base = self._lib.shm_base(self._h)
+        arr = np.ctypeslib.as_array(
+            (ctypes.c_uint8 * size.value).from_address(base + off)
+        )
+        return memoryview(arr)
+
+    def get_bytes(self, object_id: bytes) -> Optional[bytes]:
+        view = self.get(object_id)
+        if view is None:
+            return None
+        try:
+            return bytes(view)
+        finally:
+            self.release(object_id)
+
+    def release(self, object_id: bytes) -> None:
+        self._check_open()
+        self._lib.shm_release(self._h, self._id16(object_id))
+
+    def delete(self, object_id: bytes) -> bool:
+        self._check_open()
+        return self._lib.shm_delete(self._h, self._id16(object_id)) == 0
+
+    def force_delete(self, object_id: bytes) -> bool:
+        """Reclaim regardless of refcount — for objects whose referencing
+        process died holding refs (plasma reclaims on client disconnect;
+        with no broker the surviving peer does it explicitly)."""
+        self._check_open()
+        return self._lib.shm_force_delete(self._h, self._id16(object_id)) == 0
+
+    def contains(self, object_id: bytes) -> bool:
+        self._check_open()
+        return bool(self._lib.shm_contains(self._h, self._id16(object_id)))
+
+    def stats(self) -> dict:
+        self._check_open()
+        vals = [ctypes.c_uint64() for _ in range(4)]
+        self._lib.shm_stats(self._h, *[ctypes.byref(v) for v in vals])
+        return {
+            "capacity": vals[0].value,
+            "used": vals[1].value,
+            "num_objects": vals[2].value,
+            "num_evictions": vals[3].value,
+        }
