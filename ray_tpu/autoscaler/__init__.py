@@ -1,0 +1,25 @@
+"""ray_tpu.autoscaler: demand-driven cluster scaling.
+
+Reference analog: python/ray/autoscaler/ (v1 StandardAutoscaler +
+NodeProvider plugins; v2 reconciler). See autoscaler.py/node_provider.py.
+"""
+
+from ray_tpu.autoscaler.autoscaler import (
+    AutoscalerConfig,
+    NodeTypeConfig,
+    StandardAutoscaler,
+)
+from ray_tpu.autoscaler.node_provider import (
+    FakeNodeProvider,
+    NodeProvider,
+    TPUPodProvider,
+)
+
+__all__ = [
+    "AutoscalerConfig",
+    "FakeNodeProvider",
+    "NodeProvider",
+    "NodeTypeConfig",
+    "StandardAutoscaler",
+    "TPUPodProvider",
+]

@@ -1,0 +1,182 @@
+"""Autoscaler: demand-driven node reconciliation.
+
+Reference analog: autoscaler v2 (python/ray/autoscaler/v2/autoscaler.py
++ scheduler.py — reconcile desired instances from resource demand) with
+v1's bin-packing demand scheduler (resource_demand_scheduler.py).
+Demand sources: queued tasks whose requests fit no node, and
+PENDING/INFEASIBLE placement groups. Scale-down: idle nodes past the
+timeout, respecting min_workers.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.autoscaler")
+
+
+@dataclass
+class NodeTypeConfig:
+    resources: dict
+    min_workers: int = 0
+    max_workers: int = 10
+
+
+@dataclass
+class AutoscalerConfig:
+    node_types: dict  # name -> NodeTypeConfig
+    idle_timeout_s: float = 60.0
+    interval_s: float = 1.0
+
+
+def _fits(req: dict, cap: dict) -> bool:
+    return all(cap.get(k, 0.0) >= v for k, v in req.items())
+
+
+class StandardAutoscaler:
+    def __init__(self, config: AutoscalerConfig, provider: NodeProvider):
+        from ray_tpu.core import runtime as rt
+
+        self.config = config
+        self.provider = provider
+        self._runtime = rt.get_runtime()
+        self._idle_since: dict[str, float] = {}
+        self._node_type: dict[str, str] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # satisfy min_workers up front (reference: initial nodes)
+        for tname, tcfg in config.node_types.items():
+            for _ in range(tcfg.min_workers):
+                self._launch(tname, tcfg)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="ray_tpu-autoscaler", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                self.reconcile()
+            except Exception:
+                logger.exception("autoscaler tick failed")
+
+    # -- demand ---------------------------------------------------------------
+
+    def pending_demand(self) -> list[dict]:
+        """Resource requests with no node that can host them."""
+        demand: list[dict] = []
+        nodes = self._runtime.gcs.alive_nodes()
+        caps = [dict(n.resources.total) for n in nodes]
+        # queued tasks
+        sched = self._runtime.scheduler
+        with sched._cv:
+            queued = [s.options.resource_set() for s in sched._queue]
+        for req in queued:
+            r = dict(req)
+            if r and not any(_fits(r, c) for c in caps):
+                demand.append(r)
+        # pending / infeasible placement groups
+        for pg in self._runtime.gcs.list_placement_groups():
+            if getattr(pg, "_state", None) in ("PENDING", "INFEASIBLE"):
+                demand.extend(dict(b.resources) for b in pg.bundles)
+        return demand
+
+    # -- reconcile -------------------------------------------------------------
+
+    def reconcile(self) -> None:
+        self._scale_up()
+        self._retry_pending_pgs()
+        self._scale_down()
+
+    def _count(self, tname: str) -> int:
+        return sum(1 for t in self._node_type.values() if t == tname)
+
+    def _launch(self, tname: str, tcfg: NodeTypeConfig) -> Optional[str]:
+        if self._count(tname) >= tcfg.max_workers:
+            return None
+        pid = self.provider.create_node(tname, dict(tcfg.resources))
+        self._node_type[pid] = tname
+        logger.info("scaled up: %s (%s)", pid, tcfg.resources)
+        return pid
+
+    def _scale_up(self) -> None:
+        demand = self.pending_demand()
+        if not demand:
+            return
+        # first-fit-decreasing bin pack of unmet demand onto new nodes
+        demand.sort(key=lambda d: -sum(d.values()))
+        planned: list[dict] = []  # remaining capacity of nodes we'll launch
+        planned_types: list[str] = []
+        for req in demand:
+            placed = False
+            for cap in planned:
+                if _fits(req, cap):
+                    for k, v in req.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    placed = True
+                    break
+            if placed:
+                continue
+            for tname, tcfg in self.config.node_types.items():
+                if _fits(req, tcfg.resources) and self._count(tname) + planned_types.count(tname) < tcfg.max_workers:
+                    cap = dict(tcfg.resources)
+                    for k, v in req.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    planned.append(cap)
+                    planned_types.append(tname)
+                    placed = True
+                    break
+            if not placed:
+                logger.warning("demand %s fits no configured node type", req)
+        for tname in planned_types:
+            self._launch(tname, self.config.node_types[tname])
+
+    def _retry_pending_pgs(self) -> None:
+        from ray_tpu.core.placement import retry_pending_placement_groups
+
+        retry_pending_placement_groups(self._runtime)
+
+    def _scale_down(self) -> None:
+        now = time.time()
+        for pid in list(self.provider.non_terminated_nodes()):
+            tname = self._node_type.get(pid)
+            if tname is None:
+                continue
+            tcfg = self.config.node_types[tname]
+            if not self.provider.is_idle(pid):
+                self._idle_since.pop(pid, None)
+                continue
+            first_idle = self._idle_since.setdefault(pid, now)
+            if (
+                now - first_idle >= self.config.idle_timeout_s
+                and self._count(tname) > tcfg.min_workers
+            ):
+                self.provider.terminate_node(pid)
+                self._node_type.pop(pid, None)
+                self._idle_since.pop(pid, None)
+                logger.info("scaled down idle node %s", pid)
+
+    def status(self) -> dict:
+        return {
+            "nodes": {
+                pid: self._node_type.get(pid)
+                for pid in self.provider.non_terminated_nodes()
+            },
+            "pending_demand": self.pending_demand(),
+        }

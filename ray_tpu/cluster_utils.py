@@ -1,0 +1,69 @@
+"""In-process fake cluster for multi-node tests.
+
+Reference analog: python/ray/cluster_utils.py:135 (Cluster — N raylets
+sharing one GCS, used by scheduling/FT/placement tests). Here a "node"
+is a capacity domain registered in the GCS: placement groups spread/
+pack across them exactly as across real hosts, while execution remains
+in-process threads (the TPU host model — see core/scheduler.py).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ray_tpu.core.gcs import NodeInfo
+from ray_tpu.core.resources import NodeResources, ResourceSet
+from ray_tpu.utils.ids import NodeID
+
+
+class Cluster:
+    def __init__(self, initialize_head: bool = True, head_node_args: Optional[dict] = None):
+        import ray_tpu
+        from ray_tpu.core import runtime as rt
+
+        self._lock = threading.Lock()
+        self._nodes: list[NodeInfo] = []
+        if not rt.is_initialized():
+            ray_tpu.init(**(head_node_args or {}))
+        self._runtime = rt.get_runtime()
+        if initialize_head:
+            # the runtime's own node is the head
+            self.head_node = self._runtime.gcs.get_node(self._runtime.node_id)
+
+    def add_node(
+        self,
+        num_cpus: float = 1.0,
+        num_tpus: float = 0.0,
+        resources: Optional[dict] = None,
+    ) -> NodeInfo:
+        total = dict(resources or {})
+        total["CPU"] = num_cpus
+        if num_tpus:
+            total["TPU"] = num_tpus
+        info = NodeInfo(NodeID.from_random(), NodeResources(ResourceSet(total)))
+        self._runtime.gcs.register_node(info)
+        with self._lock:
+            self._nodes.append(info)
+        self._retry_pending_pgs()
+        return info
+
+    def remove_node(self, node: NodeInfo) -> None:
+        self._runtime.gcs.remove_node(node.node_id)
+        with self._lock:
+            if node in self._nodes:
+                self._nodes.remove(node)
+
+    def _retry_pending_pgs(self) -> None:
+        from ray_tpu.core.placement import retry_pending_placement_groups
+
+        retry_pending_placement_groups(self._runtime)
+
+    @property
+    def nodes(self) -> list[NodeInfo]:
+        with self._lock:
+            return list(self._nodes)
+
+    def shutdown(self) -> None:
+        for n in self.nodes:
+            self.remove_node(n)

@@ -194,6 +194,12 @@ class Actor:
         return None
 
     def _execute(self, spec: TaskSpec) -> None:
+        from ray_tpu.core.events import TaskState
+
+        self.runtime.task_events.record(
+            spec.task_id, spec.describe(), TaskState.RUNNING,
+            kind="actor_task", actor_id=self.actor_id,
+        )
         try:
             args, kwargs = resolve_args(self.runtime, spec.args, spec.kwargs)
             method = self._framework_method(spec.method_name) or getattr(
@@ -213,7 +219,13 @@ class Actor:
         self._store(spec, result)
 
     async def _execute_async(self, spec: TaskSpec, sem: asyncio.Semaphore) -> None:
+        from ray_tpu.core.events import TaskState
+
         async with sem:
+            self.runtime.task_events.record(
+                spec.task_id, spec.describe(), TaskState.RUNNING,
+                kind="actor_task", actor_id=self.actor_id,
+            )
             try:
                 args, kwargs = resolve_args(self.runtime, spec.args, spec.kwargs)
                 method = self._framework_method(spec.method_name) or getattr(
@@ -237,9 +249,11 @@ class Actor:
             self._store(spec, result)
 
     async def _stream_async(self, spec: TaskSpec, method, args, kwargs) -> None:
+        from ray_tpu.core.events import TaskState
         from ray_tpu.core.ref import ObjectRef
 
         gen = self.runtime.streaming_generators.get(spec.task_id)
+        failure = None
         try:
             it = method(*args, **kwargs)
             i = 0
@@ -258,6 +272,7 @@ class Actor:
                         gen._append(ObjectRef(obj_id, self.runtime, spec.describe()))
                     i += 1
         except BaseException as e:  # noqa: BLE001
+            failure = repr(e)
             err = errors.TaskError(e, traceback.format_exc(), spec.describe())
             if gen is not None:
                 obj_id = ObjectID.for_task_return(spec.task_id, 0)
@@ -268,17 +283,33 @@ class Actor:
                 gen._finish()
             self.runtime.streaming_generators.pop(spec.task_id, None)
             self.runtime.on_task_finished(spec)
+            self.runtime.task_events.record(
+                spec.task_id, spec.describe(),
+                TaskState.FAILED if failure else TaskState.FINISHED,
+                kind="actor_task", actor_id=self.actor_id, error=failure,
+            )
 
     def _store(self, spec: TaskSpec, result) -> None:
+        from ray_tpu.core.events import TaskState
         from ray_tpu.core.scheduler import _store_results
 
         _store_results(self.runtime, spec, result)
         self.runtime.on_task_finished(spec)
+        self.runtime.task_events.record(
+            spec.task_id, spec.describe(), TaskState.FINISHED,
+            kind="actor_task", actor_id=self.actor_id,
+        )
 
     def _fail(self, spec: TaskSpec, err: BaseException) -> None:
+        from ray_tpu.core.events import TaskState
+
         for rid in spec.return_ids:
             self.runtime.object_store.put_error(rid, err)
         self.runtime.on_task_finished(spec)
+        self.runtime.task_events.record(
+            spec.task_id, spec.describe(), TaskState.FAILED,
+            kind="actor_task", actor_id=self.actor_id, error=repr(err),
+        )
 
     def _release_resources(self) -> None:
         with self._lock:

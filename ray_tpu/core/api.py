@@ -254,6 +254,12 @@ class ActorHandle:
         runtime._retain_arg_refs(spec)
         with runtime._lock:
             runtime._pending_tasks.add(task_id)
+        from ray_tpu.core.events import TaskState
+
+        runtime.task_events.record(
+            task_id, spec.describe(), TaskState.SUBMITTED,
+            kind="actor_task", actor_id=actor.actor_id,
+        )
         if streaming:
             gen = ObjectRefGenerator(runtime, spec.describe())
             runtime.streaming_generators[task_id] = gen

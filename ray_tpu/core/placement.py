@@ -52,6 +52,9 @@ class PlacementGroup:
         self._state = "PENDING"
         self._infeasible_reason: Optional[str] = None
         self._lock = threading.Lock()
+        # serializes whole reservation attempts (autoscaler retry vs
+        # cluster.add_node retry vs creation) — _lock only guards state reads
+        self._reserve_lock = threading.Lock()
 
     @property
     def bundle_specs(self) -> list[dict]:
@@ -68,14 +71,29 @@ class PlacementGroup:
 
     def ready(self, timeout: Optional[float] = 30.0) -> bool:
         """Block until the reservation exists (reference pg.ready() is an
-        ObjectRef; here creation is synchronous enough that we expose a
-        bool + raise on infeasible instead of pending forever)."""
-        with self._lock:
-            if self._state == "INFEASIBLE":
+        ObjectRef that stays pending). INFEASIBLE is not terminal while an
+        autoscaler/cluster may add nodes — poll until the deadline, THEN
+        raise if still infeasible; return False if merely pending."""
+        import time as _time
+
+        deadline = _time.monotonic() + (timeout if timeout is not None else 0.0)
+        infinite = timeout is None
+        while True:
+            with self._lock:
+                state, reason = self._state, self._infeasible_reason
+            if state == "CREATED":
+                return True
+            if state == "REMOVED":
                 raise errors.PlacementGroupUnavailableError(
-                    f"placement group {self.name or self.id}: {self._infeasible_reason}"
+                    f"placement group {self.name or self.id} was removed"
                 )
-            return self._state == "CREATED"
+            if not infinite and _time.monotonic() >= deadline:
+                if state == "INFEASIBLE":
+                    raise errors.PlacementGroupUnavailableError(
+                        f"placement group {self.name or self.id}: {reason}"
+                    )
+                return False
+            _time.sleep(0.02)
 
     def bundle_pool(self, index: int, req: ResourceSet) -> NodeResources:
         """Resolve which bundle's reservation a task draws from."""
@@ -105,6 +123,10 @@ class PlacementGroup:
         """Reject new work immediately; release node capacity once in-flight
         bundle tasks drain (running threads can't be killed; the reference
         instead kills PG workers — raylet PlacementGroupResourceManager)."""
+        with self._reserve_lock:
+            self._remove_locked()
+
+    def _remove_locked(self) -> None:
         with self._lock:
             if self._state == "REMOVED":
                 return
@@ -143,7 +165,34 @@ def create_placement_group(
     if not bundles:
         raise ValueError("placement group needs at least one bundle")
     pg = PlacementGroup(PlacementGroupID.from_random(), bundles, strategy, name, runtime)
+    return reserve_placement_group(pg, runtime.gcs.alive_nodes())
+
+
+def retry_pending_placement_groups(runtime: "Runtime") -> None:
+    """Re-attempt reservation for every PENDING/INFEASIBLE group (called
+    by the autoscaler and cluster_utils after adding nodes)."""
     nodes = runtime.gcs.alive_nodes()
+    for pg in runtime.gcs.list_placement_groups():
+        if getattr(pg, "_state", None) in ("PENDING", "INFEASIBLE"):
+            reserve_placement_group(pg, nodes)
+
+
+def reserve_placement_group(pg: PlacementGroup, nodes: list) -> PlacementGroup:
+    """Try to reserve a PENDING/INFEASIBLE group's bundles. Separated from
+    creation so the autoscaler can retry after adding nodes (the reference
+    keeps pending PGs queued in GcsPlacementGroupManager and retries on
+    node add)."""
+    with pg._reserve_lock:
+        return _reserve_locked(pg, nodes)
+
+
+def _reserve_locked(pg: PlacementGroup, nodes: list) -> PlacementGroup:
+    with pg._lock:
+        if pg._state in ("CREATED", "REMOVED"):
+            return pg  # REMOVED is terminal: never resurrect a removed group
+        pg._state = "PENDING"
+        pg._infeasible_reason = None
+    strategy = pg.strategy
 
     def reserve(bundle: Bundle, node) -> bool:
         if node.resources.try_acquire(bundle.resources):

@@ -60,7 +60,10 @@ class Runtime:
         self.gcs = Gcs()
         self.node_resources = NodeResources(ResourceSet(total))
         self.gcs.register_node(NodeInfo(self.node_id, self.node_resources))
+        from ray_tpu.core.events import TaskEventBuffer
+
         self.scheduler = LocalScheduler(self, self.node_resources)
+        self.task_events = TaskEventBuffer()
         self.streaming_generators: dict[TaskID, ObjectRefGenerator] = {}
         self._put_counter = 0
         self._task_counter = 0
@@ -164,6 +167,11 @@ class Runtime:
         self._retain_arg_refs(spec)
         with self._lock:
             self._pending_tasks.add(task_id)
+        from ray_tpu.core.events import TaskState
+
+        self.task_events.record(
+            task_id, spec.describe(), TaskState.SUBMITTED
+        )
         if streaming:
             gen = ObjectRefGenerator(self, spec.describe())
             self.streaming_generators[task_id] = gen

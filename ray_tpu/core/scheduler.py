@@ -159,8 +159,11 @@ class LocalScheduler:
     # -- process-mode execution (crash isolation + retries) -----------------
 
     def _run_in_process(self, spec: TaskSpec, pool: NodeResources, req: ResourceSet) -> None:
+        from ray_tpu.core.events import TaskState
+
         runtime = self._runtime
         finished = True
+        runtime.task_events.record(spec.task_id, spec.describe(), TaskState.RUNNING)
         try:
             try:
                 result = runtime.process_pool.run(spec)
@@ -191,6 +194,9 @@ class LocalScheduler:
                 )
                 return
             _store_results(runtime, spec, result)
+            runtime.task_events.record(
+                spec.task_id, spec.describe(), TaskState.FINISHED
+            )
         finally:
             self._running.pop(spec.task_id, None)
             pool.release(req)
@@ -200,6 +206,11 @@ class LocalScheduler:
 
     def _fail_task(self, spec: TaskSpec, err: BaseException) -> None:
         """Store the error on all returns (caller handles on_task_finished)."""
+        from ray_tpu.core.events import TaskState
+
+        self._runtime.task_events.record(
+            spec.task_id, spec.describe(), TaskState.FAILED, error=repr(err)
+        )
         for rid in spec.return_ids:
             self._runtime.object_store.put_error(rid, err)
         gen = self._runtime.streaming_generators.pop(spec.task_id, None)
@@ -235,10 +246,13 @@ def resolve_args(runtime: "Runtime", args: tuple, kwargs: dict) -> tuple[tuple, 
 
 def execute_task(runtime: "Runtime", spec: TaskSpec) -> None:
     """Run a task inline on the current thread and store its results."""
+    from ray_tpu.core.events import TaskState
+
+    runtime.task_events.record(spec.task_id, spec.describe(), TaskState.RUNNING)
     try:
         args, kwargs = resolve_args(runtime, spec.args, spec.kwargs)
         if spec.streaming:
-            _execute_streaming(runtime, spec, args, kwargs)
+            _execute_streaming(runtime, spec, args, kwargs)  # records terminal
             return
         result = spec.func(*args, **kwargs)
     except errors.RayTpuError as e:
@@ -246,6 +260,9 @@ def execute_task(runtime: "Runtime", spec: TaskSpec) -> None:
         for rid in spec.return_ids:
             runtime.object_store.put_error(rid, e)
         runtime.on_task_finished(spec)
+        runtime.task_events.record(
+            spec.task_id, spec.describe(), TaskState.FAILED, error=str(e)
+        )
         return
     except BaseException as e:  # noqa: BLE001 - user exception
         if spec.options.retry_exceptions and spec.attempt < spec.options.max_retries:
@@ -256,8 +273,12 @@ def execute_task(runtime: "Runtime", spec: TaskSpec) -> None:
         for rid in spec.return_ids:
             runtime.object_store.put_error(rid, err)
         runtime.on_task_finished(spec)
+        runtime.task_events.record(
+            spec.task_id, spec.describe(), TaskState.FAILED, error=repr(e)
+        )
         return
     _store_results(runtime, spec, result)
+    runtime.task_events.record(spec.task_id, spec.describe(), TaskState.FINISHED)
     runtime.on_task_finished(spec)
 
 
@@ -266,7 +287,10 @@ def _execute_streaming(
 ) -> None:
     """Drive a generator task, publishing each yield as an object. `fn`
     overrides spec.func (actor methods pass the bound method)."""
+    from ray_tpu.core.events import TaskState
+
     gen = runtime.streaming_generators.get(spec.task_id)
+    failure: Optional[str] = None
     try:
         it = (fn or spec.func)(*args, **kwargs)
         for i, item in enumerate(it):
@@ -275,6 +299,7 @@ def _execute_streaming(
             if gen is not None:
                 gen._append(ObjectRef(obj_id, runtime, spec.describe()))
     except BaseException as e:  # noqa: BLE001
+        failure = repr(e)
         err = errors.TaskError(e, traceback.format_exc(), spec.describe())
         if gen is not None:
             obj_id = ObjectID.for_task_return(spec.task_id, 0)
@@ -285,6 +310,12 @@ def _execute_streaming(
             gen._finish()
         runtime.streaming_generators.pop(spec.task_id, None)
         runtime.on_task_finished(spec)
+        runtime.task_events.record(
+            spec.task_id, spec.describe(),
+            TaskState.FAILED if failure else TaskState.FINISHED,
+            kind="actor_task" if spec.actor_id is not None else "task",
+            actor_id=spec.actor_id, error=failure,
+        )
 
 
 def _store_results(runtime: "Runtime", spec: TaskSpec, result) -> None:

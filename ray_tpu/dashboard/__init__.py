@@ -1,0 +1,143 @@
+"""Minimal dashboard: HTTP state + metrics endpoints.
+
+Reference analog: python/ray/dashboard/ (head.py:62 DashboardHead + the
+modules/ API routes + metrics pipeline). Single-host collapse: one
+aiohttp server exposing
+
+  /api/tasks /api/actors /api/objects /api/nodes /api/placement_groups
+  /api/summary /api/cluster_status   — JSON state (util/state.py)
+  /metrics                           — Prometheus text (util/metrics.py)
+  /timeline                          — Chrome trace JSON
+  /healthz                           — liveness
+
+A React UI is out of scope; the JSON surface is the contract the
+reference's UI consumes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from typing import Optional
+
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.dashboard")
+
+_dashboard: Optional["Dashboard"] = None
+
+
+class Dashboard:
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self.host = host
+        self.port = port
+        self._started = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._serve, name="ray_tpu-dashboard", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(10):
+            raise RuntimeError(f"dashboard failed to bind {host}:{port}")
+
+    def _serve(self) -> None:
+        from aiohttp import web
+
+        from ray_tpu.util import metrics as metrics_mod
+        from ray_tpu.util import state
+
+        loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(loop)
+
+        def offload(fn, *args):
+            return asyncio.get_event_loop().run_in_executor(None, fn, *args)
+
+        async def healthz(_req):
+            return web.Response(text="success")
+
+        async def tasks(req):
+            st = req.query.get("state")
+            rows = await offload(lambda: [vars(r) for r in state.list_tasks(st)])
+            return web.json_response(rows)
+
+        async def actors(_req):
+            return web.json_response(await offload(state.list_actors))
+
+        async def objects(_req):
+            return web.json_response(await offload(state.list_objects))
+
+        async def nodes(_req):
+            return web.json_response(await offload(state.list_nodes))
+
+        async def pgs(_req):
+            return web.json_response(await offload(state.list_placement_groups))
+
+        async def summary(_req):
+            return web.json_response(await offload(state.summarize_tasks))
+
+        async def cluster_status(_req):
+            import ray_tpu
+
+            return web.json_response(
+                {
+                    "cluster_resources": await offload(ray_tpu.cluster_resources),
+                    "available_resources": await offload(ray_tpu.available_resources),
+                }
+            )
+
+        async def metrics(_req):
+            return web.Response(
+                text=metrics_mod.prometheus_text(),
+                content_type="text/plain",
+            )
+
+        async def timeline(_req):
+            return web.json_response(await offload(state.timeline))
+
+        app = web.Application()
+        app.router.add_get("/healthz", healthz)
+        app.router.add_get("/api/tasks", tasks)
+        app.router.add_get("/api/actors", actors)
+        app.router.add_get("/api/objects", objects)
+        app.router.add_get("/api/nodes", nodes)
+        app.router.add_get("/api/placement_groups", pgs)
+        app.router.add_get("/api/summary", summary)
+        app.router.add_get("/api/cluster_status", cluster_status)
+        app.router.add_get("/metrics", metrics)
+        app.router.add_get("/timeline", timeline)
+
+        runner = web.AppRunner(app, access_log=None)
+
+        async def _run():
+            await runner.setup()
+            site = web.TCPSite(runner, self.host, self.port)
+            await site.start()
+            self._started.set()
+            while not self._stop.is_set():
+                await asyncio.sleep(0.1)
+            await runner.cleanup()
+
+        try:
+            loop.run_until_complete(_run())
+        except Exception:
+            logger.exception("dashboard crashed")
+        finally:
+            loop.close()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> Dashboard:
+    global _dashboard
+    if _dashboard is None:
+        _dashboard = Dashboard(host, port)
+    return _dashboard
+
+
+def shutdown_dashboard() -> None:
+    global _dashboard
+    if _dashboard is not None:
+        _dashboard.shutdown()
+        _dashboard = None

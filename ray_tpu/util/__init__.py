@@ -1,0 +1,10 @@
+"""ray_tpu.util: user-facing utilities (reference: python/ray/util/).
+
+metrics (Counter/Gauge/Histogram + Prometheus), state API (list_tasks/
+actors/objects/nodes, timeline), ActorPool, Queue.
+"""
+
+from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.queue import Empty, Full, Queue
+
+__all__ = ["ActorPool", "Empty", "Full", "Queue"]

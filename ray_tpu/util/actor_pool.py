@@ -1,0 +1,88 @@
+"""ActorPool: round-robin work distribution over a fixed set of actors.
+
+Reference analog: python/ray/util/actor_pool.py (same API surface:
+map/map_unordered/submit/get_next/get_next_unordered/has_next).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable
+
+
+class ActorPool:
+    def __init__(self, actors: list):
+        self._idle = list(actors)
+        self._future_to_actor: dict = {}
+        self._index_to_future: dict[int, Any] = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits: list = []
+
+    def submit(self, fn: Callable, value: Any) -> None:
+        """fn(actor, value) -> ObjectRef."""
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = actor
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def has_next(self) -> bool:
+        return bool(self._index_to_future) or bool(self._pending_submits)
+
+    def _return_actor(self, actor) -> None:
+        self._idle.append(actor)
+        if self._pending_submits:
+            self.submit(*self._pending_submits.pop(0))
+
+    def get_next(self, timeout: float | None = None) -> Any:
+        """Next result in submission order."""
+        import ray_tpu
+
+        if self._next_return_index >= self._next_task_index:
+            raise StopIteration("no pending results")
+        future = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        # return the actor even when the task raised — losing it from the
+        # rotation would strand queued submits forever
+        self._return_actor(self._future_to_actor.pop(future))
+        return ray_tpu.get(future, timeout=timeout)
+
+    def get_next_unordered(self, timeout: float | None = None) -> Any:
+        """Next result in completion order."""
+        import ray_tpu
+
+        if not self._index_to_future:
+            raise StopIteration("no pending results")
+        ready, _ = ray_tpu.wait(
+            list(self._index_to_future.values()), num_returns=1, timeout=timeout
+        )
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        future = ready[0]
+        for idx, f in list(self._index_to_future.items()):
+            if f is future:
+                del self._index_to_future[idx]
+                break
+        self._return_actor(self._future_to_actor.pop(future))
+        return ray_tpu.get(future)
+
+    def map(self, fn: Callable, values: Iterable) -> Iterable:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable) -> Iterable:
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()
+
+    def pop_idle(self):
+        return self._idle.pop() if self._idle else None
+
+    def push(self, actor) -> None:
+        self._return_actor(actor)

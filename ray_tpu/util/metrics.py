@@ -1,0 +1,180 @@
+"""User-facing metrics: Counter / Gauge / Histogram + Prometheus export.
+
+Reference analogs: python/ray/util/metrics.py (the user API) and the
+node metrics agent pipeline (C++ opencensus -> _private/metrics_agent.py
+-> Prometheus exposition). Single-host collapse: one process-wide
+registry rendering Prometheus text directly (served by
+ray_tpu.dashboard); no agent hop.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_right
+from typing import Optional, Sequence
+
+_REGISTRY_LOCK = threading.Lock()
+_REGISTRY: dict[str, "Metric"] = {}
+
+DEFAULT_HISTOGRAM_BOUNDARIES = [
+    0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 50, 100,
+]
+
+
+def _fq(name: str) -> str:
+    return name if name.startswith("ray_tpu_") else f"ray_tpu_{name}"
+
+
+class Metric:
+    """Base: named metric with optional tag keys; one time series per
+    observed tag-value combination."""
+
+    TYPE = "untyped"
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        tag_keys: Optional[Sequence[str]] = None,
+    ):
+        if not name:
+            raise ValueError("metric name required")
+        self.name = _fq(name)
+        self.description = description
+        self.tag_keys = tuple(tag_keys or ())
+        self._default_tags: dict = {}
+        self._series: dict[tuple, float] = {}
+        self._lock = threading.Lock()
+        with _REGISTRY_LOCK:
+            existing = _REGISTRY.get(self.name)
+            if existing is not None and existing.TYPE != self.TYPE:
+                raise ValueError(
+                    f"metric {self.name!r} already registered as {existing.TYPE}"
+                )
+            _REGISTRY[self.name] = self
+
+    def set_default_tags(self, tags: dict) -> "Metric":
+        unknown = set(tags) - set(self.tag_keys)
+        if unknown:
+            raise ValueError(f"unknown tag keys: {sorted(unknown)}")
+        self._default_tags = dict(tags)
+        return self
+
+    def _key(self, tags: Optional[dict]) -> tuple:
+        merged = {**self._default_tags, **(tags or {})}
+        unknown = set(merged) - set(self.tag_keys)
+        if unknown:
+            raise ValueError(f"unknown tag keys: {sorted(unknown)}")
+        return tuple(merged.get(k, "") for k in self.tag_keys)
+
+    # subclasses implement record semantics over self._series
+
+    def series(self) -> dict[tuple, float]:
+        with self._lock:
+            return dict(self._series)
+
+
+class Counter(Metric):
+    TYPE = "counter"
+
+    def inc(self, value: float = 1.0, tags: Optional[dict] = None) -> None:
+        if value < 0:
+            raise ValueError("counters only increase")
+        k = self._key(tags)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0.0) + value
+
+
+class Gauge(Metric):
+    TYPE = "gauge"
+
+    def set(self, value: float, tags: Optional[dict] = None) -> None:
+        with self._lock:
+            self._series[self._key(tags)] = float(value)
+
+    def inc(self, value: float = 1.0, tags: Optional[dict] = None) -> None:
+        k = self._key(tags)
+        with self._lock:
+            self._series[k] = self._series.get(k, 0.0) + value
+
+    def dec(self, value: float = 1.0, tags: Optional[dict] = None) -> None:
+        self.inc(-value, tags)
+
+
+class Histogram(Metric):
+    TYPE = "histogram"
+
+    def __init__(
+        self,
+        name: str,
+        description: str = "",
+        boundaries: Optional[Sequence[float]] = None,
+        tag_keys: Optional[Sequence[str]] = None,
+    ):
+        super().__init__(name, description, tag_keys)
+        self.boundaries = sorted(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
+        self._buckets: dict[tuple, list] = {}
+        self._sums: dict[tuple, float] = {}
+        self._counts: dict[tuple, int] = {}
+
+    def observe(self, value: float, tags: Optional[dict] = None) -> None:
+        k = self._key(tags)
+        with self._lock:
+            buckets = self._buckets.setdefault(k, [0] * (len(self.boundaries) + 1))
+            buckets[bisect_right(self.boundaries, value)] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._counts[k] = self._counts.get(k, 0) + 1
+
+    def hist_data(self) -> dict:
+        with self._lock:
+            return {
+                k: (list(b), self._sums.get(k, 0.0), self._counts.get(k, 0))
+                for k, b in self._buckets.items()
+            }
+
+
+def registry_snapshot() -> list[Metric]:
+    with _REGISTRY_LOCK:
+        return list(_REGISTRY.values())
+
+
+def clear_registry() -> None:
+    """Test hook."""
+    with _REGISTRY_LOCK:
+        _REGISTRY.clear()
+
+
+def _fmt_tags(keys: Sequence[str], vals: tuple, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in zip(keys, vals) if v != ""]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def prometheus_text() -> str:
+    """Render the whole registry in Prometheus exposition format
+    (reference: metrics_agent.py's opencensus->Prometheus conversion)."""
+    lines = []
+    for m in registry_snapshot():
+        lines.append(f"# HELP {m.name} {m.description}")
+        lines.append(f"# TYPE {m.name} {m.TYPE}")
+        if isinstance(m, Histogram):
+            for k, (buckets, total, count) in m.hist_data().items():
+                cum = 0
+                for b, n in zip(m.boundaries, buckets):
+                    cum += n
+                    lines.append(
+                        f"{m.name}_bucket"
+                        f"{_fmt_tags(m.tag_keys, k, f'le=\"{b}\"')} {cum}"
+                    )
+                cum += buckets[-1]
+                lines.append(
+                    f"{m.name}_bucket"
+                    f"{_fmt_tags(m.tag_keys, k, 'le=\"+Inf\"')} {cum}"
+                )
+                lines.append(f"{m.name}_sum{_fmt_tags(m.tag_keys, k)} {total}")
+                lines.append(f"{m.name}_count{_fmt_tags(m.tag_keys, k)} {count}")
+        else:
+            for k, v in m.series().items():
+                lines.append(f"{m.name}{_fmt_tags(m.tag_keys, k)} {v}")
+    return "\n".join(lines) + "\n"
