@@ -1,0 +1,173 @@
+"""Core microbenchmarks, mirroring the reference's suite.
+
+Reference analog: release/microbenchmark/ (results snapshotted in
+release/perf_metrics/microbenchmark.json — the numbers in BASELINE.md).
+Run: python benchmarks/microbenchmark.py [--quick]
+Prints one JSON object: {metric: {value, unit, baseline, vs_baseline}}.
+
+The architecture note the numbers tell: the reference pays gRPC + plasma
+round-trips per call; this runtime's thread-actor fast path passes
+references through an in-process store, so call rates are bounded by
+Python dispatch, not IPC.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASELINES = {  # BASELINE.md "Core microbenchmarks"
+    "single_client_tasks_sync": 982,
+    "single_client_tasks_async": 7785,
+    "1_1_actor_calls_sync": 2025,
+    "1_1_actor_calls_async": 8588,
+    "1_1_async_actor_calls_async": 4185,
+    "n_n_actor_calls_async": 24718,
+    "single_client_put_calls": 4901,
+    "single_client_get_calls": 10975,
+    "placement_group_create_removal": 741,
+}
+
+
+def timeit(fn, n: int) -> float:
+    """ops/sec of fn() called n times (fn may batch internally)."""
+    t0 = time.perf_counter()
+    ops = 0
+    for _ in range(n):
+        out = fn()
+        ops += out if isinstance(out, int) else 1
+    dt = time.perf_counter() - t0
+    return ops / dt
+
+
+def main(quick: bool = False):
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")  # never hold the TPU here
+    except Exception:
+        pass
+    import ray_tpu
+
+    ray_tpu.init(num_cpus=32, ignore_reinit_error=True)
+    scale = 0.1 if quick else 1.0
+    results = {}
+
+    def record(name: str, value: float):
+        base = BASELINES.get(name)
+        results[name] = {
+            "value": round(value, 1),
+            "unit": "ops/s",
+            "baseline": base,
+            "vs_baseline": round(value / base, 2) if base else None,
+        }
+        print(f"{name}: {value:,.0f} ops/s "
+              f"(baseline {base or '-'}, {value / base:.1f}x)" if base else
+              f"{name}: {value:,.0f} ops/s", file=sys.stderr)
+
+    # -- tasks ---------------------------------------------------------------
+
+    @ray_tpu.remote
+    def nop():
+        return b"ok"
+
+    ray_tpu.get(nop.remote())  # warmup
+    record(
+        "single_client_tasks_sync",
+        timeit(lambda: ray_tpu.get(nop.remote()), int(2000 * scale)),
+    )
+
+    def batch_async():
+        n = 100
+        ray_tpu.get([nop.remote() for _ in range(n)])
+        return n
+
+    record("single_client_tasks_async", timeit(batch_async, int(50 * scale)))
+
+    # -- actor calls ---------------------------------------------------------
+
+    @ray_tpu.remote
+    class Sink:
+        def ping(self):
+            return b"ok"
+
+    @ray_tpu.remote
+    class AsyncSink:
+        async def ping(self):
+            return b"ok"
+
+    a = Sink.remote()
+    ray_tpu.get(a.ping.remote())
+    record(
+        "1_1_actor_calls_sync",
+        timeit(lambda: ray_tpu.get(a.ping.remote()), int(2000 * scale)),
+    )
+
+    def actor_async():
+        n = 100
+        ray_tpu.get([a.ping.remote() for _ in range(n)])
+        return n
+
+    record("1_1_actor_calls_async", timeit(actor_async, int(50 * scale)))
+
+    aa = AsyncSink.remote()
+    ray_tpu.get(aa.ping.remote())
+
+    def async_actor_async():
+        n = 100
+        ray_tpu.get([aa.ping.remote() for _ in range(n)])
+        return n
+
+    record("1_1_async_actor_calls_async", timeit(async_actor_async, int(30 * scale)))
+
+    sinks = [Sink.remote() for _ in range(8)]
+    ray_tpu.get([s.ping.remote() for s in sinks])
+
+    def n_n_async():
+        n = 0
+        refs = []
+        for s in sinks:
+            refs.extend(s.ping.remote() for _ in range(25))
+            n += 25
+        ray_tpu.get(refs)
+        return n
+
+    record("n_n_actor_calls_async", timeit(n_n_async, int(40 * scale)))
+
+    # -- object store --------------------------------------------------------
+
+    payload = b"x" * 1024
+    record(
+        "single_client_put_calls",
+        timeit(lambda: ray_tpu.put(payload) and 1, int(5000 * scale)),
+    )
+    ref = ray_tpu.put(payload)
+    record(
+        "single_client_get_calls",
+        timeit(lambda: ray_tpu.get(ref) and 1, int(5000 * scale)),
+    )
+
+    # -- placement groups ----------------------------------------------------
+
+    def pg_cycle():
+        pg = ray_tpu.placement_group([{"CPU": 0.01}])
+        ray_tpu.remove_placement_group(pg)
+        return 1
+
+    record("placement_group_create_removal", timeit(pg_cycle, int(500 * scale)))
+
+    results["_meta"] = {
+        "cpu_count": os.cpu_count(),
+        "note": "baselines were measured on m4.16xlarge (64 cores); "
+        "aggregate-throughput metrics (n_n_*) scale with cores",
+    }
+    print(json.dumps(results))
+    return results
+
+
+if __name__ == "__main__":
+    main(quick="--quick" in sys.argv)

@@ -74,7 +74,12 @@ class TaskSpec:
     streaming: bool = False
 
     def describe(self) -> str:
-        name = self.options.name or getattr(self.func, "__name__", "task")
-        if self.method_name:
-            name = f"{name}.{self.method_name}"
-        return f"{name}[{self.task_id.hex()[:8]}]"
+        # cached: called on every event record / error message
+        d = getattr(self, "_describe", None)
+        if d is None:
+            name = self.options.name or getattr(self.func, "__name__", "task")
+            if self.method_name:
+                name = f"{name}.{self.method_name}"
+            d = f"{name}[{self.task_id.hex()[:8]}]"
+            object.__setattr__(self, "_describe", d)
+        return d

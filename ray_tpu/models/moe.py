@@ -1,0 +1,256 @@
+"""Mixture-of-Experts decoder (Mixtral-style) with expert parallelism.
+
+The reference has NO expert parallelism (SURVEY.md §2.4 — absent from
+python/ray/llm); this is a native capability. Design: Switch/GShard-style
+capacity-bucketed dispatch expressed as einsums over an explicit expert
+axis — the expert dimension carries the logical axis "expert" which the
+sharding rules map to the mesh `ep` axis, so under pjit XLA lowers the
+dispatch/combine einsums to all-to-alls over ICI (no hand-written
+collectives; same rules table as DP/FSDP/TP/SP — parallel/sharding.py).
+
+Attention/norms/embeddings reuse the llama block structure
+(models/llama.py); only the FFN is replaced by the MoE layer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models import llama
+from ray_tpu.nn.layers import (
+    apply_rope,
+    cross_entropy_loss,
+    init_dense,
+    rms_norm,
+    rope_frequencies,
+)
+from ray_tpu.ops.attention import attention
+
+Params = dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(llama.LlamaConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_coeff: float = 0.01  # load-balancing loss weight
+
+    def flops_per_token(self) -> float:
+        d, f, L = self.d_model, self.d_ff, self.n_layers
+        hd = self.head_dim
+        attn = 2 * d * (self.n_heads * hd + 2 * self.n_kv_heads * hd + self.n_heads * hd)
+        # only top_k experts run per token
+        mlp = 2 * d * f * 3 * self.top_k
+        emb = 2 * d * self.vocab_size
+        return L * (attn + mlp) + emb
+
+    def num_params(self) -> int:
+        d, f, L, V, E = self.d_model, self.d_ff, self.n_layers, self.vocab_size, self.n_experts
+        hd = self.head_dim
+        per_layer = (
+            d * hd * (self.n_heads * 2 + self.n_kv_heads * 2)
+            + E * 3 * d * f  # experts
+            + d * E          # router
+            + 2 * d
+        )
+        head = 0 if self.tie_embeddings else d * V
+        return V * d + L * per_layer + d + head
+
+
+MOE_TINY = MoEConfig(
+    vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=128,
+    max_seq=128, remat=False, n_experts=4, top_k=2,
+)
+MIXTRAL_8X7B = MoEConfig(
+    vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+    d_ff=14336, max_seq=32768, rope_theta=1e6, n_experts=8, top_k=2,
+)
+
+
+def logical_axes(config: MoEConfig) -> Params:
+    layer = {
+        "ln1": ("layers", "norm"),
+        "wq": ("layers", "embed", "heads"),
+        "wk": ("layers", "embed", "kv_heads"),
+        "wv": ("layers", "embed", "kv_heads"),
+        "wo": ("layers", "heads", "embed"),
+        "ln2": ("layers", "norm"),
+        "router": ("layers", "embed", "expert"),
+        "w_gate": ("layers", "expert", "embed", "mlp"),
+        "w_up": ("layers", "expert", "embed", "mlp"),
+        "w_down": ("layers", "expert", "mlp", "embed"),
+    }
+    axes: Params = {
+        "embed": ("vocab", "embed"),
+        "layers": layer,
+        "final_norm": ("norm",),
+    }
+    if not config.tie_embeddings:
+        axes["lm_head"] = ("embed", "vocab")
+    return axes
+
+
+def init_params(config: MoEConfig, key: jax.Array) -> Params:
+    c = config
+    keys = jax.random.split(key, 10)
+    hd, L, E = c.head_dim, c.n_layers, c.n_experts
+
+    def dense(k, shape):
+        ks = jax.random.split(k, L)
+        return jax.vmap(lambda kk: init_dense(kk, shape, c.param_dtype))(ks)
+
+    def expert_dense(k, shape):
+        # distinct init per (layer, expert)
+        ks = jax.random.split(k, L * E).reshape(L, E)
+        return jax.vmap(
+            jax.vmap(lambda kk: init_dense(kk, shape, c.param_dtype))
+        )(ks)
+
+    params: Params = {
+        "embed": init_dense(keys[0], (c.vocab_size, c.d_model), c.param_dtype, scale=1.0),
+        "layers": {
+            "ln1": jnp.ones((L, c.d_model), c.param_dtype),
+            "wq": dense(keys[1], (c.d_model, c.n_heads * hd)),
+            "wk": dense(keys[2], (c.d_model, c.n_kv_heads * hd)),
+            "wv": dense(keys[3], (c.d_model, c.n_kv_heads * hd)),
+            "wo": dense(keys[4], (c.n_heads * hd, c.d_model)),
+            "ln2": jnp.ones((L, c.d_model), c.param_dtype),
+            "router": dense(keys[5], (c.d_model, E)),
+            "w_gate": expert_dense(keys[6], (c.d_model, c.d_ff)),
+            "w_up": expert_dense(keys[7], (c.d_model, c.d_ff)),
+            "w_down": expert_dense(keys[8], (c.d_ff, c.d_model)),
+        },
+        "final_norm": jnp.ones((c.d_model,), c.param_dtype),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = init_dense(
+            keys[9], (c.d_model, c.vocab_size), c.param_dtype
+        )
+    return params
+
+
+def moe_ffn(x: jax.Array, lp: Params, c: MoEConfig) -> tuple[jax.Array, jax.Array]:
+    """Capacity-bucketed top-k MoE FFN.
+
+    x: [B, S, D] -> (out [B, S, D], aux_loss scalar).
+    Dispatch/combine are einsums with an explicit expert dim — sharded
+    over `ep` by the rules table, XLA inserts the all-to-alls.
+    """
+    B, S, D = x.shape
+    E, K = c.n_experts, c.top_k
+    N = B * S
+    C = max(1, int(c.capacity_factor * N * K / E))  # tokens per expert
+
+    xt = x.reshape(N, D)
+    logits = jnp.einsum("nd,de->ne", xt.astype(jnp.float32), lp["router"].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)  # [N, E]
+
+    # top-k expert choice per token
+    gate_vals, gate_idx = jax.lax.top_k(probs, K)  # [N, K]
+    gate_vals = gate_vals / (gate_vals.sum(-1, keepdims=True) + 1e-9)
+
+    # position of each (token, k) within its expert's capacity bucket
+    onehot = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)        # [N, K, E]
+    flatoh = onehot.reshape(N * K, E)
+    pos_in_expert = (jnp.cumsum(flatoh, axis=0) - flatoh).reshape(N, K, E)
+    pos = (pos_in_expert * onehot).sum(-1)                        # [N, K]
+    kept = (pos < C) & (gate_vals > 0)                            # [N, K]
+
+    # dispatch tensor [N, E, C]: token n -> slot (e, c)
+    disp = (
+        jax.nn.one_hot(gate_idx, E, dtype=x.dtype)[..., None]
+        * jax.nn.one_hot(jnp.where(kept, pos, C), C + 1, dtype=x.dtype)[..., :C][:, :, None, :]
+    ).sum(1)  # [N, E, C]
+
+    # expert inputs [E, C, D]
+    xe = jnp.einsum("nec,nd->ecd", disp, xt)
+
+    # expert FFN (swiglu), batched over E: [E, C, D] x [E, D, F]
+    gate = jnp.einsum("ecd,edf->ecf", xe, lp["w_gate"].astype(x.dtype))
+    up = jnp.einsum("ecd,edf->ecf", xe, lp["w_up"].astype(x.dtype))
+    ye = jnp.einsum("ecf,efd->ecd", jax.nn.silu(gate) * up, lp["w_down"].astype(x.dtype))
+
+    # combine weighted by gates: weight for slot (n,e,c) = disp * gate_val
+    gate_per_ne = (
+        jax.nn.one_hot(gate_idx, E, dtype=x.dtype) * (gate_vals * kept).astype(x.dtype)[..., None]
+    ).sum(1)  # [N, E]
+    comb = disp * gate_per_ne[:, :, None]  # [N, E, C]
+    out = jnp.einsum("nec,ecd->nd", comb, ye)
+
+    # load-balancing aux loss (Switch): E * sum_e f_e * p_e
+    frac_tokens = (
+        jax.nn.one_hot(gate_idx[:, 0], E, dtype=jnp.float32).mean(0)
+    )
+    mean_probs = probs.mean(0)
+    aux = c.n_experts * jnp.sum(frac_tokens * mean_probs)
+    return out.reshape(B, S, D), aux.astype(jnp.float32)
+
+
+def _block(h, lp, *, config: MoEConfig, cos, sin, positions, segment_ids):
+    c = config
+    B, S, D = h.shape
+    hd = c.head_dim
+    x = rms_norm(h, lp["ln1"], c.rms_eps)
+    q = jnp.einsum("bsd,dh->bsh", x, lp["wq"].astype(x.dtype)).reshape(B, S, c.n_heads, hd)
+    k = jnp.einsum("bsd,dh->bsh", x, lp["wk"].astype(x.dtype)).reshape(B, S, c.n_kv_heads, hd)
+    v = jnp.einsum("bsd,dh->bsh", x, lp["wv"].astype(x.dtype)).reshape(B, S, c.n_kv_heads, hd)
+    q = apply_rope(q, cos, sin, positions)
+    k = apply_rope(k, cos, sin, positions)
+    o = attention(q, k, v, causal=True, segment_ids=segment_ids, impl=c.attention_impl)
+    o = jnp.einsum("bsh,hd->bsd", o.reshape(B, S, c.n_heads * hd), lp["wo"].astype(x.dtype))
+    h = h + o
+    x = rms_norm(h, lp["ln2"], c.rms_eps)
+    y, aux = moe_ffn(x, lp, c)
+    return h + y, aux
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,
+    config: MoEConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """-> (logits [B, S, V], total aux loss)."""
+    c = config
+    B, S = tokens.shape
+    if S > c.max_seq:
+        raise ValueError(f"sequence length {S} > max_seq={c.max_seq}")
+    if positions is None:
+        positions = llama.packed_positions(segment_ids, S)
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+    h = params["embed"].astype(c.dtype)[tokens]
+
+    block = partial(
+        _block, config=c, cos=cos, sin=sin, positions=positions, segment_ids=segment_ids
+    )
+    if c.remat:
+        block = jax.checkpoint(block)
+
+    def scan_fn(carry, lp):
+        h, aux = carry
+        h, a = block(h, lp)
+        return (h, aux + a), None
+
+    (h, aux), _ = jax.lax.scan(scan_fn, (h, jnp.float32(0.0)), params["layers"])
+    h = rms_norm(h, params["final_norm"], c.rms_eps)
+    w_out = params.get("lm_head", None)
+    if w_out is None:
+        w_out = params["embed"].T
+    logits = jnp.einsum("bsd,dv->bsv", h, w_out.astype(c.dtype))
+    return logits, aux
+
+
+def loss_fn(params: Params, batch: dict, config: MoEConfig) -> jax.Array:
+    logits, aux = forward(
+        params, batch["tokens"], config, segment_ids=batch.get("segment_ids")
+    )
+    ce, _ = cross_entropy_loss(logits, batch["targets"], batch.get("mask"))
+    return ce + config.router_aux_coeff * aux

@@ -43,12 +43,22 @@ class ActorPool:
 
         if self._next_return_index >= self._next_task_index:
             raise StopIteration("no pending results")
-        future = self._index_to_future.pop(self._next_return_index)
+        future = self._index_to_future[self._next_return_index]
+        try:
+            value = ray_tpu.get(future, timeout=timeout)
+        except ray_tpu.GetTimeoutError:
+            raise  # state untouched: the caller can retry the same slot
+        except Exception:
+            # task FAILED (completed with error): consume the slot and
+            # recycle the actor, then surface the error
+            del self._index_to_future[self._next_return_index]
+            self._next_return_index += 1
+            self._return_actor(self._future_to_actor.pop(future))
+            raise
+        del self._index_to_future[self._next_return_index]
         self._next_return_index += 1
-        # return the actor even when the task raised — losing it from the
-        # rotation would strand queued submits forever
         self._return_actor(self._future_to_actor.pop(future))
-        return ray_tpu.get(future, timeout=timeout)
+        return value
 
     def get_next_unordered(self, timeout: float | None = None) -> Any:
         """Next result in completion order."""

@@ -47,10 +47,22 @@ class Metric:
         self._lock = threading.Lock()
         with _REGISTRY_LOCK:
             existing = _REGISTRY.get(self.name)
-            if existing is not None and existing.TYPE != self.TYPE:
-                raise ValueError(
-                    f"metric {self.name!r} already registered as {existing.TYPE}"
-                )
+            if existing is not None:
+                if existing.TYPE != self.TYPE:
+                    raise ValueError(
+                        f"metric {self.name!r} already registered as {existing.TYPE}"
+                    )
+                # same name+type: SHARE storage so every instance's records
+                # land in the one exported time series (silently shadowing
+                # would lose the first instance's counts)
+                self._series = existing._series
+                self._lock = existing._lock
+                if isinstance(existing, Histogram) and isinstance(self, Histogram):
+                    self._buckets = existing._buckets
+                    self._sums = existing._sums
+                    self._counts = existing._counts
+                    self.boundaries = existing.boundaries
+                return
             _REGISTRY[self.name] = self
 
     def set_default_tags(self, tags: dict) -> "Metric":

@@ -62,7 +62,10 @@ class Queue:
     def __init__(self, maxsize: int = 0, actor_options: Optional[dict] = None):
         import ray_tpu
 
-        opts = actor_options or {"num_cpus": 0}
+        opts = dict(actor_options or {"num_cpus": 0})
+        # the queue actor must serve get() while a put() blocks on a full
+        # queue (and vice versa) — concurrency 1 would deadlock both sides
+        opts.setdefault("max_concurrency", 1000)
         self.actor = ray_tpu.remote(_QueueActor).options(**opts).remote(maxsize)
 
     def put(self, item: Any, block: bool = True, timeout: Optional[float] = None) -> None:

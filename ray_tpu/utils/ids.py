@@ -19,12 +19,14 @@ _ID_NBYTES = 16
 class BaseID:
     """Immutable random identifier. Subclasses carry the entity type."""
 
-    __slots__ = ("_bytes",)
+    __slots__ = ("_bytes", "_hash", "_repr")
 
     def __init__(self, id_bytes: bytes):
         if len(id_bytes) != _ID_NBYTES:
             raise ValueError(f"expected {_ID_NBYTES} bytes, got {len(id_bytes)}")
         self._bytes = id_bytes
+        self._hash = None
+        self._repr = None
 
     @classmethod
     def from_random(cls):
@@ -48,13 +50,20 @@ class BaseID:
         return self._bytes.hex()
 
     def __hash__(self):
-        return hash((type(self).__name__, self._bytes))
+        # cached: ids key every hot-path dict (object store, event table)
+        h = self._hash
+        if h is None:
+            h = self._hash = hash((type(self).__name__, self._bytes))
+        return h
 
     def __eq__(self, other):
         return type(other) is type(self) and other._bytes == self._bytes
 
     def __repr__(self):
-        return f"{type(self).__name__}({self.hex()[:12]})"
+        r = self._repr
+        if r is None:
+            r = self._repr = f"{type(self).__name__}({self.hex()[:12]})"
+        return r
 
 
 class JobID(BaseID):

@@ -1,0 +1,68 @@
+"""Job submission tests (reference strategy:
+python/ray/tests/test_job_submission_client.py + dashboard job tests)."""
+
+import sys
+
+import pytest
+
+from ray_tpu.job_submission import JobStatus, JobSubmissionClient
+
+
+@pytest.fixture
+def client(tmp_path):
+    return JobSubmissionClient(log_dir=str(tmp_path))
+
+
+def test_submit_and_succeed(client):
+    sid = client.submit_job(entrypoint=f"{sys.executable} -c 'print(6*7)'")
+    assert client.wait_until_finish(sid, timeout=30) == JobStatus.SUCCEEDED
+    assert "42" in client.get_job_logs(sid)
+
+
+def test_failed_job(client):
+    sid = client.submit_job(entrypoint=f"{sys.executable} -c 'raise SystemExit(3)'")
+    assert client.wait_until_finish(sid, timeout=30) == JobStatus.FAILED
+    assert "exit code 3" in client.get_job_info(sid).message
+
+
+def test_env_vars_and_metadata(client):
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import os; print(os.environ[\"MY_FLAG\"], os.environ[\"RAY_TPU_JOB_ID\"])'",
+        runtime_env={"env_vars": {"MY_FLAG": "on"}},
+        metadata={"team": "tpu"},
+        submission_id="job-env-test",
+    )
+    assert client.wait_until_finish(sid, timeout=30) == JobStatus.SUCCEEDED
+    logs = client.get_job_logs(sid)
+    assert "on job-env-test" in logs
+    assert client.get_job_info(sid).metadata == {"team": "tpu"}
+
+
+def test_stop_job(client):
+    sid = client.submit_job(
+        entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'"
+    )
+    import time
+
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if client.get_job_status(sid) == JobStatus.RUNNING:
+            break
+        time.sleep(0.05)
+    assert client.stop_job(sid)
+    assert client.wait_until_finish(sid, timeout=10) == JobStatus.STOPPED
+
+
+def test_list_and_delete(client):
+    sid = client.submit_job(entrypoint="true")
+    client.wait_until_finish(sid, timeout=30)
+    assert any(j.submission_id == sid for j in client.list_jobs())
+    assert client.delete_job(sid)
+    assert all(j.submission_id != sid for j in client.list_jobs())
+
+
+def test_duplicate_id_rejected(client):
+    sid = client.submit_job(entrypoint="true", submission_id="dup")
+    with pytest.raises(ValueError):
+        client.submit_job(entrypoint="true", submission_id="dup")
+    client.wait_until_finish(sid, timeout=30)
