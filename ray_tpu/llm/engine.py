@@ -54,6 +54,11 @@ class EngineConfig:
     enable_prefix_caching: bool = True
     eos_token_id: int = 2
 
+    def __post_init__(self):
+        # a prefill bucket longer than the context window can never be
+        # used; clamping keeps bucket compilation bounded by the model
+        self.max_prefill_len = min(self.max_prefill_len, self.model.max_seq)
+
     def prefill_buckets(self) -> list[int]:
         out, b = [], 16
         while b < self.max_prefill_len:
@@ -168,6 +173,15 @@ class LLMEngine:
             raise ValueError(
                 f"prompt length {len(prompt_token_ids)} exceeds "
                 f"max_prefill_len={self.config.max_prefill_len}"
+            )
+        # must leave room for >=1 generated token: a prompt of max_seq or
+        # longer would overflow the block table (sized for max_seq) during
+        # prefill and push RoPE positions past the table
+        if len(prompt_token_ids) >= self.config.model.max_seq:
+            raise ValueError(
+                f"prompt length {len(prompt_token_ids)} >= model max_seq="
+                f"{self.config.model.max_seq}; prompts must be shorter than "
+                "the model context window"
             )
         # a prompt the cache can NEVER hold would wedge the queue head:
         # _try_prefill would return [] forever while the engine spins

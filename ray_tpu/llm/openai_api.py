@@ -25,6 +25,9 @@ from typing import Any, Optional
 
 from ray_tpu.llm.engine import EngineConfig, LLMEngine, RequestOutput
 from ray_tpu.llm.sampling import SamplingParams
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.llm.openai_api")
 
 
 # ---------------------------------------------------------------------------
@@ -77,6 +80,7 @@ class _EngineRunner:
         self._queues: dict[str, queue.Queue] = {}
         self._wake = threading.Event()
         self._stop = False
+        self._dead: Optional[BaseException] = None
         self._thread = threading.Thread(
             target=self._loop, name="llm-engine-loop", daemon=True
         )
@@ -85,6 +89,12 @@ class _EngineRunner:
     def submit(self, prompt_ids: list, sp: SamplingParams) -> tuple[str, queue.Queue]:
         q: queue.Queue = queue.Queue()
         with self.lock:
+            # checked under the lock: the death handler drains _queues under
+            # it, so an insert after the drain would hang its caller forever
+            if self._dead is not None:
+                raise RuntimeError(
+                    f"engine loop died: {self._dead!r}"
+                ) from self._dead
             rid = self.engine.add_request(prompt_ids, sp)
             self._queues[rid] = q
         self._wake.set()
@@ -105,14 +115,26 @@ class _EngineRunner:
                 self._wake.wait(timeout=0.2)
                 self._wake.clear()
                 continue
-            with self.lock:
-                outputs = self.engine.step()
-                for out in outputs:
-                    q = self._queues.get(out.request_id)
-                    if q is not None:
-                        q.put(out)
-                        if out.finished:
-                            del self._queues[out.request_id]
+            try:
+                with self.lock:
+                    outputs = self.engine.step()
+                    for out in outputs:
+                        q = self._queues.get(out.request_id)
+                        if q is not None:
+                            q.put(out)
+                            if out.finished:
+                                del self._queues[out.request_id]
+            except BaseException as e:  # a wedged step must not hang callers
+                logger.exception(
+                    "engine loop failed; failing all in-flight requests"
+                )
+                self._dead = e
+                with self.lock:
+                    queues = list(self._queues.values())
+                    self._queues.clear()
+                for q in queues:
+                    q.put(e)
+                return
 
     def shutdown(self) -> None:
         self._stop = True
@@ -174,6 +196,8 @@ class LLMServer:
                 out: Optional[RequestOutput] = await loop.run_in_executor(None, q.get)
                 if out is None:
                     return
+                if isinstance(out, BaseException):  # engine loop died
+                    raise RuntimeError("engine loop failed") from out
                 yield out
                 if out.finished:
                     return

@@ -71,6 +71,11 @@ def prefill(
     """Returns (last-valid-token logits [B, V], updated cache)."""
     c = config
     B, S = tokens.shape
+    if S > c.max_seq:
+        raise ValueError(
+            f"prefill chunk length {S} > max_seq={c.max_seq}; RoPE tables "
+            "only cover max_seq positions (llama.forward has the same guard)"
+        )
     cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
     h = params["embed"].astype(c.dtype)[tokens]
     flat_slots = slot_mapping.reshape(-1)  # [B*S]

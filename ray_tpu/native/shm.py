@@ -9,6 +9,7 @@ zero-copy numpy/memoryview slices of the mapping.
 from __future__ import annotations
 
 import ctypes
+import hashlib
 import os
 import subprocess
 import threading
@@ -25,21 +26,33 @@ def _build_dir() -> str:
 
 
 def load_library(build: bool = True) -> ctypes.CDLL:
-    """Load (building if needed) libshm_store.so."""
+    """Load (building if needed) libshm_store.so.
+
+    The .so is a build artifact (gitignored); staleness is decided by a
+    source-hash stamp written after each build — mtimes are meaningless
+    after a fresh git checkout.
+    """
     global _LIB
     with _LIB_LOCK:
         if _LIB is not None:
             return _LIB
         d = _build_dir()
         so = os.path.join(d, "libshm_store.so")
-        src = os.path.join(d, "src", "shm_store.cc")
-        if build and (
-            not os.path.exists(so)
-            or os.path.getmtime(so) < os.path.getmtime(src)
-        ):
-            subprocess.run(
-                ["make", "-s", "-C", d], check=True, capture_output=True
-            )
+        if build:
+            src = os.path.join(d, "src", "shm_store.cc")
+            stamp = os.path.join(d, ".shm_store.srchash")
+            with open(src, "rb") as f:
+                src_hash = hashlib.sha256(f.read()).hexdigest()
+            stamped = None
+            if os.path.exists(stamp):
+                with open(stamp) as f:
+                    stamped = f.read().strip()
+            if not os.path.exists(so) or stamped != src_hash:
+                subprocess.run(
+                    ["make", "-s", "-C", d], check=True, capture_output=True
+                )
+                with open(stamp, "w") as f:
+                    f.write(src_hash)
         lib = ctypes.CDLL(so)
         lib.shm_store_create.restype = ctypes.c_void_p
         lib.shm_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]

@@ -58,6 +58,7 @@ struct Header {
   uint64_t lru_clock;
   uint64_t num_evictions;
   uint64_t max_probe;      // longest insert displacement (bounds miss scans)
+  uint64_t failed;         // set when post-crash validation finds corruption
   pthread_mutex_t mutex;   // process-shared
   ObjectEntry table[kTableSlots];
 };
@@ -218,6 +219,7 @@ void* shm_store_create(const char* path, uint64_t capacity) {
   h->lru_clock = 1;
   h->num_evictions = 0;
   h->max_probe = 0;
+  h->failed = 0;
   pthread_mutexattr_t attr;
   pthread_mutexattr_init(&attr);
   pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
@@ -254,9 +256,84 @@ void shm_store_close(void* store) {
   delete s;
 }
 
-static int lock_hdr(Header* h) {
+// A process died holding the lock, possibly mid-allocate/free. Before
+// trusting the header, bound-check the free list and object table and
+// recompute the byte accounting; if the structures don't validate, mark
+// the store failed so every subsequent op errors instead of operating on
+// crossed free-list links / double-allocated ranges.
+static bool validate_after_owner_death(Header* h, uint8_t* base) {
+  const uint64_t lo = h->data_start;
+  const uint64_t hi = h->data_start + h->capacity;
+  // gather every claimed interval (free blocks + live objects); any
+  // overlap means a range is double-owned (e.g. death inside shm_delete
+  // between free_bytes and clearing the entry) — unrecoverable.
+  struct Interval { uint64_t off, end; };
+  const uint64_t kMaxIvs = kTableSlots + 1024;  // free list is coalesced: short
+  Interval* ivs = new (std::nothrow) Interval[kMaxIvs];
+  if (!ivs) return false;
+  struct IvGuard { Interval* p; ~IvGuard() { delete[] p; } } guard{ivs};
+  uint64_t n_iv = 0;
+  // free list: in-bounds, aligned, strictly ascending
+  uint64_t free_total = 0, prev_end = 0, cur = h->free_head;
+  uint64_t max_iters = h->capacity / kAlign + 2;
+  while (cur) {
+    if (cur < lo || cur >= hi || (cur & (kAlign - 1)) || !max_iters--) return false;
+    FreeBlock* fb = (FreeBlock*)(base + cur);
+    if (fb->size < kAlign || (fb->size & (kAlign - 1)) || cur + fb->size > hi)
+      return false;
+    if (cur < prev_end) return false;  // overlap / out of order
+    prev_end = cur + fb->size;
+    free_total += fb->size;
+    if (n_iv < kMaxIvs)
+      ivs[n_iv++] = {cur, cur + fb->size};
+    else
+      return false;  // absurd free-list length: treat as corrupt
+    cur = fb->next;
+  }
+  // object table: entries in-bounds; recompute totals
+  uint64_t used_total = 0, n_obj = 0;
+  for (uint32_t i = 0; i < kTableSlots; i++) {
+    ObjectEntry* e = &h->table[i];
+    if (!e->used) continue;
+    if (e->offset < lo || e->offset >= hi || e->alloc_size == 0 ||
+        (e->alloc_size & (kAlign - 1)) || e->offset + e->alloc_size > hi ||
+        e->refcount < 0)
+      return false;
+    used_total += e->alloc_size;
+    n_obj++;
+    if (n_iv < kMaxIvs)
+      ivs[n_iv++] = {e->offset, e->offset + e->alloc_size};
+    else
+      return false;
+  }
+  if (free_total + used_total > h->capacity) return false;
+  // sort intervals by offset (insertion sort: list is near-sorted — free
+  // blocks arrive ascending) and reject any adjacent overlap
+  for (uint64_t i = 1; i < n_iv; i++) {
+    Interval key = ivs[i];
+    uint64_t j = i;
+    while (j > 0 && ivs[j - 1].off > key.off) { ivs[j] = ivs[j - 1]; j--; }
+    ivs[j] = key;
+  }
+  for (uint64_t i = 1; i < n_iv; i++)
+    if (ivs[i].off < ivs[i - 1].end) return false;  // double-owned range
+  // repair the counters the dead owner may have half-updated
+  h->used_bytes = used_total;
+  h->num_objects = n_obj;
+  return true;
+}
+
+static int lock_hdr(Header* h, uint8_t* base) {
   int rc = pthread_mutex_lock(&h->mutex);
-  if (rc == EOWNERDEAD) { pthread_mutex_consistent(&h->mutex); rc = 0; }
+  if (rc == EOWNERDEAD) {
+    pthread_mutex_consistent(&h->mutex);
+    if (!validate_after_owner_death(h, base)) h->failed = 1;
+    rc = 0;
+  }
+  if (rc == 0 && h->failed) {
+    pthread_mutex_unlock(&h->mutex);
+    return EBADFD;
+  }
   return rc;
 }
 
@@ -266,7 +343,7 @@ uint64_t shm_create(void* store, const uint8_t* id, uint64_t size) {
   Store* s = (Store*)store;
   Header* h = s->hdr;
   if (size == 0) size = kAlign;
-  if (lock_hdr(h)) return 0;
+  if (lock_hdr(h, s->base)) return 0;
   uint64_t out = 0;
   do {
     if (find_entry(h, id)) break;  // already exists
@@ -297,7 +374,7 @@ uint64_t shm_create(void* store, const uint8_t* id, uint64_t size) {
 int shm_seal(void* store, const uint8_t* id) {
   Store* s = (Store*)store;
   Header* h = s->hdr;
-  if (lock_hdr(h)) return -1;
+  if (lock_hdr(h, s->base)) return -1;
   ObjectEntry* e = find_entry(h, id);
   int rc = -1;
   if (e && !e->sealed) { e->sealed = 1; rc = 0; }
@@ -310,7 +387,7 @@ int shm_seal(void* store, const uint8_t* id) {
 uint64_t shm_get(void* store, const uint8_t* id, uint64_t* size_out) {
   Store* s = (Store*)store;
   Header* h = s->hdr;
-  if (lock_hdr(h)) return 0;
+  if (lock_hdr(h, s->base)) return 0;
   uint64_t off = 0;
   ObjectEntry* e = find_entry(h, id);
   if (e && e->sealed) {
@@ -325,7 +402,7 @@ uint64_t shm_get(void* store, const uint8_t* id, uint64_t* size_out) {
 int shm_release(void* store, const uint8_t* id) {
   Store* s = (Store*)store;
   Header* h = s->hdr;
-  if (lock_hdr(h)) return -1;
+  if (lock_hdr(h, s->base)) return -1;
   int rc = -1;
   ObjectEntry* e = find_entry(h, id);
   if (e && e->refcount > 0) {
@@ -340,7 +417,7 @@ int shm_release(void* store, const uint8_t* id) {
 int shm_delete(void* store, const uint8_t* id) {
   Store* s = (Store*)store;
   Header* h = s->hdr;
-  if (lock_hdr(h)) return -1;
+  if (lock_hdr(h, s->base)) return -1;
   int rc = -1;
   ObjectEntry* e = find_entry(h, id);
   if (e && e->refcount == 0) {
@@ -360,7 +437,7 @@ int shm_delete(void* store, const uint8_t* id) {
 int shm_force_delete(void* store, const uint8_t* id) {
   Store* s = (Store*)store;
   Header* h = s->hdr;
-  if (lock_hdr(h)) return -1;
+  if (lock_hdr(h, s->base)) return -1;
   int rc = -1;
   ObjectEntry* e = find_entry(h, id);
   if (e) {
@@ -377,7 +454,7 @@ int shm_force_delete(void* store, const uint8_t* id) {
 int shm_contains(void* store, const uint8_t* id) {
   Store* s = (Store*)store;
   Header* h = s->hdr;
-  if (lock_hdr(h)) return 0;
+  if (lock_hdr(h, s->base)) return 0;
   ObjectEntry* e = find_entry(h, id);
   int rc = (e && e->sealed) ? 1 : 0;
   pthread_mutex_unlock(&h->mutex);
@@ -390,7 +467,11 @@ void shm_stats(void* store, uint64_t* capacity, uint64_t* used,
                uint64_t* num_objects, uint64_t* num_evictions) {
   Store* s = (Store*)store;
   Header* h = s->hdr;
-  lock_hdr(h);
+  if (capacity) *capacity = 0;
+  if (used) *used = 0;
+  if (num_objects) *num_objects = 0;
+  if (num_evictions) *num_evictions = 0;
+  if (lock_hdr(h, s->base)) return;  // failed store: zeroed outputs
   if (capacity) *capacity = h->capacity;
   if (used) *used = h->used_bytes;
   if (num_objects) *num_objects = h->num_objects;

@@ -58,12 +58,17 @@ def run(dag: DAGNode, *, workflow_id: Optional[str] = None) -> Any:
 
 
 def run_async(dag: DAGNode, *, workflow_id: Optional[str] = None):
-    """Submit a workflow; returns an ObjectRef for its output."""
+    """Submit a workflow; returns an ObjectRef for its output. Like run(),
+    re-submitting an interrupted workflow_id drives the STORED dag — step
+    identity is node-based, so saving a freshly built graph would orphan
+    every completed step and re-execute them all."""
     import ray_tpu
 
     storage = _get_storage()
     wid = workflow_id or _new_id()
-    storage.save_dag(wid, dag)
+    meta = storage.load_meta(wid)
+    if meta is None or meta.get("status") == "SUCCESSFUL":
+        storage.save_dag(wid, dag)
 
     @ray_tpu.remote
     def _drive(workflow_id: str):
