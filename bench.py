@@ -4,11 +4,26 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 Baseline: the reference publishes no in-repo ML throughput numbers
 (BASELINE.md) — the north-star target is >=45% MFU, so vs_baseline is
 achieved_MFU / 0.45.
+
+Measurement discipline (round-1 postmortem: an unfenced timing loop on
+the axon platform published a physically impossible 70,858% MFU):
+
+ * every timed step is fenced by a host transfer of its loss —
+   ``float(metrics["loss"])`` cannot return before the step's compute
+   graph has executed, regardless of how the platform implements
+   ``block_until_ready``;
+ * the initial loss must be ~ln(vocab) (an untrained model is uniform);
+ * the loss must actually decrease while we train on a fixed batch;
+ * timing must scale linearly in iteration count (two runs cross-check);
+ * 0 < MFU <= 1.0 is a hard gate — violating any check exits non-zero
+   with an "error" JSON line instead of publishing fiction.
 """
 
 from __future__ import annotations
 
 import json
+import math
+import sys
 import time
 
 import jax
@@ -34,6 +49,28 @@ def peak_flops(device) -> float:
     return 1e12  # CPU / unknown: nominal
 
 
+def fail(reason: str, **extra):
+    print(json.dumps({"metric": "benchmark_error", "value": 0, "unit": "error",
+                      "vs_baseline": 0, "error": reason, **extra}))
+    sys.exit(1)
+
+
+def timed_steps(step, state, batch, iters: int):
+    """Run `iters` steps, each fenced by a host transfer of the loss.
+
+    Returns (state, per-step losses, wall seconds). The per-step fence
+    costs one scalar D2H round-trip per step — a small, honest tax that
+    makes it impossible to time an empty dispatch queue.
+    """
+    losses = []
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, metrics = step(state, batch)
+        losses.append(float(metrics["loss"]))  # hard fence: bytes must land
+    dt = time.perf_counter() - t0
+    return state, losses, dt
+
+
 def main():
     import os
 
@@ -46,6 +83,8 @@ def main():
         except Exception:
             pass
 
+    import dataclasses
+
     import optax
 
     from ray_tpu.models import llama
@@ -57,30 +96,69 @@ def main():
         cfg, B, S, iters = llama.LLAMA_400M, 8, 1024, 10
     else:  # keep the smoke path fast off-TPU
         cfg, B, S, iters = llama.LLAMA_TINY, 4, 64, 3
+    attn_impl = os.environ.get("RAY_TPU_BENCH_ATTN", "flash" if on_tpu else "xla")
+    cfg = dataclasses.replace(cfg, attention_impl=attn_impl)
 
     params = llama.init_params(cfg, jax.random.key(0))
-    opt = optax.adamw(1e-4)
+    opt = optax.adamw(3e-4)
     state = TrainState.create(params, opt)
     step = make_train_step(lambda p, b: llama.loss_fn(p, b, cfg), opt)
 
     tokens = jax.random.randint(jax.random.key(1), (B, S + 1), 0, cfg.vocab_size, jnp.int32)
     batch = {"tokens": tokens[:, :-1], "targets": tokens[:, 1:]}
 
-    # warmup / compile
+    # -- gate 1: untrained model must sit at the uniform-prediction loss ------
+    init_loss = float(jax.jit(lambda p, b: llama.loss_fn(p, b, cfg))(state.params, batch))
+    ln_v = math.log(cfg.vocab_size)
+    if not (0.3 * ln_v <= init_loss <= 3.0 * ln_v):
+        fail(
+            f"initial loss {init_loss:.3f} not near ln(vocab)={ln_v:.3f}: "
+            "model/loss wiring is broken",
+            init_loss=init_loss,
+        )
+
+    # warmup / compile (also primes the donated-buffer path)
     for _ in range(2):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    warm_loss = float(metrics["loss"])
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
-    dt = time.perf_counter() - t0
+    # -- timed runs: two iteration counts to cross-check linearity ------------
+    state, losses_a, dt_a = timed_steps(step, state, batch, iters)
+    state, losses_b, dt_b = timed_steps(step, state, batch, 3 * iters)
+    per_step_a = dt_a / iters
+    per_step_b = dt_b / (3 * iters)
+    if not (0.75 <= per_step_b / per_step_a <= 1.33):
+        fail(
+            f"timing not linear in iteration count: {per_step_a*1e3:.3f} ms/step "
+            f"over {iters} iters vs {per_step_b*1e3:.3f} ms/step over {3*iters} — "
+            "the timed work is not actually running per-step",
+            per_step_ms_a=per_step_a * 1e3,
+            per_step_ms_b=per_step_b * 1e3,
+        )
 
-    tokens_per_sec = B * S * iters / dt
+    # -- gate 2: training on a fixed batch must reduce the loss ---------------
+    losses = [warm_loss] + losses_a + losses_b
+    if not (losses[-1] < losses[0] and losses[-1] < init_loss):
+        fail(
+            f"loss did not decrease (init {init_loss:.3f}, first {losses[0]:.3f}, "
+            f"last {losses[-1]:.3f}): the optimizer step is not executing",
+            init_loss=init_loss, losses=losses[:8],
+        )
+
+    total_steps = 4 * iters
+    dt = dt_a + dt_b
+    tokens_per_sec = B * S * total_steps / dt
     train_flops_per_token = 3.0 * cfg.flops_per_token()  # fwd + 2x bwd
     achieved = tokens_per_sec * train_flops_per_token
     mfu = achieved / peak_flops(dev)
+
+    # -- gate 3: MFU must be physically possible ------------------------------
+    if not (0.0 < mfu <= 1.0):
+        fail(
+            f"MFU {mfu:.4f} outside (0, 1]: timing or FLOP accounting is wrong "
+            f"({tokens_per_sec:.0f} tok/s claimed on {dev.device_kind})",
+            mfu=mfu, tokens_per_sec=tokens_per_sec,
+        )
 
     print(
         json.dumps(
@@ -90,9 +168,14 @@ def main():
                 "unit": "%MFU",
                 "vs_baseline": round(mfu / 0.45, 4),
                 "tokens_per_sec": round(tokens_per_sec, 1),
+                "ms_per_step": round(1e3 * dt / total_steps, 2),
                 "device": getattr(dev, "device_kind", str(dev)),
                 "model_params": cfg.num_params(),
-                "loss": float(metrics["loss"]),
+                "attention_impl": cfg.attention_impl,
+                "batch": B,
+                "seq": S,
+                "init_loss": round(init_loss, 4),
+                "final_loss": round(losses[-1], 4),
             }
         )
     )
