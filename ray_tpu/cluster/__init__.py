@@ -1,0 +1,38 @@
+"""Distributed runtime: GCS service, node daemons, workers, driver client.
+
+The multi-process control plane (reference: src/ray/gcs + src/ray/raylet
++ src/ray/core_worker split across processes). The single-process
+runtime in ray_tpu.core stays the TPU-host fast path; this package is
+the cross-process / cross-host tier.
+"""
+
+from ray_tpu.cluster.client import (
+    ActorDiedError,
+    ClusterActorHandle,
+    ClusterClient,
+    ClusterObjectRef,
+    ClusterTaskError,
+    GetTimeoutError,
+)
+from ray_tpu.cluster.cluster import LocalCluster
+from ray_tpu.cluster.gcs_service import GcsServer, GcsService
+from ray_tpu.cluster.node_daemon import NodeDaemon
+from ray_tpu.cluster.rpc import ClientPool, RemoteError, RpcClient, RpcError, RpcServer
+
+__all__ = [
+    "ActorDiedError",
+    "ClientPool",
+    "ClusterActorHandle",
+    "ClusterClient",
+    "ClusterObjectRef",
+    "ClusterTaskError",
+    "GcsServer",
+    "GcsService",
+    "GetTimeoutError",
+    "LocalCluster",
+    "NodeDaemon",
+    "RemoteError",
+    "RpcClient",
+    "RpcError",
+    "RpcServer",
+]

@@ -1,0 +1,599 @@
+"""Driver-side cluster runtime: submit/get/put/wait/actors over the RPC plane.
+
+Reference analog: the submit path of the core worker
+(src/ray/core_worker/core_worker.cc:2475 SubmitTask ->
+transport/normal_task_submitter.h:74 — lease request, spillback retry,
+PushNormalTask to the leased worker) and the actor submit path
+(transport/actor_task_submitter.h:382). Redesigned around the node
+daemon's lease RPC: the driver leases from its local daemon, follows at
+most a few spillback hops, pushes the task directly to the granted
+worker, and releases the lease when the push returns. Results live in
+node object stores; `get` pulls through the local daemon's fetch path.
+
+Failure handling: a dead worker/node surfaces as a transport error on
+the push; the task is re-leased elsewhere up to `max_retries` (the
+reference's task_manager.h:260 retry loop, node-failure edition).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Optional, Sequence
+
+import cloudpickle
+
+from ray_tpu.cluster.rpc import ClientPool, RemoteError, RpcClient, RpcError
+from ray_tpu.cluster.serialization import _ErrorValue, dumps_value, loads_value
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.cluster.client")
+
+
+class ClusterTaskError(Exception):
+    def __init__(self, desc: str, cause: BaseException, tb: str):
+        super().__init__(f"{desc} failed: {cause!r}\n{tb}")
+        self.cause = cause
+
+
+class ActorDiedError(Exception):
+    pass
+
+
+class GetTimeoutError(Exception):
+    pass
+
+
+def _new_id() -> bytes:
+    return uuid.uuid4().bytes
+
+
+class ClusterObjectRef:
+    """A future for an object living in some node's store."""
+
+    __slots__ = ("id", "_client", "_desc")
+
+    def __init__(self, object_id: bytes, client: "ClusterClient", desc: str = ""):
+        self.id = object_id
+        self._client = client
+        self._desc = desc
+
+    def get(self, timeout: Optional[float] = None):
+        return self._client.get(self, timeout=timeout)
+
+    def __reduce__(self):
+        # travels as a persistent id through dumps_value; plain pickling
+        # (e.g. inside foreign containers) rebuilds against the ambient
+        # client on the receiving side
+        return (_rebuild_ref, (self.id, self._desc))
+
+    def __repr__(self):
+        return f"ClusterObjectRef({self.id.hex()[:12]}, {self._desc})"
+
+
+def _rebuild_ref(object_id: bytes, desc: str) -> "ClusterObjectRef":
+    return ClusterObjectRef(object_id, _ambient_client(), desc)
+
+
+_AMBIENT: list = [None]
+
+
+def _ambient_client():
+    c = _AMBIENT[0]
+    if c is None:
+        raise RuntimeError("no ClusterClient in this process")
+    return c
+
+
+class ClusterActorHandle:
+    """Location-transparent actor handle (actor_id + GCS lookup)."""
+
+    def __init__(self, actor_id: bytes, client: "ClusterClient", desc: str = "actor"):
+        self._actor_id = actor_id
+        self._client = client
+        self._desc = desc
+
+    def __getattr__(self, name: str) -> "_ActorMethod":
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return _ActorMethod(self, name)
+
+    def __reduce__(self):
+        return (_rebuild_handle, (self._actor_id, self._desc))
+
+    def kill(self) -> None:
+        self._client.kill_actor(self._actor_id)
+
+    @property
+    def state(self) -> str:
+        info = self._client.gcs.call("get_actor", {"actor_id": self._actor_id})
+        return info["state"] if info else "UNKNOWN"
+
+
+def _rebuild_handle(actor_id: bytes, desc: str) -> ClusterActorHandle:
+    return ClusterActorHandle(actor_id, _ambient_client(), desc)
+
+
+class _ActorMethod:
+    def __init__(self, handle: ClusterActorHandle, name: str):
+        self._handle = handle
+        self._name = name
+
+    def remote(self, *args, **kwargs):
+        h = self._handle
+        return h._client.submit_actor_task(
+            h._actor_id, self._name, args, kwargs
+        )
+
+    def options(self, num_returns: int = 1):
+        method = self
+
+        class _Opts:
+            def remote(self_o, *args, **kwargs):
+                h = method._handle
+                return h._client.submit_actor_task(
+                    h._actor_id, method._name, args, kwargs,
+                    num_returns=num_returns,
+                )
+
+        return _Opts()
+
+
+class ClusterClient:
+    """One per driver process. `local_daemon` is the colocated node daemon
+    the driver leases from and fetches through (the head node's raylet)."""
+
+    def __init__(self, gcs_addr: tuple, local_daemon_addr: tuple):
+        self.gcs = RpcClient(*gcs_addr, timeout=60.0).connect(retries=20)
+        self.local_daemon_addr = tuple(local_daemon_addr)
+        self.pool = ClientPool(timeout=120.0)
+        self._lock = threading.Lock()
+        _AMBIENT[0] = self
+
+    @property
+    def local_daemon(self) -> RpcClient:
+        return self.pool.get(self.local_daemon_addr)
+
+    def close(self) -> None:
+        self.gcs.close()
+        self.pool.close_all()
+        if _AMBIENT[0] is self:
+            _AMBIENT[0] = None
+
+    # -- objects --------------------------------------------------------------
+
+    def put(self, value: Any) -> ClusterObjectRef:
+        oid = _new_id()
+        self.local_daemon.call(
+            "put_object", {"object_id": oid, "data": dumps_value(value)}
+        )
+        return ClusterObjectRef(oid, self, "put")
+
+    def get(self, ref: "ClusterObjectRef | Sequence[ClusterObjectRef]",
+            timeout: Optional[float] = None):
+        if isinstance(ref, (list, tuple)):
+            return type(ref)(self.get(r, timeout=timeout) for r in ref)
+        deadline = time.monotonic() + (timeout if timeout is not None else 300.0)
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise GetTimeoutError(f"get({ref!r}) timed out")
+            data = self.local_daemon.call(
+                "fetch_object",
+                {"object_id": ref.id, "timeout": min(remaining, 5.0)},
+                timeout=min(remaining, 5.0) + 10,
+            )
+            if data is not None:
+                value = loads_value(data, self._resolve)
+                if isinstance(value, _ErrorValue):
+                    raise ClusterTaskError(value.task_desc, value.exc, value.tb)
+                return value
+
+    def _resolve(self, object_id: bytes):
+        data = self.local_daemon.call(
+            "fetch_object", {"object_id": object_id, "timeout": 30.0}, timeout=40
+        )
+        if data is None:
+            raise RuntimeError(f"object {object_id.hex()} unavailable")
+        value = loads_value(data, self._resolve)
+        if isinstance(value, _ErrorValue):
+            raise ClusterTaskError(value.task_desc, value.exc, value.tb)
+        return value
+
+    def wait(self, refs: Sequence[ClusterObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        ready: list = []
+        pending = list(refs)
+        while len(ready) < num_returns:
+            still = []
+            for r in pending:
+                locs = self.gcs.call("locate_object", {"object_id": r.id})
+                if locs:
+                    ready.append(r)
+                else:
+                    still.append(r)
+            pending = still
+            if len(ready) >= num_returns:
+                break
+            if deadline is not None and time.monotonic() >= deadline:
+                break
+            time.sleep(0.02)
+        return ready, pending
+
+    # -- task submission ------------------------------------------------------
+
+    def submit(
+        self,
+        func,
+        args: tuple = (),
+        kwargs: Optional[dict] = None,
+        *,
+        resources: Optional[dict] = None,
+        num_returns: int = 1,
+        max_retries: int = 3,
+        pg_id: Optional[bytes] = None,
+        bundle_index: int = 0,
+        desc: Optional[str] = None,
+    ) -> "ClusterObjectRef | list[ClusterObjectRef]":
+        desc = desc or getattr(func, "__name__", "task")
+        return_ids = [_new_id() for _ in range(num_returns)]
+        payload = {
+            "task_id": _new_id(),
+            "desc": desc,
+            "func": cloudpickle.dumps(func),
+            "args": dumps_value((args, dict(kwargs or {}))),
+            "return_ids": return_ids,
+            "num_returns": num_returns,
+        }
+        spec = {
+            "resources": dict(resources or {"num_cpus": 1}),
+            "pg_id": pg_id,
+            "bundle_index": bundle_index,
+        }
+        t = threading.Thread(
+            target=self._drive_task,
+            args=(payload, spec, max_retries),
+            name=f"submit-{desc}",
+            daemon=True,
+        )
+        t.start()
+        refs = [ClusterObjectRef(rid, self, desc) for rid in return_ids]
+        return refs[0] if num_returns == 1 else refs
+
+    def _drive_task(self, payload: dict, spec: dict, max_retries: int) -> None:
+        attempt = 0
+        exclude: list = []
+        while True:
+            try:
+                self._run_once(payload, spec, exclude)
+                return
+            except (RpcError, RemoteError) as e:
+                attempt += 1
+                if attempt > max_retries:
+                    err = _ErrorValue(
+                        RuntimeError(f"task lost after {max_retries} retries: {e}"),
+                        "", payload["desc"],
+                    )
+                    for rid in payload["return_ids"]:
+                        try:
+                            self.local_daemon.call(
+                                "put_object",
+                                {"object_id": rid, "data": dumps_value(err)},
+                            )
+                        except Exception:
+                            logger.exception("cannot store task-lost error")
+                    return
+                logger.warning(
+                    "%s attempt %d failed (%s); retrying", payload["desc"],
+                    attempt, e,
+                )
+                time.sleep(0.1)
+
+    def _lease(self, spec: dict, exclude: list) -> tuple[dict, RpcClient]:
+        """Lease a worker, following spillback hops. Nodes that refused
+        this lease are excluded for subsequent hops (prevents ping-pong on
+        stale availability views); the visited set resets when the whole
+        cluster is saturated and we fall back to waiting."""
+        addr = self.local_daemon_addr
+        if spec.get("pg_id") is not None:
+            # placement-group tasks go straight to the node holding the
+            # reserved bundle (reference: PG scheduling strategy bypasses
+            # the hybrid policy)
+            info = self.gcs.call("get_pg", {"pg_id": spec["pg_id"]})
+            if info is None:
+                raise RemoteError(RuntimeError("placement group removed"))
+            bundle = info["bundles"][spec.get("bundle_index", 0)]
+            if bundle["node_id"] is None:
+                raise RemoteError(RuntimeError("bundle not placed yet"))
+            nodes = {n["node_id"]: tuple(n["addr"]) for n in
+                     self.gcs.call("list_nodes", None)}
+            addr = nodes[bundle["node_id"]]
+        deadline = time.monotonic() + 120.0
+        visited: set = set()
+        hops = 0
+        while time.monotonic() < deadline:
+            daemon = self.pool.get(addr)
+            r = daemon.call(
+                "request_worker_lease",
+                {**spec, "exclude": list(set(exclude) | visited)},
+                timeout=90,
+            )
+            if "grant" in r:
+                return r["grant"], daemon
+            if "node_id" in r:
+                visited.add(r["node_id"])
+            if "spillback" in r and hops < 16:
+                addr = tuple(r["spillback"])
+                hops += 1
+                continue
+            if "error" in r:
+                raise RemoteError(RuntimeError(r["error"]))
+            time.sleep(r.get("retry_after", 0.05))
+            visited.clear()  # capacity may have freed anywhere
+            hops = 0
+            addr = self.local_daemon_addr  # re-evaluate from home
+        raise RpcError("lease request timed out")
+
+    def _run_once(self, payload: dict, spec: dict, exclude: list) -> None:
+        grant, daemon = self._lease(spec, exclude)
+        worker_addr = tuple(grant["worker_addr"])
+        kill = False
+        try:
+            w = self.pool.get(worker_addr)
+            r = w.call("push_task", payload, timeout=3600)
+            if not r.get("ok"):
+                # user-level failure: error value already stored; done
+                return
+        except (RpcError, RemoteError):
+            kill = True
+            exclude.append(grant["node_id"])
+            self.pool.invalidate(worker_addr)
+            raise
+        finally:
+            try:
+                daemon.call(
+                    "release_lease",
+                    {"lease_id": grant["lease_id"], "kill": kill},
+                    timeout=10,
+                )
+            except (RpcError, RemoteError):
+                pass  # daemon died with its node; lease died with it
+
+    # -- actors ---------------------------------------------------------------
+
+    def create_actor(
+        self,
+        cls,
+        args: tuple = (),
+        kwargs: Optional[dict] = None,
+        *,
+        resources: Optional[dict] = None,
+        name: Optional[str] = None,
+        namespace: str = "default",
+        max_restarts: int = 0,
+        pg_id: Optional[bytes] = None,
+        bundle_index: int = 0,
+    ) -> ClusterActorHandle:
+        actor_id = _new_id()
+        creation_spec = dumps_value((cls, args, dict(kwargs or {})))
+        spec = {
+            "resources": dict(resources or {"num_cpus": 1}),
+            "pg_id": pg_id,
+            "bundle_index": bundle_index,
+        }
+        grant, daemon = self._lease(spec, [])
+        worker_addr = tuple(grant["worker_addr"])
+        w = self.pool.get(worker_addr)
+        r = w.call(
+            "create_actor",
+            {"actor_id": actor_id, "creation_spec": creation_spec},
+            timeout=300,
+        )
+        if not r.get("ok"):
+            daemon.call("release_lease", {"lease_id": grant["lease_id"], "kill": True})
+            raise ClusterTaskError(
+                f"actor {getattr(cls, '__name__', cls)}",
+                RuntimeError(r.get("error", "creation failed")),
+                r.get("tb", ""),
+            )
+        reg = self.gcs.call(
+            "register_actor",
+            {
+                "actor_id": actor_id,
+                "name": name,
+                "namespace": namespace,
+                "node_id": grant["node_id"],
+                "worker_addr": worker_addr,
+                "state": "ALIVE",
+                "max_restarts": max_restarts,
+                "creation_spec": creation_spec,
+                "lease": {"resources": spec["resources"]},
+            },
+        )
+        if not reg.get("ok"):
+            raise ValueError(reg.get("error", "actor registration failed"))
+        # NOTE: the lease stays held for the actor's lifetime (the worker is
+        # dedicated to it); kill_actor releases it.
+        self._lock_actor_meta(actor_id, grant, worker_addr)
+        return ClusterActorHandle(
+            actor_id, self, desc=getattr(cls, "__name__", "actor")
+        )
+
+    def _lock_actor_meta(self, actor_id, grant, worker_addr):
+        with self._lock:
+            if not hasattr(self, "_actor_meta"):
+                self._actor_meta = {}
+            self._actor_meta[actor_id] = {
+                "grant": grant, "worker_addr": worker_addr,
+            }
+
+    def _actor_worker(self, actor_id: bytes, wait_restart: float = 30.0) -> tuple:
+        """Resolve the actor's current worker address (GCS lookup with
+        restart-aware waiting)."""
+        with self._lock:
+            meta = getattr(self, "_actor_meta", {}).get(actor_id)
+        if meta is not None:
+            return meta["worker_addr"]
+        deadline = time.monotonic() + wait_restart
+        while time.monotonic() < deadline:
+            info = self.gcs.call("get_actor", {"actor_id": actor_id})
+            if info is None:
+                raise ActorDiedError(f"actor {actor_id.hex()} unknown")
+            if info["state"] == "ALIVE" and info["worker_addr"]:
+                return tuple(info["worker_addr"])
+            if info["state"] == "DEAD":
+                raise ActorDiedError(f"actor {actor_id.hex()} is dead")
+            time.sleep(0.1)
+        raise ActorDiedError(f"actor {actor_id.hex()} not available (restarting?)")
+
+    def submit_actor_task(
+        self, actor_id: bytes, method: str, args: tuple, kwargs: dict,
+        num_returns: int = 1,
+    ):
+        return_ids = [_new_id() for _ in range(num_returns)]
+        payload = {
+            "actor_id": actor_id,
+            "method": method,
+            "args": dumps_value((args, dict(kwargs or {}))),
+            "return_ids": return_ids,
+            "num_returns": num_returns,
+        }
+        t = threading.Thread(
+            target=self._drive_actor_task, args=(actor_id, payload),
+            name=f"actor-call-{method}", daemon=True,
+        )
+        t.start()
+        refs = [ClusterObjectRef(rid, self, f"actor.{method}") for rid in return_ids]
+        return refs[0] if num_returns == 1 else refs
+
+    def _drive_actor_task(self, actor_id: bytes, payload: dict) -> None:
+        for attempt in range(2):
+            try:
+                addr = self._actor_worker(actor_id)
+                w = self.pool.get(addr)
+                r = w.call("actor_call", payload, timeout=3600)
+                if r.get("actor_missing") and attempt == 0:
+                    # stale address (restart happened): force GCS lookup
+                    self._forget_actor_addr(actor_id)
+                    continue
+                return
+            except (RpcError, RemoteError):
+                self._forget_actor_addr(actor_id)
+                if attempt == 1:
+                    break
+                time.sleep(0.2)
+            except ActorDiedError as e:
+                self._store_actor_error(payload, e)
+                return
+        self._store_actor_error(
+            payload, ActorDiedError(f"actor {actor_id.hex()} unreachable")
+        )
+
+    def _forget_actor_addr(self, actor_id: bytes) -> None:
+        with self._lock:
+            getattr(self, "_actor_meta", {}).pop(actor_id, None)
+
+    def _store_actor_error(self, payload: dict, exc: Exception) -> None:
+        err = _ErrorValue(exc, "", f"actor.{payload['method']}")
+        for rid in payload["return_ids"]:
+            try:
+                self.local_daemon.call(
+                    "put_object", {"object_id": rid, "data": dumps_value(err)}
+                )
+            except Exception:
+                pass
+
+    def get_named_actor(self, name: str, namespace: str = "default") -> ClusterActorHandle:
+        info = self.gcs.call(
+            "get_named_actor", {"name": name, "namespace": namespace}
+        )
+        if info is None or info["state"] == "DEAD":
+            raise ValueError(f"no live actor named {name!r}")
+        return ClusterActorHandle(info["actor_id"], self, desc=name)
+
+    def kill_actor(self, actor_id: bytes) -> None:
+        with self._lock:
+            meta = getattr(self, "_actor_meta", {}).pop(actor_id, None)
+        info = self.gcs.call("get_actor", {"actor_id": actor_id})
+        if info and info["worker_addr"]:
+            try:
+                self.pool.get(tuple(info["worker_addr"])).call(
+                    "destroy_actor", {"actor_id": actor_id}, timeout=5
+                )
+            except (RpcError, RemoteError):
+                pass
+        self.gcs.call(
+            "update_actor", {"actor_id": actor_id, "state": "DEAD"}
+        )
+        if meta is not None:
+            try:
+                node_addr = self.local_daemon_addr
+                # release on the granting node
+                self.pool.get(tuple(meta["grant"].get("node_addr", node_addr))).call(
+                    "release_lease",
+                    {"lease_id": meta["grant"]["lease_id"], "kill": True},
+                    timeout=5,
+                )
+            except (RpcError, RemoteError):
+                pass
+
+    # -- placement groups -----------------------------------------------------
+
+    def create_placement_group(
+        self, bundles: list, strategy: str = "PACK", name: Optional[str] = None,
+        timeout: float = 30.0,
+    ) -> dict:
+        pg_id = _new_id()
+        deadline = time.monotonic() + timeout
+        info = self.gcs.call(
+            "create_pg",
+            {"pg_id": pg_id, "bundles": bundles, "strategy": strategy, "name": name},
+        )
+        while info["state"] not in ("CREATED",):
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"placement group not placed: {info['state']}")
+            time.sleep(0.05)
+            info = self.gcs.call("get_pg", {"pg_id": pg_id})
+        # reserve the bundles on their nodes
+        nodes = {n["node_id"]: tuple(n["addr"]) for n in self.gcs.call("list_nodes", None)}
+        for i, b in enumerate(info["bundles"]):
+            addr = nodes[b["node_id"]]
+            r = self.pool.get(addr).call(
+                "reserve_pg_bundle",
+                {"pg_id": pg_id, "bundle_index": i, "resources": b["resources"]},
+            )
+            if not r.get("ok"):
+                raise RuntimeError(
+                    f"bundle {i} reservation failed on {b['node_id']}: {r}"
+                )
+        return info
+
+    def remove_placement_group(self, pg_id: bytes) -> None:
+        nodes = {n["node_id"]: tuple(n["addr"]) for n in self.gcs.call("list_nodes", None)}
+        info = self.gcs.call("get_pg", {"pg_id": pg_id})
+        if info:
+            for b in info["bundles"]:
+                addr = nodes.get(b["node_id"])
+                if addr:
+                    try:
+                        self.pool.get(addr).call(
+                            "release_pg_all", {"pg_id": pg_id}, timeout=5
+                        )
+                    except (RpcError, RemoteError):
+                        pass
+        self.gcs.call("remove_pg", {"pg_id": pg_id})
+
+    # -- cluster state --------------------------------------------------------
+
+    def nodes(self) -> list:
+        return self.gcs.call("list_nodes", None)
+
+    def cluster_resources(self) -> dict:
+        total: dict[str, float] = {}
+        for n in self.nodes():
+            if n["alive"]:
+                for k, v in n["resources"].items():
+                    total[k] = total.get(k, 0.0) + v
+        return total

@@ -1,0 +1,554 @@
+"""GCS service: the cluster control-plane server process.
+
+Reference analog: src/ray/gcs/gcs_server/ (GcsServer gcs_server.cc,
+GcsNodeManager, GcsActorManager gcs_actor_manager.h:324,
+GcsPlacementGroupManager gcs_placement_group_manager.h:228,
+GcsHealthCheckManager gcs_health_check_manager.h, InternalKVManager
+gcs_kv_manager.h). Redesigned: one asyncio RPC process holding plain
+dict tables; health is heartbeat-lease based (nodes push state, the
+sweeper declares death after `node_death_timeout_s`) instead of gRPC
+ping; placement groups are placed centrally against the authoritative
+resource view rather than via the reference's two-phase raylet commit.
+
+Event feed: monotonically numbered events (node_added / node_dead /
+actor_update / pg_update); clients poll `events_since` — the long-poll
+pubsub of the reference (src/ray/pubsub/) collapsed to cursor polling.
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ray_tpu.cluster.rpc import RpcServer
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.cluster.gcs")
+
+
+@dataclass
+class NodeEntry:
+    node_id: str
+    addr: tuple  # (host, port) of the node daemon
+    resources: dict  # name -> total
+    available: dict  # name -> available (as last reported)
+    labels: dict = field(default_factory=dict)
+    alive: bool = True
+    last_hb: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class ActorEntry:
+    actor_id: bytes
+    name: Optional[str]
+    namespace: str
+    node_id: Optional[str]
+    worker_addr: Optional[tuple]
+    state: str = "PENDING"  # PENDING | ALIVE | RESTARTING | DEAD
+    max_restarts: int = 0
+    num_restarts: int = 0
+    # enough to re-create the actor elsewhere on node death
+    creation_spec: Optional[bytes] = None
+    owner_addr: Optional[tuple] = None
+    lease_resources: dict = field(default_factory=lambda: {"num_cpus": 1})
+
+
+class GcsService:
+    """RPC handler. All methods take (payload, peer)."""
+
+    def __init__(self, node_death_timeout_s: float = 5.0):
+        self._lock = threading.RLock()
+        self._nodes: dict[str, NodeEntry] = {}
+        self._actors: dict[bytes, ActorEntry] = {}
+        self._named: dict[tuple, bytes] = {}  # (ns, name) -> actor_id
+        self._pgs: dict[bytes, dict] = {}
+        self._kv: dict[str, dict[bytes, bytes]] = {}
+        self._objects: dict[bytes, set[str]] = {}  # obj_id -> node_ids
+        self._events: list[tuple[int, str, dict]] = []
+        self._event_seq = itertools.count()
+        self._death_timeout = node_death_timeout_s
+        self._pg_counter = itertools.count()
+
+    # -- events ---------------------------------------------------------------
+
+    def _emit(self, kind: str, data: dict) -> None:
+        self._events.append((next(self._event_seq), kind, data))
+        if len(self._events) > 10000:
+            del self._events[:5000]
+
+    def rpc_events_since(self, payload, peer):
+        cursor = payload["cursor"]
+        with self._lock:
+            out = [e for e in self._events if e[0] >= cursor]
+            next_cursor = self._events[-1][0] + 1 if self._events else cursor
+        return {"events": out, "cursor": next_cursor}
+
+    # -- nodes ----------------------------------------------------------------
+
+    def rpc_register_node(self, payload, peer):
+        with self._lock:
+            e = NodeEntry(
+                node_id=payload["node_id"],
+                addr=tuple(payload["addr"]),
+                resources=dict(payload["resources"]),
+                available=dict(payload["resources"]),
+                labels=payload.get("labels", {}),
+            )
+            self._nodes[e.node_id] = e
+            self._emit("node_added", {"node_id": e.node_id, "addr": e.addr})
+            logger.info("node %s registered at %s", e.node_id, e.addr)
+        return {"ok": True}
+
+    def rpc_heartbeat(self, payload, peer):
+        with self._lock:
+            e = self._nodes.get(payload["node_id"])
+            if e is None or not e.alive:
+                # unknown/dead node: tell it to re-register (GCS restart or
+                # it was declared dead while partitioned)
+                return {"ok": False, "reregister": True}
+            e.last_hb = time.monotonic()
+            if "available" in payload:
+                e.available = dict(payload["available"])
+        return {"ok": True}
+
+    def rpc_drain_node(self, payload, peer):
+        """Graceful removal (cluster_utils teardown)."""
+        with self._lock:
+            self._mark_dead(payload["node_id"], reason="drained")
+        return {"ok": True}
+
+    def rpc_list_nodes(self, payload, peer):
+        with self._lock:
+            return [
+                {
+                    "node_id": e.node_id,
+                    "addr": e.addr,
+                    "resources": dict(e.resources),
+                    "available": dict(e.available),
+                    "labels": dict(e.labels),
+                    "alive": e.alive,
+                }
+                for e in self._nodes.values()
+            ]
+
+    def _mark_dead(self, node_id: str, reason: str) -> None:
+        e = self._nodes.get(node_id)
+        if e is None or not e.alive:
+            return
+        e.alive = False
+        logger.warning("node %s declared dead (%s)", node_id, reason)
+        self._emit("node_dead", {"node_id": node_id, "reason": reason})
+        # objects whose only copy was there are lost
+        for oid, locs in list(self._objects.items()):
+            locs.discard(node_id)
+            if not locs:
+                del self._objects[oid]
+        # actors on that node: restart or bury (reference:
+        # GcsActorManager::OnNodeDead)
+        for a in self._actors.values():
+            if a.node_id == node_id and a.state in ("ALIVE", "PENDING"):
+                if a.num_restarts < a.max_restarts:
+                    a.state = "RESTARTING"
+                    a.num_restarts += 1
+                    a.node_id = None
+                    a.worker_addr = None
+                else:
+                    a.state = "DEAD"
+                self._emit(
+                    "actor_update",
+                    {"actor_id": a.actor_id, "state": a.state,
+                     "num_restarts": a.num_restarts},
+                )
+        # placement groups with bundles there reschedule
+        for pg in self._pgs.values():
+            if any(b.get("node_id") == node_id for b in pg["bundles"]):
+                for b in pg["bundles"]:
+                    if b.get("node_id") == node_id:
+                        b["node_id"] = None
+                pg["state"] = "RESCHEDULING"
+                self._try_place_pg(pg)
+                self._emit("pg_update", {"pg_id": pg["pg_id"], "state": pg["state"]})
+
+    def health_sweep(self) -> None:
+        with self._lock:
+            now = time.monotonic()
+            for e in list(self._nodes.values()):
+                if e.alive and now - e.last_hb > self._death_timeout:
+                    self._mark_dead(e.node_id, reason="heartbeat timeout")
+
+    def restart_sweep(self, pool) -> None:
+        """Re-create RESTARTING actors on surviving nodes (reference:
+        GcsActorScheduler re-leases a worker for restartable actors)."""
+        from ray_tpu.cluster.rpc import RemoteError, RpcError
+
+        with self._lock:
+            todo = [
+                a for a in self._actors.values()
+                if a.state == "RESTARTING" and a.creation_spec is not None
+            ]
+            nodes = [
+                (e.node_id, e.addr, dict(e.available))
+                for e in self._nodes.values() if e.alive
+            ]
+        for a in todo:
+            res = a.lease_resources
+            for node_id, addr, avail in nodes:
+                if not all(avail.get(k, 0.0) >= v for k, v in res.items()):
+                    continue
+                try:
+                    daemon = pool.get(tuple(addr))
+                    r = daemon.call(
+                        "request_worker_lease", {"resources": res}, timeout=60
+                    )
+                    if "grant" not in r:
+                        continue
+                    g = r["grant"]
+                    w = pool.get(tuple(g["worker_addr"]))
+                    cr = w.call(
+                        "create_actor",
+                        {"actor_id": a.actor_id, "creation_spec": a.creation_spec},
+                        timeout=300,
+                    )
+                    if not cr.get("ok"):
+                        daemon.call(
+                            "release_lease",
+                            {"lease_id": g["lease_id"], "kill": True},
+                            timeout=10,
+                        )
+                        logger.warning(
+                            "actor %s restart failed: %s",
+                            a.actor_id.hex()[:12], cr.get("error"),
+                        )
+                        continue
+                    with self._lock:
+                        a.node_id = g["node_id"]
+                        a.worker_addr = tuple(g["worker_addr"])
+                        a.state = "ALIVE"
+                        self._emit(
+                            "actor_update",
+                            {"actor_id": a.actor_id, "state": "ALIVE",
+                             "worker_addr": a.worker_addr},
+                        )
+                    logger.info(
+                        "actor %s restarted on %s",
+                        a.actor_id.hex()[:12], g["node_id"],
+                    )
+                    break
+                except (RpcError, RemoteError):
+                    continue
+
+    # -- kv -------------------------------------------------------------------
+
+    def rpc_kv_put(self, payload, peer):
+        with self._lock:
+            ns = self._kv.setdefault(payload.get("ns", "default"), {})
+            ns[payload["key"]] = payload["value"]
+        return {"ok": True}
+
+    def rpc_kv_get(self, payload, peer):
+        with self._lock:
+            return self._kv.get(payload.get("ns", "default"), {}).get(payload["key"])
+
+    def rpc_kv_del(self, payload, peer):
+        with self._lock:
+            self._kv.get(payload.get("ns", "default"), {}).pop(payload["key"], None)
+        return {"ok": True}
+
+    def rpc_kv_keys(self, payload, peer):
+        with self._lock:
+            ns = self._kv.get(payload.get("ns", "default"), {})
+            pre = payload.get("prefix", b"")
+            return [k for k in ns if k.startswith(pre)]
+
+    # -- object directory -----------------------------------------------------
+
+    def rpc_add_object_location(self, payload, peer):
+        with self._lock:
+            self._objects.setdefault(payload["object_id"], set()).add(
+                payload["node_id"]
+            )
+        return {"ok": True}
+
+    def rpc_remove_object_location(self, payload, peer):
+        with self._lock:
+            locs = self._objects.get(payload["object_id"])
+            if locs is not None:
+                locs.discard(payload["node_id"])
+                if not locs:
+                    self._objects.pop(payload["object_id"], None)
+        return {"ok": True}
+
+    def rpc_locate_object(self, payload, peer):
+        with self._lock:
+            locs = self._objects.get(payload["object_id"], set())
+            return [
+                self._nodes[nid].addr
+                for nid in locs
+                if nid in self._nodes and self._nodes[nid].alive
+            ]
+
+    # -- actors ---------------------------------------------------------------
+
+    def rpc_register_actor(self, payload, peer):
+        with self._lock:
+            name, ns = payload.get("name"), payload.get("namespace", "default")
+            if name:
+                existing = self._named.get((ns, name))
+                if existing is not None:
+                    a = self._actors.get(existing)
+                    if a is not None and a.state != "DEAD":
+                        return {"ok": False, "error": f"name {name!r} taken"}
+            a = ActorEntry(
+                actor_id=payload["actor_id"],
+                name=name,
+                namespace=ns,
+                node_id=payload.get("node_id"),
+                worker_addr=tuple(payload["worker_addr"]) if payload.get("worker_addr") else None,
+                state=payload.get("state", "PENDING"),
+                max_restarts=payload.get("max_restarts", 0),
+                creation_spec=payload.get("creation_spec"),
+                owner_addr=tuple(payload["owner_addr"]) if payload.get("owner_addr") else None,
+                lease_resources=dict(
+                    payload.get("lease", {}).get("resources", {"num_cpus": 1})
+                ),
+            )
+            self._actors[a.actor_id] = a
+            if name:
+                self._named[(ns, name)] = a.actor_id
+        return {"ok": True}
+
+    def rpc_update_actor(self, payload, peer):
+        with self._lock:
+            a = self._actors.get(payload["actor_id"])
+            if a is None:
+                return {"ok": False}
+            for k in ("node_id", "state"):
+                if k in payload:
+                    setattr(a, k, payload[k])
+            if "worker_addr" in payload:
+                a.worker_addr = (
+                    tuple(payload["worker_addr"]) if payload["worker_addr"] else None
+                )
+            self._emit(
+                "actor_update", {"actor_id": a.actor_id, "state": a.state}
+            )
+        return {"ok": True}
+
+    def _actor_info(self, a: ActorEntry) -> dict:
+        return {
+            "actor_id": a.actor_id,
+            "name": a.name,
+            "namespace": a.namespace,
+            "node_id": a.node_id,
+            "worker_addr": a.worker_addr,
+            "state": a.state,
+            "max_restarts": a.max_restarts,
+            "num_restarts": a.num_restarts,
+            "creation_spec": a.creation_spec,
+            "owner_addr": a.owner_addr,
+        }
+
+    def rpc_get_actor(self, payload, peer):
+        with self._lock:
+            a = self._actors.get(payload["actor_id"])
+            return self._actor_info(a) if a else None
+
+    def rpc_get_named_actor(self, payload, peer):
+        with self._lock:
+            aid = self._named.get(
+                (payload.get("namespace", "default"), payload["name"])
+            )
+            a = self._actors.get(aid) if aid else None
+            return self._actor_info(a) if a else None
+
+    def rpc_list_actors(self, payload, peer):
+        with self._lock:
+            return [self._actor_info(a) for a in self._actors.values()]
+
+    # -- placement groups -----------------------------------------------------
+
+    def rpc_create_pg(self, payload, peer):
+        """Place bundles against the resource view. Returns the placement
+        (bundle index -> node) or state=PENDING when it doesn't fit."""
+        with self._lock:
+            pg = {
+                "pg_id": payload["pg_id"],
+                "bundles": [
+                    {"resources": dict(b), "node_id": None}
+                    for b in payload["bundles"]
+                ],
+                "strategy": payload.get("strategy", "PACK"),
+                "state": "PENDING",
+                "name": payload.get("name"),
+            }
+            self._pgs[pg["pg_id"]] = pg
+            self._try_place_pg(pg)
+            return self._pg_info(pg)
+
+    def _try_place_pg(self, pg: dict) -> None:
+        alive = [e for e in self._nodes.values() if e.alive]
+        if not alive:
+            return
+        strategy = pg["strategy"]
+        # work on a copy of the availability view; commit on success
+        avail = {e.node_id: dict(e.available) for e in alive}
+
+        def fits(node_id: str, res: dict) -> bool:
+            a = avail[node_id]
+            return all(a.get(k, 0.0) >= v for k, v in res.items())
+
+        def take(node_id: str, res: dict) -> None:
+            a = avail[node_id]
+            for k, v in res.items():
+                a[k] = a.get(k, 0.0) - v
+
+        assignment: list[Optional[str]] = [None] * len(pg["bundles"])
+        order = sorted(avail)  # deterministic
+        if strategy in ("STRICT_PACK",):
+            for nid in order:
+                trial = dict(avail[nid])
+                ok = True
+                for b in pg["bundles"]:
+                    if all(trial.get(k, 0.0) >= v for k, v in b["resources"].items()):
+                        for k, v in b["resources"].items():
+                            trial[k] = trial.get(k, 0.0) - v
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    assignment = [nid] * len(pg["bundles"])
+                    break
+        elif strategy in ("STRICT_SPREAD", "SPREAD"):
+            used: set[str] = set()
+            for i, b in enumerate(pg["bundles"]):
+                placed = False
+                for nid in order:
+                    if nid in used and strategy == "STRICT_SPREAD":
+                        continue
+                    if fits(nid, b["resources"]):
+                        take(nid, b["resources"])
+                        assignment[i] = nid
+                        used.add(nid)
+                        placed = True
+                        break
+                if not placed and strategy == "SPREAD":
+                    # SPREAD is best-effort: reuse nodes
+                    for nid in order:
+                        if fits(nid, b["resources"]):
+                            take(nid, b["resources"])
+                            assignment[i] = nid
+                            placed = True
+                            break
+                if not placed:
+                    assignment = [None] * len(pg["bundles"])
+                    break
+        else:  # PACK: prefer one node, overflow to others
+            for i, b in enumerate(pg["bundles"]):
+                placed = False
+                for nid in order:
+                    if fits(nid, b["resources"]):
+                        take(nid, b["resources"])
+                        assignment[i] = nid
+                        placed = True
+                        break
+                if not placed:
+                    assignment = [None] * len(pg["bundles"])
+                    break
+
+        if all(a is not None for a in assignment):
+            for b, nid in zip(pg["bundles"], assignment):
+                b["node_id"] = nid
+            pg["state"] = "CREATED"
+            # deduct from the authoritative view so back-to-back PGs don't
+            # double-book before the next heartbeat refreshes availability
+            for b, nid in zip(pg["bundles"], assignment):
+                node = self._nodes.get(nid)
+                if node is not None:
+                    for k, v in b["resources"].items():
+                        node.available[k] = node.available.get(k, 0.0) - v
+
+    def rpc_remove_pg(self, payload, peer):
+        with self._lock:
+            pg = self._pgs.pop(payload["pg_id"], None)
+            if pg is not None:
+                pg["state"] = "REMOVED"
+                self._emit("pg_update", {"pg_id": pg["pg_id"], "state": "REMOVED"})
+        return {"ok": True}
+
+    def rpc_get_pg(self, payload, peer):
+        with self._lock:
+            pg = self._pgs.get(payload["pg_id"])
+            if pg is not None and pg["state"] in ("PENDING", "RESCHEDULING"):
+                self._try_place_pg(pg)  # retry on demand (nodes may have joined)
+            return self._pg_info(pg) if pg else None
+
+    def rpc_list_pgs(self, payload, peer):
+        with self._lock:
+            return [self._pg_info(pg) for pg in self._pgs.values()]
+
+    def _pg_info(self, pg: dict) -> dict:
+        return {
+            "pg_id": pg["pg_id"],
+            "bundles": [dict(b) for b in pg["bundles"]],
+            "strategy": pg["strategy"],
+            "state": pg["state"],
+            "name": pg.get("name"),
+        }
+
+
+class GcsServer:
+    """GcsService + RpcServer + health sweeper, embeddable or standalone."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 node_death_timeout_s: float = 5.0):
+        self.service = GcsService(node_death_timeout_s=node_death_timeout_s)
+        self.rpc = RpcServer(self.service, host=host, port=port)
+        self._sweeper: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def start(self) -> tuple[str, int]:
+        from ray_tpu.cluster.rpc import ClientPool
+
+        addr = self.rpc.start()
+        pool = ClientPool(timeout=120.0)
+
+        def sweep():
+            while not self._stop.wait(0.25):
+                try:
+                    self.service.health_sweep()
+                    self.service.restart_sweep(pool)
+                except Exception:
+                    logger.exception("health sweep failed")
+
+        self._sweeper = threading.Thread(target=sweep, name="gcs-health", daemon=True)
+        self._sweeper.start()
+        return addr
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.rpc.stop()
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=0)
+    p.add_argument("--death-timeout", type=float, default=5.0)
+    args = p.parse_args()
+    server = GcsServer(args.host, args.port, args.death_timeout)
+    host, port = server.start()
+    # parent discovers the bound port from stdout
+    print(f"GCS_ADDRESS {host}:{port}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        server.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,484 @@
+"""Per-node daemon: worker pool, lease protocol, object service.
+
+Reference analog: the raylet (src/ray/raylet/node_manager.h:118 —
+worker-lease handling at node_manager.cc:1915 HandleRequestWorkerLease,
+WorkerPool worker_pool.h:125, object transfer via
+src/ray/object_manager/object_manager.h:117). Redesigned:
+
+ * leases: a submitter asks its local daemon for a worker; the daemon
+   grants a dedicated worker process if the resources fit, otherwise
+   answers with a spillback target chosen from the GCS resource view
+   (the hybrid policy's "prefer local, spill to the best-fitting remote"
+   leg, hybrid_scheduling_policy.h:29-49);
+ * workers: real OS processes (spawned clean — no fork-after-JAX),
+   each with its own RPC server for direct submitter->worker pushes;
+ * objects: a per-node in-memory store; `fetch` pulls missing objects
+   chunk-wise from a holder found via the GCS object directory and
+   caches them locally (PullManager/PushManager collapsed into one
+   chunked pull path);
+ * placement-group bundles: reservations carve sub-pools out of the
+   node's availability, keyed (pg_id, bundle_index).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+from ray_tpu.cluster.rpc import ClientPool, RemoteError, RpcClient, RpcError, RpcServer
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.cluster.node")
+
+CHUNK = 4 << 20  # object transfer chunk size
+
+
+class ObjectService:
+    """Node-local object table + chunked cross-node pull."""
+
+    def __init__(self, node_id: str, gcs: RpcClient, pool: ClientPool):
+        self._objects: dict[bytes, bytes] = {}
+        self._lock = threading.Lock()
+        self._node_id = node_id
+        self._gcs = gcs
+        self._pool = pool
+
+    def put(self, object_id: bytes, data: bytes) -> None:
+        with self._lock:
+            self._objects[object_id] = data
+        self._gcs.call(
+            "add_object_location",
+            {"object_id": object_id, "node_id": self._node_id},
+        )
+
+    def get_local(self, object_id: bytes) -> Optional[bytes]:
+        with self._lock:
+            return self._objects.get(object_id)
+
+    def free(self, object_id: bytes) -> None:
+        with self._lock:
+            self._objects.pop(object_id, None)
+        try:
+            self._gcs.call(
+                "remove_object_location",
+                {"object_id": object_id, "node_id": self._node_id},
+            )
+        except RpcError:
+            pass
+
+    def fetch(self, object_id: bytes, timeout: float = 30.0) -> Optional[bytes]:
+        """Local hit or remote pull (chunked); caches + registers locally."""
+        data = self.get_local(object_id)
+        if data is not None:
+            return data
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            addrs = self._gcs.call("locate_object", {"object_id": object_id})
+            for addr in addrs:
+                if tuple(addr) == self._pool_self_addr:
+                    continue
+                try:
+                    data = self._pull_from(tuple(addr), object_id)
+                except (RpcError, RemoteError):
+                    continue
+                if data is not None:
+                    self.put(object_id, data)
+                    return data
+            time.sleep(0.05)
+        return None
+
+    _pool_self_addr: tuple = ("", 0)  # set by daemon after bind
+
+    def _pull_from(self, addr: tuple, object_id: bytes) -> Optional[bytes]:
+        c = self._pool.get(addr)
+        meta = c.call("object_meta", {"object_id": object_id})
+        if meta is None:
+            return None
+        size = meta["size"]
+        parts = []
+        off = 0
+        while off < size:
+            chunk = c.call(
+                "object_chunk",
+                {"object_id": object_id, "offset": off, "length": CHUNK},
+            )
+            if chunk is None:
+                return None
+            parts.append(chunk)
+            off += len(chunk)
+        return b"".join(parts)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "num_objects": len(self._objects),
+                "bytes": sum(len(v) for v in self._objects.values()),
+            }
+
+
+class WorkerHandle:
+    def __init__(self, proc: subprocess.Popen, worker_id: str):
+        self.proc = proc
+        self.worker_id = worker_id
+        self.addr: Optional[tuple] = None
+        self.ready = threading.Event()
+
+    def alive(self) -> bool:
+        return self.proc.poll() is None
+
+    def kill(self) -> None:
+        try:
+            self.proc.kill()
+        except Exception:
+            pass
+
+
+class NodeDaemon:
+    """The per-node control process (raylet-equivalent)."""
+
+    def __init__(
+        self,
+        gcs_addr: tuple,
+        resources: dict,
+        node_id: Optional[str] = None,
+        host: str = "127.0.0.1",
+        labels: Optional[dict] = None,
+        worker_env: Optional[dict] = None,
+        heartbeat_interval_s: float = 0.5,
+    ):
+        self.node_id = node_id or f"node-{uuid.uuid4().hex[:8]}"
+        self.gcs_addr = gcs_addr
+        self.total = dict(resources)
+        self.available = dict(resources)
+        self.labels = labels or {}
+        self.worker_env = worker_env or {}
+        self._hb_interval = heartbeat_interval_s
+        self._res_lock = threading.Lock()
+        self._leases: dict[str, dict] = {}  # lease_id -> {resources, worker}
+        self._bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> reserved resources
+        self._idle_workers: list[WorkerHandle] = []
+        self._all_workers: dict[str, WorkerHandle] = {}
+        self._wlock = threading.Lock()
+        self.rpc = RpcServer(self, host=host)
+        self.pool = ClientPool()
+        self.gcs = RpcClient(*gcs_addr).connect(retries=20)
+        self.objects = ObjectService(self.node_id, self.gcs, self.pool)
+        self._stop = threading.Event()
+        self.addr: Optional[tuple] = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> tuple:
+        self.addr = self.rpc.start()
+        self.objects._pool_self_addr = self.addr
+        self.gcs.call(
+            "register_node",
+            {
+                "node_id": self.node_id,
+                "addr": self.addr,
+                "resources": self.total,
+                "labels": self.labels,
+            },
+        )
+        t = threading.Thread(target=self._heartbeat_loop, name="node-hb", daemon=True)
+        t.start()
+        return self.addr
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._wlock:
+            for w in self._all_workers.values():
+                w.kill()
+            self._all_workers.clear()
+            self._idle_workers.clear()
+        try:
+            self.gcs.call("drain_node", {"node_id": self.node_id}, timeout=2)
+        except (RpcError, RemoteError):
+            pass
+        self.rpc.stop()
+        self.gcs.close()
+        self.pool.close_all()
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.wait(self._hb_interval):
+            try:
+                with self._res_lock:
+                    avail = dict(self.available)
+                r = self.gcs.call(
+                    "heartbeat",
+                    {"node_id": self.node_id, "available": avail},
+                    timeout=5,
+                )
+                if not r.get("ok") and r.get("reregister"):
+                    self.gcs.call(
+                        "register_node",
+                        {
+                            "node_id": self.node_id,
+                            "addr": self.addr,
+                            "resources": self.total,
+                            "labels": self.labels,
+                        },
+                    )
+            except (RpcError, RemoteError):
+                pass  # GCS down: keep trying (it may restart)
+
+    # -- resources ------------------------------------------------------------
+
+    def _try_acquire(self, res: dict, pool: Optional[dict] = None) -> bool:
+        with self._res_lock:
+            target = pool if pool is not None else self.available
+            if all(target.get(k, 0.0) >= v - 1e-9 for k, v in res.items()):
+                for k, v in res.items():
+                    target[k] = target.get(k, 0.0) - v
+                return True
+            return False
+
+    def _release(self, res: dict, pool: Optional[dict] = None) -> None:
+        with self._res_lock:
+            target = pool if pool is not None else self.available
+            for k, v in res.items():
+                target[k] = target.get(k, 0.0) + v
+
+    # -- worker pool ----------------------------------------------------------
+
+    def _spawn_worker(self) -> WorkerHandle:
+        worker_id = f"w-{uuid.uuid4().hex[:8]}"
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        env["RAY_TPU_WORKER_ID"] = worker_id
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "ray_tpu.cluster.worker_main",
+                "--daemon", f"{self.addr[0]}:{self.addr[1]}",
+                "--worker-id", worker_id,
+            ],
+            env=env,
+            cwd=os.getcwd(),
+        )
+        h = WorkerHandle(proc, worker_id)
+        with self._wlock:
+            self._all_workers[worker_id] = h
+        return h
+
+    def _lease_worker(self) -> WorkerHandle:
+        with self._wlock:
+            while self._idle_workers:
+                w = self._idle_workers.pop()
+                if w.alive():
+                    return w
+        w = self._spawn_worker()
+        if not w.ready.wait(timeout=60):
+            w.kill()
+            raise RpcError("worker failed to start in 60s")
+        return w
+
+    def rpc_register_worker(self, payload, peer):
+        with self._wlock:
+            w = self._all_workers.get(payload["worker_id"])
+        if w is None:
+            return {"ok": False}
+        w.addr = tuple(payload["addr"])
+        w.ready.set()
+        return {
+            "ok": True,
+            "node_id": self.node_id,
+            "gcs_addr": self.gcs_addr,
+            "daemon_addr": self.addr,
+        }
+
+    # -- lease protocol -------------------------------------------------------
+
+    def rpc_request_worker_lease(self, payload, peer):
+        """Grant a local worker or answer with a spillback target.
+
+        payload: {resources, pg_id?, bundle_index?, exclude?: [node_id]}
+        """
+        res = payload.get("resources", {})
+        pg_key = None
+        if payload.get("pg_id") is not None:
+            pg_key = (payload["pg_id"], payload.get("bundle_index", 0))
+            bundle_pool = self._bundles.get(pg_key)
+            if bundle_pool is None:
+                return {"error": f"no bundle reserved here for {pg_key}"}
+            acquired = self._try_acquire(res, bundle_pool)
+        else:
+            acquired = self._try_acquire(res)
+        if acquired:
+            try:
+                w = self._lease_worker()
+            except RpcError as e:
+                self._release(res, self._bundles.get(pg_key) if pg_key else None)
+                return {"error": str(e)}
+            lease_id = uuid.uuid4().hex
+            self._leases[lease_id] = {
+                "resources": res, "worker": w, "pg_key": pg_key,
+            }
+            return {
+                "grant": {
+                    "lease_id": lease_id,
+                    "worker_addr": w.addr,
+                    "worker_id": w.worker_id,
+                    "node_id": self.node_id,
+                }
+            }
+        # spillback: consult the GCS view for a node that fits
+        if pg_key is not None:
+            return {"retry_after": 0.05}  # bundle is busy; wait for release
+        exclude = set(payload.get("exclude", ())) | {self.node_id}
+        try:
+            nodes = self.gcs.call("list_nodes", None, timeout=5)
+        except (RpcError, RemoteError):
+            nodes = []
+        candidates = [
+            n for n in nodes
+            if n["alive"] and n["node_id"] not in exclude
+            and all(n["available"].get(k, 0.0) >= v for k, v in res.items())
+        ]
+        if candidates:
+            # hybrid policy's remote leg: random among the top-k by
+            # availability, so concurrent submitters with the same (stale)
+            # view don't all herd onto one node
+            # (reference: hybrid_scheduling_policy.h:29-49)
+            import random
+
+            key = next(iter(res), None)
+            random.shuffle(candidates)
+            candidates.sort(
+                key=lambda n: -n["available"].get(key, 0.0) if key else 0.0
+            )
+            top_k = candidates[: max(1, min(3, len(candidates)))]
+            pick = random.choice(top_k)
+            return {"spillback": pick["addr"],
+                    "spillback_node": pick["node_id"],
+                    "node_id": self.node_id}
+        return {"retry_after": 0.05, "node_id": self.node_id}
+
+    def rpc_release_lease(self, payload, peer):
+        lease = self._leases.pop(payload["lease_id"], None)
+        if lease is None:
+            return {"ok": False}
+        pool = self._bundles.get(lease["pg_key"]) if lease["pg_key"] else None
+        self._release(lease["resources"], pool)
+        w: WorkerHandle = lease["worker"]
+        if payload.get("kill") or not w.alive():
+            w.kill()
+            with self._wlock:
+                self._all_workers.pop(w.worker_id, None)
+        else:
+            with self._wlock:
+                self._idle_workers.append(w)
+        return {"ok": True}
+
+    # -- placement group bundles ----------------------------------------------
+
+    def rpc_reserve_pg_bundle(self, payload, peer):
+        key = (payload["pg_id"], payload["bundle_index"])
+        res = payload["resources"]
+        if key in self._bundles:
+            return {"ok": True}  # idempotent
+        if not self._try_acquire(res):
+            return {"ok": False, "error": "insufficient resources"}
+        self._bundles[key] = dict(res)
+        return {"ok": True}
+
+    def rpc_release_pg_bundle(self, payload, peer):
+        key = (payload["pg_id"], payload["bundle_index"])
+        pool = self._bundles.pop(key, None)
+        if pool is None:
+            return {"ok": False}
+        # return whatever is still reserved plus whatever tasks gave back
+        self._release(pool)
+        return {"ok": True}
+
+    def rpc_release_pg_all(self, payload, peer):
+        pg_id = payload["pg_id"]
+        for key in [k for k in self._bundles if k[0] == pg_id]:
+            self._release(self._bundles.pop(key))
+        return {"ok": True}
+
+    # -- object service -------------------------------------------------------
+
+    def rpc_put_object(self, payload, peer):
+        self.objects.put(payload["object_id"], payload["data"])
+        return {"ok": True}
+
+    def rpc_object_meta(self, payload, peer):
+        data = self.objects.get_local(payload["object_id"])
+        return None if data is None else {"size": len(data)}
+
+    def rpc_object_chunk(self, payload, peer):
+        data = self.objects.get_local(payload["object_id"])
+        if data is None:
+            return None
+        off = payload["offset"]
+        return data[off : off + payload["length"]]
+
+    def rpc_fetch_object(self, payload, peer):
+        """Blocking local-or-remote fetch (driver/worker `get` path)."""
+        return self.objects.fetch(
+            payload["object_id"], timeout=payload.get("timeout", 30.0)
+        )
+
+    def rpc_has_object(self, payload, peer):
+        return self.objects.get_local(payload["object_id"]) is not None
+
+    def rpc_free_object(self, payload, peer):
+        self.objects.free(payload["object_id"])
+        return {"ok": True}
+
+    # -- misc -----------------------------------------------------------------
+
+    def rpc_ping(self, payload, peer):
+        return {"node_id": self.node_id}
+
+    def rpc_stats(self, payload, peer):
+        with self._res_lock:
+            return {
+                "node_id": self.node_id,
+                "total": dict(self.total),
+                "available": dict(self.available),
+                "num_leases": len(self._leases),
+                "num_workers": len(self._all_workers),
+                "objects": self.objects.stats(),
+            }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--gcs", required=True)
+    p.add_argument("--node-id", default=None)
+    p.add_argument("--resources", default="num_cpus=1")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--worker-env", default="", help="k=v,... for worker processes")
+    args = p.parse_args()
+    host, port = args.gcs.rsplit(":", 1)
+    resources: dict[str, float] = {}
+    for kv in args.resources.split(","):
+        if kv:
+            k, v = kv.split("=")
+            resources[k] = float(v)
+    worker_env: dict[str, str] = {}
+    for kv in args.worker_env.split(","):
+        if kv:
+            k, v = kv.split("=", 1)
+            worker_env[k] = v
+    daemon = NodeDaemon(
+        (host, int(port)), resources, node_id=args.node_id, worker_env=worker_env
+    )
+    addr = daemon.start()
+    print(f"NODE_ADDRESS {addr[0]}:{addr[1]} {daemon.node_id}", flush=True)
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        daemon.stop()
+
+
+if __name__ == "__main__":
+    main()

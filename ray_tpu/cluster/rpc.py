@@ -1,0 +1,356 @@
+"""Message-framed RPC over TCP for the distributed control plane.
+
+Reference analog: src/ray/rpc/ (gRPC client/server wrappers, client
+pools, retryable clients). Redesigned, not ported: the control plane
+speaks length-prefixed pickled frames over asyncio TCP — no protoc
+toolchain in the loop, and the payloads are plain Python structures the
+rest of the runtime already uses. The wire format:
+
+    frame    := uint32 length | pickled body
+    request  := (msg_id, method: str, payload)
+    response := (msg_id, ok: bool, payload | exception)
+
+Servers run an asyncio loop on a dedicated thread and dispatch to a
+handler object's `rpc_<method>` coroutines/functions. Clients are
+thread-safe: one persistent connection, pipelined requests matched by
+msg_id (the reference's CoreWorkerClientPool plays this role).
+
+Security note: peers are trusted (same-user local processes / cluster
+hosts), exactly like the reference's raylet protocol.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+import socket
+import struct
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.cluster.rpc")
+
+_LEN = struct.Struct("!I")
+MAX_FRAME = 1 << 31
+
+
+class RpcError(Exception):
+    """Transport-level failure (peer died, connection refused)."""
+
+
+class RemoteError(Exception):
+    """The remote handler raised; carries the original exception."""
+
+    def __init__(self, cause: BaseException):
+        super().__init__(repr(cause))
+        self.cause = cause
+
+
+def _dump(obj: Any) -> bytes:
+    return pickle.dumps(obj, protocol=5)
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+
+class RpcServer:
+    """Asyncio TCP server on its own thread; dispatches `rpc_<method>`.
+
+    Handlers may be plain functions or coroutines. A handler may also be
+    registered per-method via `route`. The handler receives (payload,
+    peer) where peer is a ("host", port) tuple of the connection.
+    """
+
+    def __init__(self, handler: Any = None, host: str = "127.0.0.1", port: int = 0):
+        self._handler = handler
+        self._routes: dict[str, Callable] = {}
+        self._host = host
+        self._port = port
+        self._loop: Optional[asyncio.AbstractEventLoop] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._started = threading.Event()
+        self.address: Optional[tuple[str, int]] = None
+
+    def route(self, method: str, fn: Callable) -> None:
+        self._routes[method] = fn
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> tuple[str, int]:
+        self._thread = threading.Thread(
+            target=self._run, name="ray_tpu-rpc-server", daemon=True
+        )
+        self._thread.start()
+        if not self._started.wait(timeout=10):
+            raise RpcError("rpc server failed to start")
+        assert self.address is not None
+        return self.address
+
+    def _run(self) -> None:
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._loop = asyncio.new_event_loop()
+        asyncio.set_event_loop(self._loop)
+        # sync handlers run in this pool; blocking calls (object fetch,
+        # task execution) must never occupy the event loop thread
+        self._loop.set_default_executor(
+            ThreadPoolExecutor(max_workers=64, thread_name_prefix="rpc-handler")
+        )
+        self._loop.run_until_complete(self._serve())
+        try:
+            self._loop.run_forever()
+        finally:
+            self._loop.close()
+
+    async def _serve(self) -> None:
+        self._server = await asyncio.start_server(
+            self._on_conn, self._host, self._port
+        )
+        self.address = self._server.sockets[0].getsockname()[:2]
+        self._started.set()
+
+    def stop(self) -> None:
+        loop = self._loop
+        if loop is None:
+            return
+
+        def _stop():
+            if self._server is not None:
+                self._server.close()
+            loop.stop()
+
+        try:
+            loop.call_soon_threadsafe(_stop)
+        except RuntimeError:
+            pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    @property
+    def loop(self) -> asyncio.AbstractEventLoop:
+        assert self._loop is not None
+        return self._loop
+
+    def call_soon(self, fn: Callable, *args) -> None:
+        """Schedule fn on the server loop (for timers/background work)."""
+        self.loop.call_soon_threadsafe(fn, *args)
+
+    # -- connection handling --------------------------------------------------
+
+    async def _on_conn(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername")
+        write_lock = asyncio.Lock()
+        try:
+            while True:
+                hdr = await reader.readexactly(_LEN.size)
+                (n,) = _LEN.unpack(hdr)
+                if n > MAX_FRAME:
+                    raise RpcError(f"frame too large: {n}")
+                body = await reader.readexactly(n)
+                msg_id, method, payload = pickle.loads(body)
+                # concurrent dispatch: a slow handler must not block the
+                # connection (the reference runs handlers on thread pools)
+                asyncio.ensure_future(
+                    self._dispatch(msg_id, method, payload, peer, writer, write_lock)
+                )
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(
+        self, msg_id, method, payload, peer, writer, write_lock
+    ) -> None:
+        try:
+            fn = self._routes.get(method) or getattr(self._handler, f"rpc_{method}")
+            if asyncio.iscoroutinefunction(fn):
+                result = await fn(payload, peer)
+            else:
+                # plain handlers may block (fetch, exec): keep the loop free
+                result = await asyncio.get_running_loop().run_in_executor(
+                    None, fn, payload, peer
+                )
+                if asyncio.iscoroutine(result):
+                    result = await result
+            body = _dump((msg_id, True, result))
+        except BaseException as e:  # noqa: BLE001 - serialized to caller
+            try:
+                body = _dump((msg_id, False, e))
+            except Exception:
+                body = _dump((msg_id, False, RpcError(repr(e))))
+        async with write_lock:
+            try:
+                writer.write(_LEN.pack(len(body)) + body)
+                await writer.drain()
+            except (ConnectionError, RuntimeError):
+                pass
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class RpcClient:
+    """Thread-safe pipelined client over one persistent connection."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0):
+        self.addr = (host, port)
+        self._timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._wlock = threading.Lock()
+        self._next_id = 0
+        self._pending: dict[int, tuple[threading.Event, list]] = {}
+        self._plock = threading.Lock()
+        self._reader: Optional[threading.Thread] = None
+        self._closed = False
+        self._dead = False  # reader saw the peer vanish
+
+    # -- connection -----------------------------------------------------------
+
+    def connect(self, retries: int = 0, delay: float = 0.1) -> "RpcClient":
+        last: Optional[BaseException] = None
+        for _ in range(retries + 1):
+            try:
+                s = socket.create_connection(self.addr, timeout=self._timeout)
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._sock = s
+                self._reader = threading.Thread(
+                    target=self._read_loop, name="ray_tpu-rpc-client", daemon=True
+                )
+                self._reader.start()
+                return self
+            except OSError as e:
+                last = e
+                time.sleep(delay)
+        raise RpcError(f"cannot connect to {self.addr}: {last}")
+
+    def close(self) -> None:
+        self._closed = True
+        if self._sock is not None:
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        self._fail_all(RpcError(f"connection to {self.addr} closed"))
+
+    @property
+    def connected(self) -> bool:
+        return self._sock is not None and not self._closed and not self._dead
+
+    # -- calls ----------------------------------------------------------------
+
+    def call(self, method: str, payload: Any = None, timeout: Optional[float] = None) -> Any:
+        if self._sock is None:
+            raise RpcError("not connected")
+        if self._dead:
+            raise RpcError(f"connection to {self.addr} is dead")
+        with self._plock:
+            msg_id = self._next_id
+            self._next_id += 1
+            ev: tuple[threading.Event, list] = (threading.Event(), [])
+            self._pending[msg_id] = ev
+        body = _dump((msg_id, method, payload))
+        try:
+            with self._wlock:
+                self._sock.sendall(_LEN.pack(len(body)) + body)
+        except OSError as e:
+            with self._plock:
+                self._pending.pop(msg_id, None)
+            raise RpcError(f"send to {self.addr} failed: {e}") from e
+        if not ev[0].wait(timeout if timeout is not None else self._timeout):
+            with self._plock:
+                self._pending.pop(msg_id, None)
+            raise RpcError(f"rpc {method} to {self.addr} timed out")
+        ok, result = ev[1]
+        if isinstance(result, RpcError) and not ok:
+            raise result
+        if not ok:
+            raise RemoteError(result)
+        return result
+
+    def _read_loop(self) -> None:
+        sock = self._sock
+        assert sock is not None
+        sock.settimeout(None)
+        buf = b""
+        try:
+            while not self._closed:
+                while len(buf) < _LEN.size:
+                    chunk = sock.recv(1 << 20)
+                    if not chunk:
+                        raise ConnectionError("peer closed")
+                    buf += chunk
+                (n,) = _LEN.unpack(buf[: _LEN.size])
+                while len(buf) < _LEN.size + n:
+                    chunk = sock.recv(1 << 20)
+                    if not chunk:
+                        raise ConnectionError("peer closed")
+                    buf += chunk
+                body = buf[_LEN.size : _LEN.size + n]
+                buf = buf[_LEN.size + n :]
+                msg_id, ok, result = pickle.loads(body)
+                with self._plock:
+                    ev = self._pending.pop(msg_id, None)
+                if ev is not None:
+                    ev[1][:] = [ok, result]
+                    ev[0].set()
+        except (ConnectionError, OSError) as e:
+            self._fail_all(RpcError(f"connection to {self.addr} lost: {e}"))
+
+    def _fail_all(self, err: RpcError) -> None:
+        self._dead = True  # pool must re-dial, callers must fail fast
+        with self._plock:
+            pending = list(self._pending.values())
+            self._pending.clear()
+        for ev, slot in pending:
+            slot[:] = [False, err]
+            ev.set()
+
+
+class ClientPool:
+    """Cache of RpcClients keyed by address (reference: client pools in
+    src/ray/rpc/). Dead clients are evicted and re-dialed on next use."""
+
+    def __init__(self, timeout: float = 30.0):
+        self._clients: dict[tuple[str, int], RpcClient] = {}
+        self._lock = threading.Lock()
+        self._timeout = timeout
+
+    def get(self, addr: tuple[str, int]) -> RpcClient:
+        addr = (addr[0], int(addr[1]))
+        with self._lock:
+            c = self._clients.get(addr)
+            if c is not None and c.connected:
+                return c
+            c = RpcClient(addr[0], addr[1], timeout=self._timeout).connect(retries=2)
+            self._clients[addr] = c
+            return c
+
+    def invalidate(self, addr: tuple[str, int]) -> None:
+        with self._lock:
+            c = self._clients.pop((addr[0], int(addr[1])), None)
+        if c is not None:
+            c.close()
+
+    def close_all(self) -> None:
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for c in clients:
+            c.close()

@@ -1,0 +1,54 @@
+"""Cluster value serialization: cloudpickle + persistent-id object refs.
+
+Reference analog: python/ray/_private/serialization.py
+(SerializationContext) — ObjectRefs embedded anywhere in a value travel
+as persistent ids and are re-materialized through the deserializer's
+resolver (the daemon fetch path), so values never need the refs inlined
+at submission time.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any
+
+import cloudpickle
+
+
+class _ErrorValue:
+    """Stored under a return id when a task failed; get() re-raises."""
+
+    def __init__(self, exc: BaseException, tb: str, task_desc: str):
+        self.exc = exc
+        self.tb = tb
+        self.task_desc = task_desc
+
+
+def dumps_value(value: Any) -> bytes:
+    """Pickle a value, turning embedded cluster refs into persistent ids."""
+    from ray_tpu.cluster.client import ClusterObjectRef
+
+    buf = io.BytesIO()
+
+    class _P(cloudpickle.CloudPickler):
+        def persistent_id(self, o):
+            if isinstance(o, ClusterObjectRef):
+                return ("objref", o.id)
+            return None
+
+    _P(buf, protocol=5).dump(value)
+    return buf.getvalue()
+
+
+def loads_value(data: bytes, resolver) -> Any:
+    """Unpickle, materializing ("objref", id) through `resolver(id)`."""
+
+    class _U(pickle.Unpickler):
+        def persistent_load(self, pid):
+            kind, oid = pid
+            if kind == "objref":
+                return resolver(oid)
+            raise pickle.UnpicklingError(f"unknown pid {kind!r}")
+
+    return _U(io.BytesIO(data)).load()

@@ -1,0 +1,205 @@
+"""Cluster worker process: executes tasks and hosts actors.
+
+Reference analog: the core-worker side of task execution
+(src/ray/core_worker/core_worker.h:165 — TaskReceiver, direct
+worker<->worker PushTask; actor scheduling queues in
+src/ray/core_worker/transport/actor_task_submitter.h:75). Redesigned:
+each worker is a spawned-clean Python process running one RPC server;
+normal tasks run on an executor thread; actor calls serialize through a
+per-actor FIFO asyncio lock (per-connection pipelining preserves caller
+order, the lock preserves execution order — the reference's
+ActorSchedulingQueue role).
+
+Serialization: cloudpickle with persistent ids — ObjectRefs travel as
+("objref", id) and are materialized through the node daemon's fetch
+path on the executing side (the reference inlines resolved values via
+the plasma provider; here the daemon is the provider).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import io
+import threading
+import traceback
+from typing import Any, Optional
+
+import cloudpickle
+
+from ray_tpu.cluster.rpc import RpcClient, RpcServer
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.cluster.worker")
+
+
+from ray_tpu.cluster.serialization import (  # noqa: E402
+    _ErrorValue,
+    dumps_value,
+    loads_value,
+)
+
+
+class WorkerRuntime:
+    def __init__(self, daemon_addr: tuple, worker_id: str):
+        self.worker_id = worker_id
+        self.daemon = RpcClient(*daemon_addr, timeout=120.0).connect(retries=20)
+        self.node_id: Optional[str] = None
+        self.actors: dict[bytes, Any] = {}
+        self._actor_locks: dict[bytes, asyncio.Lock] = {}
+        self.rpc = RpcServer(self)
+
+    # -- object plumbing ------------------------------------------------------
+
+    def resolve_ref(self, object_id: bytes) -> Any:
+        data = self.daemon.call(
+            "fetch_object", {"object_id": object_id}, timeout=60
+        )
+        if data is None:
+            raise RuntimeError(f"object {object_id.hex()} unavailable")
+        value = loads_value(data, self.resolve_ref)
+        if isinstance(value, _ErrorValue):
+            raise RuntimeError(
+                f"dependency failed: {value.task_desc}: {value.exc!r}"
+            )
+        return value
+
+    def put_return(self, object_id: bytes, value: Any) -> None:
+        self.daemon.call(
+            "put_object",
+            {"object_id": object_id, "data": dumps_value(value)},
+            timeout=60,
+        )
+
+    # -- task execution -------------------------------------------------------
+
+    def _execute(self, payload) -> dict:
+        desc = payload.get("desc", "task")
+        return_ids = payload["return_ids"]
+        try:
+            func = cloudpickle.loads(payload["func"])
+            args, kwargs = loads_value(payload["args"], self.resolve_ref)
+            result = func(*args, **kwargs)
+            self._store_returns(return_ids, result, payload.get("num_returns", 1))
+            return {"ok": True}
+        except BaseException as e:  # noqa: BLE001
+            tb = traceback.format_exc()
+            err = _ErrorValue(e, tb, desc)
+            for rid in return_ids:
+                try:
+                    self.put_return(rid, err)
+                except Exception:
+                    pass
+            return {"ok": False, "error": repr(e), "tb": tb,
+                    "retryable": not isinstance(e, (SystemExit,))}
+
+    def _store_returns(self, return_ids, result, num_returns: int) -> None:
+        if num_returns == 1:
+            self.put_return(return_ids[0], result)
+            return
+        if not isinstance(result, (tuple, list)) or len(result) != num_returns:
+            raise ValueError(
+                f"task declared num_returns={num_returns} but returned "
+                f"{type(result).__name__}"
+            )
+        for rid, val in zip(return_ids, result):
+            self.put_return(rid, val)
+
+    async def rpc_push_task(self, payload, peer):
+        loop = asyncio.get_running_loop()
+        return await loop.run_in_executor(None, self._execute, payload)
+
+    # -- actors ---------------------------------------------------------------
+
+    async def rpc_create_actor(self, payload, peer):
+        loop = asyncio.get_running_loop()
+
+        def _create():
+            try:
+                cls, args, kwargs = loads_value(
+                    payload["creation_spec"], self.resolve_ref
+                )
+                self.actors[payload["actor_id"]] = cls(*args, **kwargs)
+                return {"ok": True}
+            except BaseException as e:  # noqa: BLE001
+                return {"ok": False, "error": repr(e), "tb": traceback.format_exc()}
+
+        self._actor_locks.setdefault(payload["actor_id"], asyncio.Lock())
+        return await loop.run_in_executor(None, _create)
+
+    async def rpc_actor_call(self, payload, peer):
+        actor_id = payload["actor_id"]
+        actor = self.actors.get(actor_id)
+        if actor is None:
+            return {"ok": False, "error": f"actor {actor_id.hex()} not here",
+                    "actor_missing": True}
+        lock = self._actor_locks.setdefault(actor_id, asyncio.Lock())
+        loop = asyncio.get_running_loop()
+
+        def _run():
+            desc = f"{type(actor).__name__}.{payload['method']}"
+            try:
+                method = getattr(actor, payload["method"])
+                args, kwargs = loads_value(payload["args"], self.resolve_ref)
+                result = method(*args, **kwargs)
+                if asyncio.iscoroutine(result):
+                    result = asyncio.run(result)
+                self._store_returns(
+                    payload["return_ids"], result, payload.get("num_returns", 1)
+                )
+                return {"ok": True}
+            except BaseException as e:  # noqa: BLE001
+                tb = traceback.format_exc()
+                err = _ErrorValue(e, tb, desc)
+                for rid in payload["return_ids"]:
+                    try:
+                        self.put_return(rid, err)
+                    except Exception:
+                        pass
+                return {"ok": False, "error": repr(e), "tb": tb}
+
+        async with lock:  # FIFO: preserves per-caller submission order
+            return await loop.run_in_executor(None, _run)
+
+    async def rpc_destroy_actor(self, payload, peer):
+        self.actors.pop(payload["actor_id"], None)
+        self._actor_locks.pop(payload["actor_id"], None)
+        return {"ok": True}
+
+    def rpc_ping(self, payload, peer):
+        return {"worker_id": self.worker_id, "actors": len(self.actors)}
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        addr = self.rpc.start()
+        r = self.daemon.call(
+            "register_worker", {"worker_id": self.worker_id, "addr": addr}
+        )
+        self.node_id = r.get("node_id")
+        # install an ambient ClusterClient so actor handles / refs that
+        # arrive inside task args work from worker code too
+        if r.get("gcs_addr") and r.get("daemon_addr"):
+            from ray_tpu.cluster.client import ClusterClient
+
+            ClusterClient(tuple(r["gcs_addr"]), tuple(r["daemon_addr"]))
+        logger.info("worker %s serving at %s (node %s)",
+                    self.worker_id, addr, self.node_id)
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--daemon", required=True)
+    p.add_argument("--worker-id", required=True)
+    args = p.parse_args()
+    host, port = args.daemon.rsplit(":", 1)
+    rt = WorkerRuntime((host, int(port)), args.worker_id)
+    rt.start()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -28,7 +28,11 @@ if TYPE_CHECKING:
 
 logger = get_logger("ray_tpu.process_pool")
 
-_CTX = mp.get_context("fork")  # cheap startup; workers never touch the TPU
+# forkserver: children fork from a clean helper process that has never
+# imported JAX, so forking after the driver initialized a TPU backend
+# cannot deadlock in a cloned runtime thread (plain "fork" prints JAX's
+# fork-hazard warning and can hang on TPU hosts)
+_CTX = mp.get_context("forkserver")
 
 # Buffers above this ride the C++ shared-memory store (zero-copy mmap views
 # in the peer process) instead of being copied through the pipe. The store

@@ -1,31 +1,492 @@
-"""Flash attention for TPU (Pallas kernel seam).
+"""Pallas flash attention for TPU: tiled online-softmax, custom VJP.
 
-The tiled online-softmax Pallas kernel lands with the kernels milestone;
-until then this module keeps the `impl="flash"` path honest by raising a
-clear error on TPU and falling back to the XLA composite elsewhere
-(XLA already fuses the composite well enough for short sequences).
+The reference has no attention kernels of its own — it delegates model
+execution to vLLM/torch inside workers (python/ray/llm/_internal/serve/
+deployments/llm/vllm/vllm_engine.py); SURVEY §5.7 assigns the TPU
+flash/ragged lineage to this framework. Design:
+
+ * every kernel is fully blocked: the grid walks (batch, head, q-block,
+   kv-block) and VMEM holds only [block, head_dim] tiles plus fp32
+   scratch carries, so VMEM use is independent of sequence length
+   (a full-sequence [S, D] residency OOMs scoped VMEM at S=8k);
+ * forward: online-softmax recurrence (running max `m`, normalizer
+   `l`, fp32 accumulator) carried in scratch across the kv-block grid
+   dim; the output block is revisited and written once per q-block;
+ * causal: off-diagonal programs skip their compute via pl.when (the
+   block fetch still happens — compute, not bandwidth, dominates);
+ * GQA folds naturally: kv BlockSpec index maps divide the q-head
+   index by the group size;
+ * backward: dQ accumulates over kv blocks; dK/dV accumulate over
+   (q-heads in the group x q-blocks) with the grid ordered so the
+   kv-block output is revisited until the group finishes — the
+   standard flash-2 recomputation from the stored log-sum-exp;
+ * segment ids (packed sequences) and right-padding are handled by
+   masking; fully-masked rows produce zeros (matching xla_attention);
+ * off-TPU the same kernels run under the Pallas interpreter, so CPU
+   tests exercise the real code path.
+
+TPU layout notes: Mosaic requires each block's last two dims to be
+tile-aligned (8x128) or span the full array, so per-row scalars ride in
+TPU-friendly shapes — q segments [B, Sq, 1], kv segments [B, 1, Sk],
+log-sum-exp and delta [B, H, Sq, 1].
 """
 
 from __future__ import annotations
 
+import functools
+import math
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+NEG_INF = -1e30  # true -inf breeds NaN via (-inf) - (-inf)
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+# ---------------------------------------------------------------------------
+# forward kernel: grid (B, H, nq, nk), kv-block fastest
+# ---------------------------------------------------------------------------
+
+
+def _fwd_kernel(
+    q_ref,      # [1, 1, Bq, D]
+    k_ref,      # [1, 1, Bk, D]
+    v_ref,      # [1, 1, Bk, D]
+    qseg_ref,   # [1, Bq, 1]
+    kseg_ref,   # [1, 1, Bk]
+    o_ref,      # [1, 1, Bq, D]   (revisited across kv blocks)
+    lse_ref,    # [1, 1, Bq, 1]
+    m_scr,      # [Bq, 1] fp32
+    l_scr,      # [Bq, 1] fp32
+    acc_scr,    # [Bq, D] fp32
+    *,
+    scale: float,
+    causal: bool,
+    q_offset: int,
+    sk_valid: int,
+):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+    Bq, D = q_ref.shape[2], q_ref.shape[3]
+    Bk = k_ref.shape[2]
+
+    @pl.when(j == 0)
+    def _():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    # causal: whole block above the diagonal contributes nothing
+    run = True
+    if causal:
+        run = q_offset + (i + 1) * Bq - 1 >= j * Bk
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [Bq, Bk]
+        q_pos = (
+            q_offset + i * Bq
+            + jax.lax.broadcasted_iota(jnp.int32, (Bq, 1), 0)
+        )
+        k_pos = j * Bk + jax.lax.broadcasted_iota(jnp.int32, (1, Bk), 1)
+        mask = k_pos < sk_valid
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        mask = mask & (qseg_ref[0] == kseg_ref[0])  # [Bq,1] == [1,Bk]
+        s = jnp.where(mask, s, NEG_INF)
+
+        m_prev = m_scr[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        p = jnp.exp(s - m_new)  # masked entries: exp(NEG_INF - m) == 0
+        alpha = jnp.exp(m_prev - m_new)
+        m_scr[...] = m_new
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nk - 1)
+    def _():
+        l = l_scr[...]
+        safe_l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
+        o_ref[0, 0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[...] + jnp.log(safe_l)
+
+
+# ---------------------------------------------------------------------------
+# backward kernels
+# ---------------------------------------------------------------------------
+
+
+def _dq_kernel(
+    q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref, delta_ref,
+    dq_ref,     # [1, 1, Bq, D] (revisited across kv blocks)
+    dq_scr,     # [Bq, D] fp32
+    *,
+    scale: float,
+    causal: bool,
+    q_offset: int,
+    sk_valid: int,
+):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+    Bq = q_ref.shape[2]
+    Bk = k_ref.shape[2]
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    run = True
+    if causal:
+        run = q_offset + (i + 1) * Bq - 1 >= j * Bk
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]      # [Bq, 1]
+        delta = delta_ref[0, 0]  # [Bq, 1]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k.astype(jnp.float32), (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        q_pos = (
+            q_offset + i * Bq
+            + jax.lax.broadcasted_iota(jnp.int32, (Bq, 1), 0)
+        )
+        k_pos = j * Bk + jax.lax.broadcasted_iota(jnp.int32, (1, Bk), 1)
+        mask = k_pos < sk_valid
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        mask = mask & (qseg_ref[0] == kseg_ref[0])
+        # explicit where: exp(s - lse) is garbage on fully-masked rows
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [Bq, Bk]
+        dp = jax.lax.dot_general(
+            do, v.astype(jnp.float32),
+            (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        dq_scr[...] += jax.lax.dot_general(
+            ds.astype(k.dtype), k,
+            (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(
+    q_ref,      # [1, 1, Bq, D]
+    k_ref,      # [1, 1, Bk, D]  (resident across the h-group and q blocks)
+    v_ref,      # [1, 1, Bk, D]
+    qseg_ref,   # [1, Bq, 1]
+    kseg_ref,   # [1, 1, Bk]
+    do_ref,     # [1, 1, Bq, D]
+    lse_ref,    # [1, 1, Bq, 1]
+    delta_ref,  # [1, 1, Bq, 1]
+    dk_ref,     # [1, 1, Bk, D]  (revisited: written once per kv block)
+    dv_ref,
+    dk_scr,     # [Bk, D] fp32
+    dv_scr,
+    *,
+    scale: float,
+    causal: bool,
+    q_offset: int,
+    sq_valid: int,
+    sk_valid: int,
+    group: int,
+):
+    # grid (B, nk, H, nq): q-blocks fastest, then the q-heads sharing this
+    # kv head; scratch accumulates until both inner dims finish.
+    jk = pl.program_id(1)
+    h = pl.program_id(2)
+    i = pl.program_id(3)
+    nq = pl.num_programs(3)
+    Bq = q_ref.shape[2]
+    Bk = k_ref.shape[2]
+
+    @pl.when((h % group == 0) & (i == 0))
+    def _():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    run = True
+    if causal:
+        run = q_offset + (i + 1) * Bq - 1 >= jk * Bk
+
+    @pl.when(run)
+    def _():
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]      # [Bq, 1]
+        delta = delta_ref[0, 0]  # [Bq, 1]
+        k_pos = jk * Bk + jax.lax.broadcasted_iota(jnp.int32, (1, Bk), 1)
+        q_pos = (
+            q_offset + i * Bq
+            + jax.lax.broadcasted_iota(jnp.int32, (Bq, 1), 0)
+        )
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale  # [Bq, Bk]
+        mask = (k_pos < sk_valid) & (q_pos - q_offset < sq_valid)
+        if causal:
+            mask = mask & (q_pos >= k_pos)
+        mask = mask & (qseg_ref[0] == kseg_ref[0])
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dv_scr[...] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [Bk, D]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [Bq, Bk]
+        ds = p * (dp - delta) * scale
+        dk_scr[...] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [Bk, D]
+
+    @pl.when((h % group == group - 1) & (i == nq - 1))
+    def _():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+# ---------------------------------------------------------------------------
+# pallas_call plumbing (padded [B, H, S, D] layout)
+# ---------------------------------------------------------------------------
+
+
+def _fwd_call(q, k, v, qseg, kseg, scale, causal, q_offset, block_q, block_k,
+              sk_valid, interpret):
+    B, H, Sq_pad, D = q.shape
+    _, KVH, Sk_pad, _ = k.shape
+    G = H // KVH
+    nq = Sq_pad // block_q
+    nk = Sk_pad // block_k
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        q_offset=q_offset, sk_valid=sk_valid,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, h, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sq_pad, D), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sq_pad, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, qseg, kseg)
+
+
+def _bwd_call(q, k, v, qseg, kseg, o, lse, do, scale, causal, q_offset,
+              block_q, block_k, sq_valid, sk_valid, interpret):
+    B, H, Sq_pad, D = q.shape
+    _, KVH, Sk_pad, _ = k.shape
+    G = H // KVH
+    nq = Sq_pad // block_q
+    nk = Sk_pad // block_k
+    delta = jnp.sum(
+        do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
+    )  # [B, H, Sq_pad, 1]
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, causal=causal,
+            q_offset=q_offset, sk_valid=sk_valid,
+        ),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, h, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq_pad, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interpret,
+    )(q, k, v, qseg, kseg, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, causal=causal,
+            q_offset=q_offset, sq_valid=sq_valid, sk_valid=sk_valid, group=G,
+        ),
+        grid=(B, nk, H, nq),  # q-blocks fastest, then heads of the group
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, D), lambda b, j, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h // G, j, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b, j, h, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, block_k), lambda b, j, h, i: (b, 0, j)),
+            pl.BlockSpec((1, 1, block_q, D), lambda b, j, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, j, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, j, h, i: (b, h, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h // G, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, KVH, Sk_pad, D), k.dtype),
+            jax.ShapeDtypeStruct((B, KVH, Sk_pad, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, qseg, kseg, do, lse, delta)
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# custom VJP (statics leading, per custom_vjp nondiff rules)
+# ---------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _flash(scale, causal, q_offset, block_q, block_k, sq_valid, sk_valid,
+           interpret, q, k, v, qseg, kseg):
+    o, _ = _flash_fwd(scale, causal, q_offset, block_q, block_k, sq_valid,
+                      sk_valid, interpret, q, k, v, qseg, kseg)
+    return o
+
+
+def _flash_fwd(scale, causal, q_offset, block_q, block_k, sq_valid, sk_valid,
+               interpret, q, k, v, qseg, kseg):
+    o, lse = _fwd_call(q, k, v, qseg, kseg, scale, causal, q_offset,
+                       block_q, block_k, sk_valid, interpret)
+    return o, (q, k, v, qseg, kseg, o, lse)
+
+
+def _flash_bwd(scale, causal, q_offset, block_q, block_k, sq_valid, sk_valid,
+               interpret, residuals, do):
+    q, k, v, qseg, kseg, o, lse = residuals
+    dq, dk, dv = _bwd_call(q, k, v, qseg, kseg, o, lse, do, scale, causal,
+                           q_offset, block_q, block_k, sq_valid, sk_valid,
+                           interpret)
+    zero_seg = np.zeros(qseg.shape, dtype=jax.dtypes.float0)
+    zero_kseg = np.zeros(kseg.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, zero_seg, zero_kseg
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
 
 
 def flash_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
+    q: jax.Array,  # [B, Sq, H, D]
+    k: jax.Array,  # [B, Sk, KVH, D]
+    v: jax.Array,  # [B, Sk, KVH, D]
     *,
     causal: bool = True,
-    segment_ids: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,  # [B, S] (requires Sq == Sk)
     q_offset: int | jax.Array = 0,
     softmax_scale: Optional[float] = None,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: Optional[bool] = None,
 ) -> jax.Array:
-    from ray_tpu.ops.attention import xla_attention
+    """Drop-in for ops.attention.xla_attention with O(S) memory."""
+    B, Sq, H, D = q.shape
+    _, Sk, KVH, _ = k.shape
+    if H % KVH != 0:
+        raise ValueError(f"n_heads {H} not divisible by kv heads {KVH}")
+    if not isinstance(q_offset, int):
+        raise ValueError(
+            "flash_attention requires a static int q_offset (traced offsets "
+            "belong to the paged decode path, ops/paged_attention.py)"
+        )
+    if segment_ids is not None and Sq != Sk:
+        raise ValueError("segment_ids requires Sq == Sk")
+    scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(D)
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
 
-    return xla_attention(
-        q, k, v, causal=causal, segment_ids=segment_ids,
-        q_offset=q_offset, softmax_scale=softmax_scale,
-    )
+    # pad sequence dims to block multiples (sublane-aligned blocks for
+    # short test sequences)
+    bq = min(block_q, _round_up(Sq, 16))
+    bk = min(block_k, _round_up(Sk, 16))
+    Sq_pad = _round_up(Sq, bq)
+    Sk_pad = _round_up(Sk, bk)
+
+    # [B, S, H, D] -> [B, H, S, D]
+    qt = jnp.transpose(q, (0, 2, 1, 3))
+    kt = jnp.transpose(k, (0, 2, 1, 3))
+    vt = jnp.transpose(v, (0, 2, 1, 3))
+    if Sq_pad != Sq:
+        qt = jnp.pad(qt, ((0, 0), (0, 0), (0, Sq_pad - Sq), (0, 0)))
+    if Sk_pad != Sk:
+        kt = jnp.pad(kt, ((0, 0), (0, 0), (0, Sk_pad - Sk), (0, 0)))
+        vt = jnp.pad(vt, ((0, 0), (0, 0), (0, Sk_pad - Sk), (0, 0)))
+
+    if segment_ids is None:
+        qseg2 = jnp.zeros((B, Sq_pad), jnp.int32)
+        kseg2 = jnp.zeros((B, Sk_pad), jnp.int32)
+    else:
+        qseg2 = jnp.pad(segment_ids.astype(jnp.int32), ((0, 0), (0, Sq_pad - Sq)))
+        kseg2 = jnp.pad(segment_ids.astype(jnp.int32), ((0, 0), (0, Sk_pad - Sk)))
+    qseg = qseg2[:, :, None]   # [B, Sq_pad, 1]
+    kseg = kseg2[:, None, :]   # [B, 1, Sk_pad]
+
+    o = _flash(scale, causal, q_offset, bq, bk, Sq, Sk, interpret,
+               qt, kt, vt, qseg, kseg)
+    return jnp.transpose(o[:, :, :Sq, :], (0, 2, 1, 3))

@@ -1,0 +1,227 @@
+"""Distributed runtime tests: real GCS + node-daemon + worker processes.
+
+Mirrors the reference's multi-node strategy (SURVEY §4.3:
+ray.cluster_utils.Cluster starting N raylets as local processes) and its
+chaos layer (§4.5 node/worker killers) at small scale.
+"""
+
+import os
+import sys
+import time
+
+import cloudpickle
+import numpy as np
+import pytest
+
+from ray_tpu.cluster import ClusterTaskError, LocalCluster
+
+# test functions/classes must travel by value: the worker processes have
+# no tests/ on their import path
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = LocalCluster(node_death_timeout_s=2.0)
+    c.start()
+    c.add_node({"num_cpus": 2}, node_id="head")
+    c.add_node({"num_cpus": 2}, node_id="n1")
+    c.add_node({"num_cpus": 2, "magic": 1}, node_id="n2")
+    c.wait_for_nodes(3)
+    yield c
+    c.shutdown()
+
+
+def _whoami():
+    import os
+
+    return (os.environ.get("RAY_TPU_NODE_ID"), os.getpid())
+
+
+def test_tasks_execute_in_worker_processes(cluster):
+    client = cluster.client()
+    ref = client.submit(_whoami)
+    node_id, pid = client.get(ref, timeout=60)
+    assert node_id in ("head", "n1", "n2")
+    assert pid != os.getpid()  # really another process
+
+
+def test_tasks_spread_across_nodes(cluster):
+    client = cluster.client()
+    # 6 concurrent 2-cpu tasks cannot fit one 2-cpu node: they must spill
+
+    def hold(t):
+        import os
+        import time
+
+        time.sleep(t)
+        return os.environ.get("RAY_TPU_NODE_ID")
+
+    refs = [
+        client.submit(hold, (1.0,), resources={"num_cpus": 2}) for _ in range(3)
+    ]
+    nodes = {client.get(r, timeout=120) for r in refs}
+    assert len(nodes) == 3, f"expected all 3 nodes used, got {nodes}"
+
+
+def test_put_get_roundtrip_and_cross_node_transfer(cluster):
+    client = cluster.client()
+    arr = np.arange(100_000, dtype=np.float32)
+
+    def produce():
+        import numpy as np
+
+        return np.ones(200_000, dtype=np.float64)
+
+    # put/get through the head daemon
+    ref = client.put({"a": arr, "n": 7})
+    out = client.get(ref)
+    np.testing.assert_array_equal(out["a"], arr)
+    # result produced on SOME node, pulled through the head daemon
+    big = client.get(client.submit(produce), timeout=60)
+    assert big.shape == (200_000,) and big[0] == 1.0
+
+
+def test_task_dependencies_cross_node(cluster):
+    client = cluster.client()
+
+    def make():
+        return list(range(100))
+
+    def consume(xs, scale):
+        return sum(xs) * scale
+
+    ref = client.submit(make)
+    # magic resource forces consume onto n2 while make ran anywhere
+    out = client.submit(
+        consume, (ref, 2), resources={"num_cpus": 1, "magic": 1}
+    )
+    assert client.get(out, timeout=60) == sum(range(100)) * 2
+
+
+def test_error_propagation(cluster):
+    client = cluster.client()
+
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(ClusterTaskError, match="kaboom"):
+        client.get(client.submit(boom), timeout=60)
+
+
+def test_custom_resource_routing(cluster):
+    client = cluster.client()
+    refs = [
+        client.submit(_whoami, resources={"num_cpus": 1, "magic": 1})
+        for _ in range(2)
+    ]
+    for r in refs:
+        node_id, _ = client.get(r, timeout=60)
+        assert node_id == "n2"  # only n2 has `magic`
+
+
+class Counter:
+    def __init__(self, start=0):
+        self.n = start
+
+    def incr(self, by=1):
+        self.n += by
+        return self.n
+
+    def where(self):
+        import os
+
+        return os.environ.get("RAY_TPU_NODE_ID")
+
+
+def test_actor_create_call_named(cluster):
+    client = cluster.client()
+    h = client.create_actor(Counter, (10,), name="counter0")
+    assert client.get(h.incr.remote(), timeout=60) == 11
+    assert client.get(h.incr.remote(5), timeout=60) == 16
+    # lookup by name, state shared
+    h2 = client.get_named_actor("counter0")
+    assert client.get(h2.incr.remote(), timeout=60) == 17
+    h.kill()
+
+
+def test_actor_handle_travels_through_task(cluster):
+    client = cluster.client()
+    h = client.create_actor(Counter, (0,))
+
+    def poke(counter_handle):
+        r = counter_handle.incr.remote(100)
+        return r.get(timeout=30)
+
+    out = client.get(client.submit(poke, (h,)), timeout=60)
+    assert out == 100
+    h.kill()
+
+
+def test_placement_group_strict_spread(cluster):
+    client = cluster.client()
+    info = client.create_placement_group(
+        [{"num_cpus": 1}, {"num_cpus": 1}], strategy="STRICT_SPREAD"
+    )
+    nodes = [b["node_id"] for b in info["bundles"]]
+    assert len(set(nodes)) == 2
+    # tasks in the pg land on the reserved nodes
+    r0 = client.submit(
+        _whoami, resources={"num_cpus": 1}, pg_id=info["pg_id"], bundle_index=0
+    )
+    r1 = client.submit(
+        _whoami, resources={"num_cpus": 1}, pg_id=info["pg_id"], bundle_index=1
+    )
+    got = {client.get(r0, timeout=60)[0], client.get(r1, timeout=60)[0]}
+    assert got == set(nodes)
+    client.remove_placement_group(info["pg_id"])
+
+
+@pytest.mark.parametrize("mode", ["task_retry", "actor_restart"])
+def test_node_death_recovery(mode):
+    """Kill the only compute node mid-flight; a rescue node joins and the
+    work recovers (task re-executed / actor restarted by the GCS)."""
+    with LocalCluster(node_death_timeout_s=1.5) as c:
+        c.start()
+        # head is a driver-only node (no compute): all work lands on victim
+        c.add_node({"num_cpus": 0}, node_id="head")
+        c.add_node({"num_cpus": 2}, node_id="victim")
+        c.wait_for_nodes(2)
+        client = c.client()
+
+        if mode == "task_retry":
+
+            def slow():
+                import time
+
+                time.sleep(8)
+                return "done"
+
+            ref = client.submit(slow, max_retries=3)
+            doomed_ref = client.submit(slow, max_retries=0, desc="no-retries")
+            time.sleep(2.0)  # both running on victim
+            c.kill_node("victim")
+            c.add_node({"num_cpus": 2}, node_id="rescue")
+            c.wait_node_dead("victim", timeout=15)
+            # retryable task re-executes on the rescue node
+            assert client.get(ref, timeout=120) == "done"
+            # non-retryable task surfaces the loss
+            with pytest.raises(ClusterTaskError, match="lost"):
+                client.get(doomed_ref, timeout=120)
+        else:
+            h = client.create_actor(Counter, (0,), max_restarts=2)
+            assert client.get(h.incr.remote(), timeout=60) == 1
+            c.kill_node("victim")
+            c.add_node({"num_cpus": 2}, node_id="rescue")
+            c.wait_node_dead("victim", timeout=15)
+            # GCS restarts the actor on the rescue node (fresh state)
+            deadline = time.monotonic() + 60
+            val = None
+            while time.monotonic() < deadline:
+                try:
+                    val = client.get(h.incr.remote(), timeout=20)
+                    break
+                except ClusterTaskError:
+                    time.sleep(0.5)
+            assert val == 1  # restarted from scratch
+            assert client.get(h.where.remote(), timeout=30) == "rescue"
