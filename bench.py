@@ -5,6 +5,15 @@ Baseline: the reference publishes no in-repo ML throughput numbers
 (BASELINE.md) — the north-star target is >=45% MFU, so vs_baseline is
 achieved_MFU / 0.45.
 
+Capture discipline (round-2/3 postmortem: two consecutive rounds died
+rc=1 with "Unable to initialize backend" and one round hung inside
+``jax.devices()``): the parent process NEVER initializes a backend.
+It probes the accelerator in a subprocess under a hard timeout, retries
+with backoff, runs the real benchmark in another subprocess, and on
+persistent failure falls back to a CPU smoke benchmark — emitting a
+valid JSON line with the TPU diagnostics attached instead of a
+traceback. A hung backend init therefore costs minutes, not the round.
+
 Measurement discipline (round-1 postmortem: an unfenced timing loop on
 the axon platform published a physically impossible 70,858% MFU):
 
@@ -23,11 +32,10 @@ from __future__ import annotations
 
 import json
 import math
+import os
+import subprocess
 import sys
 import time
-
-import jax
-import jax.numpy as jnp
 
 # bf16 peak matmul FLOP/s by device generation.
 PEAK_FLOPS = [
@@ -39,6 +47,11 @@ PEAK_FLOPS = [
     ("v4", 275e12),
     ("v3", 123e12),
 ]
+
+PROBE_TIMEOUT_S = 150.0  # first backend init can legitimately take ~40s
+PROBE_ATTEMPTS = 3
+BENCH_TIMEOUT_S = 1500.0
+FALLBACK_TIMEOUT_S = 600.0
 
 
 def peak_flops(device) -> float:
@@ -71,20 +84,12 @@ def timed_steps(step, state, batch, iters: int):
     return state, losses, dt
 
 
-def main():
-    import os
-
-    # Honor an explicit non-TPU platform request (e.g. JAX_PLATFORMS=cpu for
-    # smoke runs) even if a TPU plugin was force-registered at startup.
-    want = os.environ.get("JAX_PLATFORMS", "")
-    if want and "axon" not in want and "tpu" not in want:
-        try:
-            jax.config.update("jax_platforms", want)
-        except Exception:
-            pass
-
+def run_bench():
+    """The actual benchmark (child process). Initializes a backend."""
     import dataclasses
 
+    import jax
+    import jax.numpy as jnp
     import optax
 
     from ray_tpu.models import llama
@@ -160,25 +165,181 @@ def main():
             mfu=mfu, tokens_per_sec=tokens_per_sec,
         )
 
-    print(
-        json.dumps(
-            {
-                "metric": "llama400m_train_mfu" if on_tpu else "llama_tiny_train_smoke",
-                "value": round(mfu * 100, 2),
-                "unit": "%MFU",
-                "vs_baseline": round(mfu / 0.45, 4),
-                "tokens_per_sec": round(tokens_per_sec, 1),
-                "ms_per_step": round(1e3 * dt / total_steps, 2),
-                "device": getattr(dev, "device_kind", str(dev)),
-                "model_params": cfg.num_params(),
-                "attention_impl": cfg.attention_impl,
-                "batch": B,
-                "seq": S,
-                "init_loss": round(init_loss, 4),
-                "final_loss": round(losses[-1], 4),
-            }
+    result = {
+        "metric": "llama400m_train_mfu" if on_tpu else "llama_tiny_train_smoke",
+        "value": round(mfu * 100, 2),
+        "unit": "%MFU",
+        "vs_baseline": round(mfu / 0.45, 4),
+        "tokens_per_sec": round(tokens_per_sec, 1),
+        "ms_per_step": round(1e3 * dt / total_steps, 2),
+        "device": getattr(dev, "device_kind", str(dev)),
+        "model_params": cfg.num_params(),
+        "attention_impl": cfg.attention_impl,
+        "batch": B,
+        "seq": S,
+        "init_loss": round(init_loss, 4),
+        "final_loss": round(losses[-1], 4),
+    }
+
+    # -- on TPU: also time the alternate attention impl for an honest delta ---
+    if on_tpu and attn_impl == "flash":
+        try:
+            cfg_x = dataclasses.replace(cfg, attention_impl="xla")
+            step_x = make_train_step(lambda p, b: llama.loss_fn(p, b, cfg_x), opt)
+            state_x = TrainState.create(llama.init_params(cfg_x, jax.random.key(0)), opt)
+            for _ in range(2):
+                state_x, m = step_x(state_x, batch)
+                float(m["loss"])
+            state_x, _, dt_x = timed_steps(step_x, state_x, batch, 5)
+            result["xla_attn_ms_per_step"] = round(1e3 * dt_x / 5, 2)
+            result["flash_speedup_vs_xla"] = round((dt_x / 5) / (dt / total_steps), 3)
+        except Exception as e:  # noqa: BLE001
+            result["xla_attn_error"] = repr(e)[:200]
+
+    print(json.dumps(result))
+
+
+# ---------------------------------------------------------------------------
+# parent-side capture harness (no backend init in this process)
+# ---------------------------------------------------------------------------
+
+_PROBE_SRC = """
+import json, sys
+import jax
+devs = jax.devices()
+d = devs[0]
+print("PROBE_OK " + json.dumps({
+    "platform": d.platform,
+    "device_kind": getattr(d, "device_kind", ""),
+    "n_devices": len(devs),
+}), flush=True)
+"""
+
+
+def _run_sub(argv, env, timeout):
+    """Run a subprocess; returns (rc, stdout, stderr). rc=-9 on timeout."""
+    try:
+        p = subprocess.run(
+            argv, env=env, capture_output=True, text=True, timeout=timeout
         )
-    )
+        return p.returncode, p.stdout, p.stderr
+    except subprocess.TimeoutExpired as e:
+        out = e.stdout.decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err = e.stderr.decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+        return -9, out, err + f"\n[timeout after {timeout}s]"
+
+
+def _tpu_diagnostics(probe_tail: str) -> dict:
+    diag = {
+        "probe_error_tail": probe_tail[-800:],
+        "env_jax_platforms": os.environ.get("JAX_PLATFORMS", ""),
+        "env_tpu": {k: v for k, v in os.environ.items()
+                    if "TPU" in k or "AXON" in k.upper()},
+    }
+    try:  # stale-holder check: processes with libtpu/accel fds
+        accel = [f for f in os.listdir("/dev") if f.startswith(("accel", "vfio"))]
+        diag["dev_accel"] = accel
+    except OSError:
+        pass
+    lockfile = "/tmp/libtpu_lockfile"
+    if os.path.exists(lockfile):
+        diag["libtpu_lockfile"] = True
+    return diag
+
+
+def _probe_backend():
+    """Probe accelerator availability in a subprocess with retry/backoff.
+
+    Returns (info_dict | None, diagnostics_tail).
+    """
+    env = dict(os.environ)
+    tail = ""
+    for attempt in range(PROBE_ATTEMPTS):
+        rc, out, err = _run_sub(
+            [sys.executable, "-c", _PROBE_SRC], env, PROBE_TIMEOUT_S
+        )
+        for line in out.splitlines():
+            if line.startswith("PROBE_OK "):
+                return json.loads(line[len("PROBE_OK "):]), ""
+        tail = (err or out).strip()
+        if attempt < PROBE_ATTEMPTS - 1:
+            time.sleep(5 * (attempt + 1))
+    return None, tail
+
+
+def _extract_json_line(out: str):
+    for line in reversed(out.splitlines()):
+        line = line.strip()
+        if line.startswith("{") and line.endswith("}"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main():
+    want = os.environ.get("JAX_PLATFORMS", "")
+    force_cpu = bool(want) and "axon" not in want and "tpu" not in want
+
+    if os.environ.get("RAY_TPU_BENCH_CHILD"):
+        # child mode: honor an explicit non-TPU platform request
+        if force_cpu:
+            import jax
+
+            try:
+                jax.config.update("jax_platforms", want)
+            except Exception:
+                pass
+        run_bench()
+        return
+
+    env = dict(os.environ)
+    env["RAY_TPU_BENCH_CHILD"] = "1"
+    me = os.path.abspath(__file__)
+
+    probe, probe_tail = (None, "") if force_cpu else _probe_backend()
+    bench_tail = ""
+    if probe is not None:
+        rc, out, err = _run_sub([sys.executable, me], env, BENCH_TIMEOUT_S)
+        result = _extract_json_line(out)
+        if result is not None and rc == 0:
+            print(json.dumps(result))
+            return
+        if result is not None and result.get("metric") == "benchmark_error":
+            # a real measurement-gate failure: surface it honestly
+            print(json.dumps(result))
+            sys.exit(1)
+        bench_tail = (err or out).strip()[-1200:]
+
+    # TPU unavailable (or the TPU run died): fallback run on the
+    # explicitly requested platform (or CPU) with diagnostics attached —
+    # a valid capture beats an rc=1 traceback.
+    env["JAX_PLATFORMS"] = want if force_cpu else "cpu"
+    rc, out, err = _run_sub([sys.executable, me], env, FALLBACK_TIMEOUT_S)
+    result = _extract_json_line(out)
+    if result is None:
+        fail(
+            "benchmark failed on TPU and on CPU fallback",
+            tpu_diagnostics=_tpu_diagnostics(probe_tail),
+            tpu_bench_error_tail=bench_tail[-400:],
+            cpu_error_tail=(err or out).strip()[-800:],
+        )
+    if result.get("metric") == "benchmark_error":
+        # a measurement-gate failure is a real defect: keep rc=1
+        print(json.dumps(result))
+        sys.exit(1)
+    if not force_cpu:
+        if probe is None:
+            # backend never came up: an environment problem, not ours
+            result["tpu_unavailable"] = True
+            result["tpu_diagnostics"] = _tpu_diagnostics(probe_tail)
+        else:
+            # backend probed fine but the benchmark run died: OUR problem
+            result["tpu_bench_failed"] = True
+            result["tpu_probe"] = probe
+            result["tpu_bench_error_tail"] = bench_tail[-800:]
+    print(json.dumps(result))
 
 
 if __name__ == "__main__":

@@ -236,6 +236,8 @@ class ClusterClient:
         pg_id: Optional[bytes] = None,
         bundle_index: int = 0,
         desc: Optional[str] = None,
+        affinity_node_id: Optional[str] = None,
+        affinity_soft: bool = False,
     ) -> "ClusterObjectRef | list[ClusterObjectRef]":
         desc = desc or getattr(func, "__name__", "task")
         return_ids = [_new_id() for _ in range(num_returns)]
@@ -251,6 +253,8 @@ class ClusterClient:
             "resources": dict(resources or {"num_cpus": 1}),
             "pg_id": pg_id,
             "bundle_index": bundle_index,
+            "affinity_node_id": affinity_node_id,
+            "affinity_soft": affinity_soft,
         }
         t = threading.Thread(
             target=self._drive_task,
@@ -297,6 +301,20 @@ class ClusterClient:
         stale availability views); the visited set resets when the whole
         cluster is saturated and we fall back to waiting."""
         addr = self.local_daemon_addr
+        pinned = False
+        if spec.get("affinity_node_id") is not None:
+            # NodeAffinity: lease directly on the named node (reference:
+            # scheduling_strategies.py NodeAffinitySchedulingStrategy)
+            nodes = {n["node_id"]: n for n in self.gcs.call("list_nodes", None)}
+            target = nodes.get(spec["affinity_node_id"])
+            if target is None or not target["alive"]:
+                if not spec.get("affinity_soft"):
+                    raise RemoteError(RuntimeError(
+                        f"node {spec['affinity_node_id']} not alive (hard affinity)"
+                    ))
+            else:
+                addr = tuple(target["addr"])
+                pinned = not spec.get("affinity_soft", False)
         if spec.get("pg_id") is not None:
             # placement-group tasks go straight to the node holding the
             # reserved bundle (reference: PG scheduling strategy bypasses
@@ -317,14 +335,15 @@ class ClusterClient:
             daemon = self.pool.get(addr)
             r = daemon.call(
                 "request_worker_lease",
-                {**spec, "exclude": list(set(exclude) | visited)},
+                {**spec, "exclude": list(set(exclude) | visited),
+                 "pinned": pinned},
                 timeout=90,
             )
             if "grant" in r:
                 return r["grant"], daemon
             if "node_id" in r:
                 visited.add(r["node_id"])
-            if "spillback" in r and hops < 16:
+            if "spillback" in r and hops < 16 and not pinned:
                 addr = tuple(r["spillback"])
                 hops += 1
                 continue
@@ -333,7 +352,8 @@ class ClusterClient:
             time.sleep(r.get("retry_after", 0.05))
             visited.clear()  # capacity may have freed anywhere
             hops = 0
-            addr = self.local_daemon_addr  # re-evaluate from home
+            if not pinned:
+                addr = self.local_daemon_addr  # re-evaluate from home
         raise RpcError("lease request timed out")
 
     def _run_once(self, payload: dict, spec: dict, exclude: list) -> None:
@@ -410,6 +430,8 @@ class ClusterClient:
                 "max_restarts": max_restarts,
                 "creation_spec": creation_spec,
                 "lease": {"resources": spec["resources"]},
+                "lease_id": grant["lease_id"],
+                "node_addr": grant.get("node_addr"),
             },
         )
         if not reg.get("ok"):
@@ -514,8 +536,7 @@ class ClusterClient:
         return ClusterActorHandle(info["actor_id"], self, desc=name)
 
     def kill_actor(self, actor_id: bytes) -> None:
-        with self._lock:
-            meta = getattr(self, "_actor_meta", {}).pop(actor_id, None)
+        self._forget_actor_addr(actor_id)
         info = self.gcs.call("get_actor", {"actor_id": actor_id})
         if info and info["worker_addr"]:
             try:
@@ -527,13 +548,14 @@ class ClusterClient:
         self.gcs.call(
             "update_actor", {"actor_id": actor_id, "state": "DEAD"}
         )
-        if meta is not None:
+        # release the backing lease on the daemon that GRANTED it — the
+        # GCS entry is authoritative (it tracks restarts onto new nodes;
+        # a locally cached grant would go stale after the first restart)
+        if info and info.get("lease_id") and info.get("node_addr"):
             try:
-                node_addr = self.local_daemon_addr
-                # release on the granting node
-                self.pool.get(tuple(meta["grant"].get("node_addr", node_addr))).call(
+                self.pool.get(tuple(info["node_addr"])).call(
                     "release_lease",
-                    {"lease_id": meta["grant"]["lease_id"], "kill": True},
+                    {"lease_id": info["lease_id"], "kill": True},
                     timeout=5,
                 )
             except (RpcError, RemoteError):

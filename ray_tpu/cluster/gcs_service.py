@@ -56,6 +56,11 @@ class ActorEntry:
     creation_spec: Optional[bytes] = None
     owner_addr: Optional[tuple] = None
     lease_resources: dict = field(default_factory=lambda: {"num_cpus": 1})
+    # the lease currently backing the actor's dedicated worker, and the
+    # daemon that granted it — kill must release it THERE (reference:
+    # GcsActorManager tracks the actor's leased worker per node)
+    lease_id: Optional[str] = None
+    node_addr: Optional[tuple] = None
 
 
 class GcsService:
@@ -228,6 +233,8 @@ class GcsService:
                     with self._lock:
                         a.node_id = g["node_id"]
                         a.worker_addr = tuple(g["worker_addr"])
+                        a.lease_id = g["lease_id"]
+                        a.node_addr = tuple(g.get("node_addr") or addr)
                         a.state = "ALIVE"
                         self._emit(
                             "actor_update",
@@ -316,6 +323,8 @@ class GcsService:
                 lease_resources=dict(
                     payload.get("lease", {}).get("resources", {"num_cpus": 1})
                 ),
+                lease_id=payload.get("lease_id"),
+                node_addr=tuple(payload["node_addr"]) if payload.get("node_addr") else None,
             )
             self._actors[a.actor_id] = a
             if name:
@@ -351,6 +360,8 @@ class GcsService:
             "num_restarts": a.num_restarts,
             "creation_spec": a.creation_spec,
             "owner_addr": a.owner_addr,
+            "lease_id": a.lease_id,
+            "node_addr": a.node_addr,
         }
 
     def rpc_get_actor(self, payload, peer):

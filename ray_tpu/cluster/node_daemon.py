@@ -159,7 +159,11 @@ class NodeDaemon:
         self.labels = labels or {}
         self.worker_env = worker_env or {}
         self._hb_interval = heartbeat_interval_s
-        self._res_lock = threading.Lock()
+        # RLock: PG-bundle reserve is check-then-act over _bundles AND the
+        # node availability — the whole sequence must be atomic across
+        # handler threads (reference: PlacementGroupResourceManager commits
+        # bundle resources atomically)
+        self._res_lock = threading.RLock()
         self._leases: dict[str, dict] = {}  # lease_id -> {resources, worker}
         self._bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> reserved resources
         self._idle_workers: list[WorkerHandle] = []
@@ -258,6 +262,7 @@ class NodeDaemon:
                 sys.executable, "-m", "ray_tpu.cluster.worker_main",
                 "--daemon", f"{self.addr[0]}:{self.addr[1]}",
                 "--worker-id", worker_id,
+                "--gcs", f"{self.gcs_addr[0]}:{self.gcs_addr[1]}",
             ],
             env=env,
             cwd=os.getcwd(),
@@ -304,10 +309,11 @@ class NodeDaemon:
         pg_key = None
         if payload.get("pg_id") is not None:
             pg_key = (payload["pg_id"], payload.get("bundle_index", 0))
-            bundle_pool = self._bundles.get(pg_key)
-            if bundle_pool is None:
-                return {"error": f"no bundle reserved here for {pg_key}"}
-            acquired = self._try_acquire(res, bundle_pool)
+            with self._res_lock:
+                bundle_pool = self._bundles.get(pg_key)
+                if bundle_pool is None:
+                    return {"error": f"no bundle reserved here for {pg_key}"}
+                acquired = self._try_acquire(res, bundle_pool)
         else:
             acquired = self._try_acquire(res)
         if acquired:
@@ -326,11 +332,19 @@ class NodeDaemon:
                     "worker_addr": w.addr,
                     "worker_id": w.worker_id,
                     "node_id": self.node_id,
+                    # the address release_lease must go to — without it a
+                    # remote actor's lease could only ever be released at
+                    # the driver's local daemon (leaking worker+resources)
+                    "node_addr": self.addr,
                 }
             }
         # spillback: consult the GCS view for a node that fits
         if pg_key is not None:
             return {"retry_after": 0.05}  # bundle is busy; wait for release
+        if payload.get("pinned"):
+            # hard node affinity: the caller can't use a spillback target,
+            # so don't compute one; tell it to back off instead
+            return {"retry_after": 0.2, "node_id": self.node_id}
         exclude = set(payload.get("exclude", ())) | {self.node_id}
         try:
             nodes = self.gcs.call("list_nodes", None, timeout=5)
@@ -364,8 +378,9 @@ class NodeDaemon:
         lease = self._leases.pop(payload["lease_id"], None)
         if lease is None:
             return {"ok": False}
-        pool = self._bundles.get(lease["pg_key"]) if lease["pg_key"] else None
-        self._release(lease["resources"], pool)
+        with self._res_lock:
+            pool = self._bundles.get(lease["pg_key"]) if lease["pg_key"] else None
+            self._release(lease["resources"], pool)
         w: WorkerHandle = lease["worker"]
         if payload.get("kill") or not w.alive():
             w.kill()
@@ -381,26 +396,29 @@ class NodeDaemon:
     def rpc_reserve_pg_bundle(self, payload, peer):
         key = (payload["pg_id"], payload["bundle_index"])
         res = payload["resources"]
-        if key in self._bundles:
-            return {"ok": True}  # idempotent
-        if not self._try_acquire(res):
-            return {"ok": False, "error": "insufficient resources"}
-        self._bundles[key] = dict(res)
+        with self._res_lock:  # atomic check-then-reserve across handlers
+            if key in self._bundles:
+                return {"ok": True}  # idempotent
+            if not self._try_acquire(res):
+                return {"ok": False, "error": "insufficient resources"}
+            self._bundles[key] = dict(res)
         return {"ok": True}
 
     def rpc_release_pg_bundle(self, payload, peer):
         key = (payload["pg_id"], payload["bundle_index"])
-        pool = self._bundles.pop(key, None)
-        if pool is None:
-            return {"ok": False}
-        # return whatever is still reserved plus whatever tasks gave back
-        self._release(pool)
+        with self._res_lock:
+            pool = self._bundles.pop(key, None)
+            if pool is None:
+                return {"ok": False}
+            # return whatever is still reserved plus whatever tasks gave back
+            self._release(pool)
         return {"ok": True}
 
     def rpc_release_pg_all(self, payload, peer):
         pg_id = payload["pg_id"]
-        for key in [k for k in self._bundles if k[0] == pg_id]:
-            self._release(self._bundles.pop(key))
+        with self._res_lock:
+            for key in [k for k in self._bundles if k[0] == pg_id]:
+                self._release(self._bundles.pop(key))
         return {"ok": True}
 
     # -- object service -------------------------------------------------------

@@ -41,8 +41,11 @@ from ray_tpu.cluster.serialization import (  # noqa: E402
 
 
 class WorkerRuntime:
-    def __init__(self, daemon_addr: tuple, worker_id: str):
+    def __init__(self, daemon_addr: tuple, worker_id: str,
+                 gcs_addr: Optional[tuple] = None):
         self.worker_id = worker_id
+        self.daemon_addr = tuple(daemon_addr)
+        self.gcs_addr = tuple(gcs_addr) if gcs_addr else None
         self.daemon = RpcClient(*daemon_addr, timeout=120.0).connect(retries=20)
         self.node_id: Optional[str] = None
         self.actors: dict[bytes, Any] = {}
@@ -173,13 +176,20 @@ class WorkerRuntime:
 
     def start(self) -> None:
         addr = self.rpc.start()
+        # install the ambient ClusterClient BEFORE registering: the moment
+        # the daemon processes register_worker it may grant a lease and a
+        # submitter may push a task carrying ObjectRefs/actor handles —
+        # their rebuild path needs the ambient client already in place
+        if self.gcs_addr is not None:
+            from ray_tpu.cluster.client import ClusterClient
+
+            ClusterClient(self.gcs_addr, self.daemon_addr)
         r = self.daemon.call(
             "register_worker", {"worker_id": self.worker_id, "addr": addr}
         )
         self.node_id = r.get("node_id")
-        # install an ambient ClusterClient so actor handles / refs that
-        # arrive inside task args work from worker code too
-        if r.get("gcs_addr") and r.get("daemon_addr"):
+        if self.gcs_addr is None and r.get("gcs_addr") and r.get("daemon_addr"):
+            # legacy fallback (daemon didn't pass --gcs): install late
             from ray_tpu.cluster.client import ClusterClient
 
             ClusterClient(tuple(r["gcs_addr"]), tuple(r["daemon_addr"]))
@@ -191,9 +201,14 @@ def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--daemon", required=True)
     p.add_argument("--worker-id", required=True)
+    p.add_argument("--gcs", default=None)
     args = p.parse_args()
     host, port = args.daemon.rsplit(":", 1)
-    rt = WorkerRuntime((host, int(port)), args.worker_id)
+    gcs = None
+    if args.gcs:
+        gh, gp = args.gcs.rsplit(":", 1)
+        gcs = (gh, int(gp))
+    rt = WorkerRuntime((host, int(port)), args.worker_id, gcs_addr=gcs)
     rt.start()
     try:
         threading.Event().wait()

@@ -46,6 +46,23 @@ def resolve_pool(
         idx = strategy.placement_group_bundle_index
     else:
         idx = options.placement_group_bundle_index
+    if strategy is not None and hasattr(strategy, "node_id") and pg is None:
+        # NodeAffinity against the single-node runtime: the only node is
+        # runtime.node_id — a hard affinity to any other node must FAIL
+        # the task, not silently run it here (reference semantics:
+        # unschedulable hard affinity raises, scheduling_strategies.py)
+        nid = strategy.node_id
+        local = runtime.node_id
+        matches = (
+            nid == local
+            or (isinstance(nid, str) and nid == local.hex())
+            or (isinstance(nid, bytes) and nid == local.binary())
+        )
+        if nid is not None and not matches and not getattr(strategy, "soft", False):
+            raise errors.RayTpuError(
+                f"NodeAffinitySchedulingStrategy(node_id={nid!r}, soft=False): "
+                f"no such node in this runtime (local node {runtime.node_id})"
+            )
     if pg is not None:
         return pg.bundle_pool(idx, req), req
     return default_pool if default_pool is not None else runtime.node_resources, req

@@ -225,3 +225,43 @@ def test_node_death_recovery(mode):
                     time.sleep(0.5)
             assert val == 1  # restarted from scratch
             assert client.get(h.where.remote(), timeout=30) == "rescue"
+
+
+def test_node_affinity_routing(cluster):
+    client = cluster.client()
+    # hard affinity: lands exactly on the named node
+    for target in ("head", "n1", "n2"):
+        ref = client.submit(_whoami, affinity_node_id=target)
+        node_id, _ = client.get(ref, timeout=60)
+        assert node_id == target
+    # hard affinity to a nonexistent node: the task fails, not silently runs
+    ref = client.submit(_whoami, affinity_node_id="no-such-node", max_retries=0)
+    with pytest.raises(ClusterTaskError, match="not alive"):
+        client.get(ref, timeout=60)
+    # soft affinity to a dead node: falls back to any node
+    ref = client.submit(
+        _whoami, affinity_node_id="no-such-node", affinity_soft=True
+    )
+    node_id, _ = client.get(ref, timeout=60)
+    assert node_id in ("head", "n1", "n2")
+
+
+def test_kill_remote_actor_releases_lease(cluster):
+    """Killing an actor on a REMOTE node must release its lease there:
+    the node's availability is restored and its dedicated worker reaped
+    (regression: release used to always go to the driver's local daemon)."""
+    client = cluster.client()
+    h = client.create_actor(Counter, (0,), resources={"num_cpus": 1, "magic": 1})
+    assert client.get(h.where.remote(), timeout=60) == "n2"  # only n2 has magic
+    nodes = {n["node_id"]: tuple(n["addr"]) for n in client.nodes()}
+    stats = client.pool.get(nodes["n2"]).call("stats", None)
+    assert stats["available"].get("magic", 0) == 0  # lease holds the resource
+    h.kill()
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        stats = client.pool.get(nodes["n2"]).call("stats", None)
+        if stats["available"].get("magic", 0) == 1 and stats["num_leases"] == 0:
+            break
+        time.sleep(0.2)
+    assert stats["available"].get("magic", 0) == 1, stats
+    assert stats["num_leases"] == 0, stats
