@@ -24,7 +24,13 @@ from typing import Any, Optional, Sequence
 
 import cloudpickle
 
-from ray_tpu.cluster.rpc import ClientPool, RemoteError, RpcClient, RpcError
+from ray_tpu.cluster.rpc import (
+    ClientPool,
+    ReconnectingRpcClient,
+    RemoteError,
+    RpcClient,
+    RpcError,
+)
 from ray_tpu.cluster.serialization import _ErrorValue, dumps_value, loads_value
 from ray_tpu.utils.logging import get_logger
 
@@ -50,14 +56,25 @@ def _new_id() -> bytes:
 
 
 class ClusterObjectRef:
-    """A future for an object living in some node's store."""
+    """A future for an object living in some node's store.
 
-    __slots__ = ("id", "_client", "_desc")
+    Refs created by the OWNING client (put / task returns) participate in
+    driver-side ref counting: when the last owned handle drops, the
+    object is freed cluster-wide (reference: owner-based ref counting,
+    src/ray/core_worker/reference_count.h:66 — here collapsed to the
+    driver as sole owner; deserialized/borrowed refs never free)."""
 
-    def __init__(self, object_id: bytes, client: "ClusterClient", desc: str = ""):
+    __slots__ = ("id", "_client", "_desc", "_owned")
+
+    def __init__(self, object_id: bytes, client: "ClusterClient", desc: str = "",
+                 owned: bool = False):
         self.id = object_id
         self._client = client
         self._desc = desc
+        self._owned = owned
+        if owned:
+            client._mark_owned(object_id)
+            client._incref(object_id)
 
     def get(self, timeout: Optional[float] = None):
         return self._client.get(self, timeout=timeout)
@@ -65,8 +82,15 @@ class ClusterObjectRef:
     def __reduce__(self):
         # travels as a persistent id through dumps_value; plain pickling
         # (e.g. inside foreign containers) rebuilds against the ambient
-        # client on the receiving side
+        # client on the receiving side — as a BORROWED ref
         return (_rebuild_ref, (self.id, self._desc))
+
+    def __del__(self):
+        if getattr(self, "_owned", False):
+            try:
+                self._client._decref(self.id)
+            except Exception:
+                pass
 
     def __repr__(self):
         return f"ClusterObjectRef({self.id.hex()[:12]}, {self._desc})"
@@ -145,10 +169,44 @@ class ClusterClient:
     the driver leases from and fetches through (the head node's raylet)."""
 
     def __init__(self, gcs_addr: tuple, local_daemon_addr: tuple):
-        self.gcs = RpcClient(*gcs_addr, timeout=60.0).connect(retries=20)
+        # reconnecting: survives a GCS restart (FT snapshot + same port)
+        self.gcs = ReconnectingRpcClient(*gcs_addr, timeout=60.0).connect(retries=20)
         self.local_daemon_addr = tuple(local_daemon_addr)
         self.pool = ClientPool(timeout=120.0)
         self._lock = threading.Lock()
+        # ref-count ops flow through a lock-free deque consumed by ONE
+        # accountant thread: __del__ may fire from cyclic GC while this
+        # thread holds any lock, so the hot path must only deque.append
+        # (GIL-atomic) — taking a client lock there can self-deadlock
+        from collections import deque as _deque
+
+        self._rc_ops: "_deque[tuple[str, bytes]]" = _deque()
+        # drivers own their objects and free on last handle drop; worker
+        # processes only BORROW (their task returns are owned by the
+        # submitting driver) — worker_main flips this off so a worker
+        # dropping a ref it created for a nested submit can't free an
+        # object some caller still holds
+        self.auto_free = True
+        self._closed = False
+        self._freer = threading.Thread(
+            target=self._rc_loop, name="ray_tpu-freer", daemon=True
+        )
+        self._freer.start()
+        # bounded submitter pool: thread-per-task melts down under wide
+        # fan-out (thousands of threads fighting the GIL); a pool sized to
+        # the host caps that while keeping pushes concurrent. Long-running
+        # pushes hold a pool thread, so size it generously.
+        import concurrent.futures
+        import os as _os
+
+        self._submitter = concurrent.futures.ThreadPoolExecutor(
+            max_workers=int(
+                _os.environ.get(
+                    "RAY_TPU_SUBMIT_THREADS", min(64, 8 * (_os.cpu_count() or 4))
+                )
+            ),
+            thread_name_prefix="ray_tpu-submit",
+        )
         _AMBIENT[0] = self
 
     @property
@@ -156,10 +214,94 @@ class ClusterClient:
         return self.pool.get(self.local_daemon_addr)
 
     def close(self) -> None:
+        self._closed = True
+        self._submitter.shutdown(wait=False, cancel_futures=True)
         self.gcs.close()
         self.pool.close_all()
         if _AMBIENT[0] is self:
             _AMBIENT[0] = None
+
+    # -- driver-side ref counting ---------------------------------------------
+    # Only OWNED ids ("own" op: put / task returns created here) are ever
+    # freed; borrowed refs pinned as task args inc/dec without freeing.
+
+    def _incref(self, object_id: bytes) -> None:
+        self._rc_ops.append(("inc", object_id))
+
+    def _decref(self, object_id: bytes) -> None:
+        self._rc_ops.append(("dec", object_id))
+
+    def _mark_owned(self, object_id: bytes) -> None:
+        self._rc_ops.append(("own", object_id))
+
+    def free(self, refs) -> None:
+        """Explicitly free objects cluster-wide (ray._private free analog)."""
+        if not isinstance(refs, (list, tuple)):
+            refs = [refs]
+        for r in refs:
+            self._rc_ops.append(("free", r.id))
+
+    def _rc_loop(self) -> None:
+        """The accountant: applies ref-count ops, frees owned objects on
+        their last decref (reference: ReferenceCounter's delete callback,
+        reference_count.h:66). A ref dropped BEFORE its task stored the
+        result has no locations yet — those frees retry until the object
+        appears (else fire-and-forget results would leak forever)."""
+        counts: dict[bytes, int] = {}
+        owned: set[bytes] = set()
+        retries: dict[bytes, tuple[float, int]] = {}  # oid -> (due, attempts)
+        while not self._closed:
+            now = time.monotonic()
+            for oid, (due, attempts) in list(retries.items()):
+                if due <= now:
+                    if self._free_everywhere(oid) or attempts >= 120:
+                        retries.pop(oid, None)
+                    else:
+                        retries[oid] = (now + 1.0, attempts + 1)
+            if not self._rc_ops:
+                time.sleep(0.05)
+                continue
+            try:
+                op, oid = self._rc_ops.popleft()
+            except IndexError:
+                continue
+            if op == "inc":
+                counts[oid] = counts.get(oid, 0) + 1
+            elif op == "own":
+                owned.add(oid)
+            elif op == "dec":
+                n = counts.get(oid, 0) - 1
+                if n > 0:
+                    counts[oid] = n
+                else:
+                    counts.pop(oid, None)
+                    if oid in owned and self.auto_free:
+                        owned.discard(oid)
+                        if not self._free_everywhere(oid):
+                            retries[oid] = (time.monotonic() + 1.0, 1)
+            elif op == "free":
+                owned.discard(oid)
+                counts.pop(oid, None)
+                retries.pop(oid, None)
+                self._free_everywhere(oid)
+
+    def _free_everywhere(self, oid: bytes) -> bool:
+        """Free on every holder; returns True when at least one holder
+        existed (False = object not stored anywhere yet)."""
+        try:
+            locs = self.gcs.call("locate_object", {"object_id": oid}, timeout=10)
+        except Exception:
+            return False
+        freed = False
+        for addr in locs or ():
+            freed = True
+            try:
+                self.pool.get(tuple(addr)).call(
+                    "free_object", {"object_id": oid}, timeout=10
+                )
+            except (RpcError, RemoteError):
+                pass
+        return freed
 
     # -- objects --------------------------------------------------------------
 
@@ -168,12 +310,12 @@ class ClusterClient:
         self.local_daemon.call(
             "put_object", {"object_id": oid, "data": dumps_value(value)}
         )
-        return ClusterObjectRef(oid, self, "put")
+        return ClusterObjectRef(oid, self, "put", owned=True)
 
     def get(self, ref: "ClusterObjectRef | Sequence[ClusterObjectRef]",
             timeout: Optional[float] = None):
         if isinstance(ref, (list, tuple)):
-            return type(ref)(self.get(r, timeout=timeout) for r in ref)
+            return type(ref)(self._get_many(list(ref), timeout))
         deadline = time.monotonic() + (timeout if timeout is not None else 300.0)
         while True:
             remaining = deadline - time.monotonic()
@@ -189,6 +331,34 @@ class ClusterClient:
                 if isinstance(value, _ErrorValue):
                     raise ClusterTaskError(value.task_desc, value.exc, value.tb)
                 return value
+
+    def _get_many(self, refs: list, timeout: Optional[float]) -> list:
+        """Batched get: pipelined fetch_object frames on one connection
+        (not one blocking round-trip per ref)."""
+        deadline = time.monotonic() + (timeout if timeout is not None else 300.0)
+        out: dict[int, Any] = {}
+        pending = list(enumerate(refs))
+        while pending:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise GetTimeoutError(f"get of {len(pending)} refs timed out")
+            step = min(remaining, 5.0)
+            datas = self.local_daemon.call(
+                "fetch_objects",
+                {"object_ids": [r.id for _, r in pending], "timeout": step},
+                timeout=step + 30,
+            )
+            still = []
+            for (i, r), data in zip(pending, datas):
+                if data is None:
+                    still.append((i, r))
+                    continue
+                value = loads_value(data, self._resolve)
+                if isinstance(value, _ErrorValue):
+                    raise ClusterTaskError(value.task_desc, value.exc, value.tb)
+                out[i] = value
+            pending = still
+        return [out[i] for i in range(len(refs))]
 
     def _resolve(self, object_id: bytes):
         data = self.local_daemon.call(
@@ -207,13 +377,13 @@ class ClusterClient:
         ready: list = []
         pending = list(refs)
         while len(ready) < num_returns:
+            # one batched probe per poll (not one RPC per ref)
+            have = self.gcs.call(
+                "locate_many", {"object_ids": [r.id for r in pending]}
+            )
             still = []
             for r in pending:
-                locs = self.gcs.call("locate_object", {"object_id": r.id})
-                if locs:
-                    ready.append(r)
-                else:
-                    still.append(r)
+                (ready if have.get(r.id) else still).append(r)
             pending = still
             if len(ready) >= num_returns:
                 break
@@ -241,59 +411,64 @@ class ClusterClient:
     ) -> "ClusterObjectRef | list[ClusterObjectRef]":
         desc = desc or getattr(func, "__name__", "task")
         return_ids = [_new_id() for _ in range(num_returns)]
+        # pin argument objects until the task completes: user code may drop
+        # its handles while the task is still pending/retrying
+        arg_refs: list[bytes] = []
         payload = {
             "task_id": _new_id(),
             "desc": desc,
             "func": cloudpickle.dumps(func),
-            "args": dumps_value((args, dict(kwargs or {}))),
+            "args": dumps_value((args, dict(kwargs or {})), arg_refs.append),
             "return_ids": return_ids,
             "num_returns": num_returns,
         }
+        for oid in arg_refs:
+            self._incref(oid)
         spec = {
-            "resources": dict(resources or {"num_cpus": 1}),
+            # None -> default 1 CPU; an explicit {} means "costs nothing"
+            "resources": dict({"num_cpus": 1} if resources is None else resources),
             "pg_id": pg_id,
             "bundle_index": bundle_index,
             "affinity_node_id": affinity_node_id,
             "affinity_soft": affinity_soft,
         }
-        t = threading.Thread(
-            target=self._drive_task,
-            args=(payload, spec, max_retries),
-            name=f"submit-{desc}",
-            daemon=True,
-        )
-        t.start()
-        refs = [ClusterObjectRef(rid, self, desc) for rid in return_ids]
+        self._submitter.submit(self._drive_task, payload, spec, max_retries, arg_refs)
+        refs = [ClusterObjectRef(rid, self, desc, owned=True) for rid in return_ids]
         return refs[0] if num_returns == 1 else refs
 
-    def _drive_task(self, payload: dict, spec: dict, max_retries: int) -> None:
+    def _drive_task(self, payload: dict, spec: dict, max_retries: int,
+                    arg_refs: Sequence[bytes] = ()) -> None:
         attempt = 0
         exclude: list = []
-        while True:
-            try:
-                self._run_once(payload, spec, exclude)
-                return
-            except (RpcError, RemoteError) as e:
-                attempt += 1
-                if attempt > max_retries:
-                    err = _ErrorValue(
-                        RuntimeError(f"task lost after {max_retries} retries: {e}"),
-                        "", payload["desc"],
-                    )
-                    for rid in payload["return_ids"]:
-                        try:
-                            self.local_daemon.call(
-                                "put_object",
-                                {"object_id": rid, "data": dumps_value(err)},
-                            )
-                        except Exception:
-                            logger.exception("cannot store task-lost error")
+        try:
+            while True:
+                try:
+                    self._run_once(payload, spec, exclude)
                     return
-                logger.warning(
-                    "%s attempt %d failed (%s); retrying", payload["desc"],
-                    attempt, e,
-                )
-                time.sleep(0.1)
+                except (RpcError, RemoteError) as e:
+                    attempt += 1
+                    if attempt > max_retries:
+                        err = _ErrorValue(
+                            RuntimeError(f"task lost after {max_retries} retries: {e}"),
+                            "", payload["desc"],
+                        )
+                        for rid in payload["return_ids"]:
+                            try:
+                                self.local_daemon.call(
+                                    "put_object",
+                                    {"object_id": rid, "data": dumps_value(err)},
+                                )
+                            except Exception:
+                                logger.exception("cannot store task-lost error")
+                        return
+                    logger.warning(
+                        "%s attempt %d failed (%s); retrying", payload["desc"],
+                        attempt, e,
+                    )
+                    time.sleep(0.1)
+        finally:
+            for oid in arg_refs:  # unpin the task's argument objects
+                self._decref(oid)
 
     def _lease(self, spec: dict, exclude: list) -> tuple[dict, RpcClient]:
         """Lease a worker, following spillback hops. Nodes that refused
@@ -318,16 +493,9 @@ class ClusterClient:
         if spec.get("pg_id") is not None:
             # placement-group tasks go straight to the node holding the
             # reserved bundle (reference: PG scheduling strategy bypasses
-            # the hybrid policy)
-            info = self.gcs.call("get_pg", {"pg_id": spec["pg_id"]})
-            if info is None:
-                raise RemoteError(RuntimeError("placement group removed"))
-            bundle = info["bundles"][spec.get("bundle_index", 0)]
-            if bundle["node_id"] is None:
-                raise RemoteError(RuntimeError("bundle not placed yet"))
-            nodes = {n["node_id"]: tuple(n["addr"]) for n in
-                     self.gcs.call("list_nodes", None)}
-            addr = nodes[bundle["node_id"]]
+            # the hybrid policy); bundle_index -1 = any bundle that fits
+            # (reference wildcard semantics, placement_group.py)
+            return self._lease_pg(spec)
         deadline = time.monotonic() + 120.0
         visited: set = set()
         hops = 0
@@ -356,6 +524,40 @@ class ClusterClient:
                 addr = self.local_daemon_addr  # re-evaluate from home
         raise RpcError("lease request timed out")
 
+    def _lease_pg(self, spec: dict) -> tuple[dict, RpcClient]:
+        """Lease inside a placement group: a fixed bundle (index >= 0) or
+        any bundle that grants (index -1), sweeping until the deadline."""
+        deadline = time.monotonic() + 120.0
+        while time.monotonic() < deadline:
+            info = self.gcs.call("get_pg", {"pg_id": spec["pg_id"]})
+            if info is None:
+                raise RemoteError(RuntimeError("placement group removed"))
+            idx = spec.get("bundle_index", 0)
+            candidates = [idx] if idx >= 0 else list(range(len(info["bundles"])))
+            nodes = {n["node_id"]: tuple(n["addr"]) for n in
+                     self.gcs.call("list_nodes", None)}
+            delay = 0.05
+            # a fixed bundle queues server-side for the full window; a
+            # wildcard sweep queues briefly per bundle so it keeps rotating
+            queue_timeout = 30.0 if idx >= 0 else 0.5
+            for i in candidates:
+                bundle = info["bundles"][i]
+                if bundle["node_id"] is None:
+                    continue  # not (re)placed yet
+                daemon = self.pool.get(nodes[bundle["node_id"]])
+                r = daemon.call(
+                    "request_worker_lease",
+                    {**spec, "bundle_index": i, "queue_timeout": queue_timeout},
+                    timeout=90,
+                )
+                if "grant" in r:
+                    return r["grant"], daemon
+                if "error" in r and idx >= 0:
+                    raise RemoteError(RuntimeError(r["error"]))
+                delay = min(delay, r.get("retry_after", 0.05))
+            time.sleep(delay)
+        raise RpcError("placement-group lease timed out")
+
     def _run_once(self, payload: dict, spec: dict, exclude: list) -> None:
         grant, daemon = self._lease(spec, exclude)
         worker_addr = tuple(grant["worker_addr"])
@@ -372,6 +574,9 @@ class ClusterClient:
             self.pool.invalidate(worker_addr)
             raise
         finally:
+            # release immediately: the daemon queues lease requests and its
+            # idle-worker pool makes re-grant instant, so holding leases
+            # client-side would only starve other queued submitters
             try:
                 daemon.call(
                     "release_lease",
@@ -397,9 +602,17 @@ class ClusterClient:
         bundle_index: int = 0,
     ) -> ClusterActorHandle:
         actor_id = _new_id()
-        creation_spec = dumps_value((cls, args, dict(kwargs or {})))
+        # ctor-arg objects must outlive the actor (restarts replay the
+        # creation_spec); pin them until kill_actor
+        ctor_refs: list[bytes] = []
+        creation_spec = dumps_value(
+            (cls, args, dict(kwargs or {})), ctor_refs.append
+        )
+        for oid in ctor_refs:
+            self._incref(oid)
         spec = {
-            "resources": dict(resources or {"num_cpus": 1}),
+            # None -> default 1 CPU; an explicit {} means "costs nothing"
+            "resources": dict({"num_cpus": 1} if resources is None else resources),
             "pg_id": pg_id,
             "bundle_index": bundle_index,
         }
@@ -438,17 +651,18 @@ class ClusterClient:
             raise ValueError(reg.get("error", "actor registration failed"))
         # NOTE: the lease stays held for the actor's lifetime (the worker is
         # dedicated to it); kill_actor releases it.
-        self._lock_actor_meta(actor_id, grant, worker_addr)
+        self._lock_actor_meta(actor_id, grant, worker_addr, ctor_refs)
         return ClusterActorHandle(
             actor_id, self, desc=getattr(cls, "__name__", "actor")
         )
 
-    def _lock_actor_meta(self, actor_id, grant, worker_addr):
+    def _lock_actor_meta(self, actor_id, grant, worker_addr, ctor_refs=()):
         with self._lock:
             if not hasattr(self, "_actor_meta"):
                 self._actor_meta = {}
             self._actor_meta[actor_id] = {
                 "grant": grant, "worker_addr": worker_addr,
+                "ctor_refs": list(ctor_refs),
             }
 
     def _actor_worker(self, actor_id: bytes, wait_restart: float = 30.0) -> tuple:
@@ -475,43 +689,50 @@ class ClusterClient:
         num_returns: int = 1,
     ):
         return_ids = [_new_id() for _ in range(num_returns)]
+        arg_refs: list[bytes] = []
         payload = {
             "actor_id": actor_id,
             "method": method,
-            "args": dumps_value((args, dict(kwargs or {}))),
+            "args": dumps_value((args, dict(kwargs or {})), arg_refs.append),
             "return_ids": return_ids,
             "num_returns": num_returns,
         }
-        t = threading.Thread(
-            target=self._drive_actor_task, args=(actor_id, payload),
-            name=f"actor-call-{method}", daemon=True,
-        )
-        t.start()
-        refs = [ClusterObjectRef(rid, self, f"actor.{method}") for rid in return_ids]
+        for oid in arg_refs:
+            self._incref(oid)
+        self._submitter.submit(self._drive_actor_task, actor_id, payload, arg_refs)
+        refs = [
+            ClusterObjectRef(rid, self, f"actor.{method}", owned=True)
+            for rid in return_ids
+        ]
         return refs[0] if num_returns == 1 else refs
 
-    def _drive_actor_task(self, actor_id: bytes, payload: dict) -> None:
-        for attempt in range(2):
-            try:
-                addr = self._actor_worker(actor_id)
-                w = self.pool.get(addr)
-                r = w.call("actor_call", payload, timeout=3600)
-                if r.get("actor_missing") and attempt == 0:
-                    # stale address (restart happened): force GCS lookup
+    def _drive_actor_task(self, actor_id: bytes, payload: dict,
+                          arg_refs: Sequence[bytes] = ()) -> None:
+        try:
+            for attempt in range(2):
+                try:
+                    addr = self._actor_worker(actor_id)
+                    w = self.pool.get(addr)
+                    r = w.call("actor_call", payload, timeout=3600)
+                    if r.get("actor_missing") and attempt == 0:
+                        # stale address (restart happened): force GCS lookup
+                        self._forget_actor_addr(actor_id)
+                        continue
+                    return
+                except (RpcError, RemoteError):
                     self._forget_actor_addr(actor_id)
-                    continue
-                return
-            except (RpcError, RemoteError):
-                self._forget_actor_addr(actor_id)
-                if attempt == 1:
-                    break
-                time.sleep(0.2)
-            except ActorDiedError as e:
-                self._store_actor_error(payload, e)
-                return
-        self._store_actor_error(
-            payload, ActorDiedError(f"actor {actor_id.hex()} unreachable")
-        )
+                    if attempt == 1:
+                        break
+                    time.sleep(0.2)
+                except ActorDiedError as e:
+                    self._store_actor_error(payload, e)
+                    return
+            self._store_actor_error(
+                payload, ActorDiedError(f"actor {actor_id.hex()} unreachable")
+            )
+        finally:
+            for oid in arg_refs:
+                self._decref(oid)
 
     def _forget_actor_addr(self, actor_id: bytes) -> None:
         with self._lock:
@@ -536,7 +757,10 @@ class ClusterClient:
         return ClusterActorHandle(info["actor_id"], self, desc=name)
 
     def kill_actor(self, actor_id: bytes) -> None:
-        self._forget_actor_addr(actor_id)
+        with self._lock:
+            meta = getattr(self, "_actor_meta", {}).pop(actor_id, None)
+        for oid in (meta or {}).get("ctor_refs", ()):
+            self._decref(oid)  # unpin the ctor args (no more restarts)
         info = self.gcs.call("get_actor", {"actor_id": actor_id})
         if info and info["worker_addr"]:
             try:
@@ -578,14 +802,21 @@ class ClusterClient:
                 raise TimeoutError(f"placement group not placed: {info['state']}")
             time.sleep(0.05)
             info = self.gcs.call("get_pg", {"pg_id": pg_id})
-        # reserve the bundles on their nodes
+        # reserve the bundles on their nodes. The GCS placed against its
+        # availability view, which can run ~1 heartbeat ahead of the node
+        # (e.g. a just-removed PG's resources flight back) — retry briefly
+        # before declaring the reservation failed.
         nodes = {n["node_id"]: tuple(n["addr"]) for n in self.gcs.call("list_nodes", None)}
         for i, b in enumerate(info["bundles"]):
             addr = nodes[b["node_id"]]
-            r = self.pool.get(addr).call(
-                "reserve_pg_bundle",
-                {"pg_id": pg_id, "bundle_index": i, "resources": b["resources"]},
-            )
+            for attempt in range(6):
+                r = self.pool.get(addr).call(
+                    "reserve_pg_bundle",
+                    {"pg_id": pg_id, "bundle_index": i, "resources": b["resources"]},
+                )
+                if r.get("ok"):
+                    break
+                time.sleep(0.2)
             if not r.get("ok"):
                 raise RuntimeError(
                     f"bundle {i} reservation failed on {b['node_id']}: {r}"

@@ -70,8 +70,10 @@ class NodeProc:
 class LocalCluster:
     """Spawn a GCS + N node-daemon processes on this machine."""
 
-    def __init__(self, node_death_timeout_s: float = 2.0):
+    def __init__(self, node_death_timeout_s: float = 2.0,
+                 gcs_persist_path: Optional[str] = None):
         self._death_timeout = node_death_timeout_s
+        self._persist_path = gcs_persist_path
         self.gcs_proc: Optional[subprocess.Popen] = None
         self.gcs_addr: Optional[tuple] = None
         self.nodes: dict[str, NodeProc] = {}
@@ -80,20 +82,47 @@ class LocalCluster:
 
     # -- lifecycle ------------------------------------------------------------
 
-    def start(self) -> "LocalCluster":
-        env = self._child_env()
+    def _spawn_gcs(self, port: int = 0) -> None:
+        cmd = [
+            sys.executable, "-m", "ray_tpu.cluster.gcs_service",
+            "--death-timeout", str(self._death_timeout),
+            "--port", str(port),
+        ]
+        if self._persist_path:
+            cmd += ["--persist", self._persist_path]
         self.gcs_proc = subprocess.Popen(
-            [
-                sys.executable, "-m", "ray_tpu.cluster.gcs_service",
-                "--death-timeout", str(self._death_timeout),
-            ],
-            stdout=subprocess.PIPE, text=True, env=env,
+            cmd, stdout=subprocess.PIPE, text=True, env=self._child_env(),
             start_new_session=True,
         )
         host_port = _read_banner(self.gcs_proc, "GCS_ADDRESS")[0]
-        host, port = host_port.rsplit(":", 1)
-        self.gcs_addr = (host, int(port))
+        host, port_s = host_port.rsplit(":", 1)
+        self.gcs_addr = (host, int(port_s))
+
+    def start(self) -> "LocalCluster":
+        self._spawn_gcs()
         return self
+
+    def kill_gcs(self) -> None:
+        """SIGKILL the control plane (FT testing)."""
+        if self.gcs_proc is not None:
+            try:
+                import signal
+
+                os.killpg(os.getpgid(self.gcs_proc.pid), signal.SIGKILL)
+            except Exception:
+                try:
+                    self.gcs_proc.kill()
+                except Exception:
+                    pass
+            self.gcs_proc = None
+
+    def restart_gcs(self) -> None:
+        """Restart the GCS at the SAME address; with a persist path it
+        replays actors/PGs/KV and nodes re-register via heartbeat
+        (reference: Redis-backed GCS restart, gcs_init_data.cc)."""
+        assert self.gcs_addr is not None, "start() first"
+        self.kill_gcs()
+        self._spawn_gcs(port=self.gcs_addr[1])
 
     def _child_env(self, extra: Optional[dict] = None) -> dict:
         env = dict(os.environ)
@@ -109,6 +138,7 @@ class LocalCluster:
         resources: Optional[dict] = None,
         node_id: Optional[str] = None,
         worker_env: Optional[dict] = None,
+        object_capacity_bytes: Optional[int] = None,
     ) -> NodeProc:
         assert self.gcs_addr is not None, "start() first"
         resources = resources or {"num_cpus": 1}
@@ -118,6 +148,8 @@ class LocalCluster:
             "--gcs", f"{self.gcs_addr[0]}:{self.gcs_addr[1]}",
             "--resources", res_s,
         ]
+        if object_capacity_bytes is not None:
+            cmd += ["--object-capacity", str(object_capacity_bytes)]
         if node_id:
             cmd += ["--node-id", node_id]
         if worker_env:
@@ -133,6 +165,12 @@ class LocalCluster:
         if self._head is None:
             self._head = node
         return node
+
+    @property
+    def address(self) -> str:
+        """GCS address for ray_tpu.init(address=...)."""
+        assert self.gcs_addr is not None, "start() first"
+        return f"{self.gcs_addr[0]}:{self.gcs_addr[1]}"
 
     def client(self) -> ClusterClient:
         if self._client is None:

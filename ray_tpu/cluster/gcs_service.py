@@ -66,7 +66,8 @@ class ActorEntry:
 class GcsService:
     """RPC handler. All methods take (payload, peer)."""
 
-    def __init__(self, node_death_timeout_s: float = 5.0):
+    def __init__(self, node_death_timeout_s: float = 5.0,
+                 persist_path: Optional[str] = None):
         self._lock = threading.RLock()
         self._nodes: dict[str, NodeEntry] = {}
         self._actors: dict[bytes, ActorEntry] = {}
@@ -78,6 +79,64 @@ class GcsService:
         self._event_seq = itertools.count()
         self._death_timeout = node_death_timeout_s
         self._pg_counter = itertools.count()
+        # fault tolerance: durable snapshot of the control-plane tables
+        # (reference: Redis-backed GCS storage, redis_store_client.h:107,
+        # replayed by gcs_init_data.cc on restart). Nodes re-register via
+        # the heartbeat "reregister" path; actor/PG/KV state comes back
+        # from the snapshot.
+        self._persist_path = persist_path
+        self._dirty = 0
+        self._persisted = 0
+        if persist_path:
+            self._load_snapshot()
+
+    # -- persistence ----------------------------------------------------------
+
+    def _mark_dirty(self) -> None:
+        if self._persist_path:
+            self._dirty += 1
+
+    def _load_snapshot(self) -> None:
+        import pickle
+
+        try:
+            with open(self._persist_path, "rb") as f:
+                snap = pickle.load(f)
+        except (OSError, pickle.UnpicklingError, EOFError):
+            return
+        self._actors = snap.get("actors", {})
+        self._named = snap.get("named", {})
+        self._pgs = snap.get("pgs", {})
+        self._kv = snap.get("kv", {})
+        logger.info(
+            "GCS restored from snapshot: %d actors, %d pgs, %d kv namespaces",
+            len(self._actors), len(self._pgs), len(self._kv),
+        )
+
+    def persist_if_dirty(self) -> None:
+        """Debounced snapshot write (driven by the server's sweeper)."""
+        if not self._persist_path:
+            return
+        with self._lock:
+            if self._dirty == self._persisted:
+                return
+            gen = self._dirty
+            import pickle
+
+            snap = pickle.dumps({
+                "actors": dict(self._actors),
+                "named": dict(self._named),
+                "pgs": {k: dict(v) for k, v in self._pgs.items()},
+                "kv": {ns: dict(kv) for ns, kv in self._kv.items()},
+            })
+        tmp = self._persist_path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(snap)
+            os.replace(tmp, self._persist_path)
+            self._persisted = gen
+        except OSError:
+            logger.exception("GCS snapshot write failed")
 
     # -- events ---------------------------------------------------------------
 
@@ -105,6 +164,11 @@ class GcsService:
                 labels=payload.get("labels", {}),
             )
             self._nodes[e.node_id] = e
+            # re-registration after a GCS restart rebuilds the object
+            # directory from the node's own inventory (the reference
+            # relearns locations via raylet resubscription)
+            for oid in payload.get("objects", ()):
+                self._objects.setdefault(oid, set()).add(e.node_id)
             self._emit("node_added", {"node_id": e.node_id, "addr": e.addr})
             logger.info("node %s registered at %s", e.node_id, e.addr)
         return {"ok": True}
@@ -236,6 +300,7 @@ class GcsService:
                         a.lease_id = g["lease_id"]
                         a.node_addr = tuple(g.get("node_addr") or addr)
                         a.state = "ALIVE"
+                        self._mark_dirty()
                         self._emit(
                             "actor_update",
                             {"actor_id": a.actor_id, "state": "ALIVE",
@@ -255,6 +320,7 @@ class GcsService:
         with self._lock:
             ns = self._kv.setdefault(payload.get("ns", "default"), {})
             ns[payload["key"]] = payload["value"]
+            self._mark_dirty()
         return {"ok": True}
 
     def rpc_kv_get(self, payload, peer):
@@ -264,6 +330,7 @@ class GcsService:
     def rpc_kv_del(self, payload, peer):
         with self._lock:
             self._kv.get(payload.get("ns", "default"), {}).pop(payload["key"], None)
+            self._mark_dirty()
         return {"ok": True}
 
     def rpc_kv_keys(self, payload, peer):
@@ -299,6 +366,21 @@ class GcsService:
                 if nid in self._nodes and self._nodes[nid].alive
             ]
 
+    def rpc_locate_many(self, payload, peer):
+        """Batched location probe: object_id -> [holder addrs]. One RPC
+        for a whole wait() poll / batched-fetch round instead of one per
+        ref (empty list = not available, truthiness works for wait)."""
+        with self._lock:
+            out = {}
+            for oid in payload["object_ids"]:
+                locs = self._objects.get(oid, set())
+                out[oid] = [
+                    self._nodes[nid].addr
+                    for nid in locs
+                    if nid in self._nodes and self._nodes[nid].alive
+                ]
+            return out
+
     # -- actors ---------------------------------------------------------------
 
     def rpc_register_actor(self, payload, peer):
@@ -329,6 +411,7 @@ class GcsService:
             self._actors[a.actor_id] = a
             if name:
                 self._named[(ns, name)] = a.actor_id
+            self._mark_dirty()
         return {"ok": True}
 
     def rpc_update_actor(self, payload, peer):
@@ -346,6 +429,7 @@ class GcsService:
             self._emit(
                 "actor_update", {"actor_id": a.actor_id, "state": a.state}
             )
+            self._mark_dirty()
         return {"ok": True}
 
     def _actor_info(self, a: ActorEntry) -> dict:
@@ -399,6 +483,7 @@ class GcsService:
             }
             self._pgs[pg["pg_id"]] = pg
             self._try_place_pg(pg)
+            self._mark_dirty()
             return self._pg_info(pg)
 
     def _try_place_pg(self, pg: dict) -> None:
@@ -487,8 +572,17 @@ class GcsService:
         with self._lock:
             pg = self._pgs.pop(payload["pg_id"], None)
             if pg is not None:
+                # restore the authoritative availability view NOW — waiting
+                # for the next heartbeat (0.5s) would serialize PG churn
+                # (create/remove rate) on the heartbeat period
+                for b in pg["bundles"]:
+                    node = self._nodes.get(b.get("node_id"))
+                    if node is not None:
+                        for k, v in b["resources"].items():
+                            node.available[k] = node.available.get(k, 0.0) + v
                 pg["state"] = "REMOVED"
                 self._emit("pg_update", {"pg_id": pg["pg_id"], "state": "REMOVED"})
+            self._mark_dirty()
         return {"ok": True}
 
     def rpc_get_pg(self, payload, peer):
@@ -516,8 +610,12 @@ class GcsServer:
     """GcsService + RpcServer + health sweeper, embeddable or standalone."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 node_death_timeout_s: float = 5.0):
-        self.service = GcsService(node_death_timeout_s=node_death_timeout_s)
+                 node_death_timeout_s: float = 5.0,
+                 persist_path: Optional[str] = None):
+        self.service = GcsService(
+            node_death_timeout_s=node_death_timeout_s,
+            persist_path=persist_path,
+        )
         self.rpc = RpcServer(self.service, host=host, port=port)
         self._sweeper: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -533,6 +631,7 @@ class GcsServer:
                 try:
                     self.service.health_sweep()
                     self.service.restart_sweep(pool)
+                    self.service.persist_if_dirty()
                 except Exception:
                     logger.exception("health sweep failed")
 
@@ -550,8 +649,11 @@ def main() -> None:
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=0)
     p.add_argument("--death-timeout", type=float, default=5.0)
+    p.add_argument("--persist", default=None,
+                   help="snapshot path for GCS fault tolerance")
     args = p.parse_args()
-    server = GcsServer(args.host, args.port, args.death_timeout)
+    server = GcsServer(args.host, args.port, args.death_timeout,
+                       persist_path=args.persist)
     host, port = server.start()
     # parent discovers the bound port from stdout
     print(f"GCS_ADDRESS {host}:{port}", flush=True)

@@ -23,7 +23,9 @@ src/ray/object_manager/object_manager.h:117). Redesigned:
 from __future__ import annotations
 
 import argparse
+import asyncio
 import os
+import queue as queue_mod
 import subprocess
 import sys
 import threading
@@ -31,7 +33,14 @@ import time
 import uuid
 from typing import Any, Optional
 
-from ray_tpu.cluster.rpc import ClientPool, RemoteError, RpcClient, RpcError, RpcServer
+from ray_tpu.cluster.rpc import (
+    ClientPool,
+    ReconnectingRpcClient,
+    RemoteError,
+    RpcClient,
+    RpcError,
+    RpcServer,
+)
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("ray_tpu.cluster.node")
@@ -40,18 +49,64 @@ CHUNK = 4 << 20  # object transfer chunk size
 
 
 class ObjectService:
-    """Node-local object table + chunked cross-node pull."""
+    """Node-local object table: byte-capped LRU memory tier + disk-spill
+    tier + chunked cross-node pull.
 
-    def __init__(self, node_id: str, gcs: RpcClient, pool: ClientPool):
-        self._objects: dict[bytes, bytes] = {}
+    Reference analog: the plasma store's LRU eviction
+    (src/ray/object_manager/plasma/eviction_policy.h:105) combined with
+    the raylet's spill-to-disk path (raylet/local_object_manager.h:41).
+    Objects never silently vanish: over-capacity entries spill to the
+    node's spill dir and reload on access; only `free` deletes."""
+
+    def __init__(self, node_id: str, gcs: RpcClient, pool: ClientPool,
+                 capacity_bytes: int = 512 << 20,
+                 spill_dir: Optional[str] = None):
+        from collections import OrderedDict
+
+        self._objects: "OrderedDict[bytes, bytes]" = OrderedDict()
+        self._bytes = 0
+        self._capacity = capacity_bytes
+        self._spill_dir = spill_dir or os.path.join(
+            os.environ.get("TMPDIR", "/tmp"), f"ray_tpu-spill-{node_id}"
+        )
+        self._spilled: set[bytes] = set()
         self._lock = threading.Lock()
+        self._arrived = threading.Condition(self._lock)  # wakes fetch waiters
         self._node_id = node_id
         self._gcs = gcs
         self._pool = pool
 
+    def _spill_path(self, object_id: bytes) -> str:
+        return os.path.join(self._spill_dir, object_id.hex())
+
+    def _evict_over_capacity_locked(self) -> None:
+        """Spill least-recently-used entries until under the byte cap."""
+        while self._bytes > self._capacity and len(self._objects) > 1:
+            oid, data = self._objects.popitem(last=False)  # LRU end
+            self._bytes -= len(data)
+            try:
+                os.makedirs(self._spill_dir, exist_ok=True)
+                tmp = self._spill_path(oid) + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(data)
+                os.replace(tmp, self._spill_path(oid))
+                self._spilled.add(oid)
+            except OSError:
+                # disk full/unwritable: keep it in memory rather than lose it
+                self._objects[oid] = data
+                self._bytes += len(data)
+                logger.exception("spill failed; keeping %s in memory", oid.hex()[:12])
+                return
+
     def put(self, object_id: bytes, data: bytes) -> None:
         with self._lock:
+            old = self._objects.pop(object_id, None)
+            if old is not None:
+                self._bytes -= len(old)
             self._objects[object_id] = data
+            self._bytes += len(data)
+            self._evict_over_capacity_locked()
+            self._arrived.notify_all()  # unblock fetch() waiters instantly
         self._gcs.call(
             "add_object_location",
             {"object_id": object_id, "node_id": self._node_id},
@@ -59,11 +114,40 @@ class ObjectService:
 
     def get_local(self, object_id: bytes) -> Optional[bytes]:
         with self._lock:
-            return self._objects.get(object_id)
+            data = self._objects.get(object_id)
+            if data is not None:
+                self._objects.move_to_end(object_id)  # MRU
+                return data
+            if object_id in self._spilled:
+                try:
+                    with open(self._spill_path(object_id), "rb") as f:
+                        data = f.read()
+                except OSError:
+                    self._spilled.discard(object_id)
+                    return None
+                # promote back into the memory tier
+                self._objects[object_id] = data
+                self._bytes += len(data)
+                self._spilled.discard(object_id)
+                try:
+                    os.unlink(self._spill_path(object_id))
+                except OSError:
+                    pass
+                self._evict_over_capacity_locked()
+                return data
+        return None
 
     def free(self, object_id: bytes) -> None:
         with self._lock:
-            self._objects.pop(object_id, None)
+            data = self._objects.pop(object_id, None)
+            if data is not None:
+                self._bytes -= len(data)
+            if object_id in self._spilled:
+                self._spilled.discard(object_id)
+                try:
+                    os.unlink(self._spill_path(object_id))
+                except OSError:
+                    pass
         try:
             self._gcs.call(
                 "remove_object_location",
@@ -73,25 +157,58 @@ class ObjectService:
             pass
 
     def fetch(self, object_id: bytes, timeout: float = 30.0) -> Optional[bytes]:
-        """Local hit or remote pull (chunked); caches + registers locally."""
-        data = self.get_local(object_id)
-        if data is not None:
-            return data
+        """Local hit or remote pull; single-object form of fetch_many."""
+        return self.fetch_many([object_id], timeout)[0]
+
+    def fetch_many(self, ids: list, timeout: float = 30.0) -> list:
+        """Batched local-or-remote fetch, the ONE pull implementation.
+
+        Local arrivals (the hot path: a worker's put_return racing the
+        caller's get) wake waiters via condition variable — no 50 ms poll
+        tax on fresh task results. Remote lookups are ONE batched
+        locate_many per rate-limited round, not a per-object GCS call per
+        wakeup (GCS thundering herd)."""
         deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
-            addrs = self._gcs.call("locate_object", {"object_id": object_id})
-            for addr in addrs:
-                if tuple(addr) == self._pool_self_addr:
-                    continue
+        out: dict[bytes, Optional[bytes]] = {oid: None for oid in ids}
+        missing = [oid for oid in dict.fromkeys(ids)]  # dedup, keep order
+        next_remote = 0.0  # first round probes immediately
+        while missing:
+            still = []
+            for oid in missing:
+                data = self.get_local(oid)
+                if data is None:
+                    still.append(oid)
+                else:
+                    out[oid] = data
+            missing = still
+            if not missing or time.monotonic() >= deadline:
+                break
+            if time.monotonic() >= next_remote:
+                next_remote = time.monotonic() + 0.25
                 try:
-                    data = self._pull_from(tuple(addr), object_id)
+                    locs = self._gcs.call(
+                        "locate_many", {"object_ids": missing}, timeout=10
+                    )
                 except (RpcError, RemoteError):
-                    continue
-                if data is not None:
-                    self.put(object_id, data)
-                    return data
-            time.sleep(0.05)
-        return None
+                    locs = {}
+                for oid in list(missing):
+                    for addr in locs.get(oid, ()):
+                        if tuple(addr) == self._pool_self_addr:
+                            continue
+                        try:
+                            data = self._pull_from(tuple(addr), oid)
+                        except (RpcError, RemoteError):
+                            continue
+                        if data is not None:
+                            self.put(oid, data)
+                            out[oid] = data
+                            missing.remove(oid)
+                            break
+            if not missing or time.monotonic() >= deadline:
+                break
+            with self._arrived:
+                self._arrived.wait(timeout=0.05)
+        return [out[oid] for oid in ids]
 
     _pool_self_addr: tuple = ("", 0)  # set by daemon after bind
 
@@ -117,8 +234,10 @@ class ObjectService:
     def stats(self) -> dict:
         with self._lock:
             return {
-                "num_objects": len(self._objects),
-                "bytes": sum(len(v) for v in self._objects.values()),
+                "num_objects": len(self._objects) + len(self._spilled),
+                "bytes": self._bytes,
+                "spilled": len(self._spilled),
+                "capacity": self._capacity,
             }
 
 
@@ -151,6 +270,7 @@ class NodeDaemon:
         labels: Optional[dict] = None,
         worker_env: Optional[dict] = None,
         heartbeat_interval_s: float = 0.5,
+        object_capacity_bytes: int = 512 << 20,
     ):
         self.node_id = node_id or f"node-{uuid.uuid4().hex[:8]}"
         self.gcs_addr = gcs_addr
@@ -169,10 +289,18 @@ class NodeDaemon:
         self._idle_workers: list[WorkerHandle] = []
         self._all_workers: dict[str, WorkerHandle] = {}
         self._wlock = threading.Lock()
+        self._grant_queue: "queue_mod.Queue" = queue_mod.Queue()
+        self._capacity_signal = threading.Event()  # wakes the granter
+        self._num_queued = 0  # granter's current waiter count (approximate)
         self.rpc = RpcServer(self, host=host)
         self.pool = ClientPool()
-        self.gcs = RpcClient(*gcs_addr).connect(retries=20)
-        self.objects = ObjectService(self.node_id, self.gcs, self.pool)
+        # reconnecting: the GCS may restart (FT snapshot) and come back at
+        # the same address; the daemon must ride through the outage
+        self.gcs = ReconnectingRpcClient(*gcs_addr).connect(retries=20)
+        self.objects = ObjectService(
+            self.node_id, self.gcs, self.pool,
+            capacity_bytes=object_capacity_bytes,
+        )
         self._stop = threading.Event()
         self.addr: Optional[tuple] = None
 
@@ -192,6 +320,9 @@ class NodeDaemon:
         )
         t = threading.Thread(target=self._heartbeat_loop, name="node-hb", daemon=True)
         t.start()
+        threading.Thread(
+            target=self._granter_loop, name="node-granter", daemon=True
+        ).start()
         return self.addr
 
     def stop(self) -> None:
@@ -220,6 +351,10 @@ class NodeDaemon:
                     timeout=5,
                 )
                 if not r.get("ok") and r.get("reregister"):
+                    with self.objects._lock:
+                        inventory = list(self.objects._objects.keys()) + list(
+                            self.objects._spilled
+                        )
                     self.gcs.call(
                         "register_node",
                         {
@@ -227,6 +362,9 @@ class NodeDaemon:
                             "addr": self.addr,
                             "resources": self.total,
                             "labels": self.labels,
+                            # a restarted GCS lost its object directory:
+                            # rebuild it from our inventory
+                            "objects": inventory,
                         },
                     )
             except (RpcError, RemoteError):
@@ -257,6 +395,9 @@ class NodeDaemon:
         env.update(self.worker_env)
         env["RAY_TPU_WORKER_ID"] = worker_id
         env["RAY_TPU_NODE_ID"] = self.node_id
+        # the host workers should advertise for cross-host rendezvous
+        # (jax.distributed coordinator election reads this)
+        env["RAY_TPU_NODE_IP"] = self.addr[0]
         proc = subprocess.Popen(
             [
                 sys.executable, "-m", "ray_tpu.cluster.worker_main",
@@ -272,17 +413,45 @@ class NodeDaemon:
             self._all_workers[worker_id] = h
         return h
 
-    def _lease_worker(self) -> WorkerHandle:
+    def _lease_worker(self, block: bool = True) -> Optional[WorkerHandle]:
         with self._wlock:
             while self._idle_workers:
                 w = self._idle_workers.pop()
                 if w.alive():
                     return w
+        if not block:
+            # the single granter thread must never sit in a multi-second
+            # worker spawn (it would stall every other queued lease):
+            # kick an async spawn and let the capacity signal re-trigger
+            self._ensure_spawning()
+            return None
         w = self._spawn_worker()
         if not w.ready.wait(timeout=60):
             w.kill()
             raise RpcError("worker failed to start in 60s")
         return w
+
+    def _ensure_spawning(self) -> None:
+        """At most one background worker spawn in flight."""
+        with self._wlock:
+            if getattr(self, "_spawning", False):
+                return
+            self._spawning = True
+
+        def run():
+            try:
+                w = self._spawn_worker()
+                if w.ready.wait(timeout=60) and w.alive():
+                    with self._wlock:
+                        self._idle_workers.append(w)
+                else:
+                    w.kill()
+            finally:
+                with self._wlock:
+                    self._spawning = False
+                self._notify_capacity()
+
+        threading.Thread(target=run, name="worker-spawn", daemon=True).start()
 
     def rpc_register_worker(self, payload, peer):
         with self._wlock:
@@ -300,11 +469,14 @@ class NodeDaemon:
 
     # -- lease protocol -------------------------------------------------------
 
-    def rpc_request_worker_lease(self, payload, peer):
-        """Grant a local worker or answer with a spillback target.
+    def _try_grant(self, payload, allow_spillback: bool = True,
+                   block_spawn: bool = True) -> Optional[dict]:
+        """One grant attempt. Returns a response dict, or None when the
+        request should QUEUE here (no capacity now, no better node).
 
-        payload: {resources, pg_id?, bundle_index?, exclude?: [node_id]}
-        """
+        allow_spillback=False on queue retries: recomputing spillback
+        candidates means a GCS list_nodes per waiter per wakeup — a
+        thundering herd that serializes the whole cluster on the GCS."""
         res = payload.get("resources", {})
         pg_key = None
         if payload.get("pg_id") is not None:
@@ -318,10 +490,19 @@ class NodeDaemon:
             acquired = self._try_acquire(res)
         if acquired:
             try:
-                w = self._lease_worker()
+                w = self._lease_worker(block=block_spawn)
             except RpcError as e:
-                self._release(res, self._bundles.get(pg_key) if pg_key else None)
+                with self._res_lock:
+                    self._release(
+                        res, self._bundles.get(pg_key) if pg_key else None
+                    )
                 return {"error": str(e)}
+            if w is None:  # spawn in flight; re-queue until it registers
+                with self._res_lock:
+                    self._release(
+                        res, self._bundles.get(pg_key) if pg_key else None
+                    )
+                return None
             lease_id = uuid.uuid4().hex
             self._leases[lease_id] = {
                 "resources": res, "worker": w, "pg_key": pg_key,
@@ -338,13 +519,10 @@ class NodeDaemon:
                     "node_addr": self.addr,
                 }
             }
+        # no local capacity: pg/pinned requests always queue here
+        if pg_key is not None or payload.get("pinned") or not allow_spillback:
+            return None
         # spillback: consult the GCS view for a node that fits
-        if pg_key is not None:
-            return {"retry_after": 0.05}  # bundle is busy; wait for release
-        if payload.get("pinned"):
-            # hard node affinity: the caller can't use a spillback target,
-            # so don't compute one; tell it to back off instead
-            return {"retry_after": 0.2, "node_id": self.node_id}
         exclude = set(payload.get("exclude", ())) | {self.node_id}
         try:
             nodes = self.gcs.call("list_nodes", None, timeout=5)
@@ -372,15 +550,117 @@ class NodeDaemon:
             return {"spillback": pick["addr"],
                     "spillback_node": pick["node_id"],
                     "node_id": self.node_id}
-        return {"retry_after": 0.05, "node_id": self.node_id}
+        return None  # saturated cluster: queue here
+
+    async def rpc_request_worker_lease(self, payload, peer):
+        """Grant a worker, spill back, or QUEUE the request server-side
+        until capacity frees (reference: ClusterTaskManager queues leases,
+        src/ray/raylet/scheduling/cluster_task_manager.h — the round-2
+        50 ms client busy-poll is gone). Queued requests are granted FIFO
+        by ONE granter thread: a broadcast wakeup would retry every
+        waiter on every release (thundering herd).
+        """
+        loop = asyncio.get_running_loop()
+        # fast path only when nobody is queued — otherwise new arrivals
+        # would steal freed capacity from FIFO waiters (starvation)
+        if self._grant_queue.qsize() == 0 and self._num_queued == 0:
+            r = await loop.run_in_executor(None, self._try_grant, payload, True)
+            if r is not None:
+                return r
+        fut = loop.create_future()
+        deadline = time.monotonic() + float(payload.get("queue_timeout", 30.0))
+        self._grant_queue.put((payload, loop, fut, deadline))
+        return await fut
+
+    def _granter_loop(self) -> None:
+        """Server-side lease queue (the ClusterTaskManager role).
+
+        Scans ALL waiters each round in arrival order: a blocked head
+        (e.g. a fixed-bundle request on a busy bundle) must not stall
+        requests for other bundles/resources behind it. Any exception in
+        a grant attempt answers THAT waiter with an error — the granter
+        thread itself must never die (every queued future would hang)."""
+        waiters: list = []  # [payload, loop, fut, deadline, next_spill]
+        while not self._stop.is_set():
+            try:  # drain new arrivals
+                while True:
+                    item = self._grant_queue.get_nowait()
+                    waiters.append(list(item) + [time.monotonic() + 0.5])
+            except queue_mod.Empty:
+                pass
+            if not waiters:
+                try:
+                    item = self._grant_queue.get(timeout=0.5)
+                    waiters.append(list(item) + [time.monotonic() + 0.5])
+                except queue_mod.Empty:
+                    continue
+            progressed = False
+            still: list = []
+            for waiter in waiters:
+                payload, loop, fut, deadline, next_spill = waiter
+                # while queued, periodically re-check the GCS for a node
+                # with free capacity — the local queue must not starve a
+                # task the rest of the cluster could run right now
+                spill = time.monotonic() >= next_spill and not payload.get("pinned")
+                try:
+                    r = self._try_grant(
+                        payload, allow_spillback=spill, block_spawn=False
+                    )
+                except Exception as e:  # noqa: BLE001 - must not kill the granter
+                    logger.exception("lease grant attempt failed")
+                    r = {"error": f"lease grant failed: {e!r}"}
+                if spill:
+                    waiter[4] = time.monotonic() + 1.0
+                if r is None and time.monotonic() >= deadline:
+                    # let the client re-evaluate (capacity may exist under
+                    # a different exclude set by now)
+                    r = {"retry_after": 0.05, "node_id": self.node_id}
+                if r is None:
+                    still.append(waiter)
+                    continue
+                progressed = True
+
+                def _finish(f=fut, rr=r):
+                    if f.cancelled():
+                        # requester vanished after we granted: reclaim the
+                        # lease or it (worker + resources) leaks forever
+                        self._reclaim_grant(rr)
+                        return
+                    f.set_result(rr)
+
+                try:
+                    loop.call_soon_threadsafe(_finish)
+                except RuntimeError:
+                    self._reclaim_grant(r)  # connection's loop is gone
+            waiters = still
+            self._num_queued = len(waiters)
+            if waiters and not progressed:
+                self._capacity_signal.wait(timeout=0.1)
+                self._capacity_signal.clear()
+
+    def _reclaim_grant(self, response: dict) -> None:
+        """Release a lease whose grant could not be delivered."""
+        grant = response.get("grant") if isinstance(response, dict) else None
+        if grant:
+            try:
+                self.rpc_release_lease(
+                    {"lease_id": grant["lease_id"], "kill": False}, None
+                )
+            except Exception:
+                logger.exception("reclaiming undeliverable grant failed")
+
+    def _notify_capacity(self) -> None:
+        """Wake the granter (called from release paths, any thread)."""
+        self._capacity_signal.set()
 
     def rpc_release_lease(self, payload, peer):
         lease = self._leases.pop(payload["lease_id"], None)
         if lease is None:
             return {"ok": False}
-        with self._res_lock:
-            pool = self._bundles.get(lease["pg_key"]) if lease["pg_key"] else None
-            self._release(lease["resources"], pool)
+        # worker back to the idle pool BEFORE freeing resources: the
+        # granter races on freed capacity, and losing this race makes it
+        # spawn a brand-new worker process (seconds) instead of reusing
+        # the one we are returning right now
         w: WorkerHandle = lease["worker"]
         if payload.get("kill") or not w.alive():
             w.kill()
@@ -389,6 +669,10 @@ class NodeDaemon:
         else:
             with self._wlock:
                 self._idle_workers.append(w)
+        with self._res_lock:
+            pool = self._bundles.get(lease["pg_key"]) if lease["pg_key"] else None
+            self._release(lease["resources"], pool)
+        self._notify_capacity()
         return {"ok": True}
 
     # -- placement group bundles ----------------------------------------------
@@ -402,6 +686,7 @@ class NodeDaemon:
             if not self._try_acquire(res):
                 return {"ok": False, "error": "insufficient resources"}
             self._bundles[key] = dict(res)
+        self._notify_capacity()  # pg-queued leases can now be granted
         return {"ok": True}
 
     def rpc_release_pg_bundle(self, payload, peer):
@@ -412,6 +697,7 @@ class NodeDaemon:
                 return {"ok": False}
             # return whatever is still reserved plus whatever tasks gave back
             self._release(pool)
+        self._notify_capacity()
         return {"ok": True}
 
     def rpc_release_pg_all(self, payload, peer):
@@ -419,6 +705,7 @@ class NodeDaemon:
         with self._res_lock:
             for key in [k for k in self._bundles if k[0] == pg_id]:
                 self._release(self._bundles.pop(key))
+        self._notify_capacity()
         return {"ok": True}
 
     # -- object service -------------------------------------------------------
@@ -442,6 +729,14 @@ class NodeDaemon:
         """Blocking local-or-remote fetch (driver/worker `get` path)."""
         return self.objects.fetch(
             payload["object_id"], timeout=payload.get("timeout", 30.0)
+        )
+
+    def rpc_fetch_objects(self, payload, peer):
+        """Batched fetch in ONE handler thread (a wide batch of blocking
+        single fetches would pin one executor thread per ref and starve
+        the daemon's put path — deadlock under load)."""
+        return self.objects.fetch_many(
+            payload["object_ids"], timeout=payload.get("timeout", 30.0)
         )
 
     def rpc_has_object(self, payload, peer):
@@ -475,6 +770,8 @@ def main() -> None:
     p.add_argument("--resources", default="num_cpus=1")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--worker-env", default="", help="k=v,... for worker processes")
+    p.add_argument("--object-capacity", type=int, default=512 << 20,
+                   help="object store memory tier cap in bytes (LRU spills to disk)")
     args = p.parse_args()
     host, port = args.gcs.rsplit(":", 1)
     resources: dict[str, float] = {}
@@ -488,7 +785,8 @@ def main() -> None:
             k, v = kv.split("=", 1)
             worker_env[k] = v
     daemon = NodeDaemon(
-        (host, int(port)), resources, node_id=args.node_id, worker_env=worker_env
+        (host, int(port)), resources, node_id=args.node_id, worker_env=worker_env,
+        object_capacity_bytes=args.object_capacity,
     )
     addr = daemon.start()
     print(f"NODE_ADDRESS {addr[0]}:{addr[1]} {daemon.node_id}", flush=True)

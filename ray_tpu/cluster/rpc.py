@@ -323,6 +323,61 @@ class RpcClient:
             ev.set()
 
 
+class ReconnectingRpcClient:
+    """RpcClient that re-dials on a dead connection — the peer (e.g. a
+    restarted GCS) may come back at the same address (reference: raylets
+    reconnect to a Redis-restored GCS, gcs_redis_failure_detector.cc)."""
+
+    def __init__(self, host: str, port: int, timeout: float = 30.0,
+                 retries: int = 20):
+        self.addr = (host, port)
+        self._timeout = timeout
+        self._retries = retries
+        self._lock = threading.Lock()
+        self._client: Optional[RpcClient] = None
+        self._closed = False
+
+    def _get(self) -> RpcClient:
+        with self._lock:
+            if self._closed:
+                raise RpcError(f"client to {self.addr} closed")
+            c = self._client
+            if c is not None and c.connected:
+                return c
+            c = RpcClient(*self.addr, timeout=self._timeout).connect(
+                retries=self._retries
+            )
+            self._client = c
+            return c
+
+    def connect(self, retries: Optional[int] = None,
+                delay: float = 0.1) -> "ReconnectingRpcClient":
+        if retries is not None:
+            self._retries = retries
+        self._get()
+        return self
+
+    def call(self, method: str, payload: Any = None,
+             timeout: Optional[float] = None) -> Any:
+        c = self._get()
+        try:
+            return c.call(method, payload, timeout)
+        except RpcError:
+            if c.connected:
+                # plain timeout on a live connection: the request may still
+                # execute — resending would make mutations at-least-once
+                raise
+            # dead peer (e.g. restarted GCS): one retry on a fresh dial
+            return self._get().call(method, payload, timeout)
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            if self._client is not None:
+                self._client.close()
+                self._client = None
+
+
 class ClientPool:
     """Cache of RpcClients keyed by address (reference: client pools in
     src/ray/rpc/). Dead clients are evicted and re-dialed on next use."""

@@ -25,8 +25,13 @@ class _ErrorValue:
         self.task_desc = task_desc
 
 
-def dumps_value(value: Any) -> bytes:
-    """Pickle a value, turning embedded cluster refs into persistent ids."""
+def dumps_value(value: Any, collect_refs=None) -> bytes:
+    """Pickle a value, turning embedded cluster refs into persistent ids.
+
+    `collect_refs(object_id)` is called for every embedded ref — the
+    submit path uses it to pin argument objects until the task finishes
+    (a slim slice of the reference's ReferenceCounter "submitted task
+    references", reference_count.h:66)."""
     from ray_tpu.cluster.client import ClusterObjectRef
 
     buf = io.BytesIO()
@@ -34,6 +39,8 @@ def dumps_value(value: Any) -> bytes:
     class _P(cloudpickle.CloudPickler):
         def persistent_id(self, o):
             if isinstance(o, ClusterObjectRef):
+                if collect_refs is not None:
+                    collect_refs(o.id)
                 return ("objref", o.id)
             return None
 

@@ -139,30 +139,37 @@ class WorkerRuntime:
         lock = self._actor_locks.setdefault(actor_id, asyncio.Lock())
         loop = asyncio.get_running_loop()
 
-        def _run():
-            desc = f"{type(actor).__name__}.{payload['method']}"
-            try:
-                method = getattr(actor, payload["method"])
-                args, kwargs = loads_value(payload["args"], self.resolve_ref)
-                result = method(*args, **kwargs)
-                if asyncio.iscoroutine(result):
-                    result = asyncio.run(result)
-                self._store_returns(
-                    payload["return_ids"], result, payload.get("num_returns", 1)
-                )
-                return {"ok": True}
-            except BaseException as e:  # noqa: BLE001
-                tb = traceback.format_exc()
-                err = _ErrorValue(e, tb, desc)
-                for rid in payload["return_ids"]:
-                    try:
-                        self.put_return(rid, err)
-                    except Exception:
-                        pass
-                return {"ok": False, "error": repr(e), "tb": tb}
+        def _invoke():
+            method = getattr(actor, payload["method"])
+            args, kwargs = loads_value(payload["args"], self.resolve_ref)
+            result = method(*args, **kwargs)
+            if asyncio.iscoroutine(result):
+                result = asyncio.run(result)
+            return result
 
-        async with lock:  # FIFO: preserves per-caller submission order
-            return await loop.run_in_executor(None, _run)
+        desc = f"{type(actor).__name__}.{payload['method']}"
+        try:
+            # only METHOD EXECUTION needs the FIFO lock (per-caller order);
+            # storing the result is an independent RPC to the daemon and
+            # serializing it under the lock would cap the actor's call rate
+            # at the store round-trip
+            async with lock:
+                result = await loop.run_in_executor(None, _invoke)
+            await loop.run_in_executor(
+                None,
+                self._store_returns,
+                payload["return_ids"], result, payload.get("num_returns", 1),
+            )
+            return {"ok": True}
+        except BaseException as e:  # noqa: BLE001
+            tb = traceback.format_exc()
+            err = _ErrorValue(e, tb, desc)
+            for rid in payload["return_ids"]:
+                try:
+                    self.put_return(rid, err)
+                except Exception:
+                    pass
+            return {"ok": False, "error": repr(e), "tb": tb}
 
     async def rpc_destroy_actor(self, payload, peer):
         self.actors.pop(payload["actor_id"], None)
@@ -182,8 +189,15 @@ class WorkerRuntime:
         # their rebuild path needs the ambient client already in place
         if self.gcs_addr is not None:
             from ray_tpu.cluster.client import ClusterClient
+            from ray_tpu.core import api
+            from ray_tpu.core.cluster_backend import ClusterBackend
 
-            ClusterClient(self.gcs_addr, self.daemon_addr)
+            client = ClusterClient(self.gcs_addr, self.daemon_addr)
+            client.auto_free = False  # workers borrow; drivers own/free
+            # nested api calls (tasks submitting tasks, actors creating
+            # actors) ride the same cluster, not a private in-process
+            # runtime (reference: workers share the driver's GCS plane)
+            api._CLUSTER[0] = ClusterBackend.from_client(client)
         r = self.daemon.call(
             "register_worker", {"worker_id": self.worker_id, "addr": addr}
         )
@@ -197,12 +211,32 @@ class WorkerRuntime:
                     self.worker_id, addr, self.node_id)
 
 
+def _pin_jax_platform() -> None:
+    """Honor an explicit non-TPU JAX_PLATFORMS before any user code runs.
+
+    Some environments force-register a TPU plugin in every process
+    (sitecustomize); the env var alone does not stop its backend init,
+    and a wedged TPU tunnel then hangs the first jax touch forever.
+    Pinning via jax.config is the only reliable opt-out."""
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want and "tpu" not in want and "axon" not in want:
+        try:
+            import jax
+
+            jax.config.update("jax_platforms", want)
+        except Exception:
+            pass
+
+
 def main() -> None:
     p = argparse.ArgumentParser()
     p.add_argument("--daemon", required=True)
     p.add_argument("--worker-id", required=True)
     p.add_argument("--gcs", default=None)
     args = p.parse_args()
+    _pin_jax_platform()
     host, port = args.daemon.rsplit(":", 1)
     gcs = None
     if args.gcs:

@@ -62,8 +62,17 @@ __all__ = [
 # ---------------------------------------------------------------------------
 
 
+# The cluster backend, when init(address=...) attached this driver to a
+# GCS/node-daemon plane. One runtime per process: either in-process
+# (threads in the host JAX process) or cluster (leases + worker
+# processes), the same split as the reference's local vs address= init
+# (python/ray/_private/worker.py:1285).
+_CLUSTER: list = [None]
+
+
 def init(
     *,
+    address: Optional[str] = None,
     num_cpus: Optional[float] = None,
     num_tpus: Optional[float] = None,
     resources: Optional[dict] = None,
@@ -71,7 +80,24 @@ def init(
     namespace: str = "default",
     ignore_reinit_error: bool = False,
 ):
-    """Start the per-process runtime (head of a single-node cluster)."""
+    """Start the per-process runtime, or attach to a running cluster.
+
+    With no `address`, boots the in-process runtime (single-node fast
+    path). With `address="host:port"` (a GCS address), attaches this
+    driver to that cluster: tasks/actors become leases on node daemons,
+    executed in worker processes cluster-wide.
+    """
+    if address is not None:
+        if _CLUSTER[0] is not None:
+            if ignore_reinit_error:
+                return _CLUSTER[0]
+            raise RuntimeError(
+                "ray_tpu.init(address=...) called twice; pass ignore_reinit_error=True"
+            )
+        from ray_tpu.core.cluster_backend import ClusterBackend
+
+        _CLUSTER[0] = ClusterBackend(address, namespace=namespace)
+        return _CLUSTER[0]
     if rt.is_initialized():
         if ignore_reinit_error:
             return rt.get_runtime()
@@ -86,15 +112,23 @@ def init(
 
 
 def shutdown() -> None:
+    if _CLUSTER[0] is not None:
+        _CLUSTER[0].close()
+        _CLUSTER[0] = None
     rt.shutdown_runtime()
 
 
 def is_initialized() -> bool:
-    return rt.is_initialized()
+    return _CLUSTER[0] is not None or rt.is_initialized()
 
 
 def _auto_init() -> rt.Runtime:
     return rt.get_runtime()
+
+
+def _cluster():
+    """The attached ClusterBackend, or None (in-process mode)."""
+    return _CLUSTER[0]
 
 
 # ---------------------------------------------------------------------------
@@ -154,6 +188,10 @@ class RemoteFunction:
         functools.update_wrapper(self, func)
 
     def remote(self, *args, **kwargs):
+        backend = _cluster()
+        if backend is not None:
+            out = backend.submit_task(self._func, args, kwargs, self._options)
+            return out[0] if self._options.num_returns == 1 else out
         runtime = _auto_init()
         out = runtime.submit_task(self._func, args, kwargs, self._options)
         if isinstance(out, ObjectRefGenerator):
@@ -327,6 +365,9 @@ class ActorClass:
         functools.update_wrapper(self, cls, updated=[])
 
     def remote(self, *args, **kwargs) -> ActorHandle:
+        backend = _cluster()
+        if backend is not None:
+            return backend.create_actor(self._cls, args, kwargs, self._options)
         runtime = _auto_init()
         opts = self._options
         if opts.name:
@@ -412,10 +453,16 @@ def remote(*args, **kwargs):
 
 
 def put(value: Any) -> ObjectRef:
+    backend = _cluster()
+    if backend is not None:
+        return backend.put(value)
     return _auto_init().put(value)
 
 
 def get(refs, timeout: Optional[float] = None):
+    backend = _cluster()
+    if backend is not None and not isinstance(refs, ObjectRef):
+        return backend.get(refs, timeout=timeout)
     runtime = _auto_init()
     if isinstance(refs, ObjectRef):
         return runtime.get([refs], timeout)[0]
@@ -429,14 +476,43 @@ def wait(
     timeout: Optional[float] = None,
     fetch_local: bool = True,
 ):
+    if not refs:
+        return [], []
+    backend = _cluster()
+    if backend is not None and not isinstance(refs[0], ObjectRef):
+        return backend.wait(list(refs), num_returns, timeout)
     return _auto_init().wait(list(refs), num_returns, timeout)
 
 
-def kill(handle: ActorHandle, *, no_restart: bool = True) -> None:
-    handle._actor.kill(no_restart=no_restart)
+def free(refs) -> None:
+    """Explicitly delete objects from the store(s) (reference:
+    ray._private.internal_api.free). Useful for fire-and-forget acks in
+    long-running loops — especially from worker processes, which borrow
+    rather than own and so never auto-free."""
+    if not isinstance(refs, (list, tuple)):
+        refs = [refs]
+    backend = _cluster()
+    if backend is not None and refs and not isinstance(refs[0], ObjectRef):
+        backend.client.free(list(refs))
+        return
+    runtime = _auto_init()
+    for r in refs:
+        # drop the producer's primary reference; the entry frees when the
+        # remaining handle refs release
+        runtime.object_store.remove_ref(r.id)
 
 
-def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
+def kill(handle, *, no_restart: bool = True) -> None:
+    if hasattr(handle, "_actor"):  # in-process handle
+        handle._actor.kill(no_restart=no_restart)
+    else:  # ClusterActorHandle
+        handle.kill()
+
+
+def get_actor(name: str, namespace: Optional[str] = None):
+    backend = _cluster()
+    if backend is not None:
+        return backend.get_named_actor(name, namespace)
     runtime = _auto_init()
     actor = runtime.gcs.get_named_actor(name, namespace or runtime.namespace)
     if actor is None or actor.state == ActorState.DEAD:
@@ -446,10 +522,16 @@ def get_actor(name: str, namespace: Optional[str] = None) -> ActorHandle:
 
 
 def cluster_resources() -> dict:
+    backend = _cluster()
+    if backend is not None:
+        return backend.cluster_resources()
     return _auto_init().gcs.cluster_resources()
 
 
 def available_resources() -> dict:
+    backend = _cluster()
+    if backend is not None:
+        return backend.available_resources()
     return _auto_init().gcs.available_resources()
 
 
@@ -463,13 +545,20 @@ def placement_group(
     strategy: str = "PACK",
     name: str = "",
 ) -> PlacementGroup:
+    backend = _cluster()
+    if backend is not None:
+        return backend.placement_group(bundles, strategy, name)
     runtime = _auto_init()
     pg = create_placement_group(runtime, bundles, strategy, name)
     runtime.gcs.register_placement_group(pg)
     return pg
 
 
-def remove_placement_group(pg: PlacementGroup) -> None:
+def remove_placement_group(pg) -> None:
+    backend = _cluster()
+    if backend is not None and not isinstance(pg, PlacementGroup):
+        backend.remove_placement_group(pg)
+        return
     runtime = _auto_init()
     pg.remove()
     runtime.gcs.remove_placement_group(pg.id)

@@ -1,0 +1,105 @@
+"""Multi-host SPMD bootstrap: jax.distributed over the cluster plane.
+
+Reference analog: Ray Train's per-worker process-group bootstrap — the
+backend hook sets MASTER_ADDR/PORT from worker 0 and calls
+torch.distributed.init_process_group inside every worker
+(/python/ray/train/torch/config.py:115,153-173); on TPU pods the
+coordinator is elected via the `TPU-{pod}-head` resource
+(/python/ray/_private/accelerators/tpu.py:330-393). TPU-native
+redesign: the "process group" is `jax.distributed.initialize` — after
+it, every process sees the GLOBAL device fleet and XLA collectives run
+over ICI/DCN with no NCCL analog to wrap. The CPU fallback backend
+(tests, laptops) is the same call with gloo cross-process collectives
+and a virtual per-process device fleet.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from dataclasses import dataclass
+from typing import Optional
+
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.parallel.distributed")
+
+
+@dataclass
+class JaxDistributedConfig:
+    """Backend config for a distributed gang (the TorchConfig analog).
+
+    enabled: run jax.distributed.initialize in every worker before the
+        user loop; jax.devices() then spans the whole gang.
+    platform: pin a platform first ("cpu" for the test backend; None
+        keeps the ambient TPU platform).
+    local_device_count: for platform="cpu", fake this many devices per
+        process (XLA_FLAGS --xla_force_host_platform_device_count).
+    coordinator_port: fixed port for worker 0's coordinator (default:
+        picked free at bootstrap time).
+    """
+
+    enabled: bool = True
+    platform: Optional[str] = None
+    local_device_count: Optional[int] = None
+    coordinator_port: Optional[int] = None
+
+
+def reserve_coordinator_address(
+    host: Optional[str] = None, port: Optional[int] = None
+) -> str:
+    """Pick `host:port` for the jax.distributed coordinator (run on the
+    rank-0 worker; the port is free at reservation time)."""
+    if host is None:
+        host = os.environ.get("RAY_TPU_NODE_IP", "127.0.0.1")
+    if port is None:
+        s = socket.socket()
+        s.bind((host, 0))
+        port = s.getsockname()[1]
+        s.close()
+    return f"{host}:{port}"
+
+
+def initialize_gang_member(
+    coordinator_address: str,
+    num_processes: int,
+    process_id: int,
+    config: Optional[JaxDistributedConfig] = None,
+) -> None:
+    """Run the jax.distributed bootstrap in this process (gang member).
+
+    Must run before the first backend touch (jax.devices/jit). After it,
+    `jax.devices()` is the global fleet and jitted collectives cross
+    process boundaries (ICI on TPU slices, gloo on the CPU test backend).
+    """
+    config = config or JaxDistributedConfig()
+    if config.platform == "cpu" and config.local_device_count:
+        import re
+
+        # replace (not just append): the controller's env may already pin a
+        # different virtual device count and children inherit it
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+",
+            "",
+            os.environ.get("XLA_FLAGS", ""),
+        ).strip()
+        want = f"--xla_force_host_platform_device_count={config.local_device_count}"
+        os.environ["XLA_FLAGS"] = f"{flags} {want}".strip()
+
+    import jax
+
+    if config.platform:
+        jax.config.update("jax_platforms", config.platform)
+        if config.platform == "cpu":
+            # cross-process collectives on the CPU backend ride gloo
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    logger.info(
+        "gang member %d/%d up: %d global / %d local devices",
+        process_id, num_processes,
+        len(jax.devices()), len(jax.local_devices()),
+    )

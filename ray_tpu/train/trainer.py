@@ -15,11 +15,7 @@ step.
 
 from __future__ import annotations
 
-import queue
-import threading
-import time
-import traceback
-from typing import Any, Callable, Optional
+from typing import Callable, Optional
 
 from ray_tpu.core import api, errors
 from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager
@@ -31,18 +27,93 @@ from ray_tpu.utils.logging import get_logger
 logger = get_logger("ray_tpu.train")
 
 
+@api.remote(num_cpus=0)
+class _ReportChannel:
+    """Controller<->gang mailbox. An ACTOR (not a shared Queue/Event) so
+    the same trainer drives thread workers in-process AND cluster worker
+    processes (reference: session.report travels worker->controller as
+    an actor round-trip, train/_internal/session.py:405)."""
+
+    def __init__(self):
+        self._reports: list = []
+        self._base = 0  # global index of _reports[0]
+        self._stop = False
+
+    def put(self, rep: dict) -> bool:
+        self._reports.append(rep)
+        return self._stop  # piggyback the stop flag on the report ack
+
+    def drain(self, cursor: int = 0) -> list:
+        # cursor = number of reports the controller has consumed. Reports
+        # at/above the cursor are returned (NOT popped — a timed-out get
+        # retries without losing checkpoints); reports below it are acked
+        # and pruned so a long run can't grow the channel unboundedly.
+        acked = max(0, min(cursor - self._base, len(self._reports)))
+        if acked:
+            del self._reports[:acked]
+            self._base += acked
+        return self._reports[max(0, cursor - self._base):]
+
+    def stop(self) -> bool:
+        self._stop = True
+        return True
+
+
+class _QueueProxy:
+    """Worker-side file of the channel: duck-types queue.put for the
+    session; remembers the stop flag the controller piggybacks back."""
+
+    def __init__(self, channel):
+        self._channel = channel
+        self._stopped = False
+
+    def put(self, rep: dict) -> None:
+        ref = self._channel.put.remote(rep)
+        self._stopped = bool(api.get(ref))
+        try:
+            # worker processes BORROW refs (no auto-free); without this a
+            # long run leaks one stored ack object per report
+            api.free(ref)
+        except Exception:
+            pass
+
+    def is_set(self) -> bool:  # also serves as the stop_event
+        return self._stopped
+
+
 @api.remote
 class _TrainWorker:
     """One gang member (1 per host). Runs the user loop under a session."""
 
-    def __init__(self, rank: int, world_size: int, trial_dir: str, report_queue, stop_event):
+    def __init__(self, rank: int, world_size: int, trial_dir: str, channel):
+        proxy = _QueueProxy(channel)
         self.ctx = session_mod.TrainContext(
             world_rank=rank,
             world_size=world_size,
             trial_dir=trial_dir,
-            report_queue=report_queue,
-            stop_event=stop_event,
+            report_queue=proxy,
+            stop_event=proxy,
         )
+
+    def reserve_coordinator(self, port=None) -> str:
+        """Rank 0 only: pick the jax.distributed coordinator address on
+        THIS host (the MASTER_ADDR election of train/torch/config.py:153,
+        done via the gang's own worker 0 instead of an env var)."""
+        from ray_tpu.parallel.distributed import reserve_coordinator_address
+
+        return reserve_coordinator_address(port=port)
+
+    def setup_distributed(self, coordinator: str, num_processes: int,
+                          process_id: int, config) -> bool:
+        """Run the jax.distributed bootstrap in this worker process.
+
+        Must happen before the user loop touches a backend; afterwards
+        jax.devices() spans the whole gang (reference analog:
+        _TorchBackend.on_start, train/torch/config.py:115)."""
+        from ray_tpu.parallel.distributed import initialize_gang_member
+
+        initialize_gang_member(coordinator, num_processes, process_id, config)
+        return True
 
     def set_resume_checkpoint(self, ckpt) -> bool:
         self.ctx.latest_checkpoint = ckpt
@@ -90,12 +161,14 @@ class JaxTrainer:
         scaling_config: Optional[ScalingConfig] = None,
         run_config: Optional[RunConfig] = None,
         datasets: Optional[dict] = None,
+        backend_config=None,  # JaxDistributedConfig for multi-host SPMD
     ):
         self._fn = train_loop_per_worker
         self._config = train_loop_config or {}
         self._scaling = scaling_config or ScalingConfig()
         self._run_config = run_config or RunConfig()
         self._datasets = datasets or {}
+        self._backend_config = backend_config
 
     # -- controller ----------------------------------------------------------
 
@@ -147,45 +220,62 @@ class JaxTrainer:
 
     def _run_attempt(self, trial_dir, manager, resume_ckpt, history, last_metrics):
         n = self._scaling.num_workers
-        report_queue: queue.Queue = queue.Queue()
-        stop_event = threading.Event()
+        channel = None
+        cursor = [0]
 
         def drain():
+            if channel is None:
+                return
             try:
-                while True:
-                    rep = report_queue.get_nowait()
-                    if rep["rank"] == 0:
-                        history.append(rep["metrics"])
-                        last_metrics.clear()
-                        last_metrics.update(rep["metrics"])
-                        if rep["checkpoint"] is not None:
-                            manager.register(rep["checkpoint"], rep["metrics"])
-            except queue.Empty:
-                pass
+                reports = api.get(channel.drain.remote(cursor[0]), timeout=30)
+            except Exception:
+                return  # cursor unchanged: nothing lost, retried next drain
+            cursor[0] += len(reports)
+            for rep in reports:
+                if rep["rank"] == 0:
+                    history.append(rep["metrics"])
+                    last_metrics.clear()
+                    last_metrics.update(rep["metrics"])
+                    if rep["checkpoint"] is not None:
+                        manager.register(rep["checkpoint"], rep["metrics"])
+
+        bc = self._backend_config
+        if (
+            bc is not None
+            and getattr(bc, "enabled", False)
+            and n > 1
+            and api._cluster() is None
+        ):
+            raise errors.RayTpuError(
+                "JaxDistributedConfig needs process-isolated workers: "
+                "jax.distributed.initialize can run once per process, but the "
+                "in-process runtime gangs workers as threads. Attach to a "
+                "cluster first: ray_tpu.init(address=...)"
+            )
 
         pg = None
-        worker_opts: dict = {"num_cpus": 0}
-        if self._scaling.pod_type:
-            from ray_tpu.core.accelerators import parse_pod_type, slice_placement_group
-
-            topo = parse_pod_type(self._scaling.pod_type)
-            pg = slice_placement_group(self._scaling.pod_type)
-            if not pg.ready(timeout=120):
-                raise errors.PlacementGroupUnavailableError(
-                    f"slice {self._scaling.pod_type} unavailable"
-                )
-            n = topo.num_hosts
-        else:
-            res = self._scaling.worker_resources()
-            bundles = [dict(res) for _ in range(n)]
-            pg = api.placement_group(
-                bundles, strategy=self._scaling.placement_strategy, name="train-gang"
-            )
-            pg.ready(timeout=120)
-
         workers = []
         splitters = []
         try:
+            if self._scaling.pod_type:
+                from ray_tpu.core.accelerators import parse_pod_type, slice_placement_group
+
+                topo = parse_pod_type(self._scaling.pod_type)
+                pg = slice_placement_group(self._scaling.pod_type)
+                if not pg.ready(timeout=120):
+                    raise errors.PlacementGroupUnavailableError(
+                        f"slice {self._scaling.pod_type} unavailable"
+                    )
+                n = topo.num_hosts
+            else:
+                res = self._scaling.worker_resources()
+                bundles = [dict(res) for _ in range(n)]
+                pg = api.placement_group(
+                    bundles, strategy=self._scaling.placement_strategy, name="train-gang"
+                )
+                pg.ready(timeout=120)
+
+            channel = _ReportChannel.remote()
             for rank in range(n):
                 strategy = api.PlacementGroupSchedulingStrategy(pg, rank)
                 res = self._scaling.worker_resources()
@@ -195,7 +285,23 @@ class JaxTrainer:
                         num_tpus=res.get("TPU", 0.0),
                         resources={k: v for k, v in res.items() if k not in ("CPU", "TPU")},
                         scheduling_strategy=strategy,
-                    ).remote(rank, n, trial_dir, report_queue, stop_event)
+                    ).remote(rank, n, trial_dir, channel)
+                )
+            if bc is not None and getattr(bc, "enabled", False):
+                # gang-wide SPMD bootstrap: rank 0 elects the coordinator,
+                # every member runs jax.distributed.initialize
+                coordinator = api.get(
+                    workers[0].reserve_coordinator.remote(
+                        getattr(bc, "coordinator_port", None)
+                    ),
+                    timeout=60,
+                )
+                api.get(
+                    [
+                        w.setup_distributed.remote(coordinator, n, rank, bc)
+                        for rank, w in enumerate(workers)
+                    ],
+                    timeout=300,
                 )
             if resume_ckpt is not None:
                 api.get([w.set_resume_checkpoint.remote(resume_ckpt) for w in workers])
@@ -229,7 +335,11 @@ class JaxTrainer:
             drain()
             return "ok", None
         except BaseException as e:  # noqa: BLE001
-            stop_event.set()
+            if channel is not None:
+                try:
+                    api.get(channel.stop.remote(), timeout=10)
+                except Exception:
+                    pass
             drain()  # keep reports/checkpoints that landed before the failure
             return "failed", e
         finally:
@@ -240,5 +350,13 @@ class JaxTrainer:
                     api.kill(w)
                 except Exception:
                     pass
+            if channel is not None:
+                try:
+                    api.kill(channel)
+                except Exception:
+                    pass
             if pg is not None:
-                api.remove_placement_group(pg)
+                try:
+                    api.remove_placement_group(pg)
+                except Exception:
+                    pass

@@ -1,0 +1,123 @@
+"""Multi-host SPMD bootstrap proof: a JaxTrainer gang of 2 cluster worker
+PROCESSES runs jax.distributed.initialize (coordinator elected on rank 0),
+forms ONE global 8-device fleet (2 procs x 4 virtual CPU devices), and
+trains LLAMA_TINY data-parallel with gloo cross-process collectives —
+the loss matches a single-process run of the same batch.
+
+Reference analog: torch.distributed.init_process_group seeded across Ray
+Train workers (/python/ray/train/torch/config.py:115,153-173); here the
+process group IS jax.distributed + XLA collectives.
+"""
+
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+from ray_tpu.cluster import LocalCluster
+from ray_tpu.core import api
+from ray_tpu.train import JaxTrainer, RunConfig, ScalingConfig, session
+from ray_tpu.parallel.distributed import JaxDistributedConfig
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+B, S = 8, 32
+SEED = 0
+
+
+def _make_batch(vocab):
+    rng = np.random.RandomState(SEED)
+    tokens = rng.randint(0, vocab, size=(B, S + 1)).astype(np.int32)
+    return {"tokens": tokens[:, :-1], "targets": tokens[:, 1:]}
+
+
+def _ddp_loop(config):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    from ray_tpu.models import llama
+    from ray_tpu.train.step import TrainState, make_train_step
+
+    cfg = llama.LLAMA_TINY
+    devs = jax.devices()
+    assert len(devs) == 8, f"global fleet should be 8 devices, got {len(devs)}"
+    assert len(jax.local_devices()) == 4
+    mesh = Mesh(np.array(devs), ("dp",))
+
+    batch = _make_batch(cfg.vocab_size)
+    rank = jax.process_index()
+    per = B // jax.process_count()
+    local = {k: v[rank * per : (rank + 1) * per] for k, v in batch.items()}
+    bshard = NamedSharding(mesh, P("dp"))
+    gbatch = {
+        k: jax.make_array_from_process_local_data(bshard, v)
+        for k, v in local.items()
+    }
+
+    params = llama.init_params(cfg, jax.random.key(SEED))
+    opt = optax.adamw(1e-2)
+    state = TrainState.create(params, opt)
+    step = make_train_step(lambda p, b: llama.loss_fn(p, b, cfg), opt)
+
+    losses = []
+    for _ in range(config["steps"]):
+        state, metrics = step(state, gbatch)
+        losses.append(float(metrics["loss"]))
+    session.report({"losses": losses, "world": jax.process_count()})
+
+
+@pytest.mark.slow
+def test_two_process_gang_matches_single_process():
+    with LocalCluster(node_death_timeout_s=2.0) as c:
+        c.start()
+        c.add_node({"num_cpus": 1}, node_id="h0")
+        c.add_node({"num_cpus": 1}, node_id="h1")
+        c.wait_for_nodes(2)
+        api.init(address=c.address)
+        try:
+            trainer = JaxTrainer(
+                _ddp_loop,
+                train_loop_config={"steps": 3},
+                scaling_config=ScalingConfig(
+                    num_workers=2,
+                    resources_per_worker={"CPU": 1},
+                    placement_strategy="STRICT_SPREAD",
+                ),
+                run_config=RunConfig(storage_path="/tmp/ddp-gang", name="g"),
+                backend_config=JaxDistributedConfig(
+                    enabled=True, platform="cpu", local_device_count=4
+                ),
+            )
+            result = trainer.fit()
+            assert result.error is None, result.error
+            dist_losses = result.metrics["losses"]
+            assert result.metrics["world"] == 2
+        finally:
+            api.shutdown()
+
+    # single-process reference on the same batch/params
+    import jax
+    import optax
+
+    from ray_tpu.models import llama
+    from ray_tpu.train.step import TrainState, make_train_step
+
+    cfg = llama.LLAMA_TINY
+    batch = _make_batch(cfg.vocab_size)
+    params = llama.init_params(cfg, jax.random.key(SEED))
+    state = TrainState.create(params, optax.adamw(1e-2))
+    step = make_train_step(lambda p, b: llama.loss_fn(p, b, cfg), optax.adamw(1e-2))
+    ref_losses = []
+    for _ in range(3):
+        state, metrics = step(state, batch)
+        ref_losses.append(float(metrics["loss"]))
+
+    # same math, different process layout: losses agree to float tolerance
+    assert dist_losses == pytest.approx(ref_losses, abs=5e-3), (
+        dist_losses, ref_losses,
+    )
+    # and it actually trained
+    assert dist_losses[-1] < dist_losses[0]

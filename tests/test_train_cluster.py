@@ -1,0 +1,123 @@
+"""JaxTrainer driving a gang of CLUSTER workers: real OS processes on
+two node daemons, reports/checkpoints flowing back over the actor
+channel — the runtime-unification proof (reference: Train's WorkerGroup
+creates Ray actors on the shared cluster plane,
+python/ray/train/_internal/worker_group.py:102)."""
+
+import os
+import sys
+
+import cloudpickle
+import pytest
+
+from ray_tpu.cluster import LocalCluster
+from ray_tpu.core import api
+from ray_tpu.train import JaxTrainer, ScalingConfig, RunConfig, session
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def attached_cluster():
+    c = LocalCluster(node_death_timeout_s=2.0)
+    c.start()
+    c.add_node({"num_cpus": 1}, node_id="t0")
+    c.add_node({"num_cpus": 1}, node_id="t1")
+    c.wait_for_nodes(2)
+    api.init(address=c.address)
+    yield c
+    api.shutdown()
+    c.shutdown()
+
+
+def _loop(config):
+    # a tiny jax regression fit: y = 3x, SGD on w
+    import jax
+    import jax.numpy as jnp
+
+    rank = session.get_world_rank()
+    world = session.get_world_size()
+    x = jnp.arange(8.0) + rank
+    y = 3.0 * x
+    w = jnp.zeros(())
+
+    @jax.jit
+    def step(w):
+        grad = jax.grad(lambda w: jnp.mean((w * x - y) ** 2))(w)
+        return w - 0.01 * grad
+
+    for i in range(config["steps"]):
+        w = step(w)
+        loss = float(jnp.mean((w * x - y) ** 2))
+        session.report(
+            {
+                "step": i,
+                "loss": loss,
+                "rank": rank,
+                "world": world,
+                "node": os.environ.get("RAY_TPU_NODE_ID"),
+                "pid": os.getpid(),
+            }
+        )
+
+
+def test_train_gang_runs_as_processes_on_two_nodes(attached_cluster, tmp_path):
+    trainer = JaxTrainer(
+        _loop,
+        train_loop_config={"steps": 5},
+        scaling_config=ScalingConfig(
+            num_workers=2, resources_per_worker={"CPU": 1},
+            placement_strategy="STRICT_SPREAD",
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path), name="cluster-gang"),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["step"] == 4
+    assert result.metrics["world"] == 2
+    # rank 0's final report came from a worker process, not this driver
+    assert result.metrics["pid"] != os.getpid()
+    assert result.metrics["node"] in ("t0", "t1")
+    # losses decreased (the loop actually trained)
+    losses = [m["loss"] for m in result.metrics_history]
+    assert losses[-1] < losses[0]
+
+
+@api.remote(num_cpus=0)
+class _NodeCollector:
+    def __init__(self):
+        self.nodes = {}
+
+    def record(self, rank, node):
+        self.nodes[rank] = node
+        return True
+
+    def all(self):
+        return dict(self.nodes)
+
+
+def test_train_gang_spreads_across_nodes(attached_cluster, tmp_path):
+    collector = _NodeCollector.options(name="node-collector").remote()
+
+    def loop(config):
+        import os as _os
+
+        c = api.get_actor("node-collector")
+        api.get(c.record.remote(
+            session.get_world_rank(), _os.environ.get("RAY_TPU_NODE_ID")
+        ))
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(
+            num_workers=2, resources_per_worker={"CPU": 1},
+            placement_strategy="STRICT_SPREAD",
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path), name="spread-gang"),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    nodes = api.get(collector.all.remote())
+    assert set(nodes.keys()) == {0, 1}
+    assert set(nodes.values()) == {"t0", "t1"}  # STRICT_SPREAD: one per node
+    api.kill(collector)
