@@ -53,6 +53,12 @@ class EngineConfig:
     cache_dtype: Any = None          # default: model dtype
     enable_prefix_caching: bool = True
     eos_token_id: int = 2
+    # tensor-parallel serving: a MeshSpec (e.g. MeshSpec(tp=2)) shards
+    # weights Megatron-style and the paged KV cache across its kv-head
+    # dim; XLA inserts the TP collectives (reference: vLLM TP degree ->
+    # placement group, vllm_models.py:117-131 — here it's one SPMD
+    # program over the mesh, no worker gang)
+    mesh_spec: Any = None
 
     def __post_init__(self):
         # a prefill bucket longer than the context window can never be
@@ -135,6 +141,29 @@ class LLMEngine:
         self.cache = init_cache(
             c.model, c.num_blocks * c.block_size, dtype=c.cache_dtype
         )
+        self.mesh = None
+        if c.mesh_spec is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ray_tpu.parallel.mesh import make_mesh
+            from ray_tpu.parallel.sharding import default_rules, tree_shardings
+
+            self.mesh = make_mesh(c.mesh_spec)
+            tp = self.mesh.shape["tp"]
+            if c.model.n_kv_heads % max(tp, 1) != 0:
+                raise ValueError(
+                    f"n_kv_heads={c.model.n_kv_heads} not divisible by tp={tp}"
+                )
+            rules = default_rules()
+            self.params = jax.device_put(
+                self.params,
+                tree_shardings(self.mesh, rules, llama.logical_axes(c.model)),
+            )
+            # cache [L, slots, kv_heads, hd]: heads across tp
+            kv_sharding = NamedSharding(self.mesh, P(None, None, "tp", None))
+            self.cache = jax.tree.map(
+                lambda x: jax.device_put(x, kv_sharding), self.cache
+            )
         self.waiting: deque[Request] = deque()
         self.running: list[Request] = []
         self.requests: dict[str, Request] = {}  # unfinished only

@@ -216,7 +216,33 @@ def forward(
     if c.remat:
         block = jax.checkpoint(block)
 
-    h, _ = jax.lax.scan(lambda carry, lp: (block(carry, lp), None), h, params["layers"])
+    from ray_tpu.parallel.context import current_mesh
+
+    mesh = current_mesh()
+    pp = mesh.shape.get("pp", 1) if mesh is not None else 1
+    if pp > 1:
+        # pipeline the layer stack over the mesh `pp` axis (GPipe
+        # microbatch schedule inside this jitted program — see
+        # parallel/pipeline.py; reference PP is external vLLM stage
+        # actors, vllm_models.py:121)
+        if segment_ids is not None:
+            raise NotImplementedError("segment packing + pipeline parallelism")
+        if positions.ndim > 1:
+            # per-batch positions would need microbatching alongside h
+            raise NotImplementedError("batched positions + pipeline parallelism")
+        from ray_tpu.parallel.pipeline import pipeline_apply, stack_stages
+
+        def stage(stage_params, x):
+            out, _ = jax.lax.scan(
+                lambda carry, lp: (block(carry, lp), None), x, stage_params
+            )
+            return out
+
+        h = pipeline_apply(
+            mesh, stage, stack_stages(params["layers"], pp), h, n_micro=pp
+        )
+    else:
+        h, _ = jax.lax.scan(lambda carry, lp: (block(carry, lp), None), h, params["layers"])
 
     h = rms_norm(h, params["final_norm"], c.rms_eps)
     w_out = params.get("lm_head", None)
