@@ -128,11 +128,16 @@ def run_bench():
     warm_loss = float(metrics["loss"])
 
     # -- timed runs: two iteration counts to cross-check linearity ------------
-    state, losses_a, dt_a = timed_steps(step, state, batch, iters)
-    state, losses_b, dt_b = timed_steps(step, state, batch, 3 * iters)
-    per_step_a = dt_a / iters
-    per_step_b = dt_b / (3 * iters)
-    if not (0.75 <= per_step_b / per_step_a <= 1.33):
+    # one retry: a transient CPU-contention spike (another process on the
+    # core) shows up as nonlinear timing; a real not-executing bug repeats
+    for attempt in range(2):
+        state, losses_a, dt_a = timed_steps(step, state, batch, iters)
+        state, losses_b, dt_b = timed_steps(step, state, batch, 3 * iters)
+        per_step_a = dt_a / iters
+        per_step_b = dt_b / (3 * iters)
+        if 0.75 <= per_step_b / per_step_a <= 1.33:
+            break
+    else:
         fail(
             f"timing not linear in iteration count: {per_step_a*1e3:.3f} ms/step "
             f"over {iters} iters vs {per_step_b*1e3:.3f} ms/step over {3*iters} — "

@@ -1,0 +1,155 @@
+"""Cluster-plane microbenchmarks: the multi-process runtime measured
+against the reference's published numbers (BASELINE.md,
+release/perf_metrics/microbenchmark.json).
+
+Run: python benchmarks/cluster_bench.py [--quick] [--out PERF.json]
+Prints one JSON object {metric: {value, unit, baseline, vs_baseline}}.
+
+Measured on a LocalCluster (real GCS + node-daemon + worker processes on
+one host) — the closest analog of the reference's single-node m4.16xlarge
+microbenchmark setup.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BASELINES = {  # BASELINE.md "Core microbenchmarks" (reference, m4.16xlarge)
+    "cluster_single_client_tasks_async": 7785,
+    "cluster_1_1_actor_calls_async": 8588,
+    "cluster_single_client_put_calls": 4901,
+    "cluster_single_client_get_calls": 10975,
+    "cluster_placement_group_create_removal": 741,
+}
+
+
+def _noop():
+    return None
+
+
+class _Counter:
+    def __init__(self):
+        self.n = 0
+
+    def incr(self):
+        self.n += 1
+        return self.n
+
+
+def bench_tasks_async(client, total: int, wave: int) -> float:
+    t0 = time.perf_counter()
+    done = 0
+    while done < total:
+        k = min(wave, total - done)
+        refs = [client.submit(_noop, resources={"num_cpus": 1}) for _ in range(k)]
+        client.get(refs, timeout=120)
+        done += k
+    return total / (time.perf_counter() - t0)
+
+
+def bench_actor_calls(client, total: int, wave: int) -> float:
+    h = client.create_actor(_Counter, ())
+    client.get(h.incr.remote(), timeout=60)  # warm
+    t0 = time.perf_counter()
+    done = 0
+    while done < total:
+        k = min(wave, total - done)
+        refs = [h.incr.remote() for _ in range(k)]
+        client.get(refs, timeout=120)
+        done += k
+    rate = total / (time.perf_counter() - t0)
+    h.kill()
+    return rate
+
+
+def bench_puts(client, total: int) -> float:
+    payload = b"x" * 1024
+    t0 = time.perf_counter()
+    refs = [client.put(payload) for _ in range(total)]
+    rate = total / (time.perf_counter() - t0)
+    del refs
+    return rate
+
+
+def bench_gets(client, total: int) -> float:
+    ref = client.put(b"y" * 1024)
+    t0 = time.perf_counter()
+    for _ in range(total):
+        client.get(ref, timeout=30)
+    return total / (time.perf_counter() - t0)
+
+
+def bench_pgs(client, total: int) -> float:
+    t0 = time.perf_counter()
+    for _ in range(total):
+        info = client.create_placement_group([{"num_cpus": 1}], strategy="PACK")
+        client.remove_placement_group(info["pg_id"])
+    return total / (time.perf_counter() - t0)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out", default=None, help="also write PERF json here")
+    args = ap.parse_args()
+
+    from ray_tpu.cluster import LocalCluster
+
+    scale = 1 if not args.quick else 10
+    results: dict = {}
+    with LocalCluster(node_death_timeout_s=5.0) as cluster:
+        cluster.start()
+        cluster.add_node({"num_cpus": 4}, node_id="bench0")
+        cluster.wait_for_nodes(1)
+        client = cluster.client()
+        # warm the worker pool (spawn cost is startup, not steady-state)
+        client.get([client.submit(_noop, resources={"num_cpus": 1})
+                    for _ in range(8)], timeout=120)
+
+        measures = {
+            "cluster_single_client_tasks_async": lambda: bench_tasks_async(
+                client, 2000 // scale, 100
+            ),
+            "cluster_1_1_actor_calls_async": lambda: bench_actor_calls(
+                client, 2000 // scale, 200
+            ),
+            "cluster_single_client_put_calls": lambda: bench_puts(
+                client, 2000 // scale
+            ),
+            "cluster_single_client_get_calls": lambda: bench_gets(
+                client, 2000 // scale
+            ),
+            "cluster_placement_group_create_removal": lambda: bench_pgs(
+                client, 200 // scale
+            ),
+        }
+        for name, fn in measures.items():
+            rate = fn()
+            results[name] = {
+                "value": round(rate, 1),
+                "unit": "ops/s",
+                "baseline": BASELINES[name],
+                "vs_baseline": round(rate / BASELINES[name], 4),
+            }
+            print(f"# {name}: {rate:.0f} ops/s "
+                  f"({rate / BASELINES[name]:.2f}x baseline)", file=sys.stderr)
+
+    results["_env"] = {
+        "host_cpus": os.cpu_count(),
+        "note": "reference baselines were measured on m4.16xlarge (64 vCPU); "
+                "single-core hosts bound every RPC path on one core",
+    }
+    print(json.dumps(results))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(results, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()
