@@ -72,6 +72,7 @@ class Algorithm(Trainable):
             hidden=tuple(cfg.model.get("hidden", (256, 256))),
             dueling=cfg.model.get("dueling", False),
             model_cls=self.module_class,
+            action_high=spec.action_high,
         )
         self.env_runner_group = EnvRunnerGroup(
             env,

@@ -1,0 +1,326 @@
+"""SAC: soft actor-critic for continuous control.
+
+Reference analog: rllib/algorithms/sac/ (twin delayed Q critics, tanh-
+squashed Gaussian actor, automatic entropy temperature). The whole
+update — both critic losses, the reparameterized actor loss, the alpha
+loss, and the polyak target sync — is ONE jitted program per train
+batch; replay stays host-side numpy (replay.py).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.algorithm import Algorithm
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.module import MLPModule, RLModule, _mlp_apply, _mlp_init
+from ray_tpu.rl.replay import ReplayBuffer
+
+LOG_STD_MIN, LOG_STD_MAX = -20.0, 2.0
+
+
+class SACModule(RLModule):
+    """Squashed-Gaussian actor + twin Q critics in one param tree."""
+
+    def init(self, key: jax.Array):
+        s = self.spec
+        k_pi, k_q1, k_q2 = jax.random.split(key, 3)
+        return {
+            "pi": _mlp_init(k_pi, [s.obs_dim, *s.hidden, 2 * s.action_dim]),
+            "q1": _mlp_init(k_q1, [s.obs_dim + s.action_dim, *s.hidden, 1]),
+            "q2": _mlp_init(k_q2, [s.obs_dim + s.action_dim, *s.hidden, 1]),
+        }
+
+    def actor_out(self, params, obs):
+        out = _mlp_apply(params["pi"], obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        return mean, jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+
+    def sample_action(self, params, obs, key):
+        """Reparameterized tanh-squashed sample -> (action, logp)."""
+        mean, log_std = self.actor_out(params, obs)
+        std = jnp.exp(log_std)
+        raw = mean + std * jax.random.normal(key, mean.shape)
+        act = jnp.tanh(raw)
+        # tanh change-of-variables correction, numerically stable form
+        logp = (
+            -0.5 * (((raw - mean) / std) ** 2 + 2 * log_std + jnp.log(2 * jnp.pi))
+        ).sum(-1)
+        logp -= (2.0 * (jnp.log(2.0) - raw - jax.nn.softplus(-2.0 * raw))).sum(-1)
+        return act * self.spec.action_high, logp
+
+    def q_values(self, params, obs, act):
+        """Both critics' Q(s, a) (act in env scale)."""
+        x = jnp.concatenate([obs, act / self.spec.action_high], axis=-1)
+        return (
+            _mlp_apply(params["q1"], x)[..., 0],
+            _mlp_apply(params["q2"], x)[..., 0],
+        )
+
+    # rollout-collection surface used by the env runner
+    def explore(self, params, obs, key):
+        act, logp = self.sample_action(params, obs, key)
+        return act, logp, jnp.zeros(act.shape[:-1], jnp.float32)
+
+    def inference(self, params, obs):
+        mean, _ = self.actor_out(params, obs)
+        return jnp.tanh(mean) * self.spec.action_high
+
+    def forward(self, params, obs):
+        mean, log_std = self.actor_out(params, obs)
+        return {
+            "action_dist_inputs": jnp.concatenate([mean, log_std], -1),
+            "vf": jnp.zeros(obs.shape[:-1], jnp.float32),
+        }
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=SAC)
+        self.lr = 3e-4
+        self.actor_lr = None        # default: lr
+        self.alpha_lr = None        # default: lr
+        self.tau = 0.005            # polyak target-critic rate
+        self.replay_capacity = 100_000
+        self.learning_starts = 1000
+        self.train_batch_size = 256
+        self.rollout_fragment_length = 4
+        self.train_intensity = 1    # learner steps per sampling round
+        self.target_entropy = None  # default: -action_dim
+        self.initial_alpha = 1.0
+        # offline / conservative (CQL) extensions
+        self.cql_alpha = 0.0        # >0 adds the conservative penalty
+        self.cql_n_actions = 4      # random actions for the logsumexp
+
+    def training(self, **kwargs):
+        for k in (
+            "actor_lr", "alpha_lr", "tau", "replay_capacity", "learning_starts",
+            "train_intensity", "target_entropy", "initial_alpha",
+            "cql_alpha", "cql_n_actions",
+        ):
+            if k in kwargs:
+                setattr(self, k, kwargs.pop(k))
+        return super().training(**kwargs)
+
+
+class SAC(Algorithm):
+    module_class = SACModule
+
+    @classmethod
+    def default_config(cls) -> SACConfig:
+        return SACConfig()
+
+    def setup(self, config) -> None:
+        self.config.model = dict(self.config.model)
+        super().setup(config)
+
+    def build_components(self) -> None:
+        cfg = self.config
+        if not self.module_spec.continuous:
+            raise ValueError("SAC requires a continuous (Box) action space")
+        # module_class = SACModule (class attr) already routed through setup
+        module = self.module_spec.build()
+        self.module = module
+        self.params = module.init(jax.random.key(cfg.seed))
+        self.target_q = {
+            "q1": jax.tree.map(jnp.copy, self.params["q1"]),
+            "q2": jax.tree.map(jnp.copy, self.params["q2"]),
+        }
+        self.log_alpha = jnp.log(jnp.float32(cfg.initial_alpha))
+        self.critic_opt = optax.adam(cfg.lr)
+        self.actor_opt = optax.adam(cfg.actor_lr or cfg.lr)
+        self.alpha_opt = optax.adam(cfg.alpha_lr or cfg.lr)
+        self.critic_state = self.critic_opt.init(
+            {"q1": self.params["q1"], "q2": self.params["q2"]}
+        )
+        self.actor_state = self.actor_opt.init(self.params["pi"])
+        self.alpha_state = self.alpha_opt.init(self.log_alpha)
+        self.replay = ReplayBuffer(cfg.replay_capacity, seed=cfg.seed)
+        self.key = jax.random.key(cfg.seed + 29)
+        self._learn_steps = 0
+        self._build_update()
+        self.learner_group = _SACLearnerShim(self)
+
+    def _build_update(self):
+        cfg = self.config
+        module: SACModule = self.module
+        gamma, tau = cfg.gamma, cfg.tau
+        target_entropy = (
+            cfg.target_entropy
+            if cfg.target_entropy is not None
+            else -float(self.module_spec.action_dim)
+        )
+        cql_alpha, cql_n = cfg.cql_alpha, cfg.cql_n_actions
+        high = self.module_spec.action_high
+
+        @jax.jit
+        def update(params, target_q, log_alpha, critic_state, actor_state,
+                   alpha_state, batch, key):
+            k_next, k_pi, k_cql = jax.random.split(key, 3)
+            alpha = jnp.exp(log_alpha)
+
+            # -- critics ----------------------------------------------------
+            next_act, next_logp = module.sample_action(
+                params, batch["next_obs"], k_next
+            )
+            tq1, tq2 = module.q_values(
+                {**params, "q1": target_q["q1"], "q2": target_q["q2"]},
+                batch["next_obs"], next_act,
+            )
+            target = batch["rewards"] + gamma * (1.0 - batch["terminateds"]) * (
+                jnp.minimum(tq1, tq2) - alpha * next_logp
+            )
+            target = jax.lax.stop_gradient(target)
+
+            def critic_loss(qp):
+                q1, q2 = module.q_values(
+                    {**params, "q1": qp["q1"], "q2": qp["q2"]},
+                    batch["obs"], batch["actions"],
+                )
+                loss = ((q1 - target) ** 2 + (q2 - target) ** 2).mean()
+                if cql_alpha > 0.0:
+                    # conservative penalty: push down Q on out-of-dataset
+                    # actions (random + policy), up on dataset actions
+                    B = batch["obs"].shape[0]
+                    rand = jax.random.uniform(
+                        k_cql, (cql_n, B, module.spec.action_dim),
+                        minval=-high, maxval=high,
+                    )
+                    pi_a, _ = module.sample_action(params, batch["obs"], k_cql)
+                    cat = jnp.concatenate([rand, pi_a[None]], 0)  # [N+1, B, A]
+
+                    def q_of(a):
+                        return module.q_values(
+                            {**params, "q1": qp["q1"], "q2": qp["q2"]},
+                            batch["obs"], a,
+                        )
+
+                    q1_all, q2_all = jax.vmap(q_of)(cat)  # [N+1, B]
+                    penalty = (
+                        (jax.scipy.special.logsumexp(q1_all, axis=0) - q1).mean()
+                        + (jax.scipy.special.logsumexp(q2_all, axis=0) - q2).mean()
+                    )
+                    loss = loss + cql_alpha * penalty
+                return loss, (q1.mean(), q2.mean())
+
+            qp = {"q1": params["q1"], "q2": params["q2"]}
+            (closs, (q1m, q2m)), cgrads = jax.value_and_grad(
+                critic_loss, has_aux=True
+            )(qp)
+            cupd, critic_state = self.critic_opt.update(cgrads, critic_state, qp)
+            qp = optax.apply_updates(qp, cupd)
+            params = {**params, "q1": qp["q1"], "q2": qp["q2"]}
+
+            # -- actor ------------------------------------------------------
+            def actor_loss(pi):
+                act, logp = module.sample_action(
+                    {**params, "pi": pi}, batch["obs"], k_pi
+                )
+                q1, q2 = module.q_values(params, batch["obs"], act)
+                return (alpha * logp - jnp.minimum(q1, q2)).mean(), logp.mean()
+
+            (aloss, logp_mean), agrads = jax.value_and_grad(
+                actor_loss, has_aux=True
+            )(params["pi"])
+            aupd, actor_state = self.actor_opt.update(
+                agrads, actor_state, params["pi"]
+            )
+            params = {**params, "pi": optax.apply_updates(params["pi"], aupd)}
+
+            # -- temperature ------------------------------------------------
+            def alpha_loss(la):
+                return -(jnp.exp(la) * (logp_mean + target_entropy))
+
+            lgrad = jax.grad(alpha_loss)(log_alpha)
+            lupd, alpha_state = self.alpha_opt.update(lgrad, alpha_state, log_alpha)
+            log_alpha = optax.apply_updates(log_alpha, lupd)
+
+            # -- polyak target sync -----------------------------------------
+            target_q = jax.tree.map(
+                lambda t, o: (1 - tau) * t + tau * o,
+                target_q, {"q1": params["q1"], "q2": params["q2"]},
+            )
+            metrics = {
+                "critic_loss": closs, "actor_loss": aloss,
+                "alpha": jnp.exp(log_alpha), "q1_mean": q1m, "q2_mean": q2m,
+                "entropy": -logp_mean,
+            }
+            return (params, target_q, log_alpha, critic_state, actor_state,
+                    alpha_state, metrics)
+
+        self._update = update
+
+    def training_step(self) -> dict:
+        cfg = self.config
+        rollouts = self.env_runner_group.sample(
+            self.params, cfg.rollout_fragment_length
+        )
+        batch = self.concat_rollouts(rollouts)
+        self._add_transitions(batch)
+        metrics: dict = {"replay_size": len(self.replay)}
+        if len(self.replay) < cfg.learning_starts:
+            return metrics
+        for _ in range(cfg.train_intensity):
+            mb = self.replay.sample(cfg.train_batch_size)
+            dev = {k: jnp.asarray(v) for k, v in mb.items()}
+            self.key, k = jax.random.split(self.key)
+            (self.params, self.target_q, self.log_alpha, self.critic_state,
+             self.actor_state, self.alpha_state, m) = self._update(
+                self.params, self.target_q, self.log_alpha, self.critic_state,
+                self.actor_state, self.alpha_state, dev, k,
+            )
+            self._learn_steps += 1
+        metrics.update({k: float(v) for k, v in m.items()})
+        metrics["learn_steps"] = self._learn_steps
+        return metrics
+
+    def _add_transitions(self, batch: dict) -> None:
+        T, B = batch["rewards"].shape
+        self._timesteps += T * B
+        obs_seq = np.concatenate([batch["obs"], batch["final_obs"][None]], axis=0)
+        flat = {
+            "obs": batch["obs"].reshape(T * B, -1),
+            "actions": batch["actions"].reshape(T * B, -1),
+            "rewards": batch["rewards"].reshape(T * B),
+            "next_obs": obs_seq[1:].reshape(T * B, -1),
+            "terminateds": batch["terminateds"].reshape(T * B).astype(np.float32),
+        }
+        self.replay.add_batch(flat)
+
+    def offline_update(self, dataset_batch: dict) -> dict:
+        """One gradient step straight from an offline batch (the CQL path:
+        reference rllib/algorithms/cql trains SAC+penalty from OfflineData
+        with no env interaction)."""
+        dev = {k: jnp.asarray(v) for k, v in dataset_batch.items()}
+        self.key, k = jax.random.split(self.key)
+        (self.params, self.target_q, self.log_alpha, self.critic_state,
+         self.actor_state, self.alpha_state, m) = self._update(
+            self.params, self.target_q, self.log_alpha, self.critic_state,
+            self.actor_state, self.alpha_state, dev, k,
+        )
+        self._learn_steps += 1
+        return {k: float(v) for k, v in m.items()}
+
+
+class _SACLearnerShim:
+    def __init__(self, algo: "SAC"):
+        self.algo = algo
+
+    def get_state(self) -> dict:
+        a = self.algo
+        return {
+            "params": jax.device_get(a.params),
+            "target_q": jax.device_get(a.target_q),
+            "log_alpha": jax.device_get(a.log_alpha),
+            "steps": a._learn_steps,
+        }
+
+    def set_state(self, state: dict) -> None:
+        a = self.algo
+        a.params = jax.device_put(state["params"])
+        a.target_q = jax.device_put(state["target_q"])
+        a.log_alpha = jax.device_put(state["log_alpha"])
+        a._learn_steps = state["steps"]

@@ -56,6 +56,7 @@ def spec_from_env(env: "str | Callable") -> RLModuleSpec:
             obs_dim=obs_dim,
             action_dim=int(np.prod(e.action_space.shape)),
             continuous=True,
+            action_high=float(np.max(np.abs(e.action_space.high))),
         )
     finally:
         e.close()

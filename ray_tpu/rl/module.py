@@ -35,6 +35,8 @@ class RLModuleSpec:
     hidden: Sequence[int] = (256, 256)
     dueling: bool = False  # DQN-style value/advantage split of the Q head
     model_cls: "type[RLModule] | None" = None
+    # Box bounds for continuous spaces (SAC's tanh squash scales to these)
+    action_high: float = 1.0
 
     def build(self) -> "RLModule":
         cls = self.model_cls or MLPModule

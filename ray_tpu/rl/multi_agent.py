@@ -1,0 +1,147 @@
+"""Multi-agent RL: env protocol, policy mapping, and a multi-agent env
+runner producing per-policy batches.
+
+Reference analog: rllib/env/multi_agent_env.py (dict-keyed spaces) +
+MultiAgentEnvRunner (env/multi_agent_env_runner.py:65) + the
+policy_mapping_fn contract. Redesigned lean: agents appear/disappear per
+step via dict keys; each policy is a functional RLModule whose params
+the caller passes per sample() (so independent learners — one per
+policy — plug straight into the existing single-agent algorithms).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from ray_tpu.rl.module import RLModuleSpec
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.rl.multi_agent")
+
+
+class MultiAgentEnv:
+    """Protocol: dict-keyed multi-agent episodes.
+
+    reset() -> ({agent_id: obs}, info)
+    step({agent_id: action}) -> (obs_d, rew_d, term_d, trunc_d, info);
+    term_d/trunc_d may carry "__all__" to end the episode for everyone.
+    `agents` lists possible agent ids; `observation_space(agent)` /
+    `action_space(agent)` give per-agent gym spaces.
+    """
+
+    agents: list = []
+
+    def reset(self, seed: Optional[int] = None):
+        raise NotImplementedError
+
+    def step(self, action_dict: dict):
+        raise NotImplementedError
+
+    def observation_space(self, agent_id):
+        raise NotImplementedError
+
+    def action_space(self, agent_id):
+        raise NotImplementedError
+
+
+def spec_for_agent(env: MultiAgentEnv, agent_id) -> RLModuleSpec:
+    obs_space = env.observation_space(agent_id)
+    act_space = env.action_space(agent_id)
+    obs_dim = int(np.prod(obs_space.shape))
+    if hasattr(act_space, "n"):
+        return RLModuleSpec(obs_dim=obs_dim, action_dim=int(act_space.n))
+    return RLModuleSpec(
+        obs_dim=obs_dim,
+        action_dim=int(np.prod(act_space.shape)),
+        continuous=True,
+        action_high=float(np.max(np.abs(act_space.high))),
+    )
+
+
+class MultiAgentEnvRunner:
+    """Steps ONE multi-agent env, routing each agent through its policy.
+
+    policies: {policy_id: RLModuleSpec} — built once here.
+    policy_mapping_fn(agent_id) -> policy_id.
+    sample(params_by_policy, num_steps) -> {policy_id: batch} where batch
+    has flat columns obs/actions/logp/rewards/terminateds/next_obs —
+    ready for the single-agent learners (independent learning)."""
+
+    def __init__(
+        self,
+        env_factory: Callable[[], MultiAgentEnv],
+        policies: dict[str, RLModuleSpec],
+        policy_mapping_fn: Callable[[Any], str],
+        seed: int = 0,
+    ):
+        self.env = env_factory()
+        self.policy_mapping_fn = policy_mapping_fn
+        self.modules = {pid: spec.build() for pid, spec in policies.items()}
+        self._explore = {
+            pid: jax.jit(m.explore) for pid, m in self.modules.items()
+        }
+        self.key = jax.random.key(seed)
+        self._obs, _ = self.env.reset(seed=seed)
+        self._ep_ret: dict = {}
+        self._done_returns: list[float] = []
+        self._episodes = 0
+
+    def sample(self, params_by_policy: dict, num_steps: int) -> dict:
+        """Collect num_steps env steps; returns per-POLICY transition
+        batches (concatenated over the agents mapped to that policy)."""
+        rows: dict[str, list] = {pid: [] for pid in self.modules}
+        pending: dict = {}  # agent_id -> (policy_id, obs, act, logp)
+        for _ in range(num_steps):
+            actions: dict = {}
+            for aid, obs in self._obs.items():
+                pid = self.policy_mapping_fn(aid)
+                self.key, k = jax.random.split(self.key)
+                act, logp, _ = self._explore[pid](
+                    params_by_policy[pid], np.asarray(obs, np.float32)[None], k
+                )
+                act = np.asarray(act)[0]
+                actions[aid] = (
+                    int(act) if not self.modules[pid].spec.continuous else act
+                )
+                pending[aid] = (pid, np.asarray(obs, np.float32),
+                                actions[aid], float(np.asarray(logp)[0]))
+            obs_d, rew_d, term_d, trunc_d, _ = self.env.step(actions)
+            all_done = bool(term_d.get("__all__", False) or
+                            trunc_d.get("__all__", False))
+            for aid, (pid, obs, act, logp) in pending.items():
+                done = bool(term_d.get(aid, False) or all_done)
+                nxt = obs_d.get(aid, obs)
+                rows[pid].append({
+                    "obs": obs,
+                    "actions": act,
+                    "logp": logp,
+                    "rewards": float(rew_d.get(aid, 0.0)),
+                    "terminateds": float(done),
+                    "next_obs": np.asarray(nxt, np.float32),
+                })
+                self._ep_ret[aid] = self._ep_ret.get(aid, 0.0) + rew_d.get(aid, 0.0)
+            pending.clear()
+            if all_done or not obs_d:
+                self._done_returns.append(sum(self._ep_ret.values()))
+                self._episodes += 1
+                self._ep_ret.clear()
+                obs_d, _ = self.env.reset()
+            self._obs = obs_d
+        out = {}
+        for pid, rs in rows.items():
+            if not rs:
+                continue
+            out[pid] = {
+                k: np.stack([np.asarray(r[k]) for r in rs]) for k in rs[0]
+            }
+        return out
+
+    def metrics(self) -> dict:
+        recent = self._done_returns[-20:]
+        return {
+            "episodes": self._episodes,
+            "episode_return_mean": float(np.mean(recent)) if recent else float("nan"),
+        }

@@ -1,0 +1,209 @@
+"""Offline RL: datasets of recorded transitions + algorithms that learn
+from them without touching an environment.
+
+Reference analog: rllib/offline/ — `OfflineData` (offline_data.py:23)
+wraps Ray-Data-backed readers feeding `OfflinePreLearner` batches into
+learners; BC (rllib/algorithms/bc) and CQL (rllib/algorithms/cql) train
+from it. TPU-native redesign: the dataset is host numpy (or a
+ray_tpu.data Dataset materialized to numpy); each algorithm's update
+stays one jitted program fed minibatches.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ray_tpu.rl.config import AlgorithmConfig
+from ray_tpu.rl.module import RLModuleSpec
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.rl.offline")
+
+REQUIRED_COLUMNS = ("obs", "actions")
+
+
+class OfflineData:
+    """A table of transitions: columns obs/actions[/rewards/next_obs/
+    terminateds]. Buildable from dict-of-arrays, an .npz file, or a
+    ray_tpu.data Dataset of row dicts."""
+
+    def __init__(self, columns: dict, seed: int = 0):
+        for c in REQUIRED_COLUMNS:
+            if c not in columns:
+                raise ValueError(f"offline dataset missing column {c!r}")
+        n = len(columns["obs"])
+        self.columns = {k: np.asarray(v) for k, v in columns.items()}
+        for k, v in self.columns.items():
+            if len(v) != n:
+                raise ValueError(f"column {k!r} length {len(v)} != {n}")
+        self.n = n
+        self._rng = np.random.RandomState(seed)
+
+    @classmethod
+    def from_npz(cls, path: str, **kw) -> "OfflineData":
+        data = np.load(path)
+        return cls({k: data[k] for k in data.files}, **kw)
+
+    @classmethod
+    def from_dataset(cls, ds, **kw) -> "OfflineData":
+        """Materialize a ray_tpu.data Dataset of row-dicts."""
+        rows = list(ds.iter_rows()) if hasattr(ds, "iter_rows") else list(ds)
+        cols = {k: np.stack([r[k] for r in rows]) for k in rows[0]}
+        return cls(cols, **kw)
+
+    def save_npz(self, path: str) -> None:
+        np.savez(path, **self.columns)
+
+    def sample(self, batch_size: int) -> dict:
+        idx = self._rng.randint(0, self.n, size=batch_size)
+        return {k: v[idx] for k, v in self.columns.items()}
+
+    def __len__(self) -> int:
+        return self.n
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__(algo_class=BC)
+        self.lr = 1e-3
+        self.train_batch_size = 256
+        self.updates_per_iteration = 100
+
+    def offline_data(self, dataset) -> "BCConfig":
+        self.extra["dataset"] = dataset
+        return self
+
+    def training(self, **kwargs):
+        for k in ("updates_per_iteration",):
+            if k in kwargs:
+                setattr(self, k, kwargs.pop(k))
+        return super().training(**kwargs)
+
+
+class BC:
+    """Behavior cloning: maximize logp(dataset actions | obs).
+
+    Reference analog: rllib/algorithms/bc (MARWIL with beta=0) reading
+    OfflineData. Standalone (no env needed): pass `module_spec`, or an
+    env in the config to derive one for later evaluation."""
+
+    @classmethod
+    def default_config(cls) -> BCConfig:
+        return BCConfig()
+
+    def __init__(self, config: Optional[BCConfig] = None,
+                 module_spec: Optional[RLModuleSpec] = None):
+        self.config = config or self.default_config()
+        cfg = self.config
+        dataset = cfg.extra.get("dataset")
+        if dataset is None:
+            raise ValueError("BCConfig.offline_data(dataset) is required")
+        if not isinstance(dataset, OfflineData):
+            dataset = OfflineData(dataset)
+        self.dataset = dataset
+        if module_spec is None:
+            import dataclasses
+
+            if cfg.env is None:
+                raise ValueError("pass module_spec or config.environment(env=)")
+            from ray_tpu.rl.env_runner import spec_from_env
+
+            module_spec = dataclasses.replace(
+                spec_from_env(cfg.env),
+                hidden=tuple(cfg.model.get("hidden", (256, 256))),
+            )
+        self.module_spec = module_spec
+        self.module = module_spec.build()
+        self.params = self.module.init(jax.random.key(cfg.seed))
+        self.optimizer = optax.adam(cfg.lr)
+        self.opt_state = self.optimizer.init(self.params)
+        self.iteration = 0
+        self._build_update()
+
+    def _build_update(self):
+        module = self.module
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            def loss_fn(p):
+                out = module.forward(p, batch["obs"])
+                logp = module.dist.logp(
+                    out["action_dist_inputs"], batch["actions"]
+                )
+                return -logp.mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._update = update
+
+    def train(self) -> dict:
+        cfg = self.config
+        loss = None
+        for _ in range(cfg.updates_per_iteration):
+            batch = self.dataset.sample(cfg.train_batch_size)
+            dev = {k: jnp.asarray(v) for k, v in batch.items()}
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state, dev
+            )
+        self.iteration += 1
+        return {"loss": float(loss), "iteration": self.iteration,
+                "dataset_size": len(self.dataset)}
+
+    def compute_actions(self, obs) -> np.ndarray:
+        return np.asarray(
+            jax.jit(self.module.inference)(self.params, jnp.asarray(obs))
+        )
+
+    def get_state(self) -> dict:
+        return {"params": jax.device_get(self.params),
+                "iteration": self.iteration}
+
+    def set_state(self, state: dict) -> None:
+        self.params = jax.device_put(state["params"])
+        self.iteration = state["iteration"]
+
+
+class CQL:
+    """Conservative Q-Learning: SAC's jitted update with cql_alpha > 0,
+    driven purely by offline minibatches (no env interaction).
+
+    Reference analog: rllib/algorithms/cql (SAC-based offline RL).
+    Build a SACConfig (cql_alpha defaults to 1.0 here if unset), pass the
+    dataset, train() consumes minibatches only."""
+
+    def __init__(self, sac_config, dataset, updates_per_iteration: int = 100):
+        from ray_tpu.rl.algorithms.sac import SAC
+
+        if not isinstance(dataset, OfflineData):
+            dataset = OfflineData(dataset)
+        self.dataset = dataset
+        if sac_config.cql_alpha <= 0:
+            sac_config.cql_alpha = 1.0
+        self.sac = SAC(sac_config)
+        self.updates_per_iteration = updates_per_iteration
+        self.iteration = 0
+
+    def train(self) -> dict:
+        m: dict = {}
+        for _ in range(self.updates_per_iteration):
+            batch = self.dataset.sample(self.sac.config.train_batch_size)
+            m = self.sac.offline_update(batch)
+        self.iteration += 1
+        m["iteration"] = self.iteration
+        return m
+
+    @property
+    def params(self):
+        return self.sac.params
+
+    def compute_actions(self, obs) -> np.ndarray:
+        return np.asarray(
+            jax.jit(self.sac.module.inference)(self.sac.params, jnp.asarray(obs))
+        )

@@ -1,0 +1,69 @@
+"""Operator CLI: start --head / status / stop round-trip (reference:
+`ray start` at python/ray/scripts/scripts.py:654)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+
+@pytest.fixture
+def state_dir(tmp_path):
+    d = str(tmp_path / "clistate")
+    env = dict(os.environ)
+    env["RAY_TPU_STATE_DIR"] = d
+    env["JAX_PLATFORMS"] = "cpu"
+    yield d, env
+    subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", "stop"],
+        env=env, capture_output=True, timeout=30,
+    )
+
+
+def _run(env, *argv, timeout=60):
+    return subprocess.run(
+        [sys.executable, "-m", "ray_tpu.scripts.cli", *argv],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_cli_start_status_stop(state_dir):
+    d, env = state_dir
+    r = _run(env, "start", "--head", "--resources", "num_cpus=2",
+             "--node-id", "cli-n0")
+    assert r.returncode == 0, r.stderr
+    assert "GCS started" in r.stdout and "cli-n0 started" in r.stdout
+
+    state = json.load(open(os.path.join(d, "cluster.json")))
+    addr = state["gcs_address"]
+
+    # status sees the node
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        r = _run(env, "status")
+        if "cli-n0" in r.stdout and "ALIVE" in r.stdout:
+            break
+        time.sleep(0.5)
+    assert "cli-n0" in r.stdout and "ALIVE" in r.stdout, r.stdout
+
+    # the public api attaches and runs work on the CLI-started cluster
+    code = (
+        "from ray_tpu.core import api\n"
+        f"api.init(address='{addr}')\n"
+        "def f():\n"
+        "    import os\n"
+        "    return os.environ.get('RAY_TPU_NODE_ID')\n"
+        "print('RAN_ON', api.get(api.remote(f).remote(), timeout=60))\n"
+    )
+    r = subprocess.run(
+        [sys.executable, "-c", code], env=env,
+        capture_output=True, text=True, timeout=120,
+    )
+    assert "RAN_ON cli-n0" in r.stdout, (r.stdout, r.stderr)
+
+    r = _run(env, "stop")
+    assert r.returncode == 0
+    assert "stopped" in r.stdout

@@ -1,0 +1,242 @@
+"""RL depth: SAC (continuous control), offline RL (BC/CQL from recorded
+data), multi-agent env runner — the rllib families beyond PPO/DQN/IMPALA
+(reference: rllib/algorithms/sac, rllib/algorithms/bc, rllib/offline/
+offline_data.py:23, rllib/env/multi_agent_env_runner.py:65)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rl.algorithms.sac import SAC, SACConfig
+from ray_tpu.rl.module import RLModuleSpec
+from ray_tpu.rl.multi_agent import MultiAgentEnv, MultiAgentEnvRunner, spec_for_agent
+from ray_tpu.rl.offline import BC, BCConfig, CQL, OfflineData
+
+
+# ---------------------------------------------------------------------------
+# SAC
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_sac_learns_pendulum():
+    """SAC solves Pendulum on CPU: ~1 critic/actor update per env step
+    (standard SAC replay ratio) reaches ~-200 within ~15k env steps."""
+    cfg = (
+        SACConfig()
+        .environment(env="Pendulum-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=8)
+        .training(
+            train_batch_size=128,
+            learning_starts=500,
+            train_intensity=32,
+            lr=1e-3,
+            tau=0.01,
+        )
+    )
+    cfg.rollout_fragment_length = 4
+    algo = cfg.build_algo()
+    best = -1e9
+    for i in range(800):
+        m = algo.step()
+        r = m.get("episode_return_mean")
+        if r == r and r is not None:  # not NaN
+            best = max(best, r)
+        if best > -200.0:
+            break
+    algo.stop()
+    # untrained Pendulum sits near -1200..-1600
+    assert best > -280.0, best
+
+
+def test_sac_rejects_discrete():
+    cfg = SACConfig().environment(env="CartPole-v1")
+    with pytest.raises(ValueError, match="continuous"):
+        cfg.build_algo()
+
+
+# ---------------------------------------------------------------------------
+# offline: BC + CQL
+# ---------------------------------------------------------------------------
+
+
+def _expert_dataset(n=4000, obs_dim=4, seed=0):
+    """Synthetic expert: action = argmax over a fixed linear policy."""
+    rng = np.random.RandomState(seed)
+    W = rng.randn(obs_dim, 3)
+    obs = rng.randn(n, obs_dim).astype(np.float32)
+    actions = np.argmax(obs @ W, axis=1).astype(np.int64)
+    return obs, actions, W
+
+
+def test_bc_learns_from_saved_dataset(tmp_path):
+    obs, actions, W = _expert_dataset()
+    path = str(tmp_path / "expert.npz")
+    OfflineData({"obs": obs, "actions": actions}).save_npz(path)
+
+    cfg = BCConfig().training(train_batch_size=256, updates_per_iteration=150)
+    cfg.lr = 3e-3
+    cfg.offline_data(OfflineData.from_npz(path))
+    bc = BC(cfg, module_spec=RLModuleSpec(obs_dim=4, action_dim=3, hidden=(64, 64)))
+    for _ in range(4):
+        metrics = bc.train()
+    assert metrics["loss"] < 0.25, metrics
+
+    # imitation accuracy on held-out expert states
+    test_obs, test_actions, _ = _expert_dataset(n=500, seed=9)
+    # same expert weights: regenerate with original W
+    test_actions = np.argmax(test_obs @ W, axis=1)
+    pred = bc.compute_actions(test_obs)
+    acc = float((pred == test_actions).mean())
+    assert acc > 0.9, acc
+
+
+def test_cql_trains_conservatively_from_offline_data():
+    """CQL runs pure-offline updates (no env stepping) and its
+    conservative penalty pushes dataset-action Q values BELOW the
+    unpenalized SAC baseline on the same data."""
+    rng = np.random.RandomState(1)
+    n = 1024
+    obs = rng.randn(n, 3).astype(np.float32)
+    actions = np.tanh(rng.randn(n, 1)).astype(np.float32) * 2.0
+    rewards = -np.abs(obs[:, 0]).astype(np.float32)
+    next_obs = obs + 0.1 * rng.randn(n, 3).astype(np.float32)
+    terminateds = np.zeros(n, np.float32)
+    data = {
+        "obs": obs, "actions": actions, "rewards": rewards,
+        "next_obs": next_obs, "terminateds": terminateds,
+    }
+
+    def make(alpha):
+        cfg = (
+            SACConfig()
+            .environment(env="Pendulum-v1")  # spaces only; never stepped
+            .training(train_batch_size=128)
+        )
+        cfg.cql_alpha = alpha
+        return CQL(cfg, OfflineData(data), updates_per_iteration=60)
+
+    conservative = make(2.0)
+    baseline = make(-1.0)  # coerced to... pass explicit 0 via sac config
+    baseline.sac.config.cql_alpha = 0.0
+    baseline.sac._build_update()
+
+    m_cons = conservative.train()
+    m_base = baseline.train()
+    assert np.isfinite(m_cons["critic_loss"]) and np.isfinite(m_base["critic_loss"])
+    # conservatism: penalized Q estimates sit below the unpenalized ones
+    assert m_cons["q1_mean"] < m_base["q1_mean"], (m_cons, m_base)
+
+
+# ---------------------------------------------------------------------------
+# multi-agent
+# ---------------------------------------------------------------------------
+
+
+class _ParityGame(MultiAgentEnv):
+    """Two agents; each sees a random +-1 vector and is rewarded for
+    matching its own parity bit. Independent policies learn it fast."""
+
+    agents = ["hunter", "gatherer"]
+
+    def __init__(self, episode_len=16, seed=0):
+        import gymnasium as gym
+
+        self._rng = np.random.RandomState(seed)
+        self._len = episode_len
+        self._t = 0
+        self._obs_space = gym.spaces.Box(-1.0, 1.0, (4,), np.float32)
+        self._act_space = gym.spaces.Discrete(2)
+
+    def observation_space(self, agent_id):
+        return self._obs_space
+
+    def action_space(self, agent_id):
+        return self._act_space
+
+    def _draw(self):
+        return {
+            a: self._rng.choice([-1.0, 1.0], size=4).astype(np.float32)
+            for a in self.agents
+        }
+
+    def reset(self, seed=None):
+        self._t = 0
+        self._obs = self._draw()
+        return self._obs, {}
+
+    def step(self, action_dict):
+        rew = {}
+        for a, act in action_dict.items():
+            parity = int(self._obs[a][0] > 0)
+            rew[a] = 1.0 if act == parity else -1.0
+        self._t += 1
+        done = self._t >= self._len
+        self._obs = self._draw()
+        term = {a: False for a in self.agents}
+        term["__all__"] = done
+        trunc = {"__all__": False}
+        return self._obs, rew, term, trunc, {}
+
+
+def test_multi_agent_runner_routes_policies_and_learns():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    import dataclasses
+
+    env_factory = _ParityGame
+    env = env_factory()
+    policies = {
+        "p_hunter": dataclasses.replace(
+            spec_for_agent(env, "hunter"), hidden=(32,)
+        ),
+        "p_gatherer": dataclasses.replace(
+            spec_for_agent(env, "gatherer"), hidden=(32,)
+        ),
+    }
+    mapping = lambda aid: f"p_{aid}"
+    runner = MultiAgentEnvRunner(env_factory, policies, mapping, seed=0)
+
+    modules = runner.modules
+    params = {pid: m.init(jax.random.key(i))
+              for i, (pid, m) in enumerate(modules.items())}
+    batches = runner.sample(params, num_steps=32)
+    # both policies got their own transitions
+    assert set(batches) == {"p_hunter", "p_gatherer"}
+    for b in batches.values():
+        assert b["obs"].shape == (32, 4)
+        assert b["rewards"].shape == (32,)
+
+    # independent REINFORCE-style learners: reward goes up for both
+    opts = {pid: optax.adam(3e-2) for pid in modules}
+    opt_states = {pid: opts[pid].init(params[pid]) for pid in modules}
+
+    def make_update(pid):
+        module = modules[pid]
+
+        @jax.jit
+        def update(p, os, batch):
+            def loss(p):
+                out = module.forward(p, batch["obs"])
+                logp = module.dist.logp(out["action_dist_inputs"], batch["actions"])
+                adv = batch["rewards"] - batch["rewards"].mean()
+                return -(logp * adv).mean()
+
+            g = jax.grad(loss)(p)
+            upd, os2 = opts[pid].update(g, os, p)
+            return optax.apply_updates(p, upd), os2
+
+        return update
+
+    updates = {pid: make_update(pid) for pid in modules}
+    for _ in range(30):
+        batches = runner.sample(params, num_steps=16)
+        for pid, b in batches.items():
+            dev = {k: jnp.asarray(v) for k, v in b.items()}
+            params[pid], opt_states[pid] = updates[pid](
+                params[pid], opt_states[pid], dev
+            )
+    final = runner.sample(params, num_steps=64)
+    for pid, b in final.items():
+        assert b["rewards"].mean() > 0.6, (pid, b["rewards"].mean())
