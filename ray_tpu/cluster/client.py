@@ -408,6 +408,7 @@ class ClusterClient:
         desc: Optional[str] = None,
         affinity_node_id: Optional[str] = None,
         affinity_soft: bool = False,
+        runtime_env: Optional[dict] = None,
     ) -> "ClusterObjectRef | list[ClusterObjectRef]":
         desc = desc or getattr(func, "__name__", "task")
         return_ids = [_new_id() for _ in range(num_returns)]
@@ -431,6 +432,7 @@ class ClusterClient:
             "bundle_index": bundle_index,
             "affinity_node_id": affinity_node_id,
             "affinity_soft": affinity_soft,
+            "runtime_env": self._package_runtime_env(runtime_env),
         }
         self._submitter.submit(self._drive_task, payload, spec, max_retries, arg_refs)
         refs = [ClusterObjectRef(rid, self, desc, owned=True) for rid in return_ids]
@@ -600,6 +602,7 @@ class ClusterClient:
         max_restarts: int = 0,
         pg_id: Optional[bytes] = None,
         bundle_index: int = 0,
+        runtime_env: Optional[dict] = None,
     ) -> ClusterActorHandle:
         actor_id = _new_id()
         # ctor-arg objects must outlive the actor (restarts replay the
@@ -615,6 +618,7 @@ class ClusterClient:
             "resources": dict({"num_cpus": 1} if resources is None else resources),
             "pg_id": pg_id,
             "bundle_index": bundle_index,
+            "runtime_env": self._package_runtime_env(runtime_env),
         }
         grant, daemon = self._lease(spec, [])
         worker_addr = tuple(grant["worker_addr"])
@@ -784,6 +788,32 @@ class ClusterClient:
                 )
             except (RpcError, RemoteError):
                 pass
+
+    # -- runtime envs ---------------------------------------------------------
+
+    def _package_runtime_env(self, runtime_env: Optional[dict]) -> Optional[dict]:
+        """Zip + stage a runtime env's directories; cache by content so a
+        task storm doesn't re-upload the same working_dir, and PIN the
+        staged packages for the client's lifetime (workers fetch them on
+        every env-dedicated worker spawn)."""
+        if not runtime_env:
+            return None
+        from ray_tpu.cluster.runtime_env import package_runtime_env
+
+        if not hasattr(self, "_env_packages"):
+            self._env_packages: dict[str, ClusterObjectRef] = {}
+
+        def put_pkg(data: bytes) -> bytes:
+            import hashlib
+
+            key = hashlib.sha256(data).hexdigest()
+            ref = self._env_packages.get(key)
+            if ref is None:
+                ref = self.put(data)
+                self._env_packages[key] = ref  # pinned until close
+            return ref.id
+
+        return package_runtime_env(runtime_env, put_pkg)
 
     # -- placement groups -----------------------------------------------------
 

@@ -247,6 +247,8 @@ class WorkerHandle:
         self.worker_id = worker_id
         self.addr: Optional[tuple] = None
         self.ready = threading.Event()
+        self.env_key = ""  # runtime-env hash this worker is dedicated to
+        self.idle_since = time.monotonic()
 
     def alive(self) -> bool:
         return self.proc.poll() is None
@@ -286,8 +288,15 @@ class NodeDaemon:
         self._res_lock = threading.RLock()
         self._leases: dict[str, dict] = {}  # lease_id -> {resources, worker}
         self._bundles: dict[tuple, dict] = {}  # (pg_id, idx) -> reserved resources
-        self._idle_workers: list[WorkerHandle] = []
+        # idle pool keyed by runtime-env hash: a worker only ever runs
+        # tasks of ONE runtime env (reference: worker_pool.h dedicated
+        # workers per runtime env)
+        self._idle_workers: dict[str, list[WorkerHandle]] = {}
         self._all_workers: dict[str, WorkerHandle] = {}
+        self._env_cache = os.path.join(
+            os.environ.get("TMPDIR", "/tmp"),
+            f"ray_tpu-envs-{node_id or 'node'}-{os.getpid()}",
+        )
         self._wlock = threading.Lock()
         self._grant_queue: "queue_mod.Queue" = queue_mod.Queue()
         self._capacity_signal = threading.Event()  # wakes the granter
@@ -343,6 +352,10 @@ class NodeDaemon:
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self._hb_interval):
             try:
+                self._reap_idle_workers()
+            except Exception:
+                pass
+            try:
                 with self._res_lock:
                     avail = dict(self.available)
                 r = self.gcs.call(
@@ -389,7 +402,7 @@ class NodeDaemon:
 
     # -- worker pool ----------------------------------------------------------
 
-    def _spawn_worker(self) -> WorkerHandle:
+    def _spawn_worker(self, runtime_env: Optional[dict] = None) -> WorkerHandle:
         worker_id = f"w-{uuid.uuid4().hex[:8]}"
         env = dict(os.environ)
         env.update(self.worker_env)
@@ -398,6 +411,24 @@ class NodeDaemon:
         # the host workers should advertise for cross-host rendezvous
         # (jax.distributed coordinator election reads this)
         env["RAY_TPU_NODE_IP"] = self.addr[0]
+        cwd = os.getcwd()
+        env_key = ""
+        if runtime_env:
+            from ray_tpu.cluster.runtime_env import env_hash, materialize
+            from ray_tpu.cluster.serialization import loads_value
+
+            env_key = env_hash(runtime_env)
+
+            def fetch_bytes(oid):
+                data = self.objects.fetch(oid, timeout=60.0)
+                return None if data is None else loads_value(data, lambda _: None)
+
+            extra, workdir = materialize(
+                runtime_env, fetch_bytes, self._env_cache, base_env=env
+            )
+            env.update(extra)
+            if workdir:
+                cwd = workdir
         proc = subprocess.Popen(
             [
                 sys.executable, "-m", "ray_tpu.cluster.worker_main",
@@ -406,52 +437,99 @@ class NodeDaemon:
                 "--gcs", f"{self.gcs_addr[0]}:{self.gcs_addr[1]}",
             ],
             env=env,
-            cwd=os.getcwd(),
+            cwd=cwd,
         )
         h = WorkerHandle(proc, worker_id)
+        h.env_key = env_key
         with self._wlock:
             self._all_workers[worker_id] = h
         return h
 
-    def _lease_worker(self, block: bool = True) -> Optional[WorkerHandle]:
+    def _lease_worker(self, block: bool = True,
+                      runtime_env: Optional[dict] = None) -> Optional[WorkerHandle]:
+        from ray_tpu.cluster.runtime_env import env_hash
+
+        key = env_hash(runtime_env)
         with self._wlock:
-            while self._idle_workers:
-                w = self._idle_workers.pop()
+            err = getattr(self, "_spawn_errors", {}).pop(key, None)
+            if err is not None:
+                # a background spawn for this env failed (bad runtime_env,
+                # missing package): surface it instead of retrying forever
+                raise RpcError(f"worker spawn failed: {err}")
+            pool = self._idle_workers.get(key, [])
+            while pool:
+                w = pool.pop()
                 if w.alive():
                     return w
         if not block:
             # the single granter thread must never sit in a multi-second
             # worker spawn (it would stall every other queued lease):
             # kick an async spawn and let the capacity signal re-trigger
-            self._ensure_spawning()
+            self._ensure_spawning(runtime_env, key)
             return None
-        w = self._spawn_worker()
+        w = self._spawn_worker(runtime_env)
         if not w.ready.wait(timeout=60):
             w.kill()
             raise RpcError("worker failed to start in 60s")
         return w
 
-    def _ensure_spawning(self) -> None:
-        """At most one background worker spawn in flight."""
+    def _ensure_spawning(self, runtime_env: Optional[dict], key: str) -> None:
+        """At most one background worker spawn in flight per runtime env."""
         with self._wlock:
-            if getattr(self, "_spawning", False):
+            spawning = getattr(self, "_spawning", None)
+            if spawning is None:
+                spawning = self._spawning = set()
+            if not hasattr(self, "_spawn_errors"):
+                self._spawn_errors: dict[str, str] = {}
+            if key in spawning:
                 return
-            self._spawning = True
+            spawning.add(key)
 
         def run():
             try:
-                w = self._spawn_worker()
+                w = self._spawn_worker(runtime_env)
                 if w.ready.wait(timeout=60) and w.alive():
+                    w.idle_since = time.monotonic()
                     with self._wlock:
-                        self._idle_workers.append(w)
+                        self._idle_workers.setdefault(key, []).append(w)
                 else:
                     w.kill()
+                    with self._wlock:
+                        self._spawn_errors[key] = "worker failed to start in 60s"
+            except Exception as e:  # noqa: BLE001 - deliver to the waiter
+                with self._wlock:
+                    self._spawn_errors[key] = repr(e)
             finally:
                 with self._wlock:
-                    self._spawning = False
+                    self._spawning.discard(key)
                 self._notify_capacity()
 
         threading.Thread(target=run, name="worker-spawn", daemon=True).start()
+
+    def _reap_idle_workers(self, ttl_s: float = 60.0) -> None:
+        """Kill runtime-env-dedicated workers idle past their TTL; the
+        default ("") pool is exempt (reference: worker_pool idle-worker
+        killing for dedicated workers)."""
+        now = time.monotonic()
+        doomed: list[WorkerHandle] = []
+        with self._wlock:
+            for key, pool in list(self._idle_workers.items()):
+                if key == "":
+                    continue
+                keep = []
+                for w in pool:
+                    if now - getattr(w, "idle_since", now) > ttl_s:
+                        doomed.append(w)
+                    else:
+                        keep.append(w)
+                if keep:
+                    self._idle_workers[key] = keep
+                else:
+                    self._idle_workers.pop(key, None)
+            for w in doomed:
+                self._all_workers.pop(w.worker_id, None)
+        for w in doomed:
+            w.kill()
 
     def rpc_register_worker(self, payload, peer):
         with self._wlock:
@@ -490,8 +568,10 @@ class NodeDaemon:
             acquired = self._try_acquire(res)
         if acquired:
             try:
-                w = self._lease_worker(block=block_spawn)
-            except RpcError as e:
+                w = self._lease_worker(
+                    block=block_spawn, runtime_env=payload.get("runtime_env")
+                )
+            except Exception as e:  # noqa: BLE001 - incl. runtime_env failures
                 with self._res_lock:
                     self._release(
                         res, self._bundles.get(pg_key) if pg_key else None
@@ -667,8 +747,9 @@ class NodeDaemon:
             with self._wlock:
                 self._all_workers.pop(w.worker_id, None)
         else:
+            w.idle_since = time.monotonic()
             with self._wlock:
-                self._idle_workers.append(w)
+                self._idle_workers.setdefault(w.env_key, []).append(w)
         with self._res_lock:
             pool = self._bundles.get(lease["pg_key"]) if lease["pg_key"] else None
             self._release(lease["resources"], pool)

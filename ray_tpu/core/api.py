@@ -192,6 +192,11 @@ class RemoteFunction:
         if backend is not None:
             out = backend.submit_task(self._func, args, kwargs, self._options)
             return out[0] if self._options.num_returns == 1 else out
+        if self._options.runtime_env:
+            raise ValueError(
+                "runtime_env needs process-isolated workers: attach to a "
+                "cluster first (ray_tpu.init(address=...))"
+            )
         runtime = _auto_init()
         out = runtime.submit_task(self._func, args, kwargs, self._options)
         if isinstance(out, ObjectRefGenerator):
@@ -368,6 +373,11 @@ class ActorClass:
         backend = _cluster()
         if backend is not None:
             return backend.create_actor(self._cls, args, kwargs, self._options)
+        if self._options.runtime_env:
+            raise ValueError(
+                "runtime_env needs process-isolated workers: attach to a "
+                "cluster first (ray_tpu.init(address=...))"
+            )
         runtime = _auto_init()
         opts = self._options
         if opts.name:

@@ -156,6 +156,7 @@ class ClusterBackend:
             desc=options.name or getattr(func, "__name__", "task"),
             affinity_node_id=affinity,
             affinity_soft=soft,
+            runtime_env=options.runtime_env,
         )
         return out if isinstance(out, list) else [out]
 
@@ -178,6 +179,7 @@ class ClusterBackend:
             max_restarts=options.max_restarts,
             pg_id=pg_id,
             bundle_index=bundle_index,
+            runtime_env=options.runtime_env,
         )
 
     def get_named_actor(self, name: str, namespace: Optional[str] = None):

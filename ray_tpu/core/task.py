@@ -24,6 +24,9 @@ class TaskOptions:
     placement_group: Optional[Any] = None  # PlacementGroup
     placement_group_bundle_index: int = -1
     scheduling_strategy: Optional[Any] = None
+    # {env_vars, working_dir, py_modules} — cluster mode only (worker
+    # processes); the in-process thread runtime cannot isolate an env
+    runtime_env: Optional[dict] = None
 
     def resource_set(self) -> ResourceSet:
         req = dict(self.resources)
@@ -48,6 +51,7 @@ class ActorOptions:
     placement_group: Optional[Any] = None
     placement_group_bundle_index: int = -1
     scheduling_strategy: Optional[Any] = None
+    runtime_env: Optional[dict] = None
 
     def resource_set(self) -> ResourceSet:
         req = dict(self.resources)

@@ -123,6 +123,7 @@ class BC:
         self.optimizer = optax.adam(cfg.lr)
         self.opt_state = self.optimizer.init(self.params)
         self.iteration = 0
+        self._infer = jax.jit(self.module.inference)
         self._build_update()
 
     def _build_update(self):
@@ -157,9 +158,7 @@ class BC:
                 "dataset_size": len(self.dataset)}
 
     def compute_actions(self, obs) -> np.ndarray:
-        return np.asarray(
-            jax.jit(self.module.inference)(self.params, jnp.asarray(obs))
-        )
+        return np.asarray(self._infer(self.params, jnp.asarray(obs)))
 
     def get_state(self) -> dict:
         return {"params": jax.device_get(self.params),
@@ -189,6 +188,7 @@ class CQL:
         self.sac = SAC(sac_config)
         self.updates_per_iteration = updates_per_iteration
         self.iteration = 0
+        self._infer = jax.jit(self.sac.module.inference)
 
     def train(self) -> dict:
         m: dict = {}
@@ -204,6 +204,4 @@ class CQL:
         return self.sac.params
 
     def compute_actions(self, obs) -> np.ndarray:
-        return np.asarray(
-            jax.jit(self.sac.module.inference)(self.sac.params, jnp.asarray(obs))
-        )
+        return np.asarray(self._infer(self.sac.params, jnp.asarray(obs)))

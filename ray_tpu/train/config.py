@@ -26,6 +26,11 @@ class ScalingConfig:
     resources_per_worker: dict = dataclasses.field(default_factory=dict)
     placement_strategy: str = "PACK"
     pod_type: Optional[str] = None  # e.g. "v5p-16": gang = the slice's hosts
+    # elastic training (reference: Train v2 scaling_policy): when set, each
+    # attempt sizes the gang to what the cluster can actually place, between
+    # min_workers and num_workers — a shrunk cluster trains on fewer hosts
+    # instead of failing; a recovered one scales back up on the next attempt
+    min_workers: Optional[int] = None
 
     def worker_resources(self) -> dict:
         res = dict(self.resources_per_worker)

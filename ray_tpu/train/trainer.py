@@ -218,8 +218,34 @@ class JaxTrainer:
                 error, failures, failure_cfg.max_failures,
             )
 
-    def _run_attempt(self, trial_dir, manager, resume_ckpt, history, last_metrics):
+    def _gang_size(self) -> int:
+        """Elastic sizing: the largest gang in [min_workers, num_workers]
+        the cluster can place right now (Train-v2 scaling_policy seam)."""
         n = self._scaling.num_workers
+        mn = self._scaling.min_workers
+        if not mn or mn >= n:
+            return n
+        req = self._scaling.worker_resources()
+        try:
+            avail = api.available_resources()
+        except Exception:
+            return n
+        fits = n
+        for k, v in req.items():
+            if v <= 0:
+                continue
+            # cluster naming vs in-process naming for the CPU resource
+            a = avail.get(k, avail.get("num_cpus" if k == "CPU" else k, 0.0))
+            fits = min(fits, int(a // v))
+        return max(mn, min(n, fits))
+
+    def _run_attempt(self, trial_dir, manager, resume_ckpt, history, last_metrics):
+        n = self._gang_size()
+        if n < self._scaling.num_workers:
+            logger.warning(
+                "elastic gang: sizing down to %d/%d workers (cluster capacity)",
+                n, self._scaling.num_workers,
+            )
         channel = None
         cursor = [0]
 

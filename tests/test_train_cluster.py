@@ -121,3 +121,23 @@ def test_train_gang_spreads_across_nodes(attached_cluster, tmp_path):
     assert set(nodes.keys()) == {0, 1}
     assert set(nodes.values()) == {"t0", "t1"}  # STRICT_SPREAD: one per node
     api.kill(collector)
+
+
+def test_elastic_gang_sizes_to_capacity(attached_cluster, tmp_path):
+    """Ask for 4 workers with min_workers=1 on a 2-CPU cluster: the gang
+    elastically sizes to 2 instead of failing placement (reference:
+    Train v2 scaling_policy elastic sizing)."""
+
+    def loop(config):
+        session.report({"world": session.get_world_size()})
+
+    trainer = JaxTrainer(
+        loop,
+        scaling_config=ScalingConfig(
+            num_workers=4, min_workers=1, resources_per_worker={"CPU": 1},
+        ),
+        run_config=RunConfig(storage_path=str(tmp_path), name="elastic"),
+    )
+    result = trainer.fit()
+    assert result.error is None, result.error
+    assert result.metrics["world"] == 2  # t0 + t1 have 1 CPU each
