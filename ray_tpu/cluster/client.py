@@ -792,20 +792,52 @@ class ClusterClient:
     # -- runtime envs ---------------------------------------------------------
 
     def _package_runtime_env(self, runtime_env: Optional[dict]) -> Optional[dict]:
-        """Zip + stage a runtime env's directories; cache by content so a
-        task storm doesn't re-upload the same working_dir, and PIN the
-        staged packages for the client's lifetime (workers fetch them on
-        every env-dedicated worker spawn)."""
+        """Zip + stage a runtime env's directories, memoizing the WHOLE
+        wire form by (spec, directory fingerprints) so a task storm pays
+        one stat-walk per submit instead of a re-zip; staged packages are
+        PINNED for the client's lifetime (workers fetch them on every
+        env-dedicated worker spawn)."""
         if not runtime_env:
             return None
+        import hashlib
+        import json
+        import os as _os
+
         from ray_tpu.cluster.runtime_env import package_runtime_env
 
         if not hasattr(self, "_env_packages"):
             self._env_packages: dict[str, ClusterObjectRef] = {}
+            self._env_wire_cache: dict[str, dict] = {}
+
+        def fingerprint(path: str) -> tuple:
+            out = []
+            for root, dirs, files in _os.walk(path, followlinks=True):
+                dirs.sort()
+                for f in sorted(files):
+                    try:
+                        st = _os.stat(_os.path.join(root, f))
+                        out.append((_os.path.relpath(_os.path.join(root, f), path),
+                                    st.st_size, st.st_mtime_ns))
+                    except OSError:
+                        pass
+            return tuple(out)
+
+        spec_key = json.dumps(
+            {
+                "env_vars": runtime_env.get("env_vars", {}),
+                "working_dir": [runtime_env.get("working_dir"),
+                                fingerprint(runtime_env["working_dir"])
+                                if runtime_env.get("working_dir") else None],
+                "py_modules": [(m, fingerprint(m))
+                               for m in runtime_env.get("py_modules", ())],
+            },
+            sort_keys=True, default=str,
+        )
+        cached = self._env_wire_cache.get(spec_key)
+        if cached is not None:
+            return cached
 
         def put_pkg(data: bytes) -> bytes:
-            import hashlib
-
             key = hashlib.sha256(data).hexdigest()
             ref = self._env_packages.get(key)
             if ref is None:
@@ -813,7 +845,9 @@ class ClusterClient:
                 self._env_packages[key] = ref  # pinned until close
             return ref.id
 
-        return package_runtime_env(runtime_env, put_pkg)
+        wire = package_runtime_env(runtime_env, put_pkg)
+        self._env_wire_cache[spec_key] = wire
+        return wire
 
     # -- placement groups -----------------------------------------------------
 

@@ -451,16 +451,18 @@ class NodeDaemon:
 
         key = env_hash(runtime_env)
         with self._wlock:
+            pool = self._idle_workers.get(key, [])
+            while pool:
+                w = pool.pop()
+                if w.alive():
+                    # a live worker trumps any stale spawn error
+                    getattr(self, "_spawn_errors", {}).pop(key, None)
+                    return w
             err = getattr(self, "_spawn_errors", {}).pop(key, None)
             if err is not None:
                 # a background spawn for this env failed (bad runtime_env,
                 # missing package): surface it instead of retrying forever
                 raise RpcError(f"worker spawn failed: {err}")
-            pool = self._idle_workers.get(key, [])
-            while pool:
-                w = pool.pop()
-                if w.alive():
-                    return w
         if not block:
             # the single granter thread must never sit in a multi-second
             # worker spawn (it would stall every other queued lease):

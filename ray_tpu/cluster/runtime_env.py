@@ -43,7 +43,9 @@ def _zip_dir(path: str) -> bytes:
     """Deterministic zip of a directory tree (stable hash across runs)."""
     buf = io.BytesIO()
     with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
-        for root, dirs, files in os.walk(path):
+        # followlinks: a symlinked data/ subdir must ship its contents,
+        # not silently vanish from the package
+        for root, dirs, files in os.walk(path, followlinks=True):
             dirs.sort()
             if "__pycache__" in dirs:
                 dirs.remove("__pycache__")

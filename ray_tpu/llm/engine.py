@@ -59,6 +59,13 @@ class EngineConfig:
     # placement group, vllm_models.py:117-131 — here it's one SPMD
     # program over the mesh, no worker gang)
     mesh_spec: Any = None
+    # LoRA multiplexing: serve up to max_loras adapters from ONE engine
+    # with mixed-adapter continuous batching — every sequence in a decode
+    # batch may use a different adapter (reference: per-replica adapter
+    # load/unload, llm/_internal/serve/deployments/llm/multiplex/)
+    max_loras: int = 0
+    lora_rank: int = 8
+    lora_targets: tuple = ("wq", "wv")
 
     def __post_init__(self):
         # a prefill bucket longer than the context window can never be
@@ -106,6 +113,7 @@ class Request:
     num_preemptions: int = 0
     cumulative_logprob: float = 0.0
     token_logprobs: list = dataclasses.field(default_factory=list)
+    lora_slot: int = 0
     _key: Any = None
 
     @property
@@ -171,22 +179,107 @@ class LLMEngine:
         self._counter = itertools.count()
         self._root_key = jax.random.key(seed ^ 0x5EED)
 
+        # LoRA adapter stacks: slot 0 is the zero adapter ("no lora");
+        # per-target A [L, n_slots, d_in, r], B [L, n_slots, r, d_out]
+        self._lora_slots: dict[str, int] = {}
+        self._lora = None
+        if c.max_loras > 0:
+            m = c.model
+            n = c.max_loras + 1
+            out_dims = {
+                "wq": m.n_heads * m.head_dim,
+                "wk": m.n_kv_heads * m.head_dim,
+                "wv": m.n_kv_heads * m.head_dim,
+            }
+            stacks = {}
+            for t in c.lora_targets:
+                stacks[f"{t}_A"] = jnp.zeros(
+                    (m.n_layers, n, m.d_model, c.lora_rank), m.dtype
+                )
+                stacks[f"{t}_B"] = jnp.zeros(
+                    (m.n_layers, n, c.lora_rank, out_dims[t]), m.dtype
+                )
+            self._lora = stacks
+
         # jitted entry points; cache buffers are donated so XLA updates pages
         # in place instead of copying the whole cache every step
         self._prefill = jax.jit(
-            lambda params, t, p, sl, sm, bt, cl, cache: prefill(
+            lambda params, t, p, sl, sm, bt, cl, cache, lora: prefill(
                 params, t, p, sl, sm, bt, cl, cache, c.model,
-                block_size=c.block_size,
+                block_size=c.block_size, lora=lora,
             ),
             donate_argnums=(7,),
         )
         self._decode = jax.jit(
-            lambda params, t, p, sm, bt, cl, cache: decode_step(
+            lambda params, t, p, sm, bt, cl, cache, lora: decode_step(
                 params, t, p, sm, bt, cl, cache, c.model,
-                block_size=c.block_size, attn_impl=c.attn_impl,
+                block_size=c.block_size, attn_impl=c.attn_impl, lora=lora,
             ),
             donate_argnums=(6,),
         )
+
+    # -- LoRA multiplexing ----------------------------------------------------
+
+    def add_lora(self, lora_id: str, adapters: dict) -> None:
+        """Register an adapter: {"wq": (A [L,d,r], B [L,r,out]), ...} for
+        the configured lora_targets. Requests select it by lora_id."""
+        c = self.config
+        if c.max_loras <= 0:
+            raise ValueError("EngineConfig.max_loras is 0: LoRA disabled")
+        if lora_id in self._lora_slots:
+            raise ValueError(f"lora {lora_id!r} already loaded")
+        if len(self._lora_slots) >= c.max_loras:
+            raise ValueError(f"all {c.max_loras} adapter slots in use")
+        used = set(self._lora_slots.values())
+        slot = next(i for i in range(1, c.max_loras + 1) if i not in used)
+        for t, (A, B) in adapters.items():
+            if t not in c.lora_targets:
+                raise ValueError(
+                    f"adapter target {t!r} not in lora_targets={c.lora_targets}"
+                )
+            self._lora[f"{t}_A"] = self._lora[f"{t}_A"].at[:, slot].set(
+                jnp.asarray(A, self.config.model.dtype)
+            )
+            self._lora[f"{t}_B"] = self._lora[f"{t}_B"].at[:, slot].set(
+                jnp.asarray(B, self.config.model.dtype)
+            )
+        self._lora_slots[lora_id] = slot
+
+    def remove_lora(self, lora_id: str) -> None:
+        slot = self._lora_slots.get(lora_id)
+        if slot is None:
+            raise ValueError(f"unknown lora {lora_id!r}")
+        in_flight = [
+            r.request_id for r in list(self.waiting) + self.running
+            if r.lora_slot == slot
+        ]
+        if in_flight:
+            # zeroing the slot mid-generation would silently switch those
+            # sequences to the base model
+            raise ValueError(
+                f"lora {lora_id!r} is in use by requests {in_flight[:4]}; "
+                "abort or drain them first"
+            )
+        self._lora_slots.pop(lora_id)
+        for k in list(self._lora):
+            self._lora[k] = self._lora[k].at[:, slot].set(0.0)
+        # cached prefixes salted with this slot would serve the NEXT
+        # adapter assigned to it stale K/V
+        self.allocator.drop_prefix_cache()
+
+    def _lora_slot(self, lora_id) -> int:
+        if lora_id is None:
+            return 0
+        try:
+            return self._lora_slots[lora_id]
+        except KeyError:
+            raise ValueError(f"unknown lora {lora_id!r}; add_lora first") from None
+
+    def _lora_arg(self, ids: "np.ndarray") -> "dict | None":
+        if self._lora is None:
+            return None
+        # stacks are [L, n_slots, ...]; the scan consumes the layer dim
+        return {"ids": jnp.asarray(ids, jnp.int32), **self._lora}
 
     # -- public API -----------------------------------------------------------
 
@@ -195,9 +288,11 @@ class LLMEngine:
         prompt_token_ids: list,
         sampling_params: Optional[SamplingParams] = None,
         request_id: Optional[str] = None,
+        lora_id: Optional[str] = None,
     ) -> str:
         sp = sampling_params or SamplingParams()
         rid = request_id or f"req-{next(self._counter)}"
+        lora_slot = self._lora_slot(lora_id)
         if len(prompt_token_ids) > self.config.max_prefill_len:
             raise ValueError(
                 f"prompt length {len(prompt_token_ids)} exceeds "
@@ -221,6 +316,7 @@ class LLMEngine:
                 f"{self.config.num_blocks}; raise num_blocks or shorten it"
             )
         req = Request(rid, list(map(int, prompt_token_ids)), sp)
+        req.lora_slot = lora_slot
         key = self._root_key if sp.seed is None else jax.random.key(sp.seed)
         req._key = jax.random.fold_in(key, hash(rid) & 0x7FFFFFFF)
         self.requests[rid] = req
@@ -300,13 +396,17 @@ class LLMEngine:
         # leave >=1 token to prefill so we get next-token logits)
         matched_blocks: list = []
         matched = 0
+        # adapters change K/V: salt the prefix-hash chain by lora slot so
+        # sequences under different adapters never share cached blocks
+        salt = req.lora_slot
+        seq.chain = salt
         if c.enable_prefix_caching:
-            blocks, matched, chain = self.allocator.match_prefix(prompt)
+            blocks, matched, chain = self.allocator.match_prefix(prompt, salt)
             if matched >= len(prompt):
                 # whole prompt cached — we still need last-token logits, so
                 # re-match against prompt[:-1] to leave >=1 token to prefill
                 self.allocator.free(blocks)
-                blocks, matched, chain = self.allocator.match_prefix(prompt[:-1])
+                blocks, matched, chain = self.allocator.match_prefix(prompt[:-1], salt)
             if blocks:
                 seq.adopt_prefix(blocks, chain, matched)
                 matched_blocks = blocks
@@ -347,6 +447,7 @@ class LLMEngine:
                 bt,
                 jnp.asarray([start + len(chunk)], jnp.int32),
                 self.cache,
+                self._lora_arg(np.asarray([req.lora_slot], np.int32)),
             )
         seq.num_tokens = len(prompt)
         if c.enable_prefix_caching:
@@ -394,6 +495,7 @@ class LLMEngine:
         positions = np.zeros(B_pad, np.int32)
         slot_mapping = np.full(B_pad, num_slots, np.int32)
         context_lens = np.zeros(B_pad, np.int32)
+        lora_ids = np.zeros(B_pad, np.int32)
         bt = np.zeros((B_pad, c.max_blocks_per_seq), np.int32)
         for i, r in enumerate(batch):
             last_tok = (
@@ -404,6 +506,7 @@ class LLMEngine:
             positions[i] = pos
             slot_mapping[i] = r.seq.slot(pos)
             context_lens[i] = r.num_tokens
+            lora_ids[i] = r.lora_slot
             bt[i, : len(r.seq.blocks)] = r.seq.blocks
 
         logits, self.cache = self._decode(
@@ -414,6 +517,7 @@ class LLMEngine:
             jnp.asarray(bt),
             jnp.asarray(context_lens),
             self.cache,
+            self._lora_arg(lora_ids),
         )
         tok, logprob = self._sample_batch(logits[:B], batch)
         return self._append_tokens(batch, tok, logprob)

@@ -125,6 +125,19 @@ class BlockAllocator:
     def chain_hash(parent_hash: int, block_tokens: tuple) -> int:
         return hash((parent_hash, block_tokens))
 
+    def drop_prefix_cache(self) -> None:
+        """Invalidate ALL cached prefixes: zero-ref cached blocks return to
+        the free list, live blocks lose their hashes (they stay private to
+        their sequences). Needed when cached K/V may no longer match what
+        a salt would recompute — e.g. a LoRA slot being reused by a new
+        adapter."""
+        for b in self._zero_ref_lru:
+            self._block_hash.pop(b, None)
+            self._free.append(b)
+        self._zero_ref_lru.clear()
+        self._hash_to_block.clear()
+        self._block_hash.clear()
+
     def register_full_block(self, block_id: int, content_hash: int) -> None:
         """Mark a just-written full block reusable under its content hash."""
         existing = self._hash_to_block.get(content_hash)
@@ -143,11 +156,15 @@ class BlockAllocator:
         self._refcount[b] = self._refcount.get(b, 0) + 1
         return b
 
-    def match_prefix(self, tokens: list[int]) -> tuple[list[int], int, int]:
+    def match_prefix(self, tokens: list[int],
+                     salt: int = 0) -> tuple[list[int], int, int]:
         """Longest cached chain of FULL blocks prefixing `tokens`.
-        Returns (block_ids_with_refs_taken, num_tokens_matched, chain_hash)."""
+        Returns (block_ids_with_refs_taken, num_tokens_matched, chain_hash).
+        `salt` roots the chain (e.g. a LoRA adapter id): sequences under
+        different adapters produce different K/V for the same tokens, so
+        their prefixes must never cross-match."""
         matched: list[int] = []
-        h = chain = 0
+        h = chain = salt
         n_full = len(tokens) // self.block_size
         for i in range(n_full):
             blk = tuple(tokens[i * self.block_size : (i + 1) * self.block_size])

@@ -55,6 +55,37 @@ def _unstack_layer(params_layers: Params, i) -> Params:
     return jax.tree.map(lambda x: x[i], params_layers)
 
 
+def _lora_delta(x, A_l, B_l, ids):
+    """Per-row LoRA delta: x [B,S,d], A_l [n_slots,d,r], B_l [n_slots,r,o],
+    ids [B] (slot 0 = zero adapter). Returns [B,S,o]."""
+    t = jnp.einsum("bsd,bdr->bsr", x, A_l[ids].astype(x.dtype))
+    return jnp.einsum("bsr,bro->bso", t, B_l[ids].astype(x.dtype))
+
+
+def _apply_lora(q, k, v, x, lora_l, ids, c: LlamaConfig):
+    """Add per-sequence adapter deltas to the attention projections.
+
+    lora_l: this layer's stacks {"wq_A": [n,d,r], "wq_B": [n,r,H*hd], ...}
+    — mixed-adapter continuous batching: every row of the batch may use a
+    different adapter (or none), selected by `ids` (reference role: LoRA
+    multiplexing, llm/_internal/serve/deployments/llm/multiplex/)."""
+    B, S, _ = x.shape
+    hd = c.head_dim
+    if "wq_A" in lora_l:
+        q = q + _lora_delta(x, lora_l["wq_A"], lora_l["wq_B"], ids).reshape(
+            B, S, c.n_heads, hd
+        )
+    if "wk_A" in lora_l:
+        k = k + _lora_delta(x, lora_l["wk_A"], lora_l["wk_B"], ids).reshape(
+            B, S, c.n_kv_heads, hd
+        )
+    if "wv_A" in lora_l:
+        v = v + _lora_delta(x, lora_l["wv_A"], lora_l["wv_B"], ids).reshape(
+            B, S, c.n_kv_heads, hd
+        )
+    return q, k, v
+
+
 def prefill(
     params: Params,
     tokens: jax.Array,       # [B, S_pad] suffix tokens (right-padded)
@@ -67,6 +98,7 @@ def prefill(
     config: LlamaConfig,
     *,
     block_size: int,
+    lora: "dict | None" = None,  # {"ids": [B], "<t>_A": [L,n,d,r], "<t>_B": [L,n,r,o]}
 ) -> tuple[jax.Array, Cache]:
     """Returns (last-valid-token logits [B, V], updated cache)."""
     c = config
@@ -80,11 +112,21 @@ def prefill(
     h = params["embed"].astype(c.dtype)[tokens]
     flat_slots = slot_mapping.reshape(-1)  # [B*S]
 
+    lora_ids = lora["ids"] if lora is not None else None
+    lora_stacks = (
+        {k_: v_ for k_, v_ in lora.items() if k_ != "ids"} if lora is not None else None
+    )
+
     def layer_step(carry, xs):
         h, = carry
-        lp, k_cache_l, v_cache_l = xs
+        if lora_stacks is not None:
+            lp, k_cache_l, v_cache_l, lora_l = xs
+        else:
+            lp, k_cache_l, v_cache_l = xs
         x = rms_norm(h, lp["ln1"], c.rms_eps)
         q, k, v = _qkv(x, lp, c)
+        if lora_stacks is not None:
+            q, k, v = _apply_lora(q, k, v, x, lora_l, lora_ids, c)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
         # scatter suffix K/V into this layer's pages (pad rows -> trash slot)
@@ -103,9 +145,10 @@ def prefill(
         h = h + swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
         return (h,), (k_cache_l, v_cache_l)
 
-    (h,), (new_k, new_v) = jax.lax.scan(
-        layer_step, (h,), (params["layers"], cache["k"], cache["v"])
-    )
+    xs = (params["layers"], cache["k"], cache["v"])
+    if lora_stacks is not None:
+        xs = xs + (lora_stacks,)
+    (h,), (new_k, new_v) = jax.lax.scan(layer_step, (h,), xs)
     h = rms_norm(h, params["final_norm"], c.rms_eps)
     # only the last valid suffix position's logits matter per row
     last = jnp.clip(suffix_lens - 1, 0, S - 1)  # [B]
@@ -167,6 +210,7 @@ def decode_step(
     *,
     block_size: int,
     attn_impl: str = "auto",
+    lora: "dict | None" = None,
 ) -> tuple[jax.Array, Cache]:
     """One decode step for the running batch -> (logits [B, V], cache)."""
     c = config
@@ -175,11 +219,21 @@ def decode_step(
     h = params["embed"].astype(c.dtype)[tokens][:, None]  # [B, 1, D]
     pos2 = positions[:, None]  # [B, 1]
 
+    lora_ids = lora["ids"] if lora is not None else None
+    lora_stacks = (
+        {k_: v_ for k_, v_ in lora.items() if k_ != "ids"} if lora is not None else None
+    )
+
     def layer_step(carry, xs):
         h, = carry
-        lp, k_cache_l, v_cache_l = xs
+        if lora_stacks is not None:
+            lp, k_cache_l, v_cache_l, lora_l = xs
+        else:
+            lp, k_cache_l, v_cache_l = xs
         x = rms_norm(h, lp["ln1"], c.rms_eps)
         q, k, v = _qkv(x, lp, c)
+        if lora_stacks is not None:
+            q, k, v = _apply_lora(q, k, v, x, lora_l, lora_ids, c)
         q = apply_rope(q, cos, sin, pos2)
         k = apply_rope(k, cos, sin, pos2)
         k_cache_l = k_cache_l.at[slot_mapping].set(
@@ -202,9 +256,10 @@ def decode_step(
         h = h + swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
         return (h,), (k_cache_l, v_cache_l)
 
-    (h,), (new_k, new_v) = jax.lax.scan(
-        layer_step, (h,), (params["layers"], cache["k"], cache["v"])
-    )
+    xs = (params["layers"], cache["k"], cache["v"])
+    if lora_stacks is not None:
+        xs = xs + (lora_stacks,)
+    (h,), (new_k, new_v) = jax.lax.scan(layer_step, (h,), xs)
     h = rms_norm(h[:, 0], params["final_norm"], c.rms_eps)  # [B, D]
     w_out = params.get("lm_head", None)
     if w_out is None:

@@ -217,6 +217,13 @@ class JaxTrainer:
                 "train attempt failed (%s); restarting gang (failure %d/%s)",
                 error, failures, failure_cfg.max_failures,
             )
+            if self._scaling.min_workers:
+                # elastic: let the failed attempt's leases release and the
+                # availability view refresh before sizing the next gang,
+                # or it would collapse toward min_workers spuriously
+                import time as _time
+
+                _time.sleep(2.0)
 
     def _gang_size(self) -> int:
         """Elastic sizing: the largest gang in [min_workers, num_workers]

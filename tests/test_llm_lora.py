@@ -1,0 +1,109 @@
+"""LoRA multiplexing: mixed-adapter continuous batching from ONE engine
+(reference role: llm/_internal/serve/deployments/llm/multiplex/ — there,
+per-replica adapter load/unload; here, per-SEQUENCE adapter selection
+inside each prefill/decode batch)."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from ray_tpu.llm import EngineConfig, LLMEngine, SamplingParams
+from ray_tpu.models import llama
+
+CFG = EngineConfig(
+    model=llama.LLAMA_TINY, num_blocks=64, max_num_seqs=4,
+    max_loras=2, lora_rank=4,
+)
+PROMPT = [5, 9, 17, 3]
+
+
+def _adapters(seed, scale=1.0):
+    m = CFG.model
+    rng = np.random.RandomState(seed)
+    mk = lambda *shape: (rng.randn(*shape) * scale).astype(np.float32)
+    r = CFG.lora_rank
+    return {
+        "wq": (mk(m.n_layers, m.d_model, r), mk(m.n_layers, r, m.n_heads * m.head_dim)),
+        "wv": (mk(m.n_layers, m.d_model, r), mk(m.n_layers, r, m.n_kv_heads * m.head_dim)),
+    }
+
+
+def _gen(engine, lora_id=None, n=10):
+    rid = engine.add_request(PROMPT, SamplingParams(max_tokens=n, temperature=0.0),
+                             lora_id=lora_id)
+    out = []
+    while engine.has_unfinished():
+        for ro in engine.step():
+            if ro.request_id == rid and ro.finished:
+                out = ro.output_token_ids
+    return tuple(out)
+
+
+def test_zero_adapter_matches_base():
+    base = LLMEngine(EngineConfig(model=llama.LLAMA_TINY, num_blocks=64,
+                                  max_num_seqs=4), seed=7)
+    lora = LLMEngine(CFG, seed=7)
+    assert _gen(base) == _gen(lora, None)  # slot 0 = exact no-op
+
+
+def test_adapters_change_output_and_multiplex():
+    engine = LLMEngine(CFG, seed=7)
+    engine.add_lora("styleA", _adapters(1, scale=0.5))
+    engine.add_lora("styleB", _adapters(2, scale=0.5))
+
+    base_out = _gen(engine, None)
+    a_out = _gen(engine, "styleA")
+    b_out = _gen(engine, "styleB")
+    assert a_out != base_out and b_out != base_out and a_out != b_out
+
+    # MIXED batch: all three adapters decode concurrently and each request
+    # reproduces its solo output exactly
+    rids = {
+        engine.add_request(PROMPT, SamplingParams(max_tokens=10, temperature=0.0),
+                           lora_id=lid): expect
+        for lid, expect in [(None, base_out), ("styleA", a_out), ("styleB", b_out)]
+    }
+    got = {}
+    while engine.has_unfinished():
+        for ro in engine.step():
+            if ro.finished and ro.request_id in rids:
+                got[ro.request_id] = tuple(ro.output_token_ids)
+    for rid, expect in rids.items():
+        assert got[rid] == expect, (got[rid], expect)
+
+
+def test_prefix_cache_isolated_per_adapter():
+    engine = LLMEngine(CFG, seed=7)
+    engine.add_lora("styleA", _adapters(1, scale=0.5))
+    long_prompt = list(range(40, 40 + 3 * CFG.block_size + 2))
+    base = _gen_prompt(engine, long_prompt, None)
+    # same tokens under an adapter must NOT reuse base-cached blocks
+    a1 = _gen_prompt(engine, long_prompt, "styleA")
+    a2 = _gen_prompt(engine, long_prompt, "styleA")
+    assert a1 != base
+    assert a1 == a2  # adapter runs are self-consistent (cache or not)
+
+
+def _gen_prompt(engine, prompt, lora_id, n=8):
+    rid = engine.add_request(prompt, SamplingParams(max_tokens=n, temperature=0.0),
+                             lora_id=lora_id)
+    out = []
+    while engine.has_unfinished():
+        for ro in engine.step():
+            if ro.request_id == rid and ro.finished:
+                out = ro.output_token_ids
+    return tuple(out)
+
+
+def test_lora_slot_management():
+    engine = LLMEngine(CFG, seed=0)
+    engine.add_lora("a", _adapters(1))
+    engine.add_lora("b", _adapters(2))
+    with pytest.raises(ValueError, match="slots in use"):
+        engine.add_lora("c", _adapters(3))
+    engine.remove_lora("a")
+    engine.add_lora("c", _adapters(3))  # freed slot reused
+    with pytest.raises(ValueError, match="unknown lora"):
+        engine.add_request(PROMPT, lora_id="nope")
