@@ -48,10 +48,10 @@ PEAK_FLOPS = [
     ("v3", 123e12),
 ]
 
-PROBE_TIMEOUT_S = 150.0  # first backend init can legitimately take ~40s
-PROBE_ATTEMPTS = 3
-BENCH_TIMEOUT_S = 1500.0
-FALLBACK_TIMEOUT_S = 600.0
+PROBE_TIMEOUT_S = 120.0  # first backend init can legitimately take ~40s
+PROBE_ATTEMPTS = 2
+BENCH_TIMEOUT_S = 1200.0
+FALLBACK_TIMEOUT_S = 420.0
 
 
 def peak_flops(device) -> float:

@@ -181,6 +181,7 @@ class ClusterClient:
         from collections import deque as _deque
 
         self._rc_ops: "_deque[tuple[str, bytes]]" = _deque()
+        self._spans: "_deque[dict]" = _deque(maxlen=10000)  # task tracing
         # drivers own their objects and free on last handle drop; worker
         # processes only BORROW (their task returns are owned by the
         # submitting driver) — worker_main flips this off so a worker
@@ -561,7 +562,9 @@ class ClusterClient:
         raise RpcError("placement-group lease timed out")
 
     def _run_once(self, payload: dict, spec: dict, exclude: list) -> None:
+        t0 = time.monotonic()
         grant, daemon = self._lease(spec, exclude)
+        t_leased = time.monotonic()
         worker_addr = tuple(grant["worker_addr"])
         kill = False
         try:
@@ -576,6 +579,10 @@ class ClusterClient:
             self.pool.invalidate(worker_addr)
             raise
         finally:
+            self._record_span(
+                payload.get("desc", "task"), grant.get("node_id"), t0,
+                t_leased, time.monotonic(),
+            )
             # release immediately: the daemon queues lease requests and its
             # idle-worker pool makes re-grant instant, so holding leases
             # client-side would only starve other queued submitters
@@ -587,6 +594,59 @@ class ClusterClient:
                 )
             except (RpcError, RemoteError):
                 pass  # daemon died with its node; lease died with it
+
+    # -- tracing --------------------------------------------------------------
+
+    def _record_span(self, desc: str, node_id, t0: float, t_leased: float,
+                     t_done: float) -> None:
+        """Per-task spans (lease wait + execution), bounded buffer.
+        Reference analog: per-task ProfileEvents batched into
+        GcsTaskManager powering `ray timeline` (core_worker/
+        task_event_buffer.h); here driver-side, exported Chrome-trace."""
+        self._spans.append(
+            {"desc": desc, "node": node_id, "start": t0,
+             "leased": t_leased, "end": t_done}
+        )
+
+    def timeline(self) -> list:
+        """Chrome-trace events (chrome://tracing / Perfetto) for this
+        driver's cluster tasks: a `lease` slice and an `exec` slice per
+        task, rows grouped by node (the `ray timeline` analog for the
+        cluster plane)."""
+        spans = list(getattr(self, "_spans", ()))
+        events = []
+        for i, s in enumerate(spans):
+            for name, a, b in (("lease", "start", "leased"),
+                               ("exec", "leased", "end")):
+                events.append({
+                    "name": f"{s['desc']}:{name}",
+                    "ph": "X",
+                    "ts": s[a] * 1e6,
+                    "dur": max(0.0, (s[b] - s[a])) * 1e6,
+                    "pid": s["node"] or "cluster",
+                    "tid": i % 64,
+                    "cat": name,
+                })
+        return events
+
+    def task_stats(self) -> dict:
+        """Aggregate latency split across recorded spans (ms)."""
+        spans = list(getattr(self, "_spans", ()))
+        if not spans:
+            return {"tasks": 0}
+        lease = [(s["leased"] - s["start"]) * 1e3 for s in spans]
+        ex = [(s["end"] - s["leased"]) * 1e3 for s in spans]
+        lease.sort()
+        ex.sort()
+
+        def pct(a, p):
+            return round(a[min(len(a) - 1, int(len(a) * p))], 2)
+
+        return {
+            "tasks": len(spans),
+            "lease_ms_p50": pct(lease, 0.5), "lease_ms_p99": pct(lease, 0.99),
+            "exec_ms_p50": pct(ex, 0.5), "exec_ms_p99": pct(ex, 0.99),
+        }
 
     # -- actors ---------------------------------------------------------------
 
@@ -803,16 +863,24 @@ class ClusterClient:
         import json
         import os as _os
 
-        from ray_tpu.cluster.runtime_env import package_runtime_env
+        from ray_tpu.cluster.runtime_env import (
+            package_runtime_env,
+            validate_keys,
+            walk_dir,
+        )
 
+        # validate BEFORE the cache: a cached wire form must not let a
+        # later request smuggle a rejected key (pip/conda) past the check
+        validate_keys(runtime_env)
         if not hasattr(self, "_env_packages"):
             self._env_packages: dict[str, ClusterObjectRef] = {}
             self._env_wire_cache: dict[str, dict] = {}
 
         def fingerprint(path: str) -> tuple:
+            # mirrors _zip_dir's walk (cycle-safe, __pycache__-free) so
+            # pyc churn can't invalidate a byte-identical package
             out = []
-            for root, dirs, files in _os.walk(path, followlinks=True):
-                dirs.sort()
+            for root, dirs, files in walk_dir(path):
                 for f in sorted(files):
                     try:
                         st = _os.stat(_os.path.join(root, f))

@@ -39,16 +39,32 @@ SUPPORTED_KEYS = {"env_vars", "working_dir", "py_modules"}
 REJECTED_KEYS = {"pip", "conda", "container", "image_uri", "uv"}
 
 
+def walk_dir(path: str):
+    """os.walk with followlinks (a symlinked data/ subdir must ship, not
+    silently vanish) plus cycle detection by (st_dev, st_ino) so a
+    self-referential link can't recurse forever. Skips __pycache__."""
+    seen: set = set()
+    for root, dirs, files in os.walk(path, followlinks=True):
+        try:
+            st = os.stat(root)
+        except OSError:
+            continue
+        key = (st.st_dev, st.st_ino)
+        if key in seen:
+            dirs[:] = []
+            continue
+        seen.add(key)
+        dirs.sort()
+        if "__pycache__" in dirs:
+            dirs.remove("__pycache__")
+        yield root, dirs, files
+
+
 def _zip_dir(path: str) -> bytes:
     """Deterministic zip of a directory tree (stable hash across runs)."""
     buf = io.BytesIO()
     with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
-        # followlinks: a symlinked data/ subdir must ship its contents,
-        # not silently vanish from the package
-        for root, dirs, files in os.walk(path, followlinks=True):
-            dirs.sort()
-            if "__pycache__" in dirs:
-                dirs.remove("__pycache__")
+        for root, dirs, files in walk_dir(path):
             for f in sorted(files):
                 full = os.path.join(root, f)
                 rel = os.path.relpath(full, path)
@@ -59,11 +75,7 @@ def _zip_dir(path: str) -> bytes:
     return buf.getvalue()
 
 
-def package_runtime_env(runtime_env: Optional[dict], put) -> Optional[dict]:
-    """Client side: validate, zip directories, stage zips via `put(bytes)
-    -> object_id`. Returns the wire form of the runtime env (or None)."""
-    if not runtime_env:
-        return None
+def validate_keys(runtime_env: dict) -> None:
     bad = set(runtime_env) & REJECTED_KEYS
     if bad:
         raise ValueError(
@@ -73,6 +85,14 @@ def package_runtime_env(runtime_env: Optional[dict], put) -> Optional[dict]:
     unknown = set(runtime_env) - SUPPORTED_KEYS
     if unknown:
         raise ValueError(f"unknown runtime_env keys: {sorted(unknown)}")
+
+
+def package_runtime_env(runtime_env: Optional[dict], put) -> Optional[dict]:
+    """Client side: validate, zip directories, stage zips via `put(bytes)
+    -> object_id`. Returns the wire form of the runtime env (or None)."""
+    if not runtime_env:
+        return None
+    validate_keys(runtime_env)
     wire: dict[str, Any] = {}
     env_vars = runtime_env.get("env_vars")
     if env_vars:

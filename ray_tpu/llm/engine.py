@@ -230,13 +230,23 @@ class LLMEngine:
             raise ValueError(f"lora {lora_id!r} already loaded")
         if len(self._lora_slots) >= c.max_loras:
             raise ValueError(f"all {c.max_loras} adapter slots in use")
-        used = set(self._lora_slots.values())
-        slot = next(i for i in range(1, c.max_loras + 1) if i not in used)
+        # validate EVERYTHING before mutating: a partial write would leave
+        # stale weights in a slot still marked free
         for t, (A, B) in adapters.items():
             if t not in c.lora_targets:
                 raise ValueError(
                     f"adapter target {t!r} not in lora_targets={c.lora_targets}"
                 )
+            want_a = self._lora[f"{t}_A"].shape[0:1] + self._lora[f"{t}_A"].shape[2:]
+            want_b = self._lora[f"{t}_B"].shape[0:1] + self._lora[f"{t}_B"].shape[2:]
+            if tuple(np.shape(A)) != want_a or tuple(np.shape(B)) != want_b:
+                raise ValueError(
+                    f"adapter {t!r} shapes {np.shape(A)}/{np.shape(B)} != "
+                    f"expected {want_a}/{want_b}"
+                )
+        used = set(self._lora_slots.values())
+        slot = next(i for i in range(1, c.max_loras + 1) if i not in used)
+        for t, (A, B) in adapters.items():
             self._lora[f"{t}_A"] = self._lora[f"{t}_A"].at[:, slot].set(
                 jnp.asarray(A, self.config.model.dtype)
             )

@@ -218,12 +218,18 @@ class JaxTrainer:
                 error, failures, failure_cfg.max_failures,
             )
             if self._scaling.min_workers:
-                # elastic: let the failed attempt's leases release and the
-                # availability view refresh before sizing the next gang,
-                # or it would collapse toward min_workers spuriously
+                # elastic: the failed attempt's leases release asynchronously
+                # and the availability view refreshes by heartbeat — POLL for
+                # capacity recovery (bounded) instead of guessing a sleep, or
+                # the next gang would collapse toward min_workers spuriously
                 import time as _time
 
-                _time.sleep(2.0)
+                deadline = _time.monotonic() + 10.0
+                while (
+                    _time.monotonic() < deadline
+                    and self._gang_size() < self._scaling.num_workers
+                ):
+                    _time.sleep(0.5)
 
     def _gang_size(self) -> int:
         """Elastic sizing: the largest gang in [min_workers, num_workers]
