@@ -26,6 +26,10 @@ BASELINES = {  # BASELINE.md "Core microbenchmarks" (reference, m4.16xlarge)
     "cluster_single_client_put_calls": 4901,
     "cluster_single_client_get_calls": 10975,
     "cluster_placement_group_create_removal": 741,
+    # reference single_client_put_gigabytes = 18.3 GiB/s (plasma zero-copy);
+    # here: end-to-end task-RETURN bandwidth (worker seals into the shm
+    # store, driver pulls once) in MB/s
+    "cluster_task_return_mb_s": 18.3 * 1024,
 }
 
 
@@ -85,6 +89,22 @@ def bench_gets(client, total: int) -> float:
     return total / (time.perf_counter() - t0)
 
 
+def bench_task_returns(client, total: int, mb: int = 8) -> float:
+    """MB/s of large task RETURNS (worker -> shm store -> driver pull)."""
+
+    def big(n):
+        return b"\x7f" * (n << 20)
+
+    t0 = time.perf_counter()
+    refs = [client.submit(big, (mb,), resources={"num_cpus": 1})
+            for _ in range(total)]
+    outs = client.get(refs, timeout=300)
+    dt = time.perf_counter() - t0
+    assert all(len(o) == mb << 20 for o in outs)
+    del outs
+    return total * mb / dt
+
+
 def bench_pgs(client, total: int) -> float:
     t0 = time.perf_counter()
     for _ in range(total):
@@ -127,6 +147,9 @@ def main():
             ),
             "cluster_placement_group_create_removal": lambda: bench_pgs(
                 client, 200 // scale
+            ),
+            "cluster_task_return_mb_s": lambda: bench_task_returns(
+                client, 16 // max(1, scale // 4)
             ),
         }
         for name, fn in measures.items():

@@ -60,12 +60,35 @@ class ObjectService:
 
     def __init__(self, node_id: str, gcs: RpcClient, pool: ClientPool,
                  capacity_bytes: int = 512 << 20,
-                 spill_dir: Optional[str] = None):
+                 spill_dir: Optional[str] = None,
+                 shm_path: Optional[str] = None):
         from collections import OrderedDict
 
         self._objects: "OrderedDict[bytes, bytes]" = OrderedDict()
         self._bytes = 0
         self._capacity = capacity_bytes
+        # shared-memory primary tier (the C++ plasma-equivalent,
+        # native/src/shm_store.cc): workers on this node read results
+        # zero-RPC and write returns without shipping bytes through the
+        # daemon. The daemon PINS every adopted object (holds a ref) so
+        # the store's zero-ref LRU eviction can never drop a primary copy;
+        # shm-full falls back to the Python dict tier + disk spill.
+        self._shm = None
+        self._shm_held: set[bytes] = set()
+        self.shm_path = None
+        if shm_path:
+            try:
+                from ray_tpu.native.shm import ShmObjectStore
+
+                self._shm = ShmObjectStore.create(shm_path, capacity_bytes)
+                self.shm_path = shm_path
+                # ONE memory budget: shm takes it, the dict tier becomes a
+                # small overflow buffer (not a second full-size cache)
+                self._capacity = max(
+                    capacity_bytes // 4, min(capacity_bytes, 16 << 20)
+                )
+            except Exception:
+                logger.exception("shm store unavailable; using dict tier only")
         self._spill_dir = spill_dir or os.path.join(
             os.environ.get("TMPDIR", "/tmp"), f"ray_tpu-spill-{node_id}"
         )
@@ -98,14 +121,49 @@ class ObjectService:
                 logger.exception("spill failed; keeping %s in memory", oid.hex()[:12])
                 return
 
+    # objects below this ride the dict tier: for tiny payloads the shm
+    # alloc/seal/ref protocol costs more than the bytes it saves
+    SHM_MIN_BYTES = 64 << 10
+
+    def _shm_put_pinned(self, object_id: bytes, data: bytes) -> bool:
+        """Store into shm holding the creator ref (pin). False on full."""
+        if self._shm is None or len(data) < self.SHM_MIN_BYTES:
+            return False
+        if not self._shm.put_pinned(object_id, data):
+            return False
+        self._shm_held.add(object_id)
+        return True
+
+    def adopt_shm(self, object_id: bytes) -> bool:
+        """Pin an object a WORKER sealed directly into shm (its bytes never
+        crossed an RPC) and publish its location."""
+        if self._shm is None:
+            return False
+        view = self._shm.get(object_id)  # takes the pin ref
+        if view is None:
+            return False
+        with self._lock:
+            self._shm_held.add(object_id)
+            self._arrived.notify_all()
+        self._gcs.call(
+            "add_object_location",
+            {"object_id": object_id, "node_id": self._node_id},
+        )
+        return True
+
     def put(self, object_id: bytes, data: bytes) -> None:
         with self._lock:
-            old = self._objects.pop(object_id, None)
-            if old is not None:
-                self._bytes -= len(old)
-            self._objects[object_id] = data
-            self._bytes += len(data)
-            self._evict_over_capacity_locked()
+            if object_id in self._shm_held:
+                pass  # already resident in shm
+            elif self._shm_put_pinned(object_id, data):
+                pass
+            else:
+                old = self._objects.pop(object_id, None)
+                if old is not None:
+                    self._bytes -= len(old)
+                self._objects[object_id] = data
+                self._bytes += len(data)
+                self._evict_over_capacity_locked()
             self._arrived.notify_all()  # unblock fetch() waiters instantly
         self._gcs.call(
             "add_object_location",
@@ -113,11 +171,44 @@ class ObjectService:
         )
 
     def get_local(self, object_id: bytes) -> Optional[bytes]:
+        if self._shm is not None and object_id in self._shm_held:
+            data = self._shm.get_bytes(object_id)
+            if data is not None:
+                return data
         with self._lock:
             data = self._objects.get(object_id)
             if data is not None:
                 self._objects.move_to_end(object_id)  # MRU
                 return data
+        return self._get_spilled(object_id)
+
+    def local_size(self, object_id: bytes) -> Optional[int]:
+        """Size without materializing (chunk-serving metadata)."""
+        if self._shm is not None and object_id in self._shm_held:
+            n = self._shm.size_of(object_id)
+            if n is not None:
+                return n
+        with self._lock:
+            data = self._objects.get(object_id)
+        if data is not None:
+            return len(data)
+        data = self._get_spilled(object_id)
+        return None if data is None else len(data)
+
+    def local_slice(self, object_id: bytes, offset: int,
+                    length: int) -> Optional[bytes]:
+        """One chunk of a local object — for shm objects this copies ONLY
+        the slice (a full get_bytes per chunk would make cross-node pulls
+        quadratic in object size)."""
+        if self._shm is not None and object_id in self._shm_held:
+            data = self._shm.get_slice(object_id, offset, length)
+            if data is not None:
+                return data
+        data = self.get_local(object_id)
+        return None if data is None else data[offset:offset + length]
+
+    def _get_spilled(self, object_id: bytes) -> Optional[bytes]:
+        with self._lock:
             if object_id in self._spilled:
                 try:
                     with open(self._spill_path(object_id), "rb") as f:
@@ -139,6 +230,13 @@ class ObjectService:
 
     def free(self, object_id: bytes) -> None:
         with self._lock:
+            if object_id in self._shm_held:
+                self._shm_held.discard(object_id)
+                try:
+                    self._shm.release(object_id)  # drop the pin
+                    self._shm.delete(object_id)
+                except OSError:
+                    pass
             data = self._objects.pop(object_id, None)
             if data is not None:
                 self._bytes -= len(data)
@@ -231,14 +329,37 @@ class ObjectService:
             off += len(chunk)
         return b"".join(parts)
 
+    def close(self) -> None:
+        """Release pins and close (owner: unlink) the shm store."""
+        if self._shm is None:
+            return
+        for oid in list(self._shm_held):
+            try:
+                self._shm.release(oid)
+            except OSError:
+                pass
+        self._shm_held.clear()
+        try:
+            self._shm.close()
+        except Exception:
+            pass
+
     def stats(self) -> dict:
         with self._lock:
-            return {
-                "num_objects": len(self._objects) + len(self._spilled),
+            out = {
+                "num_objects": len(self._objects) + len(self._spilled)
+                + len(self._shm_held),
                 "bytes": self._bytes,
                 "spilled": len(self._spilled),
                 "capacity": self._capacity,
+                "shm_objects": len(self._shm_held),
             }
+            if self._shm is not None:
+                try:
+                    out["shm"] = self._shm.stats()
+                except OSError:
+                    pass
+            return out
 
 
 class WorkerHandle:
@@ -306,9 +427,15 @@ class NodeDaemon:
         # reconnecting: the GCS may restart (FT snapshot) and come back at
         # the same address; the daemon must ride through the outage
         self.gcs = ReconnectingRpcClient(*gcs_addr).connect(retries=20)
+        shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else (
+            os.environ.get("TMPDIR", "/tmp")
+        )
         self.objects = ObjectService(
             self.node_id, self.gcs, self.pool,
             capacity_bytes=object_capacity_bytes,
+            shm_path=os.path.join(
+                shm_dir, f"ray_tpu-store-{self.node_id}-{os.getpid()}"
+            ),
         )
         self._stop = threading.Event()
         self.addr: Optional[tuple] = None
@@ -348,6 +475,7 @@ class NodeDaemon:
         self.rpc.stop()
         self.gcs.close()
         self.pool.close_all()
+        self.objects.close()  # releases pins; owner unlinks the tmpfs file
 
     def _heartbeat_loop(self) -> None:
         while not self._stop.wait(self._hb_interval):
@@ -545,6 +673,7 @@ class NodeDaemon:
             "node_id": self.node_id,
             "gcs_addr": self.gcs_addr,
             "daemon_addr": self.addr,
+            "shm_path": self.objects.shm_path,
         }
 
     # -- lease protocol -------------------------------------------------------
@@ -682,11 +811,19 @@ class NodeDaemon:
                 payload, loop, fut, deadline, next_spill = waiter
                 # while queued, periodically re-check the GCS for a node
                 # with free capacity — the local queue must not starve a
-                # task the rest of the cluster could run right now
+                # task the rest of the cluster could run right now. The
+                # request's exclude list is DROPPED for these probes: it
+                # records nodes that were full when the client hopped
+                # through them, and by now (>=0.5s later, a fresh heartbeat)
+                # those views are stale — keeping it would permanently
+                # blind the queue to a node that has since freed up
                 spill = time.monotonic() >= next_spill and not payload.get("pinned")
+                probe = payload
+                if spill and payload.get("exclude"):
+                    probe = {k: v for k, v in payload.items() if k != "exclude"}
                 try:
                     r = self._try_grant(
-                        payload, allow_spillback=spill, block_spawn=False
+                        probe, allow_spillback=spill, block_spawn=False
                     )
                 except Exception as e:  # noqa: BLE001 - must not kill the granter
                     logger.exception("lease grant attempt failed")
@@ -797,16 +934,20 @@ class NodeDaemon:
         self.objects.put(payload["object_id"], payload["data"])
         return {"ok": True}
 
+    def rpc_object_sealed(self, payload, peer):
+        """A colocated worker sealed this object straight into the shared-
+        memory store — adopt (pin) it; the bytes never cross an RPC
+        (reference: plasma seal notification, plasma/client.cc)."""
+        return {"ok": self.objects.adopt_shm(payload["object_id"])}
+
     def rpc_object_meta(self, payload, peer):
-        data = self.objects.get_local(payload["object_id"])
-        return None if data is None else {"size": len(data)}
+        size = self.objects.local_size(payload["object_id"])
+        return None if size is None else {"size": size}
 
     def rpc_object_chunk(self, payload, peer):
-        data = self.objects.get_local(payload["object_id"])
-        if data is None:
-            return None
-        off = payload["offset"]
-        return data[off : off + payload["length"]]
+        return self.objects.local_slice(
+            payload["object_id"], payload["offset"], payload["length"]
+        )
 
     def rpc_fetch_object(self, payload, peer):
         """Blocking local-or-remote fetch (driver/worker `get` path)."""

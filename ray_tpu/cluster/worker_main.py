@@ -48,16 +48,28 @@ class WorkerRuntime:
         self.gcs_addr = tuple(gcs_addr) if gcs_addr else None
         self.daemon = RpcClient(*daemon_addr, timeout=120.0).connect(retries=20)
         self.node_id: Optional[str] = None
+        self.shm = None  # attached after registration (daemon owns the file)
         self.actors: dict[bytes, Any] = {}
         self._actor_locks: dict[bytes, asyncio.Lock] = {}
         self.rpc = RpcServer(self)
 
     # -- object plumbing ------------------------------------------------------
+    # Same-node objects ride the shared-memory store (plasma-equivalent):
+    # reads hit the mapping directly, returns are sealed in place and only
+    # the 16-byte id crosses the RPC (reference: plasma client over the
+    # raylet's in-process store). RPC paths remain the fallback.
 
     def resolve_ref(self, object_id: bytes) -> Any:
-        data = self.daemon.call(
-            "fetch_object", {"object_id": object_id}, timeout=60
-        )
+        data = None
+        if self.shm is not None:
+            try:
+                data = self.shm.get_bytes(object_id)
+            except OSError:
+                data = None
+        if data is None:
+            data = self.daemon.call(
+                "fetch_object", {"object_id": object_id}, timeout=60
+            )
         if data is None:
             raise RuntimeError(f"object {object_id.hex()} unavailable")
         value = loads_value(data, self.resolve_ref)
@@ -67,10 +79,35 @@ class WorkerRuntime:
             )
         return value
 
+    SHM_MIN_BYTES = 64 << 10  # small returns: one RPC beats the shm protocol
+
     def put_return(self, object_id: bytes, value: Any) -> None:
+        data = dumps_value(value)
+        if (
+            self.shm is not None
+            and len(data) >= self.SHM_MIN_BYTES
+            and self.shm.put_pinned(object_id, data)
+        ):
+            try:
+                r = self.daemon.call(
+                    "object_sealed", {"object_id": object_id}, timeout=60
+                )
+            finally:
+                # drop the creator ref only after the daemon pinned it
+                # (no zero-ref window for the LRU to evict through)
+                try:
+                    self.shm.release(object_id)
+                except OSError:
+                    pass
+            if r.get("ok"):
+                return
+            try:  # daemon would not adopt: reclaim and fall back
+                self.shm.force_delete(object_id)
+            except OSError:
+                pass
         self.daemon.call(
             "put_object",
-            {"object_id": object_id, "data": dumps_value(value)},
+            {"object_id": object_id, "data": data},
             timeout=60,
         )
 
@@ -202,6 +239,13 @@ class WorkerRuntime:
             "register_worker", {"worker_id": self.worker_id, "addr": addr}
         )
         self.node_id = r.get("node_id")
+        if r.get("shm_path"):
+            try:
+                from ray_tpu.native.shm import ShmObjectStore
+
+                self.shm = ShmObjectStore.open(r["shm_path"])
+            except Exception:
+                logger.warning("shm store unavailable; using RPC object path")
         if self.gcs_addr is None and r.get("gcs_addr") and r.get("daemon_addr"):
             # legacy fallback (daemon didn't pass --gcs): install late
             from ray_tpu.cluster.client import ClusterClient

@@ -39,20 +39,30 @@ def load_library(build: bool = True) -> ctypes.CDLL:
         d = _build_dir()
         so = os.path.join(d, "libshm_store.so")
         if build:
+            import fcntl
+
             src = os.path.join(d, "src", "shm_store.cc")
             stamp = os.path.join(d, ".shm_store.srchash")
             with open(src, "rb") as f:
                 src_hash = hashlib.sha256(f.read()).hexdigest()
-            stamped = None
-            if os.path.exists(stamp):
-                with open(stamp) as f:
-                    stamped = f.read().strip()
-            if not os.path.exists(so) or stamped != src_hash:
-                subprocess.run(
-                    ["make", "-s", "-C", d], check=True, capture_output=True
-                )
-                with open(stamp, "w") as f:
-                    f.write(src_hash)
+            # cross-PROCESS build lock: N daemons starting together must
+            # not race one `make` (a half-written .so fails to dlopen)
+            with open(os.path.join(d, ".build.lock"), "w") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                try:
+                    stamped = None
+                    if os.path.exists(stamp):
+                        with open(stamp) as f:
+                            stamped = f.read().strip()
+                    if not os.path.exists(so) or stamped != src_hash:
+                        subprocess.run(
+                            ["make", "-s", "-C", d], check=True,
+                            capture_output=True,
+                        )
+                        with open(stamp, "w") as f:
+                            f.write(src_hash)
+                finally:
+                    fcntl.flock(lockf, fcntl.LOCK_UN)
         lib = ctypes.CDLL(so)
         lib.shm_store_create.restype = ctypes.c_void_p
         lib.shm_store_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
@@ -161,6 +171,43 @@ class ShmObjectStore:
         ctypes.memmove(buf, data, len(data))
         self.seal(object_id)
         self.release(object_id)
+
+    def put_pinned(self, object_id: bytes, data: bytes) -> bool:
+        """create + write + seal, KEEPING the creator reference — the
+        object is pinned against LRU eviction until release()/delete().
+        Returns False (instead of raising) when the store is full or the
+        id already exists; the one sealing protocol both the daemon and
+        workers use."""
+        if len(data) == 0:
+            return False  # store rounds 0 up to 1 byte: size would lie
+        try:
+            buf, _ = self.create_buffer(object_id, len(data))
+            ctypes.memmove(buf, data, len(data))
+            self.seal(object_id)
+        except (MemoryError, OSError, KeyError):
+            return False
+        return True
+
+    def get_slice(self, object_id: bytes, offset: int,
+                  length: int) -> Optional[bytes]:
+        """Copy out one slice of a sealed object (chunked cross-node
+        serving must not memcpy the WHOLE object per chunk)."""
+        view = self.get(object_id)
+        if view is None:
+            return None
+        try:
+            return bytes(view[offset:offset + length])
+        finally:
+            self.release(object_id)
+
+    def size_of(self, object_id: bytes) -> Optional[int]:
+        view = self.get(object_id)
+        if view is None:
+            return None
+        try:
+            return len(view)
+        finally:
+            self.release(object_id)
 
     def seal(self, object_id: bytes) -> None:
         self._check_open()

@@ -224,11 +224,16 @@ class JaxTrainer:
                 # the next gang would collapse toward min_workers spuriously
                 import time as _time
 
+                # stop early when capacity STABILIZES (two equal readings):
+                # a permanently lost node must not cost the full bound on
+                # every restart
                 deadline = _time.monotonic() + 10.0
-                while (
-                    _time.monotonic() < deadline
-                    and self._gang_size() < self._scaling.num_workers
-                ):
+                prev = -1
+                while _time.monotonic() < deadline:
+                    size = self._gang_size()
+                    if size >= self._scaling.num_workers or size == prev:
+                        break
+                    prev = size
                     _time.sleep(0.5)
 
     def _gang_size(self) -> int:
