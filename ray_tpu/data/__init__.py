@@ -14,12 +14,18 @@ from ray_tpu.data.dataset import (
     from_numpy,
     from_pandas,
     range,
+    from_arrow,
+    read_arrow,
     read_binary_files,
     read_csv,
     read_datasource,
+    read_images,
     read_json,
     read_parquet,
+    read_sql,
     read_text,
+    read_tfrecords,
+    read_webdataset,
 )
 from ray_tpu.data.datasource import Datasource, ReadTask
 from ray_tpu.data.iterator import DataIterator
@@ -39,14 +45,20 @@ __all__ = [
     "ReadTask",
     "Std",
     "Sum",
+    "from_arrow",
     "from_items",
     "from_numpy",
     "from_pandas",
     "range",
+    "read_arrow",
     "read_binary_files",
     "read_csv",
     "read_datasource",
+    "read_images",
     "read_json",
     "read_parquet",
+    "read_sql",
     "read_text",
+    "read_tfrecords",
+    "read_webdataset",
 ]

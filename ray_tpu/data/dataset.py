@@ -385,3 +385,47 @@ def read_binary_files(paths, *, parallelism: int = -1) -> Dataset:
 
 def read_datasource(ds: Datasource, *, parallelism: int = -1) -> Dataset:
     return _read(ds, parallelism)
+
+
+def read_tfrecords(paths, *, parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.datasources_ext import TFRecordDatasource
+
+    return _read(TFRecordDatasource(paths), parallelism)
+
+
+def read_arrow(paths, *, parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.datasources_ext import ArrowDatasource
+
+    return _read(ArrowDatasource(paths), parallelism)
+
+
+def read_sql(sql: str, connection_factory, *, parallelism_queries=None,
+             parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.datasources_ext import SQLDatasource
+
+    return _read(
+        SQLDatasource(sql, connection_factory, parallelism_queries), parallelism
+    )
+
+
+def read_images(paths, *, size=None, mode="RGB", parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.datasources_ext import ImageDatasource
+
+    return _read(ImageDatasource(paths, size=size, mode=mode), parallelism)
+
+
+def read_webdataset(paths, *, parallelism: int = -1) -> Dataset:
+    from ray_tpu.data.datasources_ext import WebDatasetDatasource
+
+    return _read(WebDatasetDatasource(paths), parallelism)
+
+
+def from_arrow(tables) -> Dataset:
+    """Datasets from in-memory pyarrow Tables (reference: from_arrow) —
+    dtype-preserving (columns convert via to_numpy, not a row round trip)."""
+    from ray_tpu.data.datasources_ext import block_from_arrow
+
+    if not isinstance(tables, (list, tuple)):
+        tables = [tables]
+    merged = Block.concat([block_from_arrow(t) for t in tables])
+    return _read(NumpyDatasource(dict(merged.columns)))
