@@ -1,0 +1,62 @@
+"""Measure true per-step device time by amortizing the tunnel round-trip:
+launch K data-dependent steps, fence once on the last loss. Losses are
+pulled after timing (device scalars) for the sanity gates.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models import llama
+from ray_tpu.train.step import TrainState, make_train_step
+
+
+def probe(tag, cfg, B, S, K=20):
+    params = llama.init_params(cfg, jax.random.key(0))
+    opt = optax.adamw(3e-4)
+    state = TrainState.create(params, opt)
+    step = make_train_step(lambda p, b: llama.loss_fn(p, b, cfg), opt)
+    tokens = jax.random.randint(jax.random.key(1), (B, S + 1), 0, cfg.vocab_size, jnp.int32)
+    batch = {"tokens": tokens[:, :-1], "targets": tokens[:, 1:]}
+    try:
+        for _ in range(2):
+            state, m = step(state, batch)
+            float(m["loss"])  # fenced warmup
+        # chained: no host sync inside the loop
+        losses = []
+        t0 = time.perf_counter()
+        for _ in range(K):
+            state, m = step(state, batch)
+            losses.append(m["loss"])
+        last = float(losses[-1])  # single fence
+        dt = (time.perf_counter() - t0) / K
+        # gates after timing
+        fl = [float(x) for x in losses]
+        assert fl[-1] < fl[0], (fl[0], fl[-1])
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"tag": tag, "error": repr(e)[:200]}), flush=True)
+        return
+    tok_s = B * S / dt
+    mfu = tok_s * 3.0 * cfg.flops_per_token() / 197e12
+    print(json.dumps({"tag": tag, "ms_per_step": round(dt * 1e3, 2),
+                      "tok_s": round(tok_s), "mfu_pct": round(mfu * 100, 2)}),
+          flush=True)
+
+
+def main():
+    base = llama.LLAMA_400M
+    probe("xla_dots_b8", dataclasses.replace(base, attention_impl="xla"), 8, 1024)
+    probe("xla_dots_b16", dataclasses.replace(base, attention_impl="xla"), 16, 1024)
+    probe("flash_dots_b8", dataclasses.replace(base, attention_impl="flash"), 8, 1024)
+    probe("xla_full_b16", dataclasses.replace(base, attention_impl="xla",
+                                              remat_policy="full"), 16, 1024)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,76 @@
+"""MFU sweep on the local accelerator: remat policy x attention impl x batch.
+
+Prints one JSON line per config. Used to pick the flagship bench config;
+not part of the driver bench path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ray_tpu.models import llama
+from ray_tpu.train.step import TrainState, make_train_step
+
+PEAK = {"tpu": 197e12}
+
+
+def bench_config(cfg, B, S, iters=8, tag=""):
+    params = llama.init_params(cfg, jax.random.key(0))
+    opt = optax.adamw(3e-4)
+    state = TrainState.create(params, opt)
+    step = make_train_step(lambda p, b: llama.loss_fn(p, b, cfg), opt)
+    tokens = jax.random.randint(jax.random.key(1), (B, S + 1), 0, cfg.vocab_size, jnp.int32)
+    batch = {"tokens": tokens[:, :-1], "targets": tokens[:, 1:]}
+    try:
+        for _ in range(2):
+            state, m = step(state, batch)
+            float(m["loss"])
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            state, m = step(state, batch)
+            float(m["loss"])
+        dt = (time.perf_counter() - t0) / iters
+    except Exception as e:  # noqa: BLE001
+        print(json.dumps({"tag": tag, "error": repr(e)[:300]}), flush=True)
+        return
+    tok_s = B * S / dt
+    peak = PEAK.get(jax.devices()[0].platform, 1e12)
+    mfu = tok_s * 3.0 * cfg.flops_per_token() / peak
+    print(
+        json.dumps(
+            {
+                "tag": tag,
+                "ms_per_step": round(dt * 1e3, 2),
+                "tok_s": round(tok_s, 0),
+                "mfu_pct": round(mfu * 100, 2),
+            }
+        ),
+        flush=True,
+    )
+
+
+def main():
+    base = llama.LLAMA_400M
+    S = 1024
+    configs = [
+        ("xla_full_b8", dataclasses.replace(base, attention_impl="xla", remat_policy="full"), 8),
+        ("xla_dots_b8", dataclasses.replace(base, attention_impl="xla", remat_policy="dots"), 8),
+        ("xla_none_b8", dataclasses.replace(base, attention_impl="xla", remat=False), 8),
+        ("flash_dots_b8", dataclasses.replace(base, attention_impl="flash", remat_policy="dots"), 8),
+        ("flash_none_b8", dataclasses.replace(base, attention_impl="flash", remat=False), 8),
+        ("xla_dots_b16", dataclasses.replace(base, attention_impl="xla", remat_policy="dots"), 16),
+        ("flash_dots_b16", dataclasses.replace(base, attention_impl="flash", remat_policy="dots"), 16),
+        ("xla_dots_b32", dataclasses.replace(base, attention_impl="xla", remat_policy="dots"), 32),
+    ]
+    for tag, cfg, B in configs:
+        bench_config(cfg, B, S, tag=tag)
+
+
+if __name__ == "__main__":
+    main()
