@@ -9,6 +9,10 @@ from ray_tpu.autoscaler.autoscaler import (
     NodeTypeConfig,
     StandardAutoscaler,
 )
+from ray_tpu.autoscaler.cluster_autoscaler import (
+    ClusterAutoscaler,
+    LocalClusterNodeProvider,
+)
 from ray_tpu.autoscaler.node_provider import (
     FakeNodeProvider,
     NodeProvider,
@@ -17,7 +21,9 @@ from ray_tpu.autoscaler.node_provider import (
 
 __all__ = [
     "AutoscalerConfig",
+    "ClusterAutoscaler",
     "FakeNodeProvider",
+    "LocalClusterNodeProvider",
     "NodeProvider",
     "NodeTypeConfig",
     "StandardAutoscaler",

@@ -1,0 +1,211 @@
+"""Autoscaler driving the DISTRIBUTED cluster plane.
+
+Reference analog: the autoscaler monitor reading resource-demand
+reports the raylets ship to the GCS and asking a NodeProvider for
+more/fewer nodes (python/ray/autoscaler/_private/monitor.py,
+autoscaler.py StandardAutoscaler.update). Here:
+
+  * demand: every node daemon ships its server-side lease queue's
+    resource specs in its heartbeat; `cluster_demand` on the GCS
+    aggregates them (gcs_service.rpc_cluster_demand);
+  * supply: a NodeProvider that launches/terminates REAL node-daemon
+    processes — `LocalClusterNodeProvider` drives a LocalCluster the
+    way the reference's fake multinode provider drives sub-raylets.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, NodeTypeConfig, _fits
+from ray_tpu.autoscaler.node_provider import NodeProvider
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.autoscaler.cluster")
+
+
+class LocalClusterNodeProvider(NodeProvider):
+    """Launch/terminate real node-daemon processes on a LocalCluster."""
+
+    def __init__(self, cluster):
+        self._cluster = cluster
+        self._counter = 0
+        self._mine: set[str] = set()
+
+    def create_node(self, node_type: str, resources: dict) -> str:
+        self._counter += 1
+        node_id = f"auto-{node_type}-{self._counter}"
+        self._cluster.add_node(dict(resources), node_id=node_id)
+        self._mine.add(node_id)
+        return node_id
+
+    def terminate_node(self, node_id: str) -> None:
+        self._mine.discard(node_id)
+        self._cluster.kill_node(node_id)
+
+    def non_terminated_nodes(self) -> list[str]:
+        alive = {n["node_id"] for n in self._cluster.client().nodes() if n["alive"]}
+        return sorted(self._mine & alive)
+
+    def node_resources(self, node_id: str) -> dict:
+        for n in self._cluster.client().nodes():
+            if n["node_id"] == node_id:
+                return dict(n["resources"])
+        return {}
+
+    def is_idle(self, node_id: str) -> bool:
+        for n in self._cluster.client().nodes():
+            if n["node_id"] == node_id:
+                return n.get("available") == n.get("resources")
+        return True
+
+
+class ClusterAutoscaler:
+    """Reconcile cluster-plane demand against a NodeProvider.
+
+    Same binpack policy as the in-process StandardAutoscaler, but demand
+    and idleness come from the GCS's aggregated heartbeat view instead
+    of the local scheduler queue.
+    """
+
+    def __init__(self, config: AutoscalerConfig, provider: NodeProvider, gcs):
+        self.config = config
+        self.provider = provider
+        self._gcs = gcs  # RpcClient (or any .call("cluster_demand", None))
+        self._idle_since: dict[str, float] = {}
+        self._node_type: dict[str, str] = {}
+        # in-flight launches: a freshly-spawned daemon takes seconds to
+        # register and absorb the queued lease that justified it, during
+        # which the demand spec is STILL in the heartbeat feed — without
+        # netting launches against demand every tick would launch again
+        # (reference: the autoscaler's pending-launch accounting)
+        self._launching: dict[str, tuple[dict, float]] = {}
+        self._launch_grace_s = 30.0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        for tname, tcfg in config.node_types.items():
+            for _ in range(tcfg.min_workers):
+                self._launch(tname, tcfg)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="ray_tpu-cluster-autoscaler", daemon=True
+            )
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                self.reconcile()
+            except Exception:
+                logger.exception("cluster autoscaler tick failed")
+
+    # -- demand ---------------------------------------------------------------
+
+    def pending_demand(self) -> list[dict]:
+        """Queued lease specs that no alive node could EVER host — plus
+        queued specs that fit somewhere but are waiting on capacity (the
+        scale-up signal the reference acts on)."""
+        view = self._gcs.call("cluster_demand", None)
+        return [dict(s) for s in view["pending"] if s]
+
+    def reconcile(self) -> None:
+        self._scale_up()
+        self._scale_down()
+
+    def _count(self, tname: str) -> int:
+        return sum(1 for t in self._node_type.values() if t == tname)
+
+    def _launch(self, tname: str, tcfg: NodeTypeConfig) -> Optional[str]:
+        if self._count(tname) >= tcfg.max_workers:
+            return None
+        nid = self.provider.create_node(tname, dict(tcfg.resources))
+        self._node_type[nid] = tname
+        self._launching[nid] = (dict(tcfg.resources), time.time())
+        logger.info("cluster scale-up: %s (%s)", nid, tcfg.resources)
+        return nid
+
+    def _scale_up(self) -> None:
+        demand = self.pending_demand()
+        if not demand:
+            self._launching = {
+                k: v for k, v in self._launching.items()
+                if time.time() - v[1] <= self._launch_grace_s
+            }
+            return
+        demand.sort(key=lambda d: -sum(d.values()))
+        # seed the plan with capacity already launched but not yet
+        # absorbed, so repeat ticks don't re-buy the same demand
+        now = time.time()
+        self._launching = {
+            k: v for k, v in self._launching.items()
+            if now - v[1] <= self._launch_grace_s
+        }
+        planned: list[dict] = [dict(res) for res, _ in self._launching.values()]
+        planned_types: list[str] = []
+        for req in demand:
+            placed = False
+            for cap in planned:
+                if _fits(req, cap):
+                    for k, v in req.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    placed = True
+                    break
+            if placed:
+                continue
+            for tname, tcfg in self.config.node_types.items():
+                if (
+                    _fits(req, tcfg.resources)
+                    and self._count(tname) + planned_types.count(tname)
+                    < tcfg.max_workers
+                ):
+                    cap = dict(tcfg.resources)
+                    for k, v in req.items():
+                        cap[k] = cap.get(k, 0.0) - v
+                    planned.append(cap)
+                    planned_types.append(tname)
+                    placed = True
+                    break
+            if not placed:
+                logger.warning("demand %s fits no configured node type", req)
+        for tname in planned_types:
+            self._launch(tname, self.config.node_types[tname])
+
+    def _scale_down(self) -> None:
+        now = time.time()
+        for nid in list(self.provider.non_terminated_nodes()):
+            tname = self._node_type.get(nid)
+            if tname is None:
+                continue
+            tcfg = self.config.node_types[tname]
+            if not self.provider.is_idle(nid):
+                self._idle_since.pop(nid, None)
+                continue
+            first_idle = self._idle_since.setdefault(nid, now)
+            if (
+                now - first_idle >= self.config.idle_timeout_s
+                and self._count(tname) > tcfg.min_workers
+            ):
+                self.provider.terminate_node(nid)
+                self._node_type.pop(nid, None)
+                self._idle_since.pop(nid, None)
+                logger.info("cluster scale-down: idle node %s", nid)
+
+    def status(self) -> dict:
+        return {
+            "nodes": {
+                nid: self._node_type.get(nid)
+                for nid in self.provider.non_terminated_nodes()
+            },
+            "pending_demand": self.pending_demand(),
+        }

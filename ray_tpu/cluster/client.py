@@ -150,6 +150,13 @@ class _ActorMethod:
             h._actor_id, self._name, args, kwargs
         )
 
+    def bind(self, *args, **kwargs):
+        """Compiled-DAG node construction (reference: actor method .bind
+        building a ClassMethodNode, python/ray/dag/class_node.py)."""
+        from ray_tpu.dag.nodes import bind_actor_method
+
+        return bind_actor_method(self._handle, self._name)(*args, **kwargs)
+
     def options(self, num_returns: int = 1):
         method = self
 
@@ -303,6 +310,32 @@ class ClusterClient:
             except (RpcError, RemoteError):
                 pass
         return freed
+
+    # -- kv -------------------------------------------------------------------
+
+    def kv_put(self, key: bytes, value: bytes, ns: str = "default") -> None:
+        self.gcs.call("kv_put", {"ns": ns, "key": key, "value": value})
+
+    def kv_get(self, key: bytes, ns: str = "default"):
+        return self.gcs.call("kv_get", {"ns": ns, "key": key})
+
+    def kv_del(self, key: bytes, ns: str = "default") -> None:
+        self.gcs.call("kv_del", {"ns": ns, "key": key})
+
+    def kv_wait(self, key: bytes, ns: str = "default",
+                timeout: float = 120.0):
+        """Block until `key` exists (server-side long-poll loop); returns
+        its value, or raises TimeoutError."""
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"kv_wait({ns}/{key!r}) after {timeout}s")
+            v = self.gcs.call(
+                "kv_wait", {"ns": ns, "key": key, "wait": min(remaining, 5.0)}
+            )
+            if v is not None:
+                return v
 
     # -- objects --------------------------------------------------------------
 

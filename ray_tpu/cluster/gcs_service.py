@@ -40,6 +40,7 @@ class NodeEntry:
     labels: dict = field(default_factory=dict)
     alive: bool = True
     last_hb: float = field(default_factory=time.monotonic)
+    pending: list = field(default_factory=list)  # queued lease specs
 
 
 @dataclass
@@ -77,6 +78,10 @@ class GcsService:
         self._objects: dict[bytes, set[str]] = {}  # obj_id -> node_ids
         self._events: list[tuple[int, str, dict]] = []
         self._event_seq = itertools.count()
+        # push-tier pubsub: subscribers long-poll `events_since` with a
+        # wait budget; _emit wakes them (reference: GCS pubsub push via
+        # long-poll channels, src/ray/pubsub/publisher.h)
+        self._events_cv = threading.Condition(self._lock)
         self._death_timeout = node_death_timeout_s
         self._pg_counter = itertools.count()
         # fault tolerance: durable snapshot of the control-plane tables
@@ -144,11 +149,28 @@ class GcsService:
         self._events.append((next(self._event_seq), kind, data))
         if len(self._events) > 10000:
             del self._events[:5000]
+        self._events_cv.notify_all()
 
     def rpc_events_since(self, payload, peer):
+        """Cursor'd event feed. With `wait` > 0 this is a long-poll: the
+        handler thread parks until an event at/after `cursor` lands or
+        the wait budget expires — push-latency delivery without a
+        persistent subscriber channel (reference: GCS pubsub long-poll,
+        src/ray/pubsub/publisher.h)."""
         cursor = payload["cursor"]
+        # cap well below RpcClient's 30s default call timeout: a quiet
+        # feed must answer (empty) before the client gives up on the RPC
+        wait = min(float(payload.get("wait", 0.0)), 10.0)
+        deadline = time.monotonic() + wait
         with self._lock:
-            out = [e for e in self._events if e[0] >= cursor]
+            while True:
+                out = [e for e in self._events if e[0] >= cursor]
+                if out or wait <= 0:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._events_cv.wait(remaining)
             next_cursor = self._events[-1][0] + 1 if self._events else cursor
         return {"events": out, "cursor": next_cursor}
 
@@ -183,7 +205,31 @@ class GcsService:
             e.last_hb = time.monotonic()
             if "available" in payload:
                 e.available = dict(payload["available"])
+            e.pending = list(payload.get("pending", ()))
         return {"ok": True}
+
+    def rpc_cluster_demand(self, payload, peer):
+        """Aggregate autoscaling view: per-node capacity plus every lease
+        spec currently parked in a daemon's server-side queue (reference:
+        resource demand aggregation the GCS feeds the autoscaler)."""
+        with self._lock:
+            return {
+                "nodes": [
+                    {
+                        "node_id": e.node_id,
+                        "resources": dict(e.resources),
+                        "available": dict(e.available),
+                        "alive": e.alive,
+                    }
+                    for e in self._nodes.values()
+                ],
+                "pending": [
+                    spec
+                    for e in self._nodes.values()
+                    if e.alive
+                    for spec in getattr(e, "pending", ())
+                ],
+            }
 
     def rpc_drain_node(self, payload, peer):
         """Graceful removal (cluster_utils teardown)."""
@@ -321,7 +367,28 @@ class GcsService:
             ns = self._kv.setdefault(payload.get("ns", "default"), {})
             ns[payload["key"]] = payload["value"]
             self._mark_dirty()
+            self._events_cv.notify_all()  # wake kv_wait long-pollers
         return {"ok": True}
+
+    def rpc_kv_wait(self, payload, peer):
+        """Long-poll kv_get: park until `key` appears (or the wait budget
+        expires) and return its value (None on timeout). Per-call wait is
+        capped low so a fully parked handler pool self-heals; callers loop
+        to their own deadline. This is the synchronization primitive the
+        cluster-tier collectives rendezvous on (reference analog: Redis
+        BLPOP-style waits in the GCS store client)."""
+        deadline = time.monotonic() + min(float(payload.get("wait", 1.0)), 5.0)
+        ns_name = payload.get("ns", "default")
+        key = payload["key"]
+        with self._lock:
+            while True:
+                v = self._kv.get(ns_name, {}).get(key)
+                if v is not None:
+                    return v
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return None
+                self._events_cv.wait(remaining)
 
     def rpc_kv_get(self, payload, peer):
         with self._lock:

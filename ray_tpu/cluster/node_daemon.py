@@ -422,6 +422,7 @@ class NodeDaemon:
         self._grant_queue: "queue_mod.Queue" = queue_mod.Queue()
         self._capacity_signal = threading.Event()  # wakes the granter
         self._num_queued = 0  # granter's current waiter count (approximate)
+        self._pending_specs: list[dict] = []  # queued lease resource specs
         self.rpc = RpcServer(self, host=host)
         self.pool = ClientPool()
         # reconnecting: the GCS may restart (FT snapshot) and come back at
@@ -488,7 +489,8 @@ class NodeDaemon:
                     avail = dict(self.available)
                 r = self.gcs.call(
                     "heartbeat",
-                    {"node_id": self.node_id, "available": avail},
+                    {"node_id": self.node_id, "available": avail,
+                     "pending": self._pending_specs},
                     timeout=5,
                 )
                 if not r.get("ok") and r.get("reregister"):
@@ -853,6 +855,12 @@ class NodeDaemon:
                     self._reclaim_grant(r)  # connection's loop is gone
             waiters = still
             self._num_queued = len(waiters)
+            # autoscaler demand feed: specs of leases parked here, shipped
+            # to the GCS with the next heartbeat (reference: resource
+            # demand in raylet heartbeats driving the autoscaler)
+            self._pending_specs = [
+                dict(w[0].get("resources", {})) for w in waiters[:64]
+            ]
             if waiters and not progressed:
                 self._capacity_signal.wait(timeout=0.1)
                 self._capacity_signal.clear()

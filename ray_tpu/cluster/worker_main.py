@@ -40,6 +40,25 @@ from ray_tpu.cluster.serialization import (  # noqa: E402
 )
 
 
+def _framework_actor_method(actor, name: str):
+    """Framework-injected actor methods for PROCESS actors (the in-process
+    twin is actor_runtime._framework_method): gang/DAG setup calls the
+    driver fires at every member before user traffic."""
+    if name == "__ray_tpu_collective_init__":
+        from ray_tpu.collective.collective import init_collective_group
+
+        return lambda world, rank, backend, group: init_collective_group(
+            world, rank, backend=backend, group_name=group
+        )
+    if name == "__ray_tpu_dag_exec_loop__":
+        from ray_tpu.dag.compiled import _actor_exec_loop
+
+        return lambda plan, input_source: _actor_exec_loop(
+            actor, plan, input_source
+        )
+    return None
+
+
 class WorkerRuntime:
     def __init__(self, daemon_addr: tuple, worker_id: str,
                  gcs_addr: Optional[tuple] = None):
@@ -177,7 +196,9 @@ class WorkerRuntime:
         loop = asyncio.get_running_loop()
 
         def _invoke():
-            method = getattr(actor, payload["method"])
+            method = _framework_actor_method(actor, payload["method"]) or getattr(
+                actor, payload["method"]
+            )
             args, kwargs = loads_value(payload["args"], self.resolve_ref)
             result = method(*args, **kwargs)
             if asyncio.iscoroutine(result):

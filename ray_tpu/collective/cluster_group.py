@@ -1,0 +1,127 @@
+"""Cluster-tier (cross-process / DCN) collective group.
+
+Reference analog: the gloo-backed collective groups the reference uses
+for CPU-side gangs (python/ray/util/collective/collective_group/
+gloo_collective_group.py) — host arrays moved between worker PROCESSES,
+not threads. TPU-native split:
+
+  * device arrays never come here — they ride XLA collectives over ICI
+    inside jitted programs (mesh_for_group);
+  * host/control arrays (metrics, broadcast weights, rendezvous
+    payloads) synchronize through the GCS KV: contributions land under
+    a per-round key, rank 0 reduces and publishes the result, everyone
+    else long-polls it (`kv_wait`, a server-side parked read — no
+    client busy-poll).
+
+Same collective contract as the in-process `_HostGroup`: every rank
+issues the same collectives in the same order.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Optional
+
+
+class ClusterGroup:
+    """One per rank PROCESS (unlike _HostGroup: one shared per host).
+
+    All instances with the same group name rendezvous through the
+    attached cluster's GCS KV (`ns="__collective__"`).
+    """
+
+    NS = "__collective__"
+
+    def __init__(self, name: str, world_size: int, rank: int, client=None):
+        if client is None:
+            from ray_tpu.cluster.client import _ambient_client
+
+            try:
+                client = _ambient_client()
+            except RuntimeError:
+                client = None
+            if client is None:
+                raise RuntimeError(
+                    "backend='cluster' collectives need an attached cluster "
+                    "(ray_tpu.init(address=...) or a cluster worker process)"
+                )
+        self.name = name
+        self.world_size = world_size
+        self.rank = rank
+        self._client = client
+        self._round = 0
+        self._send_seq: dict[int, int] = {}
+        self._recv_seq: dict[int, int] = {}
+        if rank == 0:
+            client.kv_put(
+                self._key("meta"), pickle.dumps({"world_size": world_size}), self.NS
+            )
+        else:
+            meta = pickle.loads(client.kv_wait(self._key("meta"), self.NS, 60.0))
+            if meta["world_size"] != world_size:
+                raise ValueError(
+                    f"group {name!r} exists with world_size "
+                    f"{meta['world_size']} != {world_size}"
+                )
+
+    def _key(self, *parts) -> bytes:
+        return "/".join((self.name,) + tuple(str(p) for p in parts)).encode()
+
+    # -- collective rendezvous ------------------------------------------------
+
+    def rendezvous(self, rank: int, value: Any, compute, timeout: float = 120.0):
+        """Deposit value under this round; rank 0 reduces once all ranks
+        landed and publishes; everyone returns the published result."""
+        rnd, self._round = self._round, self._round + 1
+        kv = self._client
+        kv.kv_put(self._key(rnd, "c", rank), pickle.dumps(value), self.NS)
+        if rank == 0:
+            vals = []
+            for r in range(self.world_size):
+                raw = kv.kv_wait(self._key(rnd, "c", r), self.NS, timeout)
+                vals.append(pickle.loads(raw))
+            result = compute(vals)
+            kv.kv_put(self._key(rnd, "r"), pickle.dumps(result), self.NS)
+            # garbage: contributions of this round; result of the previous
+            # round (published results can only be awaited by ranks that
+            # already contributed to THIS round, i.e. consumed round-1)
+            for r in range(self.world_size):
+                kv.kv_del(self._key(rnd, "c", r), self.NS)
+            if rnd > 0:
+                kv.kv_del(self._key(rnd - 1, "r"), self.NS)
+            return result
+        raw = kv.kv_wait(self._key(rnd, "r"), self.NS, timeout)
+        return pickle.loads(raw)
+
+    # -- p2p ------------------------------------------------------------------
+
+    def send(self, src: int, dst: int, value: Any, timeout: float = 120.0) -> None:
+        seq = self._send_seq.get(dst, 0)
+        self._send_seq[dst] = seq + 1
+        self._client.kv_put(
+            self._key("p2p", src, dst, seq), pickle.dumps(value), self.NS
+        )
+
+    def recv(self, src: int, dst: int, timeout: float = 120.0) -> Any:
+        seq = self._recv_seq.get(src, 0)
+        self._recv_seq[src] = seq + 1
+        key = self._key("p2p", src, dst, seq)
+        raw = self._client.kv_wait(key, self.NS, timeout)
+        self._client.kv_del(key, self.NS)
+        return pickle.loads(raw)
+
+    def destroy(self) -> None:
+        clear_group_kv(self._client, self.name)
+
+
+def clear_group_kv(client, name: str) -> None:
+    """Best-effort removal of a group's GCS residue (meta, unread round
+    results, unclaimed p2p payloads) — shared by rank-side destroy and
+    the driver-side destroy_collective_group path."""
+    try:
+        for key in client.gcs.call(
+            "kv_keys", {"ns": ClusterGroup.NS, "prefix": name.encode() + b"/"}
+        ):
+            client.kv_del(key, ClusterGroup.NS)
+    except Exception:  # noqa: BLE001 — cleanup must never raise
+        pass

@@ -126,11 +126,38 @@ def init_collective_group(
     backend: str = "host",
     group_name: str = "default",
 ) -> None:
-    """Join (creating if first) a collective group. Called by every rank."""
-    if backend not in ("host", "ici"):
-        raise ValueError(f"unknown backend {backend!r}; 'host' or 'ici'")
+    """Join (creating if first) a collective group. Called by every rank.
+
+    Backends: "host" (thread ranks of one process), "cluster" (process
+    ranks rendezvousing through the attached cluster's GCS — the
+    cross-process/DCN tier), "ici" (device tier: use mesh_for_group).
+    """
+    if backend not in ("host", "ici", "cluster"):
+        raise ValueError(f"unknown backend {backend!r}; 'host', 'cluster' or 'ici'")
     if not 0 <= rank < world_size:
         raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    if backend == "cluster":
+        from ray_tpu.collective.cluster_group import ClusterGroup
+
+        with _lock:
+            existing = _groups.get(group_name)
+            if isinstance(existing, ClusterGroup) and existing.rank != rank:
+                # the rank->group fallback in _group_and_rank is per-process;
+                # two ranks of one cluster group inside one process would
+                # silently collapse onto the last writer. Cluster ranks are
+                # process actors — use backend="host" for thread gangs.
+                raise ValueError(
+                    f"group {group_name!r} already has cluster rank "
+                    f"{existing.rank} in this process; one cluster-backend "
+                    "rank per process"
+                )
+        group = ClusterGroup(group_name, world_size, rank)
+        with _lock:
+            _groups[group_name] = group
+        if not hasattr(_local, "ranks"):
+            _local.ranks = {}
+        _local.ranks[group_name] = (group, rank)
+        return
     with _lock:
         group = _groups.get(group_name)
         if group is None:
@@ -163,25 +190,58 @@ def create_collective_group(
 
     if len(actors) != len(ranks) or len(actors) != world_size:
         raise ValueError("actors/ranks/world_size mismatch")
+    try:
+        from ray_tpu.cluster.client import ClusterActorHandle
+
+        cluster_actors = all(isinstance(a, ClusterActorHandle) for a in actors)
+    except ImportError:
+        cluster_actors = False
+    if cluster_actors and backend == "host":
+        # process actors can't share a thread rendezvous — route the gang
+        # through the cluster tier automatically
+        backend = "cluster"
     with _lock:
         _declared[group_name] = {"world_size": world_size, "backend": backend}
-        if group_name not in _groups:
+        if backend != "cluster" and group_name not in _groups:
             _groups[group_name] = _HostGroup(group_name, world_size)
-    refs = [
-        actor._invoke(
-            "__ray_tpu_collective_init__",
-            (world_size, rank, backend, group_name),
-            {},
-        )
-        for actor, rank in zip(actors, ranks)
-    ]
+    if cluster_actors:
+        from ray_tpu.cluster.client import _ActorMethod
+
+        refs = [
+            _ActorMethod(actor, "__ray_tpu_collective_init__").remote(
+                world_size, rank, backend, group_name
+            )
+            for actor, rank in zip(actors, ranks)
+        ]
+    else:
+        refs = [
+            actor._invoke(
+                "__ray_tpu_collective_init__",
+                (world_size, rank, backend, group_name),
+                {},
+            )
+            for actor, rank in zip(actors, ranks)
+        ]
     _api.get(refs, timeout=60)
 
 
 def destroy_collective_group(group_name: str = "default") -> None:
     with _lock:
-        _groups.pop(group_name, None)
-        _declared.pop(group_name, None)
+        group = _groups.pop(group_name, None)
+        declared = _declared.pop(group_name, None)
+    if group is not None and hasattr(group, "destroy"):
+        group.destroy()  # cluster-tier: clear its GCS KV residue
+    elif declared is not None and declared.get("backend") == "cluster":
+        # driver declared the gang but never joined it, so no local
+        # ClusterGroup exists; clear the GCS residue directly (stale
+        # round results poison a recreated same-name group)
+        try:
+            from ray_tpu.cluster.client import _ambient_client
+            from ray_tpu.collective.cluster_group import clear_group_kv
+
+            clear_group_kv(_ambient_client(), group_name)
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            pass
     if hasattr(_local, "ranks"):
         _local.ranks.pop(group_name, None)
 
@@ -198,6 +258,10 @@ def _group_and_rank(group_name: str, rank: Optional[int]) -> tuple[_HostGroup, i
         bound = getattr(_local, "ranks", {}).get(group_name)
         if bound is not None and bound[0] is group:
             rank = bound[1]
+        elif hasattr(group, "rank"):
+            # cluster-tier groups are per-process with a fixed rank, so
+            # the binding survives actor method calls hopping pool threads
+            rank = group.rank
         else:
             raise RuntimeError(
                 f"calling thread has no rank in group {group_name!r}; pass rank= "

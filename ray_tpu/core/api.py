@@ -86,8 +86,17 @@ def init(
     path). With `address="host:port"` (a GCS address), attaches this
     driver to that cluster: tasks/actors become leases on node daemons,
     executed in worker processes cluster-wide.
+
+    `address="ray://host:port"` is accepted as an alias: the wire
+    protocol is plain TCP RPC either way, so a driver OUTSIDE the
+    cluster attaches exactly like a colocated one — the remote-client
+    role the reference needs a separate gRPC proxy stack for
+    (python/ray/_private/client_mode_hook.py, ray client server) is
+    just the normal attach path here.
     """
     if address is not None:
+        if address.startswith("ray://"):
+            address = address[len("ray://"):]
         if _CLUSTER[0] is not None:
             if ignore_reinit_error:
                 return _CLUSTER[0]

@@ -141,14 +141,18 @@ class CompiledDAG:
 
         # group executable nodes by loop: one loop per actor, one per
         # collective node (driver-side thread)
-        actor_loops: dict[int, dict] = {}  # id(actor) -> {handle, nodes}
+        actor_loops: dict = {}  # actor identity -> {handle, nodes}
         collectives: list[CollectiveOutputNode] = []
+        self._cluster_mode = False
         for n in nodes:
             if isinstance(n, ClassMethodNode):
-                key = id(n.actor_handle._actor)
-                loop = actor_loops.setdefault(
-                    key, {"handle": n.actor_handle, "nodes": []}
-                )
+                h = n.actor_handle
+                if hasattr(h, "_actor"):  # in-process handle
+                    key = id(h._actor)
+                else:  # cluster handle: PROCESS actor -> shm channels
+                    key = h._actor_id
+                    self._cluster_mode = True
+                loop = actor_loops.setdefault(key, {"handle": h, "nodes": []})
                 loop["nodes"].append(n)
             elif isinstance(n, CollectiveOutputNode):
                 collectives.append(n)
@@ -181,12 +185,22 @@ class CompiledDAG:
         chan_for: dict[int, Channel] = {}
         reader_idx: dict[tuple, int] = {}  # (node_id, consumer_loop) -> idx
 
+        def make_channel(num_readers: int):
+            if self._cluster_mode:
+                # PROCESS actors: named single-writer ring over one shared
+                # memory mapping (dag/shm_channel.py) — the plasma-mutable-
+                # object channel role
+                from ray_tpu.dag.shm_channel import ShmChannel
+
+                return ShmChannel(num_readers=num_readers, maxsize=max_in_flight)
+            return Channel(num_readers=num_readers, maxsize=max_in_flight)
+
         def alloc_channel(n: DAGNode, extra_driver_reads: int):
             cons = consumers_of(n)
             total = len(cons) + extra_driver_reads
             if total == 0:
                 return None
-            ch = Channel(num_readers=total, maxsize=max_in_flight)
+            ch = make_channel(total)
             self._channels.append(ch)
             chan_for[n.id] = ch
             for i, c in enumerate(cons):
@@ -215,9 +229,7 @@ class CompiledDAG:
                 raise ValueError("DAG output cannot be the input itself")
             self._input_consumers = consuming_loops
             if consuming_loops:
-                self._input_channel = Channel(
-                    num_readers=len(consuming_loops), maxsize=max_in_flight
-                )
+                self._input_channel = make_channel(len(consuming_loops))
                 self._channels.append(self._input_channel)
 
         # --- build per-loop plans ------------------------------------------
@@ -336,6 +348,9 @@ class CompiledDAG:
                 ray_tpu.get(ref, timeout=5)
             except Exception:
                 pass
+        for ch in self._channels:
+            if hasattr(ch, "unlink"):  # shm channels: reclaim the mapping
+                ch.unlink()
 
     def __del__(self):
         try:
@@ -346,9 +361,14 @@ class CompiledDAG:
 
 def _submit_exec_loop(handle, plan, input_source):
     """Kick off the framework exec-loop task on the actor; returns its ref."""
-    from ray_tpu.core.api import ActorMethod
+    if hasattr(handle, "_actor"):  # in-process actor
+        from ray_tpu.core.api import ActorMethod
 
-    method = ActorMethod(handle, "__ray_tpu_dag_exec_loop__")
+        method = ActorMethod(handle, "__ray_tpu_dag_exec_loop__")
+    else:  # cluster (process) actor
+        from ray_tpu.cluster.client import _ActorMethod
+
+        method = _ActorMethod(handle, "__ray_tpu_dag_exec_loop__")
     return method.remote(plan, input_source)
 
 

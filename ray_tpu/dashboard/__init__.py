@@ -28,9 +28,18 @@ _dashboard: Optional["Dashboard"] = None
 
 
 class Dashboard:
-    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+    """`gcs_address` switches on the CLUSTER view: /api/cluster/* routes
+    aggregate the GCS tables plus per-node stats pulled live from every
+    node daemon's RPC server — each daemon IS the per-node dashboard
+    agent (reference: dashboard/agent.py processes colocated with each
+    raylet; here the daemon's rpc_stats/rpc_timeline endpoints fill that
+    role without a separate process)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265,
+                 gcs_address: Optional[str] = None):
         self.host = host
         self.port = port
+        self.gcs_address = gcs_address
         self._started = threading.Event()
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -94,8 +103,75 @@ class Dashboard:
         async def timeline(_req):
             return web.json_response(await offload(state.timeline))
 
+        # -- cluster view: GCS tables + live per-daemon agent stats --------
+        def _gcs_call(method, payload=None):
+            from ray_tpu.cluster.rpc import RpcClient
+
+            host, port = self.gcs_address.rsplit(":", 1)
+            c = RpcClient(host, int(port), timeout=10.0).connect()
+            try:
+                return c.call(method, payload)
+            finally:
+                c.close()
+
+        def _agent_stats(n):
+            from ray_tpu.cluster.rpc import RpcClient
+
+            try:  # the daemon doubles as the per-node agent
+                host, port = n["addr"]
+                c = RpcClient(host, port, timeout=5.0).connect()
+                try:
+                    n["stats"] = c.call("stats", None)
+                finally:
+                    c.close()
+            except Exception as e:  # noqa: BLE001
+                n["stats_error"] = repr(e)[:120]
+            return n
+
+        def _cluster_nodes():
+            from concurrent.futures import ThreadPoolExecutor
+
+            nodes = _gcs_call("list_nodes")
+            alive = [n for n in nodes if n.get("alive")]
+            if alive:  # fan out: one wedged daemon must not serialize all
+                with ThreadPoolExecutor(max_workers=min(16, len(alive))) as ex:
+                    list(ex.map(_agent_stats, alive))
+            return nodes
+
+        async def cluster_nodes(_req):
+            return web.json_response(await offload(_cluster_nodes))
+
+        async def cluster_actors(_req):
+            rows = await offload(lambda: _gcs_call("list_actors"))
+            for r in rows:
+                r.pop("creation_spec", None)  # pickled blob, not JSON
+            return web.json_response(_jsonable(rows))
+
+        def _jsonable(x):
+            if isinstance(x, bytes):
+                return x.hex()
+            if isinstance(x, dict):
+                return {k: _jsonable(v) for k, v in x.items()}
+            if isinstance(x, (list, tuple)):
+                return [_jsonable(v) for v in x]
+            return x
+
+        async def cluster_pgs(_req):
+            rows = await offload(lambda: _gcs_call("list_pgs"))
+            return web.json_response(_jsonable(rows))
+
+        async def cluster_demand(_req):
+            return web.json_response(
+                await offload(lambda: _gcs_call("cluster_demand"))
+            )
+
         app = web.Application()
         app.router.add_get("/healthz", healthz)
+        if self.gcs_address:
+            app.router.add_get("/api/cluster/nodes", cluster_nodes)
+            app.router.add_get("/api/cluster/actors", cluster_actors)
+            app.router.add_get("/api/cluster/placement_groups", cluster_pgs)
+            app.router.add_get("/api/cluster/demand", cluster_demand)
         app.router.add_get("/api/tasks", tasks)
         app.router.add_get("/api/actors", actors)
         app.router.add_get("/api/objects", objects)
@@ -129,10 +205,11 @@ class Dashboard:
         self._thread.join(timeout=5)
 
 
-def start_dashboard(host: str = "127.0.0.1", port: int = 8265) -> Dashboard:
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265,
+                    gcs_address: Optional[str] = None) -> Dashboard:
     global _dashboard
     if _dashboard is None:
-        _dashboard = Dashboard(host, port)
+        _dashboard = Dashboard(host, port, gcs_address=gcs_address)
     return _dashboard
 
 

@@ -141,6 +141,11 @@ class TFRecordDatasource(FileDatasource):
                 k: (v[0] if isinstance(v, list) and len(v) == 1 else v)
                 for k, v in ex.items()
             })
+        # tf.train.Example features are optional per record: union the
+        # keys (missing -> None) so heterogeneous records neither crash
+        # schema inference nor silently drop late-appearing features
+        keys = sorted({k for r in rows for k in r})
+        rows = [{k: r.get(k) for k in keys} for r in rows]
         return [Block.from_rows(rows)]
 
 
@@ -309,7 +314,12 @@ class WebDatasetDatasource(FileDatasource):
             for member in tar.getmembers():
                 if not member.isfile():
                     continue
-                key, _, ext = member.name.rpartition(".")
+                key, dot, ext = member.name.rpartition(".")
+                if not dot:
+                    # extensionless member (README, LICENSE, ...): no
+                    # sample key to group under — lumping them into one
+                    # "" sample would cross-contaminate the shard
+                    continue
                 data = tar.extractfile(member).read()
                 ext = ext.lower()
                 if ext in ("txt", "cls"):

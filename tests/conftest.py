@@ -7,8 +7,12 @@ sharding paths are exercised without TPU hardware.
 
 import os
 
-# Must be set before jax import anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax import anywhere in the test process — and must
+# OVERRIDE an inherited JAX_PLATFORMS=axon/tpu: cluster tests spawn
+# GCS/daemon/worker subprocesses that inherit this environment, and a
+# fleet of CPU test workers must never race each other (or a concurrent
+# benchmark) for the one real TPU.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()

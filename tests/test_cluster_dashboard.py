@@ -1,0 +1,60 @@
+"""Cluster-view dashboard: per-node agent stats aggregated over the
+GCS + node-daemon plane.
+
+Reference analog: dashboard head + per-raylet dashboard agents
+(python/ray/dashboard/head.py, dashboard/agent.py). Here each node
+daemon's RPC server doubles as the agent; the dashboard fans out to
+them live.
+"""
+
+import sys
+
+import cloudpickle
+import pytest
+
+from ray_tpu.cluster import LocalCluster
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+def _answer():
+    return 42
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = LocalCluster(node_death_timeout_s=2.0)
+    c.start()
+    c.add_node({"num_cpus": 2}, node_id="head")
+    c.add_node({"num_cpus": 2}, node_id="n1")
+    c.wait_for_nodes(2)
+    yield c
+    c.shutdown()
+
+
+def test_cluster_dashboard_routes(cluster):
+    import requests
+
+    from ray_tpu.dashboard import Dashboard
+
+    client = cluster.client()
+    assert client.get(client.submit(_answer), timeout=60) == 42
+
+    dash = Dashboard(port=18266, gcs_address=cluster.address)
+    try:
+        base = "http://127.0.0.1:18266"
+        nodes = requests.get(f"{base}/api/cluster/nodes", timeout=15).json()
+        assert {n["node_id"] for n in nodes} == {"head", "n1"}
+        # live agent stats pulled from each daemon
+        for n in nodes:
+            assert "stats" in n, n
+            assert "available" in n["stats"]
+            assert "objects" in n["stats"]
+        demand = requests.get(f"{base}/api/cluster/demand", timeout=15).json()
+        assert "pending" in demand and "nodes" in demand
+        actors = requests.get(f"{base}/api/cluster/actors", timeout=15).json()
+        assert isinstance(actors, list)
+        pgs = requests.get(f"{base}/api/cluster/placement_groups", timeout=15).json()
+        assert isinstance(pgs, list)
+    finally:
+        dash.shutdown()

@@ -1,0 +1,106 @@
+"""Cross-PROCESS collective gangs over the cluster plane.
+
+Reference analog: gloo-backed collective groups between worker
+processes (python/ray/util/collective/collective_group/
+gloo_collective_group.py); here the host-tier rendezvous rides the GCS
+KV long-poll (collective/cluster_group.py), so ranks living in separate
+OS processes on separate node daemons synchronize without any shared
+memory or threads.
+"""
+
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+from ray_tpu.cluster import LocalCluster
+from ray_tpu.core import api
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def attached_cluster():
+    c = LocalCluster(node_death_timeout_s=2.0)
+    c.start()
+    c.add_node({"num_cpus": 2}, node_id="head")
+    c.add_node({"num_cpus": 2}, node_id="n1")
+    c.wait_for_nodes(2)
+    api.init(address=c.address, ignore_reinit_error=True)
+    yield c
+    api.shutdown()
+    c.shutdown()
+
+
+@api.remote
+class Rank:
+    def pid(self):
+        import os
+
+        return os.getpid()
+
+    def do_allreduce(self, x):
+        from ray_tpu import collective
+
+        return collective.allreduce(np.asarray(x, np.float32), group_name="g1")
+
+    def do_broadcast(self, x):
+        from ray_tpu import collective
+
+        return collective.broadcast(np.asarray(x, np.float32), src_rank=0,
+                                    group_name="g1")
+
+    def do_sendrecv(self, rank):
+        from ray_tpu import collective
+
+        if rank == 0:
+            collective.send(np.arange(4.0), dst_rank=1, group_name="g1")
+            return None
+        return collective.recv(src_rank=0, group_name="g1")
+
+    def my_rank(self):
+        from ray_tpu import collective
+
+        return collective.get_rank(group_name="g1")
+
+
+def test_cluster_collective_gang(attached_cluster):
+    from ray_tpu import collective
+
+    a = Rank.options(num_cpus=1, resources={}).remote()
+    b = Rank.options(num_cpus=1).remote()
+    # separate processes
+    pids = api.get([a.pid.remote(), b.pid.remote()])
+    assert pids[0] != pids[1]
+
+    collective.create_collective_group([a, b], 2, [0, 1], group_name="g1")
+    assert api.get([a.my_rank.remote(), b.my_rank.remote()]) == [0, 1]
+
+    # allreduce across processes
+    r0, r1 = api.get([a.do_allreduce.remote([1.0, 2.0]),
+                      b.do_allreduce.remote([10.0, 20.0])], timeout=60)
+    np.testing.assert_allclose(r0, [11.0, 22.0])
+    np.testing.assert_allclose(r1, [11.0, 22.0])
+
+    # broadcast from rank 0
+    r0, r1 = api.get([a.do_broadcast.remote([7.0]), b.do_broadcast.remote([0.0])],
+                     timeout=60)
+    np.testing.assert_allclose(r1, [7.0])
+
+    # p2p
+    _, got = api.get([a.do_sendrecv.remote(0), b.do_sendrecv.remote(1)], timeout=60)
+    np.testing.assert_allclose(got, np.arange(4.0))
+
+
+def test_driver_participates_in_gang(attached_cluster):
+    """The driver itself can be a rank (reference: the trainer driver
+    joining the gloo group)."""
+    from ray_tpu import collective
+
+    a = Rank.options(num_cpus=1).remote()
+    collective.create_collective_group([a], 1, [0], group_name="solo")
+    # driver-side group on the same GCS: world of 1, trivial allreduce
+    collective.init_collective_group(1, 0, backend="cluster", group_name="d1")
+    out = collective.allreduce(np.ones(3), group_name="d1", rank=0)
+    np.testing.assert_allclose(out, np.ones(3))

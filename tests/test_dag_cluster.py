@@ -1,0 +1,75 @@
+"""Compiled DAGs over cluster (PROCESS) actors: shm-channel data plane.
+
+Reference analog: compiled graphs executing over worker processes with
+mutable-plasma channels (python/ray/dag/compiled_dag_node.py +
+experimental/channel/shared_memory_channel.py). Values move between OS
+processes through a named shared-memory ring (dag/shm_channel.py), not
+through the task RPC path.
+"""
+
+import sys
+
+import cloudpickle
+import pytest
+
+from ray_tpu.cluster import LocalCluster
+from ray_tpu.core import api
+from ray_tpu.dag import InputNode, MultiOutputNode
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def attached_cluster():
+    c = LocalCluster(node_death_timeout_s=2.0)
+    c.start()
+    c.add_node({"num_cpus": 2}, node_id="head")
+    c.add_node({"num_cpus": 2}, node_id="n1")
+    c.wait_for_nodes(2)
+    api.init(address=c.address, ignore_reinit_error=True)
+    yield c
+    api.shutdown()
+    c.shutdown()
+
+
+@api.remote
+class Stage:
+    def __init__(self, add):
+        self.add = add
+
+    def apply(self, x):
+        return x + self.add
+
+    def pid(self):
+        import os
+
+        return os.getpid()
+
+
+def test_shm_channel_cross_process_pipeline(attached_cluster):
+    a = Stage.options(num_cpus=1).remote(1)
+    b = Stage.options(num_cpus=1).remote(10)
+    pids = api.get([a.pid.remote(), b.pid.remote()])
+    assert pids[0] != pids[1] and all(p != __import__("os").getpid() for p in pids)
+
+    with InputNode() as inp:
+        mid = a.apply.bind(inp)
+        out = b.apply.bind(mid)
+    dag = out.experimental_compile()
+    try:
+        for i in range(5):
+            assert dag.execute(i).get(timeout=60) == i + 11
+    finally:
+        dag.teardown()
+
+
+def test_shm_channel_multi_output(attached_cluster):
+    a = Stage.options(num_cpus=1).remote(1)
+    b = Stage.options(num_cpus=1).remote(2)
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.apply.bind(inp), b.apply.bind(inp)])
+    compiled = dag.experimental_compile()
+    try:
+        assert compiled.execute(100).get(timeout=60) == [101, 102]
+    finally:
+        compiled.teardown()

@@ -9,9 +9,22 @@ import tarfile
 import numpy as np
 import pytest
 
+import ray_tpu
 from ray_tpu import data as rdata
 from ray_tpu.data.block import Block
 from ray_tpu.data.datasources_ext import write_tfrecord_block
+
+
+@pytest.fixture(autouse=True)
+def rt():
+    # explicit sizing: auto-init would size the pool to the host's CPU
+    # count (1 in CI), and the runtime is process-global — a 1-CPU pool
+    # left behind here would starve every later module's actors
+    if not ray_tpu.is_initialized():
+        # 32 matches the largest pool any module asks for (first init
+        # wins process-wide, so be as generous as the hungriest module)
+        ray_tpu.init(num_cpus=32)
+    yield
 
 
 def test_tfrecords_roundtrip(tmp_path):

@@ -1,0 +1,34 @@
+"""Sanitizer gate for the native shm store (SURVEY §5.2).
+
+Reference analog: ASAN/TSAN CI jobs over the C++ object-store core.
+Builds the store + a multithreaded stress driver under ASan/TSan and
+runs it; any sanitizer report exits non-zero and fails the test.
+"""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "ray_tpu", "native")
+
+
+def _run_target(target: str) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        ["make", target],
+        cwd=NATIVE_DIR,
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no g++")
+@pytest.mark.parametrize("target", ["asan", "tsan"])
+def test_shm_store_under_sanitizer(target):
+    proc = _run_target(target)
+    assert proc.returncode == 0, (
+        f"{target} run failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    assert "failures=0" in proc.stdout
