@@ -51,11 +51,12 @@ def probe(tag, cfg, B, S, K=20):
 
 def main():
     base = llama.LLAMA_400M
-    probe("xla_dots_b8", dataclasses.replace(base, attention_impl="xla"), 8, 1024)
-    probe("xla_dots_b16", dataclasses.replace(base, attention_impl="xla"), 16, 1024)
     probe("flash_dots_b8", dataclasses.replace(base, attention_impl="flash"), 8, 1024)
-    probe("xla_full_b16", dataclasses.replace(base, attention_impl="xla",
-                                              remat_policy="full"), 16, 1024)
+    probe("flash_dots_b16", dataclasses.replace(base, attention_impl="flash"), 16, 1024)
+    probe("flash_dots_b32", dataclasses.replace(base, attention_impl="flash"), 32, 1024)
+    probe("flash_none_b8", dataclasses.replace(base, attention_impl="flash", remat=False), 8, 1024)
+    probe("flash_dots_b8_s2048", dataclasses.replace(base, attention_impl="flash"), 8, 2048)
+    probe("flash_dots_b4_s4096", dataclasses.replace(base, attention_impl="flash"), 4, 4096)
 
 
 if __name__ == "__main__":

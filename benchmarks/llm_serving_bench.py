@@ -1,0 +1,88 @@
+"""LLM serving throughput on the local accelerator.
+
+Continuous-batching decode throughput (tokens/s) for the paged-KV
+engine at a fixed concurrency — the serving-side counterpart of
+bench.py's training MFU. Prints one JSON line.
+
+Reference headline analog: vLLM-style tokens/s serving benchmarks.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def main():
+    import os
+
+    import jax
+
+    want = os.environ.get("JAX_PLATFORMS", "")
+    if want and "axon" not in want and "tpu" not in want:
+        # the axon plugin registers via sitecustomize regardless of the
+        # env var; only the config pin actually keeps this off the TPU
+        jax.config.update("jax_platforms", want)
+
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine, SamplingParams
+    from ray_tpu.models import llama
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = llama.LLAMA_400M
+        n_requests, prompt_len, max_new = 32, 128, 128
+    else:
+        cfg = llama.LLAMA_TINY
+        n_requests, prompt_len, max_new = 8, 16, 16
+
+    engine = LLMEngine(
+        EngineConfig(
+            model=cfg,
+            max_num_seqs=min(n_requests, 16),
+            num_blocks=1024 if on_tpu else 128,
+        )
+    )
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    params = SamplingParams(max_tokens=max_new, temperature=0.0, ignore_eos=True)
+    t_submit = time.perf_counter()
+    for i in range(n_requests):
+        engine.add_request(
+            rng.integers(1, cfg.vocab_size, prompt_len).tolist(),
+            params,
+            request_id=f"r{i}",
+        )
+
+    generated = 0
+    first_token_at = None
+    while engine.has_unfinished():
+        outs = engine.step()
+        for o in outs:
+            if o.new_token_ids:
+                if first_token_at is None:
+                    first_token_at = time.perf_counter()
+                generated += len(o.new_token_ids)
+    dt = time.perf_counter() - t_submit
+
+    expected = n_requests * max_new
+    result = {
+        "metric": "llm_decode_tok_s" if on_tpu else "llm_decode_smoke_tok_s",
+        "value": round(generated / dt, 1),
+        "unit": "tok/s",
+        "vs_baseline": 0,
+        "generated_tokens": generated,
+        "expected_tokens": expected,
+        "wall_s": round(dt, 2),
+        "ttft_s": round((first_token_at or t_submit) - t_submit, 3),
+        "concurrency": min(n_requests, 16),
+        "model_params": cfg.num_params(),
+        "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+    }
+    if generated < expected * 0.9:
+        result["warning"] = "fewer tokens than expected (early stops?)"
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

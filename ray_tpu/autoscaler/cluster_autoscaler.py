@@ -183,7 +183,20 @@ class ClusterAutoscaler:
 
     def _scale_down(self) -> None:
         now = time.time()
-        for nid in list(self.provider.non_terminated_nodes()):
+        # reap bookkeeping for nodes that died on their own (daemon crash):
+        # leaving them in _node_type would count them against max_workers
+        # forever and starve replacement launches
+        live = set(self.provider.non_terminated_nodes())
+        for nid in list(self._node_type):
+            if nid in live:
+                continue
+            launching = self._launching.get(nid)
+            if launching is not None and now - launching[1] <= self._launch_grace_s:
+                continue  # still booting; not registered yet
+            self._node_type.pop(nid, None)
+            self._idle_since.pop(nid, None)
+            self._launching.pop(nid, None)
+        for nid in list(live):
             tname = self._node_type.get(nid)
             if tname is None:
                 continue

@@ -196,6 +196,15 @@ class ClusterClient:
         # object some caller still holds
         self.auto_free = True
         self._closed = False
+        # lineage: return-oid -> shared task record, enough to RE-EXECUTE
+        # the producing task when its stored result is lost with the node
+        # that held it (reference: lineage reconstruction driven by the
+        # ownership table, core_worker object recovery). Depth 1: a
+        # reconstruction whose ARGS were also lost fails over to the
+        # normal task-lost error. Bounded; entries drop with the ref.
+        self._lineage: dict[bytes, dict] = {}
+        self._lineage_cap = 8192
+        self._lineage_guard = threading.Lock()  # check-then-act on records
         self._freer = threading.Thread(
             target=self._rc_loop, name="ray_tpu-freer", daemon=True
         )
@@ -285,12 +294,14 @@ class ClusterClient:
                     counts.pop(oid, None)
                     if oid in owned and self.auto_free:
                         owned.discard(oid)
+                        self._lineage.pop(oid, None)  # freed: never rebuild
                         if not self._free_everywhere(oid):
                             retries[oid] = (time.monotonic() + 1.0, 1)
             elif op == "free":
                 owned.discard(oid)
                 counts.pop(oid, None)
                 retries.pop(oid, None)
+                self._lineage.pop(oid, None)
                 self._free_everywhere(oid)
 
     def _free_everywhere(self, oid: bytes) -> bool:
@@ -351,6 +362,7 @@ class ClusterClient:
         if isinstance(ref, (list, tuple)):
             return type(ref)(self._get_many(list(ref), timeout))
         deadline = time.monotonic() + (timeout if timeout is not None else 300.0)
+        t0 = time.monotonic()
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -360,6 +372,8 @@ class ClusterClient:
                 {"object_id": ref.id, "timeout": min(remaining, 5.0)},
                 timeout=min(remaining, 5.0) + 10,
             )
+            if data is None and time.monotonic() - t0 > 2.0:
+                self._maybe_reconstruct(ref.id)
             if data is not None:
                 value = loads_value(data, self._resolve)
                 if isinstance(value, _ErrorValue):
@@ -372,6 +386,7 @@ class ClusterClient:
         deadline = time.monotonic() + (timeout if timeout is not None else 300.0)
         out: dict[int, Any] = {}
         pending = list(enumerate(refs))
+        t0 = time.monotonic()
         while pending:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -383,8 +398,11 @@ class ClusterClient:
                 timeout=step + 30,
             )
             still = []
+            reconstruct = time.monotonic() - t0 > 2.0
             for (i, r), data in zip(pending, datas):
                 if data is None:
+                    if reconstruct:
+                        self._maybe_reconstruct(r.id)
                     still.append((i, r))
                     continue
                 value = loads_value(data, self._resolve)
@@ -410,14 +428,21 @@ class ClusterClient:
         deadline = None if timeout is None else time.monotonic() + timeout
         ready: list = []
         pending = list(refs)
+        t0 = time.monotonic()
         while len(ready) < num_returns:
             # one batched probe per poll (not one RPC per ref)
             have = self.gcs.call(
                 "locate_many", {"object_ids": [r.id for r in pending]}
             )
             still = []
+            reconstruct = time.monotonic() - t0 > 2.0
             for r in pending:
-                (ready if have.get(r.id) else still).append(r)
+                if have.get(r.id):
+                    ready.append(r)
+                else:
+                    if reconstruct:
+                        self._maybe_reconstruct(r.id)
+                    still.append(r)
             pending = still
             if len(ready) >= num_returns:
                 break
@@ -468,9 +493,66 @@ class ClusterClient:
             "affinity_soft": affinity_soft,
             "runtime_env": self._package_runtime_env(runtime_env),
         }
-        self._submitter.submit(self._drive_task, payload, spec, max_retries, arg_refs)
+        if self.auto_free and len(self._lineage) < self._lineage_cap:
+            record = {
+                "payload": payload, "spec": spec, "arg_refs": list(arg_refs),
+                "attempts": 2, "done": False, "inflight": True,
+            }
+            for rid in return_ids:
+                self._lineage[rid] = record
+        else:
+            record = None
+        fut = self._submitter.submit(
+            self._drive_task, payload, spec, max_retries, arg_refs
+        )
+        if record is not None:
+            def _done(_f, rec=record):
+                rec["done"] = True
+                rec["inflight"] = False
+
+            fut.add_done_callback(_done)
         refs = [ClusterObjectRef(rid, self, desc, owned=True) for rid in return_ids]
         return refs[0] if num_returns == 1 else refs
+
+    def _maybe_reconstruct(self, object_id: bytes) -> bool:
+        """If `object_id` is a finished task's return that no node holds
+        anymore, re-execute the producing task (same return ids). Returns
+        True when a reconstruction was dispatched."""
+        rec = self._lineage.get(object_id)
+        if rec is None or rec["inflight"] or not rec["done"] or rec["attempts"] <= 0:
+            return False
+        try:
+            locs = self.gcs.call(
+                "locate_object", {"object_id": object_id}, timeout=10
+            )
+        except Exception:  # noqa: BLE001 — treat a flaky GCS as "not lost"
+            return False
+        if locs:
+            return False  # stored somewhere; the fetch path will find it
+        with self._lineage_guard:
+            # re-check under the lock: concurrent get()/wait() callers on
+            # the same lost task must dispatch exactly ONE re-execution
+            if rec["inflight"] or not rec["done"] or rec["attempts"] <= 0:
+                return False
+            rec["attempts"] -= 1
+            rec["inflight"] = True
+            rec["done"] = False
+        logger.warning(
+            "object %s lost with its node; re-executing task %r via lineage",
+            object_id.hex()[:12], rec["payload"]["desc"],
+        )
+        for oid in rec["arg_refs"]:
+            self._incref(oid)
+        fut = self._submitter.submit(
+            self._drive_task, rec["payload"], rec["spec"], 3, rec["arg_refs"]
+        )
+
+        def _done(_f, r=rec):
+            r["done"] = True
+            r["inflight"] = False
+
+        fut.add_done_callback(_done)
+        return True
 
     def _drive_task(self, payload: dict, spec: dict, max_retries: int,
                     arg_refs: Sequence[bytes] = ()) -> None:

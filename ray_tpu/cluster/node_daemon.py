@@ -423,6 +423,9 @@ class NodeDaemon:
         self._capacity_signal = threading.Event()  # wakes the granter
         self._num_queued = 0  # granter's current waiter count (approximate)
         self._pending_specs: list[dict] = []  # queued lease resource specs
+        from collections import deque as _deque
+
+        self._spans: "_deque[dict]" = _deque(maxlen=20000)  # worker exec spans
         self.rpc = RpcServer(self, host=host)
         self.pool = ClientPool()
         # reconnecting: the GCS may restart (FT snapshot) and come back at
@@ -982,6 +985,18 @@ class NodeDaemon:
 
     def rpc_ping(self, payload, peer):
         return {"node_id": self.node_id}
+
+    def rpc_record_spans(self, payload, peer):
+        """Batched execution spans from this node's workers (reference:
+        worker ProfileEvents flowing to the task-event pipeline). Bounded
+        buffer; rpc_timeline serves it to the dashboard/state API."""
+        self._spans.extend(payload.get("spans", ()))
+        return {"ok": True}
+
+    def rpc_timeline(self, payload, peer):
+        since = float(payload.get("since", 0.0)) if payload else 0.0
+        return [s for s in list(self._spans)
+                if float(s.get("end", 0.0)) >= since]
 
     def rpc_stats(self, payload, peer):
         with self._res_lock:

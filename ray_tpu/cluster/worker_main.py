@@ -71,6 +71,32 @@ class WorkerRuntime:
         self.actors: dict[bytes, Any] = {}
         self._actor_locks: dict[bytes, asyncio.Lock] = {}
         self.rpc = RpcServer(self)
+        # execution-side tracing: spans buffered here, flushed to the node
+        # daemon in batches off the hot path (reference: per-worker
+        # ProfileEvents batched to the GCS task-event pipeline,
+        # core_worker/task_event_buffer.h)
+        from collections import deque
+
+        self._spans: "deque[dict]" = deque(maxlen=4096)
+        self._span_flusher = threading.Thread(
+            target=self._flush_spans_loop, name="span-flush", daemon=True
+        )
+        self._span_flusher.start()
+
+    def _flush_spans_loop(self) -> None:
+        import time as _time
+
+        while True:
+            _time.sleep(0.5)
+            if not self._spans:
+                continue
+            batch = []
+            while self._spans and len(batch) < 512:
+                batch.append(self._spans.popleft())
+            try:
+                self.daemon.call("record_spans", {"spans": batch}, timeout=10)
+            except Exception:  # noqa: BLE001 — tracing must never hurt tasks
+                pass
 
     # -- object plumbing ------------------------------------------------------
     # Same-node objects ride the shared-memory store (plasma-equivalent):
@@ -133,13 +159,21 @@ class WorkerRuntime:
     # -- task execution -------------------------------------------------------
 
     def _execute(self, payload) -> dict:
+        import time as _time
+
         desc = payload.get("desc", "task")
         return_ids = payload["return_ids"]
+        t0 = _time.time()
         try:
             func = cloudpickle.loads(payload["func"])
             args, kwargs = loads_value(payload["args"], self.resolve_ref)
             result = func(*args, **kwargs)
             self._store_returns(return_ids, result, payload.get("num_returns", 1))
+            self._spans.append({
+                "desc": desc, "task_id": payload.get("task_id", b"").hex(),
+                "worker_id": self.worker_id, "start": t0, "end": _time.time(),
+                "ok": True,
+            })
             return {"ok": True}
         except BaseException as e:  # noqa: BLE001
             tb = traceback.format_exc()
@@ -149,6 +183,11 @@ class WorkerRuntime:
                     self.put_return(rid, err)
                 except Exception:
                     pass
+            self._spans.append({
+                "desc": desc, "task_id": payload.get("task_id", b"").hex(),
+                "worker_id": self.worker_id, "start": t0, "end": _time.time(),
+                "ok": False,
+            })
             return {"ok": False, "error": repr(e), "tb": tb,
                     "retryable": not isinstance(e, (SystemExit,))}
 
@@ -206,6 +245,9 @@ class WorkerRuntime:
             return result
 
         desc = f"{type(actor).__name__}.{payload['method']}"
+        import time as _time
+
+        t0 = _time.time()
         try:
             # only METHOD EXECUTION needs the FIFO lock (per-caller order);
             # storing the result is an independent RPC to the daemon and
@@ -213,6 +255,11 @@ class WorkerRuntime:
             # at the store round-trip
             async with lock:
                 result = await loop.run_in_executor(None, _invoke)
+            self._spans.append({
+                "desc": desc, "worker_id": self.worker_id,
+                "actor_id": actor_id.hex(), "start": t0, "end": _time.time(),
+                "ok": True,
+            })
             await loop.run_in_executor(
                 None,
                 self._store_returns,

@@ -185,6 +185,31 @@ class CompiledDAG:
         chan_for: dict[int, Channel] = {}
         reader_idx: dict[tuple, int] = {}  # (node_id, consumer_loop) -> idx
 
+        if self._cluster_mode:
+            # the shm data plane requires every participant (actors AND
+            # the driver, which writes input / reads outputs) to share one
+            # /dev/shm — fail at compile time with a clear message rather
+            # than a "No such file" deep inside a remote exec loop
+            hosts = set()
+            for loop in actor_loops.values():
+                h = loop["handle"]
+                if hasattr(h, "_actor"):
+                    continue
+                info = h._client.gcs.call("get_actor", {"actor_id": h._actor_id})
+                addr = (info or {}).get("node_addr") or (info or {}).get(
+                    "worker_addr"
+                )
+                if addr:
+                    hosts.add(addr[0])
+                hosts.add(h._client.local_daemon_addr[0])
+            if len(hosts) > 1:
+                raise NotImplementedError(
+                    f"compiled DAGs over cluster actors require all actors "
+                    f"and the driver on ONE host (shared-memory channels); "
+                    f"got hosts {sorted(hosts)}. Cross-node DAG edges go "
+                    "through the object plane (plain .remote calls)"
+                )
+
         def make_channel(num_readers: int):
             if self._cluster_mode:
                 # PROCESS actors: named single-writer ring over one shared

@@ -104,38 +104,48 @@ class Dashboard:
             return web.json_response(await offload(state.timeline))
 
         # -- cluster view: GCS tables + live per-daemon agent stats --------
-        def _gcs_call(method, payload=None):
-            from ray_tpu.cluster.rpc import RpcClient
+        # one cached connection per address (reference: rpc client pools);
+        # per-request connect/teardown churn would spawn and abandon a
+        # reader thread per node per poll
+        from ray_tpu.cluster.rpc import ClientPool
 
+        pool = ClientPool(timeout=5.0)
+        self._pool = pool
+
+        def _gcs_call(method, payload=None):
             host, port = self.gcs_address.rsplit(":", 1)
-            c = RpcClient(host, int(port), timeout=10.0).connect()
+            return pool.get((host, int(port))).call(method, payload)
+
+        def _node_call(n, method, payload=None):
+            """One agent RPC; evict the cached connection on failure so a
+            recovered daemon re-dials clean."""
+            addr = tuple(n["addr"])
             try:
-                return c.call(method, payload)
-            finally:
-                c.close()
+                return pool.get(addr).call(method, payload)
+            except Exception:
+                pool.invalidate(addr)
+                raise
 
         def _agent_stats(n):
-            from ray_tpu.cluster.rpc import RpcClient
-
             try:  # the daemon doubles as the per-node agent
-                host, port = n["addr"]
-                c = RpcClient(host, port, timeout=5.0).connect()
-                try:
-                    n["stats"] = c.call("stats", None)
-                finally:
-                    c.close()
+                n["stats"] = _node_call(n, "stats")
             except Exception as e:  # noqa: BLE001
                 n["stats_error"] = repr(e)[:120]
             return n
 
-        def _cluster_nodes():
+        def _fan_out(nodes, fn):
             from concurrent.futures import ThreadPoolExecutor
 
-            nodes = _gcs_call("list_nodes")
             alive = [n for n in nodes if n.get("alive")]
-            if alive:  # fan out: one wedged daemon must not serialize all
-                with ThreadPoolExecutor(max_workers=min(16, len(alive))) as ex:
-                    list(ex.map(_agent_stats, alive))
+            if not alive:
+                return []
+            # fan out: one wedged daemon must not serialize the sweep
+            with ThreadPoolExecutor(max_workers=min(16, len(alive))) as ex:
+                return list(ex.map(fn, alive))
+
+        def _cluster_nodes():
+            nodes = _gcs_call("list_nodes")
+            _fan_out(nodes, _agent_stats)
             return nodes
 
         async def cluster_nodes(_req):
@@ -165,6 +175,37 @@ class Dashboard:
                 await offload(lambda: _gcs_call("cluster_demand"))
             )
 
+        def _cluster_timeline():
+            """Chrome-trace events of worker-side execution spans across
+            all node daemons (the cross-process half of `ray timeline`;
+            driver-side lease/exec spans live in the driver's client)."""
+
+            def pull(n):
+                try:
+                    return n["node_id"], _node_call(n, "timeline", {})
+                except Exception:  # noqa: BLE001
+                    return n["node_id"], []
+
+            events = []
+            for node_id, spans in _fan_out(_gcs_call("list_nodes"), pull):
+                for s in spans:
+                    events.append({
+                        "name": s.get("desc", "task"),
+                        "ph": "X",
+                        "ts": float(s.get("start", 0.0)) * 1e6,
+                        "dur": max(
+                            0.0,
+                            float(s.get("end", 0.0)) - float(s.get("start", 0.0)),
+                        ) * 1e6,
+                        "pid": node_id,
+                        "tid": s.get("worker_id", "worker"),
+                        "cat": "exec" if s.get("ok", True) else "error",
+                    })
+            return events
+
+        async def cluster_timeline(_req):
+            return web.json_response(await offload(_cluster_timeline))
+
         app = web.Application()
         app.router.add_get("/healthz", healthz)
         if self.gcs_address:
@@ -172,6 +213,7 @@ class Dashboard:
             app.router.add_get("/api/cluster/actors", cluster_actors)
             app.router.add_get("/api/cluster/placement_groups", cluster_pgs)
             app.router.add_get("/api/cluster/demand", cluster_demand)
+            app.router.add_get("/api/cluster/timeline", cluster_timeline)
         app.router.add_get("/api/tasks", tasks)
         app.router.add_get("/api/actors", actors)
         app.router.add_get("/api/objects", objects)
@@ -198,6 +240,8 @@ class Dashboard:
         except Exception:
             logger.exception("dashboard crashed")
         finally:
+            if getattr(self, "_pool", None) is not None:
+                self._pool.close_all()
             loop.close()
 
     def shutdown(self) -> None:

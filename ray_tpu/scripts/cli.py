@@ -196,6 +196,25 @@ def main(argv: Optional[list] = None) -> int:
     pu.add_argument("--address", default=None)
     pu.set_defaults(fn=cmd_status)
 
+    from ray_tpu.scripts.k8s import cmd_k8s
+
+    pk = sub.add_parser(
+        "k8s", help="emit Kubernetes manifests (the KubeRay-operator role)"
+    )
+    pk.add_argument("--name", default="ray-tpu")
+    pk.add_argument("--image", default="ray-tpu:latest")
+    pk.add_argument("--namespace", default="default")
+    pk.add_argument("--gcs-port", type=int, default=6379)
+    pk.add_argument("--workers", type=int, default=2)
+    pk.add_argument("--worker-resources", default="num_cpus=4")
+    pk.add_argument("--worker-cpu", default=None,
+                    help="pod cpu request (default: num_cpus from --worker-resources)")
+    pk.add_argument("--worker-memory", default="8Gi")
+    pk.add_argument("--tpu-workers", type=int, default=0)
+    pk.add_argument("--tpu-accelerator", default="v5e-8")
+    pk.add_argument("--tpu-chips-per-host", type=int, default=4)
+    pk.set_defaults(fn=cmd_k8s)
+
     args = p.parse_args(argv)
     return args.fn(args)
 

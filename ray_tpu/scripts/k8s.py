@@ -1,0 +1,194 @@
+"""Kubernetes deployment generator: the KubeRay operator's surface,
+collapsed to manifests.
+
+Reference analog: the KubeRay RayCluster CRD (head group + worker
+groups, rayStartParams) that the reference's docs/tooling target. There
+is no custom controller here — a head Deployment + Service and plain
+worker Deployments reconcile the same topology with stock Kubernetes
+controllers, and the TPU worker group maps to a nodeSelector +
+`google.com/tpu` resource requests (slice-gang scheduling stays in the
+framework's placement groups, core/accelerators.py).
+
+`ray_tpu k8s --workers N [--worker-cpu 8 --worker-memory 16Gi]` prints
+YAML to stdout; pipe to kubectl apply.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def _container(name: str, image: str, command: list, resources: Optional[dict],
+               env: Optional[dict] = None) -> dict:
+    c: dict = {"name": name, "image": image, "command": command}
+    if resources:
+        c["resources"] = {"requests": dict(resources), "limits": dict(resources)}
+    if env:
+        c["env"] = [{"name": k, "value": str(v)} for k, v in env.items()]
+    return c
+
+
+def generate_manifests(
+    name: str = "ray-tpu",
+    image: str = "ray-tpu:latest",
+    namespace: str = "default",
+    gcs_port: int = 6379,
+    workers: int = 2,
+    worker_resources: str = "num_cpus=4",
+    worker_cpu: Optional[str] = None,
+    worker_memory: str = "8Gi",
+    tpu_workers: int = 0,
+    tpu_accelerator: str = "v5e-8",
+    tpu_chips_per_host: int = 4,
+) -> list:
+    """Returns a list of Kubernetes manifest dicts (Service, head
+    Deployment, worker Deployment, optional TPU worker Deployment)."""
+    labels = {"app": name}
+    head_labels = {**labels, "ray-tpu-role": "head"}
+    gcs_addr = f"{name}-head.{namespace}.svc:{gcs_port}"
+    if worker_cpu is None:
+        # pod requests must match what the daemon advertises to the
+        # scheduler, or leases over-commit the cgroup
+        cpus = "4"
+        for kv in worker_resources.split(","):
+            if kv.startswith("num_cpus="):
+                cpus = str(int(float(kv.split("=", 1)[1])))
+        worker_cpu = cpus
+
+    service = {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {"name": f"{name}-head", "namespace": namespace,
+                     "labels": labels},
+        "spec": {
+            "selector": head_labels,
+            "ports": [
+                {"name": "gcs", "port": gcs_port, "targetPort": gcs_port},
+                {"name": "dashboard", "port": 8265, "targetPort": 8265},
+            ],
+        },
+    }
+
+    head = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": f"{name}-head", "namespace": namespace,
+                     "labels": head_labels},
+        "spec": {
+            "replicas": 1,
+            "selector": {"matchLabels": head_labels},
+            "template": {
+                "metadata": {"labels": head_labels},
+                "spec": {
+                    "containers": [
+                        {
+                            **_container(
+                                "head", image,
+                                ["python", "-m", "ray_tpu.scripts.cli", "start",
+                                 "--head", "--host", "0.0.0.0",
+                                 "--port", str(gcs_port),
+                                 "--persist", "/var/lib/ray-tpu/gcs.snapshot",
+                                 "--resources", "num_cpus=2"],
+                                {"cpu": "2", "memory": "4Gi"},
+                            ),
+                            "volumeMounts": [
+                                {"name": "gcs-state",
+                                 "mountPath": "/var/lib/ray-tpu"}
+                            ],
+                        }
+                    ],
+                    # swap for a PVC to survive pod RESCHEDULING; emptyDir
+                    # already survives container restarts in place, which
+                    # is what --persist protects against on one node
+                    "volumes": [{"name": "gcs-state", "emptyDir": {}}],
+                },
+            },
+        },
+    }
+
+    worker_labels = {**labels, "ray-tpu-role": "worker"}
+    worker = {
+        "apiVersion": "apps/v1",
+        "kind": "Deployment",
+        "metadata": {"name": f"{name}-worker", "namespace": namespace,
+                     "labels": worker_labels},
+        "spec": {
+            "replicas": workers,
+            "selector": {"matchLabels": worker_labels},
+            "template": {
+                "metadata": {"labels": worker_labels},
+                "spec": {
+                    "containers": [
+                        _container(
+                            "worker", image,
+                            ["python", "-m", "ray_tpu.scripts.cli", "start",
+                             "--address", gcs_addr,
+                             "--host", "0.0.0.0",
+                             "--resources", worker_resources],
+                            {"cpu": worker_cpu, "memory": worker_memory},
+                        )
+                    ],
+                },
+            },
+        },
+    }
+
+    out = [service, head, worker]
+    if tpu_workers > 0:
+        tpu_labels = {**labels, "ray-tpu-role": "tpu-worker"}
+        tpu_res = f"num_cpus={worker_cpu},TPU={tpu_chips_per_host}"
+        out.append({
+            "apiVersion": "apps/v1",
+            "kind": "Deployment",
+            "metadata": {"name": f"{name}-tpu-worker", "namespace": namespace,
+                         "labels": tpu_labels},
+            "spec": {
+                "replicas": tpu_workers,
+                "selector": {"matchLabels": tpu_labels},
+                "template": {
+                    "metadata": {"labels": tpu_labels},
+                    "spec": {
+                        "nodeSelector": {
+                            "cloud.google.com/gke-tpu-accelerator": tpu_accelerator,
+                        },
+                        "containers": [
+                            _container(
+                                "tpu-worker", image,
+                                ["python", "-m", "ray_tpu.scripts.cli", "start",
+                                 "--address", gcs_addr,
+                                 "--host", "0.0.0.0",
+                                 "--resources", tpu_res],
+                                {"cpu": worker_cpu, "memory": worker_memory,
+                                 "google.com/tpu": str(tpu_chips_per_host)},
+                            )
+                        ],
+                    },
+                },
+            },
+        })
+    return out
+
+
+def manifests_yaml(**kwargs) -> str:
+    import yaml
+
+    return "---\n".join(
+        yaml.safe_dump(m, sort_keys=False) for m in generate_manifests(**kwargs)
+    )
+
+
+def cmd_k8s(args) -> int:
+    print(manifests_yaml(
+        name=args.name,
+        image=args.image,
+        namespace=args.namespace,
+        gcs_port=args.gcs_port,
+        workers=args.workers,
+        worker_resources=args.worker_resources,
+        worker_cpu=args.worker_cpu,
+        worker_memory=args.worker_memory,
+        tpu_workers=args.tpu_workers,
+        tpu_accelerator=args.tpu_accelerator,
+        tpu_chips_per_host=args.tpu_chips_per_host,
+    ), end="")
+    return 0

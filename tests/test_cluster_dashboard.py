@@ -8,6 +8,7 @@ them live.
 """
 
 import sys
+import time
 
 import cloudpickle
 import pytest
@@ -56,5 +57,18 @@ def test_cluster_dashboard_routes(cluster):
         assert isinstance(actors, list)
         pgs = requests.get(f"{base}/api/cluster/placement_groups", timeout=15).json()
         assert isinstance(pgs, list)
+        # worker-side execution spans flow worker -> daemon -> dashboard
+        deadline = time.time() + 15
+        events = []
+        while time.time() < deadline:
+            events = requests.get(
+                f"{base}/api/cluster/timeline", timeout=15
+            ).json()
+            if any(e["name"] == "_answer" for e in events):
+                break
+            time.sleep(0.5)
+        assert any(e["name"] == "_answer" for e in events), events[:5]
+        ev = next(e for e in events if e["name"] == "_answer")
+        assert ev["ph"] == "X" and ev["dur"] >= 0 and ev["pid"] in ("head", "n1")
     finally:
         dash.shutdown()

@@ -1,0 +1,46 @@
+"""Kubernetes manifest generation (the KubeRay RayCluster role).
+
+Reference analog: KubeRay's head-group + worker-groups topology with
+rayStartParams; here stock Deployments/Service running the operator
+CLI's start commands.
+"""
+
+import yaml
+
+from ray_tpu.scripts.cli import main as cli_main
+from ray_tpu.scripts.k8s import generate_manifests, manifests_yaml
+
+
+def test_manifest_topology():
+    docs = generate_manifests(workers=3, tpu_workers=2, tpu_chips_per_host=8)
+    kinds = [(d["kind"], d["metadata"]["name"]) for d in docs]
+    assert ("Service", "ray-tpu-head") in kinds
+    assert ("Deployment", "ray-tpu-head") in kinds
+    assert ("Deployment", "ray-tpu-worker") in kinds
+    assert ("Deployment", "ray-tpu-tpu-worker") in kinds
+
+    by_name = {d["metadata"]["name"]: d for d in docs if d["kind"] == "Deployment"}
+    assert by_name["ray-tpu-worker"]["spec"]["replicas"] == 3
+    tpu = by_name["ray-tpu-tpu-worker"]
+    assert tpu["spec"]["replicas"] == 2
+    box = tpu["spec"]["template"]["spec"]["containers"][0]
+    assert box["resources"]["requests"]["google.com/tpu"] == "8"
+    assert "cloud.google.com/gke-tpu-accelerator" in (
+        tpu["spec"]["template"]["spec"]["nodeSelector"]
+    )
+    # workers join through the head service address
+    wcmd = by_name["ray-tpu-worker"]["spec"]["template"]["spec"]["containers"][0]["command"]
+    assert "--address" in wcmd
+    assert any("ray-tpu-head.default.svc:6379" in c for c in wcmd)
+
+
+def test_yaml_roundtrip_and_cli(capsys):
+    text = manifests_yaml(workers=1)
+    docs = list(yaml.safe_load_all(text))
+    assert len(docs) == 3 and all(d for d in docs)
+
+    rc = cli_main(["k8s", "--workers", "1", "--tpu-workers", "1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    docs = list(yaml.safe_load_all(out))
+    assert len(docs) == 4
