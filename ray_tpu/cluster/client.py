@@ -493,10 +493,14 @@ class ClusterClient:
             "affinity_soft": affinity_soft,
             "runtime_env": self._package_runtime_env(runtime_env),
         }
-        if self.auto_free and len(self._lineage) < self._lineage_cap:
+        if (self.auto_free and max_retries > 0
+                and len(self._lineage) < self._lineage_cap):
+            # max_retries=0 means the caller forbids re-execution (side
+            # effects); such tasks are never rebuilt from lineage either
             record = {
                 "payload": payload, "spec": spec, "arg_refs": list(arg_refs),
                 "attempts": 2, "done": False, "inflight": True,
+                "max_retries": max_retries,
             }
             for rid in return_ids:
                 self._lineage[rid] = record
@@ -544,7 +548,8 @@ class ClusterClient:
         for oid in rec["arg_refs"]:
             self._incref(oid)
         fut = self._submitter.submit(
-            self._drive_task, rec["payload"], rec["spec"], 3, rec["arg_refs"]
+            self._drive_task, rec["payload"], rec["spec"],
+            rec.get("max_retries", 3), rec["arg_refs"],
         )
 
         def _done(_f, r=rec):

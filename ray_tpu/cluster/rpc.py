@@ -393,7 +393,15 @@ class ClientPool:
             c = self._clients.get(addr)
             if c is not None and c.connected:
                 return c
-            c = RpcClient(addr[0], addr[1], timeout=self._timeout).connect(retries=2)
+        # dial OUTSIDE the lock: holding it through a connect timeout
+        # would serialize every other address behind one wedged peer
+        c = RpcClient(addr[0], addr[1], timeout=self._timeout).connect(retries=2)
+        with self._lock:
+            existing = self._clients.get(addr)
+            if existing is not None and existing.connected:
+                # another thread won the dial race; keep theirs
+                c.close()
+                return existing
             self._clients[addr] = c
             return c
 

@@ -274,6 +274,11 @@ class WorkerRuntime:
                     self.put_return(rid, err)
                 except Exception:
                     pass
+            self._spans.append({
+                "desc": desc, "worker_id": self.worker_id,
+                "actor_id": actor_id.hex(), "start": t0, "end": _time.time(),
+                "ok": False,
+            })
             return {"ok": False, "error": repr(e), "tb": tb}
 
     async def rpc_destroy_actor(self, payload, peer):

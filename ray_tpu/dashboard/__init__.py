@@ -180,9 +180,16 @@ class Dashboard:
             all node daemons (the cross-process half of `ray timeline`;
             driver-side lease/exec spans live in the driver's client)."""
 
+            import time as _time
+
+            # bounded window: shipping each daemon's whole 20k-span
+            # buffer per poll grows linearly with cluster size
+            since = _time.time() - 600.0
+
             def pull(n):
                 try:
-                    return n["node_id"], _node_call(n, "timeline", {})
+                    return n["node_id"], _node_call(n, "timeline",
+                                                    {"since": since})
                 except Exception:  # noqa: BLE001
                     return n["node_id"], []
 

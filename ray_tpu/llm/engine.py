@@ -147,7 +147,8 @@ class LLMEngine:
         )
         self.allocator = BlockAllocator(c.num_blocks, c.block_size)
         self.cache = init_cache(
-            c.model, c.num_blocks * c.block_size, dtype=c.cache_dtype
+            c.model, c.num_blocks * c.block_size, dtype=c.cache_dtype,
+            trash_slots=c.block_size,
         )
         self.mesh = None
         if c.mesh_spec is not None:
@@ -167,8 +168,8 @@ class LLMEngine:
                 self.params,
                 tree_shardings(self.mesh, rules, llama.logical_axes(c.model)),
             )
-            # cache [L, slots, kv_heads, hd]: heads across tp
-            kv_sharding = NamedSharding(self.mesh, P(None, None, "tp", None))
+            # cache [L, kv_heads, slots, hd]: heads across tp
+            kv_sharding = NamedSharding(self.mesh, P(None, "tp", None, None))
             self.cache = jax.tree.map(
                 lambda x: jax.device_put(x, kv_sharding), self.cache
             )

@@ -5,10 +5,12 @@ The reference's serving path gets paged attention from vLLM
 here the block manager is native. Design follows the paged-attention
 idea (and the TPU ragged-paged-attention lineage, see PAPERS.md):
 
- * device cache = two arrays per model: K and V, each
-   [n_layers, num_blocks * block_size, n_kv_heads, head_dim] — flat
+ * device cache = two arrays per model: K and V, each HEAD-MAJOR
+   [n_layers, n_kv_heads, num_blocks * block_size, head_dim] — flat
    "slot" addressing (slot = block_id * block_size + offset) so prefill
-   scatter and decode gather are single-index ops;
+   scatter and decode gather are single-index ops; head-major because
+   the Pallas decode kernel DMAs per-head pages and Mosaic needs the
+   sliced slots dim sublane-aligned next to head_dim;
  * host-side BlockAllocator hands out blocks, refcounts them, and
    reuses full blocks across requests via content hashing (prefix
    caching — hash chains over block token contents).
@@ -38,7 +40,7 @@ class KVCacheConfig:
 
 
 def init_kv_cache(cfg: KVCacheConfig) -> dict[str, jax.Array]:
-    shape = (cfg.n_layers, cfg.num_slots, cfg.n_kv_heads, cfg.head_dim)
+    shape = (cfg.n_layers, cfg.n_kv_heads, cfg.num_slots, cfg.head_dim)
     return {
         "k": jnp.zeros(shape, cfg.dtype),
         "v": jnp.zeros(shape, cfg.dtype),

@@ -10,7 +10,7 @@ both to vLLM — vllm_engine.py):
  * `decode_step` — one token per running sequence, scatter K/V to each
    sequence's next slot, paged attention over its pages.
 
-Cache layout: k/v [n_layers, num_slots + 1, n_kv_heads, head_dim];
+Cache layout: k/v [n_layers, n_kv_heads, num_slots + 1, head_dim];
 the extra final slot is the trash row padding writes land in.
 """
 
@@ -28,10 +28,19 @@ from ray_tpu.ops.paged_attention import paged_attention
 Cache = dict[str, jax.Array]
 
 
-def init_cache(config: LlamaConfig, num_slots: int, dtype=None) -> Cache:
-    """num_slots = num_blocks * block_size; one trash row appended."""
+def init_cache(config: LlamaConfig, num_slots: int, dtype=None,
+               trash_slots: int = 16) -> Cache:
+    """num_slots = num_blocks * block_size; a TRASH PAGE appended (pad
+    rows scatter to slot `num_slots`) — a whole page, not one row, so the
+    slot count stays a multiple of every block_size <= trash_slots and
+    the Pallas kernel can view the cache pre-blocked.
+
+    HEAD-MAJOR layout [L, KVH, slots, D]: the Pallas decode kernel
+    fetches one page per kv head, and Mosaic requires the sliced
+    (second-minor) dim be sublane-aligned — slots must therefore sit
+    next to D, with the scalar-indexed head dim leading."""
     c = config
-    shape = (c.n_layers, num_slots + 1, c.n_kv_heads, c.head_dim)
+    shape = (c.n_layers, c.n_kv_heads, num_slots + trash_slots, c.head_dim)
     dt = dtype or c.dtype
     return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
 
@@ -129,12 +138,15 @@ def prefill(
             q, k, v = _apply_lora(q, k, v, x, lora_l, lora_ids, c)
         q = apply_rope(q, cos, sin, positions)
         k = apply_rope(k, cos, sin, positions)
-        # scatter suffix K/V into this layer's pages (pad rows -> trash slot)
-        k_cache_l = k_cache_l.at[flat_slots].set(
-            k.reshape(B * S, c.n_kv_heads, c.head_dim).astype(k_cache_l.dtype)
+        # scatter suffix K/V into this layer's pages (pad rows -> trash
+        # slot); cache is head-major [KVH, slots, D]
+        k_cache_l = k_cache_l.at[:, flat_slots].set(
+            k.reshape(B * S, c.n_kv_heads, c.head_dim)
+            .swapaxes(0, 1).astype(k_cache_l.dtype)
         )
-        v_cache_l = v_cache_l.at[flat_slots].set(
-            v.reshape(B * S, c.n_kv_heads, c.head_dim).astype(v_cache_l.dtype)
+        v_cache_l = v_cache_l.at[:, flat_slots].set(
+            v.reshape(B * S, c.n_kv_heads, c.head_dim)
+            .swapaxes(0, 1).astype(v_cache_l.dtype)
         )
         o = _page_attend_prefill(
             q, k_cache_l, v_cache_l, block_tables, context_lens, positions, c,
@@ -162,7 +174,7 @@ def prefill(
 
 def _page_attend_prefill(
     q: jax.Array,            # [B, S, H, D] (rope'd)
-    k_cache_l: jax.Array,    # [num_slots+1, KVH, D]
+    k_cache_l: jax.Array,    # [KVH, num_slots+1, D]
     v_cache_l: jax.Array,
     block_tables: jax.Array, # [B, MB]
     context_lens: jax.Array, # [B]
@@ -181,11 +193,11 @@ def _page_attend_prefill(
 
     offs = jnp.arange(S_kv, dtype=jnp.int32)
     slots = block_tables[:, offs // block_size] * block_size + offs % block_size
-    k = k_cache_l[slots]  # [B, S_kv, KVH, D]
-    v = v_cache_l[slots]
+    k = k_cache_l[:, slots]  # [KVH, B, S_kv, D] (head-major cache)
+    v = v_cache_l[:, slots]
 
     qg = q.reshape(B, S, KVH, G, D).astype(jnp.float32)
-    scores = jnp.einsum("bshgd,bthd->bhgst", qg, k.astype(jnp.float32))
+    scores = jnp.einsum("bshgd,hbtd->bhgst", qg, k.astype(jnp.float32))
     scores *= 1.0 / jnp.sqrt(D).astype(jnp.float32)
     kv_pos = offs[None, :]  # [1, S_kv]
     valid = kv_pos < context_lens[:, None]  # [B, S_kv]
@@ -194,7 +206,7 @@ def _page_attend_prefill(
     scores = jnp.where(mask, scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
     probs = jnp.where(jnp.isnan(probs), 0.0, probs)  # fully-masked pad rows
-    out = jnp.einsum("bhgst,bthd->bshgd", probs, v.astype(jnp.float32))
+    out = jnp.einsum("bhgst,hbtd->bshgd", probs, v.astype(jnp.float32))
     return out.reshape(B, S, H, D).astype(q.dtype)
 
 
@@ -236,11 +248,11 @@ def decode_step(
             q, k, v = _apply_lora(q, k, v, x, lora_l, lora_ids, c)
         q = apply_rope(q, cos, sin, pos2)
         k = apply_rope(k, cos, sin, pos2)
-        k_cache_l = k_cache_l.at[slot_mapping].set(
-            k[:, 0].astype(k_cache_l.dtype)
+        k_cache_l = k_cache_l.at[:, slot_mapping].set(
+            k[:, 0].swapaxes(0, 1).astype(k_cache_l.dtype)
         )
-        v_cache_l = v_cache_l.at[slot_mapping].set(
-            v[:, 0].astype(v_cache_l.dtype)
+        v_cache_l = v_cache_l.at[:, slot_mapping].set(
+            v[:, 0].swapaxes(0, 1).astype(v_cache_l.dtype)
         )
         o = paged_attention(
             q[:, 0],

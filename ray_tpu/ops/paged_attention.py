@@ -15,9 +15,12 @@ Two implementations:
    kv-head. This is the kernel shape recommended by the TPU kernel
    playbook (ragged paged attention lineage, PAPERS.md).
 
-Layout (see llm/kv_cache.py): k_cache/v_cache are
-[num_slots, n_kv_heads, head_dim] PER LAYER (the caller scans layers);
-slot = block_id * block_size + offset.
+Layout (see llm/kv_cache.py): k_cache/v_cache are HEAD-MAJOR
+[n_kv_heads, num_slots, head_dim] PER LAYER (the caller scans layers);
+slot = block_id * block_size + offset. Head-major is a Mosaic
+constraint: the kernel DMAs one page per kv head, and the sliced
+second-minor dim (slots, sliced in block_size chunks) must be
+sublane-aligned — a size-1 slice of a middle head dim is rejected.
 """
 
 from __future__ import annotations
@@ -27,18 +30,22 @@ import functools
 import jax
 import jax.numpy as jnp
 
+# finite sentinel (not -inf): a page that is entirely masked must not
+# produce exp(-inf - -inf) = nan in the online-softmax update
+NEG_INF = -1e30
+
 
 def paged_attention_xla(
     q: jax.Array,            # [B, n_heads, head_dim]
-    k_cache: jax.Array,      # [num_slots, n_kv_heads, head_dim]
-    v_cache: jax.Array,      # [num_slots, n_kv_heads, head_dim]
+    k_cache: jax.Array,      # [n_kv_heads, num_slots, head_dim]
+    v_cache: jax.Array,      # [n_kv_heads, num_slots, head_dim]
     block_tables: jax.Array, # [B, max_blocks] int32 block ids (padded w/ 0)
     context_lens: jax.Array, # [B] int32 valid tokens per sequence
     *,
     block_size: int,
 ) -> jax.Array:              # [B, n_heads, head_dim]
     B, H, D = q.shape
-    KVH = k_cache.shape[1]
+    KVH = k_cache.shape[0]
     G = H // KVH  # query heads per kv head (GQA group)
     MB = block_tables.shape[1]
     S = MB * block_size  # padded kv length
@@ -47,15 +54,15 @@ def paged_attention_xla(
     offs = jnp.arange(S, dtype=jnp.int32)
     slots = block_tables[:, offs // block_size] * block_size + offs % block_size
 
-    k = k_cache[slots]  # [B, S, KVH, D]
-    v = v_cache[slots]
+    k = k_cache[:, slots]  # [KVH, B, S, D] (head-major cache)
+    v = v_cache[:, slots]
     qg = q.reshape(B, KVH, G, D).astype(jnp.float32)
-    scores = jnp.einsum("bhgd,bshd->bhgs", qg, k.astype(jnp.float32))
+    scores = jnp.einsum("bhgd,hbsd->bhgs", qg, k.astype(jnp.float32))
     scores *= 1.0 / jnp.sqrt(D).astype(jnp.float32)
     mask = offs[None, :] < context_lens[:, None]  # [B, S]
     scores = jnp.where(mask[:, None, None, :], scores, -jnp.inf)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhgs,bshd->bhgd", probs, v.astype(jnp.float32))
+    out = jnp.einsum("bhgs,hbsd->bhgd", probs, v.astype(jnp.float32))
     return out.reshape(B, H, D).astype(q.dtype)
 
 
@@ -68,60 +75,49 @@ def _paged_attn_kernel(
     # scalar-prefetch
     block_tables_ref,  # [B, MB] SMEM
     context_lens_ref,  # [B] SMEM
-    # inputs (blocked by grid)
+    # inputs (blocked by grid; the PIPELINE fetches this (b,h,i)'s page —
+    # the index map reads the prefetched block table, so no manual DMA.
+    # Mosaic handles sub-128 minor dims in pipelined copies where raw
+    # make_async_copy slices reject them)
     q_ref,       # [1, 1, G, D] VMEM — this (b, kvh)'s query group
-    k_hbm,       # [num_slots, KVH, D] stays in HBM (ANY)
-    v_hbm,
+    k_ref,       # [1, 1, block_size, D] VMEM — page bt[b, i] of kv head h
+    v_ref,
     # output
-    o_ref,       # [1, 1, G, D] VMEM
+    o_ref,       # [1, 1, G, D] VMEM (revisited across pages)
     # scratch
-    k_vmem,      # [block_size, D]
-    v_vmem,
     acc_ref,     # [G, D] fp32
     m_ref,       # [G, 128] running max
     l_ref,       # [G, 128] running denom
-    sem,
     *,
     block_size: int,
-    max_blocks: int,
 ):
     from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
 
     b = pl.program_id(0)
-    h = pl.program_id(1)  # kv head
-
+    i = pl.program_id(2)  # page index within this sequence
+    n_pages = pl.num_programs(2)
     G, D = acc_ref.shape
-    acc_ref[...] = jnp.zeros_like(acc_ref)
-    m_ref[...] = jnp.full_like(m_ref, -jnp.inf)
-    l_ref[...] = jnp.zeros_like(l_ref)
+
+    @pl.when(i == 0)
+    def _():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
     ctx = context_lens_ref[b]
-    n_blocks = pl.cdiv(ctx, block_size)
-    q = q_ref[0, 0].astype(jnp.float32) * (1.0 / (D ** 0.5))  # [G, D]
 
-    def body(i, _):
-        blk = block_tables_ref[b, i]
-        start = blk * block_size
-        # DMA this page's K/V for our kv head: [block_size, D]
-        copy_k = pltpu.make_async_copy(
-            k_hbm.at[pl.ds(start, block_size), h], k_vmem, sem
-        )
-        copy_k.start()
-        copy_k.wait()
-        copy_v = pltpu.make_async_copy(
-            v_hbm.at[pl.ds(start, block_size), h], v_vmem, sem
-        )
-        copy_v.start()
-        copy_v.wait()
-
-        k = k_vmem[...].astype(jnp.float32)  # [bs, D]
-        v = v_vmem[...].astype(jnp.float32)
+    @pl.when(i * block_size < ctx)
+    def _():
+        q = q_ref[0, 0].astype(jnp.float32) * (1.0 / (D ** 0.5))  # [G, D]
+        k = k_ref[0, 0].astype(jnp.float32)  # [bs, D]
+        v = v_ref[0, 0].astype(jnp.float32)
         s = jax.lax.dot_general(  # [G, bs]
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        pos = i * block_size + jax.lax.broadcasted_iota(jnp.int32, (1, block_size), 1)
-        s = jnp.where(pos < ctx, s, -jnp.inf)
+        pos = i * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_size), 1
+        )
+        s = jnp.where(pos < ctx, s, NEG_INF)
 
         # online softmax update
         m_prev = m_ref[:, :1]                      # [G, 1]
@@ -134,17 +130,17 @@ def _paged_attn_kernel(
         )
         m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
         l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
-        return 0
 
-    jax.lax.fori_loop(0, n_blocks, body, 0)
-    l = l_ref[:, :1]
-    safe_l = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0, 0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
+    @pl.when(i == n_pages - 1)
+    def _():
+        l = l_ref[:, :1]
+        safe_l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[...] / safe_l).astype(o_ref.dtype)
 
 
 def paged_attention_pallas(
     q: jax.Array,            # [B, n_heads, head_dim]
-    k_cache: jax.Array,      # [num_slots, n_kv_heads, head_dim]
+    k_cache: jax.Array,      # [n_kv_heads, num_slots, head_dim]
     v_cache: jax.Array,
     block_tables: jax.Array, # [B, max_blocks]
     context_lens: jax.Array, # [B]
@@ -156,42 +152,52 @@ def paged_attention_pallas(
     from jax.experimental.pallas import tpu as pltpu
 
     B, H, D = q.shape
-    KVH = k_cache.shape[1]
+    KVH = k_cache.shape[0]
     G = H // KVH
     MB = block_tables.shape[1]
+    num_slots = k_cache.shape[1]
+    if num_slots % block_size:
+        raise ValueError(
+            f"cache slots {num_slots} not a multiple of block_size {block_size}"
+        )
 
-    # [B, KVH, G, D] query layout: one grid cell per (request, kv head)
+    # [B, KVH, G, D] query layout: one grid cell per (request, kv head);
+    # caches viewed pre-blocked [KVH, num_blocks, block_size, D] so each
+    # grid step's index map picks page bt[b, i] straight from the
+    # scalar-prefetched block table
     qg = q.reshape(B, KVH, G, D)
+    kp = k_cache.reshape(KVH, num_slots // block_size, block_size, D)
+    vp = v_cache.reshape(KVH, num_slots // block_size, block_size, D)
+
+    def page_index(b, h, i, bt, cl):
+        # pages past the context read page bt[b, MB-1-padding]=0 and are
+        # skipped in-kernel; the table is padded with block 0
+        return (h, bt[b, i], 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B, KVH),
+        grid=(B, KVH, MB),
         in_specs=[
-            pl.BlockSpec((1, 1, G, D), lambda b, h, *_: (b, h, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((1, 1, G, D), lambda b, h, i, *_: (b, h, 0, 0)),
+            pl.BlockSpec((1, 1, block_size, D), page_index),
+            pl.BlockSpec((1, 1, block_size, D), page_index),
         ],
-        out_specs=pl.BlockSpec((1, 1, G, D), lambda b, h, *_: (b, h, 0, 0)),
+        out_specs=pl.BlockSpec((1, 1, G, D), lambda b, h, i, *_: (b, h, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((block_size, D), k_cache.dtype),
-            pltpu.VMEM((block_size, D), v_cache.dtype),
             pltpu.VMEM((G, D), jnp.float32),
             pltpu.VMEM((G, 128), jnp.float32),
             pltpu.VMEM((G, 128), jnp.float32),
-            pltpu.SemaphoreType.DMA(()),
         ],
     )
     kernel = pl.pallas_call(
-        functools.partial(
-            _paged_attn_kernel, block_size=block_size, max_blocks=MB
-        ),
+        functools.partial(_paged_attn_kernel, block_size=block_size),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KVH, G, D), q.dtype),
         interpret=interpret,
     )
     out = kernel(
         block_tables.astype(jnp.int32), context_lens.astype(jnp.int32),
-        qg, k_cache, v_cache,
+        qg, kp, vp,
     )
     return out.reshape(B, H, D)
 

@@ -48,11 +48,13 @@ def generate_manifests(
     gcs_addr = f"{name}-head.{namespace}.svc:{gcs_port}"
     if worker_cpu is None:
         # pod requests must match what the daemon advertises to the
-        # scheduler, or leases over-commit the cgroup
+        # scheduler, or leases over-commit the cgroup. Keep the float
+        # form — k8s accepts fractional cpu quantities ("0.5"); int
+        # truncation would request cpu:0 for sub-core daemons
         cpus = "4"
         for kv in worker_resources.split(","):
             if kv.startswith("num_cpus="):
-                cpus = str(int(float(kv.split("=", 1)[1])))
+                cpus = kv.split("=", 1)[1]
         worker_cpu = cpus
 
     service = {
@@ -136,7 +138,10 @@ def generate_manifests(
     out = [service, head, worker]
     if tpu_workers > 0:
         tpu_labels = {**labels, "ray-tpu-role": "tpu-worker"}
-        tpu_res = f"num_cpus={worker_cpu},TPU={tpu_chips_per_host}"
+        # scheduler resources come from --worker-resources (daemon
+        # vocabulary), NOT the pod-cpu quantity (k8s vocabulary — may be
+        # "3500m", which the daemon's float parse rejects)
+        tpu_res = f"{worker_resources},TPU={tpu_chips_per_host}"
         out.append({
             "apiVersion": "apps/v1",
             "kind": "Deployment",

@@ -26,20 +26,21 @@ FP32_TINY = dataclasses.replace(llama.LLAMA_TINY, dtype=jnp.float32)
 
 
 def _dense_paged_ref(q, k_cache, v_cache, bt, ctx, bs):
+    # caches are head-major [KVH, slots, D]
     B, H, D = q.shape
-    KVH = k_cache.shape[1]
+    KVH = k_cache.shape[0]
     G = H // KVH
     out = np.zeros((B, H, D), np.float32)
     for b in range(B):
         slots = [int(bt[b, p // bs]) * bs + p % bs for p in range(int(ctx[b]))]
-        k = np.asarray(k_cache)[slots]
-        v = np.asarray(v_cache)[slots]
+        k = np.asarray(k_cache)[:, slots]  # [KVH, n, D]
+        v = np.asarray(v_cache)[:, slots]
         for h in range(H):
             kvh = h // G
-            s = (np.asarray(q)[b, h] @ k[:, kvh].T) / np.sqrt(D)
+            s = (np.asarray(q)[b, h] @ k[kvh].T) / np.sqrt(D)
             p_ = np.exp(s - s.max())
             p_ /= p_.sum()
-            out[b, h] = p_ @ v[:, kvh]
+            out[b, h] = p_ @ v[kvh]
     return out
 
 
@@ -51,8 +52,8 @@ def test_paged_attention_matches_dense(impl):
     B, H, KVH, D, bs, MB = 3, 8, 2, 16, 4, 5
     num_slots = 64 * bs
     q = jnp.asarray(rng.normal(size=(B, H, D)), jnp.float32)
-    k_cache = jnp.asarray(rng.normal(size=(num_slots, KVH, D)), jnp.float32)
-    v_cache = jnp.asarray(rng.normal(size=(num_slots, KVH, D)), jnp.float32)
+    k_cache = jnp.asarray(rng.normal(size=(KVH, num_slots, D)), jnp.float32)
+    v_cache = jnp.asarray(rng.normal(size=(KVH, num_slots, D)), jnp.float32)
     bt = jnp.asarray(rng.choice(64, size=(B, MB), replace=False), jnp.int32)
     ctx = jnp.asarray([7, 20, 13], jnp.int32)
     ref = _dense_paged_ref(q, k_cache, v_cache, bt, ctx, bs)
