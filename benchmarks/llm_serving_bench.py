@@ -40,30 +40,39 @@ def main():
             model=cfg,
             max_num_seqs=min(n_requests, 16),
             num_blocks=1024 if on_tpu else 128,
+            # the tunnel's ~70ms host sync dominates small chunks; 16
+            # device-side steps per sync is the sweet spot at this scale
+            decode_chunk=16 if on_tpu else 8,
         )
     )
     import numpy as np
 
     rng = np.random.default_rng(0)
     params = SamplingParams(max_tokens=max_new, temperature=0.0, ignore_eos=True)
-    t_submit = time.perf_counter()
-    for i in range(n_requests):
-        engine.add_request(
-            rng.integers(1, cfg.vocab_size, prompt_len).tolist(),
-            params,
-            request_id=f"r{i}",
-        )
 
-    generated = 0
-    first_token_at = None
-    while engine.has_unfinished():
-        outs = engine.step()
-        for o in outs:
-            if o.new_token_ids:
-                if first_token_at is None:
-                    first_token_at = time.perf_counter()
-                generated += len(o.new_token_ids)
-    dt = time.perf_counter() - t_submit
+    def run(n):
+        t0 = time.perf_counter()
+        for i in range(n):
+            engine.add_request(
+                rng.integers(1, cfg.vocab_size, prompt_len).tolist(),
+                params,
+                request_id=f"r{time.monotonic_ns()}-{i}",
+            )
+        generated = 0
+        first = None
+        while engine.has_unfinished():
+            for o in engine.step():
+                if o.new_token_ids:
+                    if first is None:
+                        first = time.perf_counter()
+                    generated += len(o.new_token_ids)
+        return generated, time.perf_counter() - t0, (first or t0) - t0
+
+    # warmup pass compiles every (bucket, chunk, table-width) shape —
+    # through a remote-compile tunnel each shape costs ~10-20s and would
+    # otherwise be billed to throughput; serving numbers are steady-state
+    run(min(n_requests, 16))
+    generated, dt, ttft = run(n_requests)
 
     expected = n_requests * max_new
     result = {
@@ -74,7 +83,7 @@ def main():
         "generated_tokens": generated,
         "expected_tokens": expected,
         "wall_s": round(dt, 2),
-        "ttft_s": round((first_token_at or t_submit) - t_submit, 3),
+        "ttft_s": round(ttft, 3),
         "concurrency": min(n_requests, 16),
         "model_params": cfg.num_params(),
         "device": getattr(jax.devices()[0], "device_kind", "cpu"),

@@ -255,16 +255,18 @@ class WorkerRuntime:
             # at the store round-trip
             async with lock:
                 result = await loop.run_in_executor(None, _invoke)
-            self._spans.append({
-                "desc": desc, "worker_id": self.worker_id,
-                "actor_id": actor_id.hex(), "start": t0, "end": _time.time(),
-                "ok": True,
-            })
             await loop.run_in_executor(
                 None,
                 self._store_returns,
                 payload["return_ids"], result, payload.get("num_returns", 1),
             )
+            # span only after the returns landed: a store failure takes the
+            # except path and must record ONE ok=False span, not both
+            self._spans.append({
+                "desc": desc, "worker_id": self.worker_id,
+                "actor_id": actor_id.hex(), "start": t0, "end": _time.time(),
+                "ok": True,
+            })
             return {"ok": True}
         except BaseException as e:  # noqa: BLE001
             tb = traceback.format_exc()

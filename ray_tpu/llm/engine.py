@@ -66,6 +66,11 @@ class EngineConfig:
     max_loras: int = 0
     lora_rank: int = 8
     lora_targets: tuple = ("wq", "wv")
+    # multi-step decode: run up to this many decode+sample iterations ON
+    # DEVICE per host round-trip (llm/decode_loop.py). 1 = classic
+    # one-sync-per-token stepping. Chunks shrink automatically near a
+    # request's max_tokens/max_seq; EOS overshoot is discarded host-side.
+    decode_chunk: int = 8
 
     def __post_init__(self):
         # a prefill bucket longer than the context window can never be
@@ -218,6 +223,26 @@ class LLMEngine:
             ),
             donate_argnums=(6,),
         )
+        self._decode_chunks: dict[int, Any] = {}  # n_steps -> jitted loop
+
+    def _decode_chunk_fn(self, n_steps: int):
+        c = self.config
+        fn = self._decode_chunks.get(n_steps)
+        if fn is None:
+            from ray_tpu.llm.decode_loop import decode_chunk
+
+            fn = jax.jit(
+                lambda params, t, p, bt, cl, cache, temps, tks, tps, keys, lora:
+                decode_chunk(
+                    params, t, p, bt, cl, cache, temps, tks, tps, keys,
+                    c.model, n_steps=n_steps, block_size=c.block_size,
+                    trash_slot=c.num_blocks * c.block_size,
+                    attn_impl=c.attn_impl, lora=lora,
+                ),
+                donate_argnums=(5,),
+            )
+            self._decode_chunks[n_steps] = fn
+        return fn
 
     # -- LoRA multiplexing ----------------------------------------------------
 
@@ -432,7 +457,7 @@ class LLMEngine:
         self.waiting.popleft()
 
         num_slots = c.num_blocks * c.block_size
-        bt = np.zeros((1, c.max_blocks_per_seq), np.int32)
+        bt = np.zeros((1, self._bt_width([len(seq.blocks)])), np.int32)
         bt[0, : len(seq.blocks)] = seq.blocks
         bt = jnp.asarray(bt)
 
@@ -486,13 +511,43 @@ class LLMEngine:
         logger.info("preempted %s (recompute)", victim.request_id)
         return True
 
+    def _bt_width(self, page_counts) -> int:
+        """Block-table width for this call: the batch's real page count
+        rounded up to a power of two (compiled-shape bucketing), capped
+        at the model maximum. Sizing to max_blocks_per_seq regardless of
+        context made the paged kernel's grid iterate (and the XLA gather
+        materialize) every POSSIBLE page — at short contexts that is an
+        order of magnitude of wasted work per step."""
+        w = max(list(page_counts) or [1])
+        w = 1 << max(0, (w - 1)).bit_length()
+        # floor: tiny width buckets would recompile as contexts grow past
+        # each power of two right at the start of every run
+        w = max(w, min(16, self.config.max_blocks_per_seq))
+        return min(w, self.config.max_blocks_per_seq)
+
+    def _chunk_steps(self) -> int:
+        """Device-side steps this round: the configured chunk, shrunk so
+        no running request can overrun max_tokens/max_seq, floored to a
+        power of two (compiled-shape bucketing)."""
+        c = self.config
+        n = max(1, c.decode_chunk)
+        for r in self.running:
+            # only the HARD max_seq wall shrinks the chunk (positions past
+            # it would index off the RoPE table). A request near its
+            # max_tokens just overshoots and _append_chunk discards the
+            # excess — throttling the whole batch to the shortest request
+            # would reinstate the per-token host sync under staggered load
+            n = min(n, max(1, c.model.max_seq - r.num_tokens))
+        return 1 << (n.bit_length() - 1)
+
     def _decode_step(self) -> list[RequestOutput]:
         c = self.config
-        # grow each sequence by one slot; preempt on cache pressure
+        n_steps = self._chunk_steps()
+        # grow each sequence by the chunk's slots; preempt on cache pressure
         while True:
             try:
                 for r in self.running:
-                    r.seq.ensure_capacity(r.num_tokens + 1)
+                    r.seq.ensure_capacity(r.num_tokens + n_steps)
                 break
             except NoFreeBlocksError:
                 if not self._preempt_one():
@@ -507,7 +562,10 @@ class LLMEngine:
         slot_mapping = np.full(B_pad, num_slots, np.int32)
         context_lens = np.zeros(B_pad, np.int32)
         lora_ids = np.zeros(B_pad, np.int32)
-        bt = np.zeros((B_pad, c.max_blocks_per_seq), np.int32)
+        bt = np.zeros(
+            (B_pad, self._bt_width([len(r.seq.blocks) for r in batch])),
+            np.int32,
+        )
         for i, r in enumerate(batch):
             last_tok = (
                 r.output_token_ids[-1] if r.output_token_ids else r.prompt_token_ids[-1]
@@ -520,18 +578,45 @@ class LLMEngine:
             lora_ids[i] = r.lora_slot
             bt[i, : len(r.seq.blocks)] = r.seq.blocks
 
-        logits, self.cache = self._decode(
+        if n_steps == 1:
+            logits, self.cache = self._decode(
+                self.params,
+                jnp.asarray(tokens),
+                jnp.asarray(positions),
+                jnp.asarray(slot_mapping),
+                jnp.asarray(bt),
+                jnp.asarray(context_lens),
+                self.cache,
+                self._lora_arg(lora_ids),
+            )
+            tok, logprob = self._sample_batch(logits[:B], batch)
+            return self._append_tokens(batch, tok, logprob)
+
+        # multi-step chunk: decode+sample n_steps times on device, one sync
+        temps = np.ones(B_pad, np.float32)
+        top_ks = np.zeros(B_pad, np.int32)
+        top_ps = np.ones(B_pad, np.float32)
+        keys = [jax.random.key(0)] * B_pad
+        for i, r in enumerate(batch):
+            temps[i] = r.sampling_params.temperature
+            top_ks[i] = r.sampling_params.top_k
+            top_ps[i] = r.sampling_params.top_p
+            r._key, sub = jax.random.split(r._key)
+            keys[i] = sub
+        toks, logprobs, self.cache = self._decode_chunk_fn(n_steps)(
             self.params,
             jnp.asarray(tokens),
             jnp.asarray(positions),
-            jnp.asarray(slot_mapping),
             jnp.asarray(bt),
             jnp.asarray(context_lens),
             self.cache,
+            jnp.asarray(temps),
+            jnp.asarray(top_ks),
+            jnp.asarray(top_ps),
+            jnp.stack(keys),
             self._lora_arg(lora_ids),
         )
-        tok, logprob = self._sample_batch(logits[:B], batch)
-        return self._append_tokens(batch, tok, logprob)
+        return self._append_chunk(batch, np.asarray(toks), np.asarray(logprobs))
 
     # -- sampling + bookkeeping ----------------------------------------------
 
@@ -553,48 +638,55 @@ class LLMEngine:
         )
         return np.asarray(toks), np.asarray(logprobs)
 
-    def _append_tokens(self, batch: list, toks, logprobs) -> list[RequestOutput]:
+    def _append_chunk(self, batch: list, toks, logprobs) -> list[RequestOutput]:
+        """Host bookkeeping after a device-side chunk: walk each request's
+        token column in order, keep until a stop condition fires, discard
+        the overshoot (its KV sits in the request's own unsealed blocks,
+        released with the sequence). One RequestOutput per request."""
         c = self.config
         outputs = []
+        n = toks.shape[0]
         for i, r in enumerate(batch):
-            t = int(toks[i])
-            r.output_token_ids.append(t)
-            r.cumulative_logprob += float(logprobs[i])
-            if r.sampling_params.logprobs:
-                r.token_logprobs.append(float(logprobs[i]))
             sp = r.sampling_params
+            new_toks: list[int] = []
             finished = False
-            if not sp.ignore_eos and t == c.eos_token_id:
-                finished, r.finish_reason = True, "stop"
-            elif t in sp.stop_token_ids:
-                finished, r.finish_reason = True, "stop"
-            elif len(r.output_token_ids) >= sp.max_tokens:
-                finished, r.finish_reason = True, "length"
-            elif r.num_tokens >= c.model.max_seq:
-                finished, r.finish_reason = True, "length"
+            for s in range(n):
+                t = int(toks[s, i])
+                lp = float(logprobs[s, i])
+                new_toks.append(t)
+                r.output_token_ids.append(t)
+                r.cumulative_logprob += lp
+                if sp.logprobs:
+                    r.token_logprobs.append(lp)
+                if not sp.ignore_eos and t == c.eos_token_id:
+                    finished, r.finish_reason = True, "stop"
+                elif t in sp.stop_token_ids:
+                    finished, r.finish_reason = True, "stop"
+                elif len(r.output_token_ids) >= sp.max_tokens:
+                    finished, r.finish_reason = True, "length"
+                elif r.num_tokens >= c.model.max_seq:
+                    finished, r.finish_reason = True, "length"
+                if finished:
+                    break
             num_cached = r.seq.num_cached_tokens if r.seq else 0
-            # KV written so far = prompt + all outputs except the token just
-            # sampled (its KV lands when it is fed next step) — only blocks
-            # fully inside that range may be sealed for prefix reuse
             written = r.prompt_token_ids + r.output_token_ids[:-1]
             if finished:
                 r.status = RequestStatus.FINISHED
                 self.running.remove(r)
                 if c.enable_prefix_caching:
-                    # full written blocks stay reusable; the tail is freed
                     r.seq.seal_full_blocks(written)
                 r.seq.release()
-                # finished requests are dropped — a long-lived engine must
-                # not retain every token list it ever produced
                 self.requests.pop(r.request_id, None)
             else:
-                if c.enable_prefix_caching and len(written) % c.block_size == 0:
+                if c.enable_prefix_caching:
+                    # seals only blocks fully covered by `written`; a
+                    # mid-chunk boundary crossing is caught here too
                     r.seq.seal_full_blocks(written)
                 r.seq.num_tokens = r.num_tokens
             outputs.append(
                 RequestOutput(
                     request_id=r.request_id,
-                    new_token_ids=[t],
+                    new_token_ids=new_toks,
                     output_token_ids=list(r.output_token_ids),
                     finished=finished,
                     finish_reason=r.finish_reason,
@@ -602,3 +694,10 @@ class LLMEngine:
                 )
             )
         return outputs
+
+    def _append_tokens(self, batch: list, toks, logprobs) -> list[RequestOutput]:
+        """Single-step bookkeeping: the n=1 case of _append_chunk (ONE
+        stop-condition/seal/release ladder, not two copies that drift)."""
+        return self._append_chunk(
+            batch, np.asarray(toks)[None, :], np.asarray(logprobs)[None, :]
+        )
