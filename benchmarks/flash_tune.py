@@ -42,8 +42,8 @@ def main():
     dt = bench(functools.partial(xla_attention, causal=True), q, k, v)
     print(json.dumps({"tag": "xla", "fwdbwd_ms": round(dt * 1e3, 2)}), flush=True)
 
-    for bq, bk in [(128, 128), (256, 256), (256, 512), (512, 512), (512, 256),
-                   (1024, 512), (512, 1024)]:
+    for bq, bk in [(512, 1024), (1024, 1024), (256, 1024), (128, 1024),
+                   (1024, 256)]:
         try:
             f = functools.partial(
                 flash_attention, causal=True, block_q=bq, block_k=bk,

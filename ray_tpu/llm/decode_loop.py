@@ -34,7 +34,9 @@ def decode_chunk(
     temperatures: jax.Array,  # [B]
     top_ks: jax.Array,        # [B]
     top_ps: jax.Array,        # [B]
-    keys: jax.Array,          # [B] PRNG keys (folded with the step index)
+    keys: jax.Array,          # [B] STABLE per-request PRNG keys
+    starts: jax.Array,        # [B] absolute output index of step 0's token
+    remaining: jax.Array,     # [B] tokens each request can still KEEP
     config,
     *,
     n_steps: int,
@@ -43,7 +45,14 @@ def decode_chunk(
     attn_impl: str = "auto",
     lora=None,
 ):
-    """Returns (tokens [n_steps, B], logprobs [n_steps, B], cache)."""
+    """Returns (tokens [n_steps, B], logprobs [n_steps, B], cache).
+
+    Sampling key for step s = fold(request key, starts + s) — a pure
+    function of the request and the token's absolute index, so seeded
+    requests reproduce regardless of chunk partitioning or batch-mates.
+    Steps at/past `remaining` (overshoot the host will discard) write
+    the trash page: their KV blocks were never reserved.
+    """
     B = tokens.shape[0]
     rows = jnp.arange(B)
     # pad-row mask decided ONCE from the chunk's entry state: inside the
@@ -56,17 +65,18 @@ def decode_chunk(
     def one_step(carry, s):
         tok, pos, ctx, cache = carry
         # slot for the fed token straight from the block table; padded
-        # rows write the trash page, NOT block 0
+        # rows and unreserved overshoot steps write the trash page, NOT
+        # block 0
         slot = (
             block_tables[rows, pos // block_size] * block_size
             + pos % block_size
         )
-        slot = jnp.where(valid, slot, trash_slot)
+        slot = jnp.where(valid & (s < remaining), slot, trash_slot)
         logits, new_cache = decode_step(
             params, tok, pos, slot, block_tables, ctx, cache, config,
             block_size=block_size, attn_impl=attn_impl, lora=lora,
         )
-        step_keys = jax.vmap(lambda k: jax.random.fold_in(k, s))(keys)
+        step_keys = jax.vmap(jax.random.fold_in)(keys, starts + s)
         next_tok, logprob = sample_tokens(
             logits, temperatures, top_ks, top_ps, step_keys
         )

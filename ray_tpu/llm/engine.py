@@ -232,9 +232,11 @@ class LLMEngine:
             from ray_tpu.llm.decode_loop import decode_chunk
 
             fn = jax.jit(
-                lambda params, t, p, bt, cl, cache, temps, tks, tps, keys, lora:
+                lambda params, t, p, bt, cl, cache, temps, tks, tps, keys,
+                starts, remaining, lora:
                 decode_chunk(
                     params, t, p, bt, cl, cache, temps, tks, tps, keys,
+                    starts, remaining,
                     c.model, n_steps=n_steps, block_size=c.block_size,
                     trash_slot=c.num_blocks * c.block_size,
                     attn_impl=c.attn_impl, lora=lora,
@@ -540,14 +542,24 @@ class LLMEngine:
             n = min(n, max(1, c.model.max_seq - r.num_tokens))
         return 1 << (n.bit_length() - 1)
 
+    def _remaining(self, r) -> int:
+        """Output tokens this request can still KEEP (max_tokens budget)."""
+        return max(1, r.sampling_params.max_tokens - len(r.output_token_ids))
+
     def _decode_step(self) -> list[RequestOutput]:
         c = self.config
         n_steps = self._chunk_steps()
-        # grow each sequence by the chunk's slots; preempt on cache pressure
+        # grow each sequence by the chunk's slots it can actually USE —
+        # overshoot steps past a request's max_tokens write the trash page
+        # in-graph (decode_loop `remaining`), so reserving full-chunk KV
+        # for a request that finishes next token would preempt a peer to
+        # fund blocks nobody reads. Preempt on real cache pressure only.
         while True:
             try:
                 for r in self.running:
-                    r.seq.ensure_capacity(r.num_tokens + n_steps)
+                    r.seq.ensure_capacity(
+                        r.num_tokens + min(n_steps, self._remaining(r))
+                    )
                 break
             except NoFreeBlocksError:
                 if not self._preempt_one():
@@ -559,7 +571,6 @@ class LLMEngine:
 
         tokens = np.zeros(B_pad, np.int32)
         positions = np.zeros(B_pad, np.int32)
-        slot_mapping = np.full(B_pad, num_slots, np.int32)
         context_lens = np.zeros(B_pad, np.int32)
         lora_ids = np.zeros(B_pad, np.int32)
         bt = np.zeros(
@@ -573,12 +584,14 @@ class LLMEngine:
             pos = r.num_tokens - 1  # position of the token being fed
             tokens[i] = last_tok
             positions[i] = pos
-            slot_mapping[i] = r.seq.slot(pos)
             context_lens[i] = r.num_tokens
             lora_ids[i] = r.lora_slot
             bt[i, : len(r.seq.blocks)] = r.seq.blocks
 
         if n_steps == 1:
+            slot_mapping = np.full(B_pad, num_slots, np.int32)
+            for i, r in enumerate(batch):
+                slot_mapping[i] = r.seq.slot(int(positions[i]))
             logits, self.cache = self._decode(
                 self.params,
                 jnp.asarray(tokens),
@@ -596,13 +609,21 @@ class LLMEngine:
         temps = np.ones(B_pad, np.float32)
         top_ks = np.zeros(B_pad, np.int32)
         top_ps = np.ones(B_pad, np.float32)
+        remaining = np.zeros(B_pad, np.int32)
+        starts = np.zeros(B_pad, np.int32)
         keys = [jax.random.key(0)] * B_pad
         for i, r in enumerate(batch):
             temps[i] = r.sampling_params.temperature
             top_ks[i] = r.sampling_params.top_k
             top_ps[i] = r.sampling_params.top_p
-            r._key, sub = jax.random.split(r._key)
-            keys[i] = sub
+            # keep-capacity this chunk (writes past it hit the trash page)
+            remaining[i] = self._remaining(r)
+            # keys derive from (stable request key, absolute output index):
+            # identical sampling regardless of how co-running requests
+            # partition the chunks (a per-chunk split would make a seeded
+            # request's tokens depend on batch-mates' load)
+            starts[i] = len(r.output_token_ids)
+            keys[i] = r._key
         toks, logprobs, self.cache = self._decode_chunk_fn(n_steps)(
             self.params,
             jnp.asarray(tokens),
@@ -614,6 +635,8 @@ class LLMEngine:
             jnp.asarray(top_ks),
             jnp.asarray(top_ps),
             jnp.stack(keys),
+            jnp.asarray(starts),
+            jnp.asarray(remaining),
             self._lora_arg(lora_ids),
         )
         return self._append_chunk(batch, np.asarray(toks), np.asarray(logprobs))
@@ -625,10 +648,12 @@ class LLMEngine:
         temps = np.array([r.sampling_params.temperature for r in batch], np.float32)
         top_ks = np.array([r.sampling_params.top_k for r in batch], np.int32)
         top_ps = np.array([r.sampling_params.top_p for r in batch], np.float32)
-        keys = []
-        for r in batch:
-            r._key, sub = jax.random.split(r._key)
-            keys.append(sub)
+        # key = fold(stable request key, absolute output index): the same
+        # request samples the same stream whether it decodes token-by-token
+        # or in chunks, under any co-running load (see _decode_step)
+        keys = [
+            jax.random.fold_in(r._key, len(r.output_token_ids)) for r in batch
+        ]
         toks, logprobs = sample_tokens(
             logits[:B],
             jnp.asarray(temps),

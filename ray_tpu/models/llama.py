@@ -21,6 +21,7 @@ from functools import partial
 from typing import Any, Optional
 
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
 
 from ray_tpu.nn.layers import (
@@ -187,6 +188,11 @@ def _block(
     q = apply_rope(q, cos, sin, positions)
     k = apply_rope(k, cos, sin, positions)
     o = attention(q, k, v, causal=True, segment_ids=segment_ids, impl=c.attention_impl)
+    # named so the "dots" remat policy can SAVE it: the policy recognizes
+    # dot_general outputs but not a pallas_call's, so without the name the
+    # backward pass re-runs the whole flash kernel forward (~25% of a
+    # train step) just to rebuild this tensor
+    o = jax.ad_checkpoint.checkpoint_name(o, "attn_out")
     o = jnp.einsum("bsh,hd->bsd", o.reshape(B, S, c.n_heads * hd), lp["wo"].astype(x.dtype))
     h = h + o
 
@@ -223,7 +229,12 @@ def forward(
         if c.remat_policy == "dots":
             block = jax.checkpoint(
                 block,
-                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                policy=jax.checkpoint_policies.save_from_both_policies(
+                    jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                    jax.checkpoint_policies.save_only_these_names(
+                        "attn_out", "attn_lse"
+                    ),
+                ),
             )
         elif c.remat_policy == "full":
             block = jax.checkpoint(block)

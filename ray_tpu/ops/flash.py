@@ -38,6 +38,7 @@ import math
 from typing import Optional
 
 import jax
+import jax.ad_checkpoint
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
@@ -78,6 +79,8 @@ def _fwd_kernel(
     causal: bool,
     q_offset: int,
     sk_valid: int,
+    has_segments: bool,
+    kpad: bool,
 ):
     i = pl.program_id(2)
     j = pl.program_id(3)
@@ -110,16 +113,27 @@ def _fwd_kernel(
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [Bq, Bk] fp32
-        q_pos = (
-            q_offset + i * Bq
-            + jax.lax.broadcasted_iota(jnp.int32, (Bq, 1), 0)
-        )
-        k_pos = j * Bk + jax.lax.broadcasted_iota(jnp.int32, (1, Bk), 1)
-        mask = k_pos < sk_valid
+        # mask terms are STATICALLY gated: every skipped term saves VPU
+        # passes over the [Bq, Bk] tile, and the kernel is VPU-bound —
+        # on the common path (causal, no packing, no pad) only the
+        # triangle compare survives
+        mask = None
+        if causal or kpad:
+            k_pos = j * Bk + jax.lax.broadcasted_iota(jnp.int32, (1, Bk), 1)
+        if kpad:
+            mask = k_pos < sk_valid
         if causal:
-            mask = mask & (q_pos >= k_pos)
-        mask = mask & (qseg_ref[0] == kseg_ref[0])  # [Bq,1] == [1,Bk]
-        s = jnp.where(mask, s, NEG_INF)
+            q_pos = (
+                q_offset + i * Bq
+                + jax.lax.broadcasted_iota(jnp.int32, (Bq, 1), 0)
+            )
+            cm = q_pos >= k_pos
+            mask = cm if mask is None else mask & cm
+        if has_segments:
+            sm = qseg_ref[0] == kseg_ref[0]  # [Bq,1] == [1,Bk]
+            mask = sm if mask is None else mask & sm
+        if mask is not None:
+            s = jnp.where(mask, s, NEG_INF)
 
         m_prev = m_scr[...]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -155,6 +169,8 @@ def _dq_kernel(
     causal: bool,
     q_offset: int,
     sk_valid: int,
+    has_segments: bool,
+    kpad: bool,
 ):
     i = pl.program_id(2)
     j = pl.program_id(3)
@@ -183,17 +199,25 @@ def _dq_kernel(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale
-        q_pos = (
-            q_offset + i * Bq
-            + jax.lax.broadcasted_iota(jnp.int32, (Bq, 1), 0)
-        )
-        k_pos = j * Bk + jax.lax.broadcasted_iota(jnp.int32, (1, Bk), 1)
-        mask = k_pos < sk_valid
+        mask = None
+        if causal or kpad:
+            k_pos = j * Bk + jax.lax.broadcasted_iota(jnp.int32, (1, Bk), 1)
+        if kpad:
+            mask = k_pos < sk_valid
         if causal:
-            mask = mask & (q_pos >= k_pos)
-        mask = mask & (qseg_ref[0] == kseg_ref[0])
+            q_pos = (
+                q_offset + i * Bq
+                + jax.lax.broadcasted_iota(jnp.int32, (Bq, 1), 0)
+            )
+            cm = q_pos >= k_pos
+            mask = cm if mask is None else mask & cm
+        if has_segments:
+            sm = qseg_ref[0] == kseg_ref[0]
+            mask = sm if mask is None else mask & sm
         # explicit where: exp(s - lse) is garbage on fully-masked rows
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)  # [Bq, Bk]
+        p = jnp.exp(s - lse)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)  # [Bq, Bk]
         dp = jax.lax.dot_general(
             do, v,
             (((1,), (1,)), ((), ())),
@@ -231,9 +255,17 @@ def _dkv_kernel(
     sq_valid: int,
     sk_valid: int,
     group: int,
+    has_segments: bool,
+    kpad: bool,
+    qpad: bool,
+    fused_dq: bool = False,
+    dq_ref=None,  # fused mode only: [1, 1, Bq, D], written per (h, i)
 ):
     # grid (B, nk, H, nq): q-blocks fastest, then the q-heads sharing this
-    # kv head; scratch accumulates until both inner dims finish.
+    # kv head; scratch accumulates until both inner dims finish. In FUSED
+    # mode (nk == 1, the whole kv sequence in one block) this kernel also
+    # emits dq — a q-block's dq needs no cross-j accumulation then, which
+    # deletes the separate dq kernel's full s/p/dp recompute.
     jk = pl.program_id(1)
     h = pl.program_id(2)
     i = pl.program_id(3)
@@ -249,6 +281,11 @@ def _dkv_kernel(
     run = True
     if causal:
         run = q_offset + (i + 1) * Bq - 1 >= jk * Bk
+    if fused_dq and causal:
+        # a causally-skipped program must still define its dq block
+        @pl.when(jnp.logical_not(run))
+        def _():
+            dq_ref[0, 0] = jnp.zeros_like(dq_ref[0, 0])
 
     @pl.when(run)
     def _():
@@ -259,20 +296,32 @@ def _dkv_kernel(
         do = do_ref[0, 0]
         lse = lse_ref[0, 0]      # [Bq, 1]
         delta = delta_ref[0, 0]  # [Bq, 1]
-        k_pos = jk * Bk + jax.lax.broadcasted_iota(jnp.int32, (1, Bk), 1)
-        q_pos = (
-            q_offset + i * Bq
-            + jax.lax.broadcasted_iota(jnp.int32, (Bq, 1), 0)
-        )
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [Bq, Bk]
-        mask = (k_pos < sk_valid) & (q_pos - q_offset < sq_valid)
+        mask = None
+        if causal or kpad:
+            k_pos = jk * Bk + jax.lax.broadcasted_iota(jnp.int32, (1, Bk), 1)
+        if causal or qpad:
+            q_pos = (
+                q_offset + i * Bq
+                + jax.lax.broadcasted_iota(jnp.int32, (Bq, 1), 0)
+            )
+        if kpad:
+            mask = k_pos < sk_valid
+        if qpad:
+            qm = q_pos - q_offset < sq_valid
+            mask = qm if mask is None else mask & qm
         if causal:
-            mask = mask & (q_pos >= k_pos)
-        mask = mask & (qseg_ref[0] == kseg_ref[0])
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+            cm = q_pos >= k_pos
+            mask = cm if mask is None else mask & cm
+        if has_segments:
+            sm = qseg_ref[0] == kseg_ref[0]
+            mask = sm if mask is None else mask & sm
+        p = jnp.exp(s - lse)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         dv_scr[...] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -286,6 +335,11 @@ def _dkv_kernel(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )  # [Bk, D]
+        if fused_dq:
+            dq_ref[0, 0] = jax.lax.dot_general(
+                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            ).astype(dq_ref.dtype)
 
     @pl.when((h % group == group - 1) & (i == nq - 1))
     def _():
@@ -299,7 +353,7 @@ def _dkv_kernel(
 
 
 def _fwd_call(q, k, v, qseg, kseg, scale, causal, q_offset, block_q, block_k,
-              sk_valid, interpret):
+              sk_valid, interpret, has_segments):
     B, H, Sq_pad, D = q.shape
     _, KVH, Sk_pad, _ = k.shape
     G = H // KVH
@@ -308,6 +362,7 @@ def _fwd_call(q, k, v, qseg, kseg, scale, causal, q_offset, block_q, block_k,
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal,
         q_offset=q_offset, sk_valid=sk_valid,
+        has_segments=has_segments, kpad=sk_valid != Sk_pad,
     )
     return pl.pallas_call(
         kernel,
@@ -336,21 +391,72 @@ def _fwd_call(q, k, v, qseg, kseg, scale, causal, q_offset, block_q, block_k,
     )(q, k, v, qseg, kseg)
 
 
+def _bwd_fused_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref,
+                      lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
+                      dk_scr, dv_scr, **statics):
+    """nk == 1 backward: dq needs no cross-kv-block accumulation, so the
+    dkv kernel emits it too — one s/p/dp computation instead of two."""
+    return _dkv_kernel(
+        q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref, delta_ref,
+        dk_ref, dv_ref, dk_scr, dv_scr, fused_dq=True, dq_ref=dq_ref,
+        **statics,
+    )
+
+
 def _bwd_call(q, k, v, qseg, kseg, o, lse, do, scale, causal, q_offset,
-              block_q, block_k, sq_valid, sk_valid, interpret):
+              block_q, block_k, sq_valid, sk_valid, interpret, has_segments):
     B, H, Sq_pad, D = q.shape
     _, KVH, Sk_pad, _ = k.shape
     G = H // KVH
     nq = Sq_pad // block_q
     nk = Sk_pad // block_k
+    kpad = sk_valid != Sk_pad
+    qpad = sq_valid != Sq_pad
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
     )  # [B, H, Sq_pad, 1]
+
+    if nk == 1:
+        dq, dk, dv = pl.pallas_call(
+            functools.partial(
+                _bwd_fused_kernel, scale=scale, causal=causal,
+                q_offset=q_offset, sq_valid=sq_valid, sk_valid=sk_valid,
+                group=G, has_segments=has_segments, kpad=kpad, qpad=qpad,
+            ),
+            grid=(B, 1, H, nq),  # q-blocks fastest, then heads of the group
+            in_specs=[
+                pl.BlockSpec((1, 1, block_q, D), lambda b, j, h, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h // G, j, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h // G, j, 0)),
+                pl.BlockSpec((1, block_q, 1), lambda b, j, h, i: (b, i, 0)),
+                pl.BlockSpec((1, 1, block_k), lambda b, j, h, i: (b, 0, j)),
+                pl.BlockSpec((1, 1, block_q, D), lambda b, j, h, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1), lambda b, j, h, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_q, 1), lambda b, j, h, i: (b, h, i, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, 1, block_q, D), lambda b, j, h, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h // G, j, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h // G, j, 0)),
+            ],
+            out_shape=[
+                jax.ShapeDtypeStruct((B, H, Sq_pad, D), q.dtype),
+                jax.ShapeDtypeStruct((B, KVH, Sk_pad, D), k.dtype),
+                jax.ShapeDtypeStruct((B, KVH, Sk_pad, D), v.dtype),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((block_k, D), jnp.float32),
+                pltpu.VMEM((block_k, D), jnp.float32),
+            ],
+            interpret=interpret,
+        )(q, k, v, qseg, kseg, do, lse, delta)
+        return dq, dk, dv
 
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal,
             q_offset=q_offset, sk_valid=sk_valid,
+            has_segments=has_segments, kpad=kpad,
         ),
         grid=(B, H, nq, nk),
         in_specs=[
@@ -375,6 +481,7 @@ def _bwd_call(q, k, v, qseg, kseg, o, lse, do, scale, causal, q_offset,
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal,
             q_offset=q_offset, sq_valid=sq_valid, sk_valid=sk_valid, group=G,
+            has_segments=has_segments, kpad=kpad, qpad=qpad,
         ),
         grid=(B, nk, H, nq),  # q-blocks fastest, then heads of the group
         in_specs=[
@@ -409,27 +516,33 @@ def _bwd_call(q, k, v, qseg, kseg, o, lse, do, scale, causal, q_offset,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8))
 def _flash(scale, causal, q_offset, block_q, block_k, sq_valid, sk_valid,
-           interpret, q, k, v, qseg, kseg):
+           interpret, has_segments, q, k, v, qseg, kseg):
     o, _ = _flash_fwd(scale, causal, q_offset, block_q, block_k, sq_valid,
-                      sk_valid, interpret, q, k, v, qseg, kseg)
+                      sk_valid, interpret, has_segments, q, k, v, qseg, kseg)
     return o
 
 
 def _flash_fwd(scale, causal, q_offset, block_q, block_k, sq_valid, sk_valid,
-               interpret, q, k, v, qseg, kseg):
+               interpret, has_segments, q, k, v, qseg, kseg):
     o, lse = _fwd_call(q, k, v, qseg, kseg, scale, causal, q_offset,
-                       block_q, block_k, sk_valid, interpret)
+                       block_q, block_k, sk_valid, interpret, has_segments)
+    # named residuals: under jax.checkpoint, the backward re-runs this
+    # whole kernel just to rebuild (o, lse) unless the remat policy can
+    # SAVE them — the "dots" policy recognizes dot_general outputs, not a
+    # pallas_call's (llama.py pairs this with save_only_these_names)
+    o = jax.ad_checkpoint.checkpoint_name(o, "attn_out")
+    lse = jax.ad_checkpoint.checkpoint_name(lse, "attn_lse")
     return o, (q, k, v, qseg, kseg, o, lse)
 
 
 def _flash_bwd(scale, causal, q_offset, block_q, block_k, sq_valid, sk_valid,
-               interpret, residuals, do):
+               interpret, has_segments, residuals, do):
     q, k, v, qseg, kseg, o, lse = residuals
     dq, dk, dv = _bwd_call(q, k, v, qseg, kseg, o, lse, do, scale, causal,
                            q_offset, block_q, block_k, sq_valid, sk_valid,
-                           interpret)
+                           interpret, has_segments)
     zero_seg = np.zeros(qseg.shape, dtype=jax.dtypes.float0)
     zero_kseg = np.zeros(kseg.shape, dtype=jax.dtypes.float0)
     return dq, dk, dv, zero_seg, zero_kseg
@@ -499,5 +612,6 @@ def flash_attention(
     kseg = kseg2[:, None, :]   # [B, 1, Sk_pad]
 
     o = _flash(scale, causal, q_offset, bq, bk, Sq, Sk, interpret,
+               segment_ids is not None,
                qt, kt, vt, qseg, kseg)
     return jnp.transpose(o[:, :, :Sq, :], (0, 2, 1, 3))

@@ -222,6 +222,25 @@ def test_engine_sampling_seeded_and_stop():
     assert o3[-1] == stop_tok and len(o3) == 4
 
 
+def test_seeded_sampling_chunk_invariant():
+    """Keys derive from (request key, absolute token index): a seeded
+    request must emit identical tokens whether it decodes one token per
+    host sync or in device-side chunks, and regardless of batch-mates."""
+    p = [5, 6, 7]
+    sp = SamplingParams(max_tokens=20, temperature=1.0, seed=7, ignore_eos=True)
+    outs = {}
+    for chunk in (1, 4, 8):
+        eng = _engine(decode_chunk=chunk)
+        outs[chunk] = eng.generate([p], sp)[0]
+    assert outs[1] == outs[4] == outs[8]
+    # with a co-running request whose shorter budget used to reshape the
+    # chunking for everyone
+    eng = _engine(decode_chunk=8)
+    sp_short = SamplingParams(max_tokens=3, temperature=0.0, ignore_eos=True)
+    both = eng.generate([p, [9, 10, 11, 12]], [sp, sp_short])
+    assert both[0] == outs[1]
+
+
 def test_sampler_topk_topp():
     logits = jnp.asarray(np.log([[0.5, 0.3, 0.15, 0.05]]), jnp.float32)
     keys = jax.random.split(jax.random.key(0), 200)
