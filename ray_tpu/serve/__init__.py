@@ -16,6 +16,7 @@ from ray_tpu.serve.api import (
     run,
     shutdown,
     start,
+    start_rpc_ingress,
     status,
 )
 from ray_tpu.serve.config import AutoscalingConfig, DeploymentConfig, HTTPOptions
@@ -38,5 +39,6 @@ __all__ = [
     "run",
     "shutdown",
     "start",
+    "start_rpc_ingress",
     "status",
 ]

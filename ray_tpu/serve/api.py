@@ -187,6 +187,33 @@ def start(http_options: Optional[HTTPOptions] = None, **kwargs) -> None:
             _proxy = HTTPProxy(http_options.host, http_options.port, controller)
 
 
+_rpc_ingress = None
+
+
+def start_rpc_ingress(host: str = "127.0.0.1", port: int = 0):
+    """Start the binary RPC front door next to (or instead of) HTTP — the
+    gRPC-proxy role (reference: serve gRPC ingress); returns the ingress
+    with its bound `.addr`."""
+    global _rpc_ingress
+    controller = _get_controller_handle()
+    with _lock:
+        if _rpc_ingress is None:
+            from ray_tpu.serve.rpc_ingress import RpcIngress
+
+            _rpc_ingress = RpcIngress(host, port, controller)
+        elif (host, port) != ("127.0.0.1", 0) and (
+            _rpc_ingress.addr[0] != host
+            or (port != 0 and _rpc_ingress.addr[1] != port)
+        ):
+            # silently returning an ingress on a DIFFERENT address than
+            # requested strands external clients on a dead port
+            raise RuntimeError(
+                f"RPC ingress already bound at {_rpc_ingress.addr}; "
+                f"cannot rebind to ({host}, {port}) — serve.shutdown() first"
+            )
+        return _rpc_ingress
+
+
 def _collect_deployments(app: Application):
     """Walk the bound-argument DAG; return ({name: (Deployment, args, kwargs)},
     ingress_name) with nested Applications replaced by handle placeholders."""
@@ -324,14 +351,17 @@ def delete(name: str) -> None:
 
 
 def shutdown() -> None:
-    global _controller_handle, _proxy
+    global _controller_handle, _proxy, _rpc_ingress
     import ray_tpu
     from ray_tpu.serve.handle import _drop_routers
 
     _drop_routers()
     with _lock:
         proxy, _proxy = _proxy, None
+        ingress, _rpc_ingress = _rpc_ingress, None
         controller, _controller_handle = _controller_handle, None
+    if ingress is not None:
+        ingress.shutdown()
     if proxy is not None:
         proxy.shutdown()
     if controller is not None:

@@ -48,8 +48,9 @@ class HTTPProxy:
         self._port = port
         self._controller = controller_handle
         self._handles: dict[str, Any] = {}  # app_name -> DeploymentHandle
-        self._routes: dict[str, tuple] = {}
-        self._routes_stamp = 0.0
+        from ray_tpu.serve.routes import RouteTableCache
+
+        self._route_cache = RouteTableCache(controller_handle)
         self._loop: Optional[asyncio.AbstractEventLoop] = None
         self._started = threading.Event()
         self._stop = threading.Event()
@@ -64,26 +65,9 @@ class HTTPProxy:
     def port(self) -> int:
         return self._port
 
-    def _refresh_routes(self) -> None:
-        import time
-
-        import ray_tpu
-
-        if time.time() - self._routes_stamp < 0.5 and self._routes:
-            return
-        self._routes = ray_tpu.get(self._controller.list_routes.remote())
-        self._routes_stamp = time.time()
-
     def _match(self, path: str):
-        """Longest-prefix route match."""
-        self._refresh_routes()
-        best = None
-        for prefix, (app, ingress) in self._routes.items():
-            norm = prefix.rstrip("/") or "/"
-            if path == norm or path.startswith(norm + "/") or norm == "/":
-                if best is None or len(norm) > len(best[0]):
-                    best = (norm, prefix, app, ingress)
-        return best
+        """Longest-prefix route match (shared cache: serve/routes.py)."""
+        return self._route_cache.match(path)
 
     def _get_handle(self, app: str, ingress: str):
         h = self._handles.get(app)
@@ -102,10 +86,10 @@ class HTTPProxy:
             return web.Response(text="success")
         if path == "/-/routes":
             # controller RPC off-loop, like the data path
-            await asyncio.get_running_loop().run_in_executor(
-                None, self._refresh_routes
+            routes = await asyncio.get_running_loop().run_in_executor(
+                None, self._route_cache.get
             )
-            return web.json_response({p: a for p, (a, _) in self._routes.items()})
+            return web.json_response({p: a for p, (a, _) in routes.items()})
         match = await asyncio.get_running_loop().run_in_executor(
             None, self._match, path
         )

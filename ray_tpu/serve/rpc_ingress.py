@@ -1,0 +1,97 @@
+"""RPC ingress: the binary second front door next to the HTTP proxy.
+
+Reference analog: Serve's gRPC proxy (python/ray/serve/_private/
+proxy.py gRPCProxy — user-defined service methods routed to
+deployments). This framework's wire substrate is the framed TCP RPC
+plane (cluster/rpc.py), so the binary ingress speaks that instead of
+protoc services: one `call` method carrying (app, method, args,
+kwargs), routed through the same controller route table and
+DeploymentHandles the HTTP proxy uses. Python clients get structured
+arguments/results with no JSON round-trip.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+import ray_tpu
+from ray_tpu.cluster.rpc import RpcClient, RpcServer
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.serve.rpc_ingress")
+
+
+class RpcIngress:
+    """Framed-RPC front end. Handlers run on the RPC server's executor
+    threads; each blocks on the deployment's reply like an HTTP worker."""
+
+    def __init__(self, host: str, port: int, controller_handle):
+        self._controller = controller_handle
+        self._handles: dict[tuple, Any] = {}
+        from ray_tpu.serve.routes import RouteTableCache
+
+        self._route_cache = RouteTableCache(controller_handle)
+        self._lock = threading.Lock()
+        self.rpc = RpcServer(self, host=host, port=port)
+        self.addr = self.rpc.start()
+
+    # -- routing (same table the HTTP proxy consumes) -------------------------
+
+    def _resolve(self, app: Optional[str]):
+        apps = {a: ingress for _, (a, ingress) in self._route_cache.get().items()}
+        if app is None:
+            if len(apps) != 1:
+                raise ValueError(
+                    f"app= required: {sorted(apps)} apps are deployed"
+                )
+            app = next(iter(apps))
+        ingress = apps.get(app)
+        if ingress is None:
+            raise KeyError(f"no deployed app {app!r}; have {sorted(apps)}")
+        return app, ingress
+
+    def _handle_for(self, app: str, ingress: str):
+        with self._lock:
+            h = self._handles.get((app, ingress))
+            if h is None:
+                from ray_tpu.serve.handle import DeploymentHandle
+
+                h = DeploymentHandle(ingress, app)
+                self._handles[(app, ingress)] = h
+            return h
+
+    # -- RPC surface ----------------------------------------------------------
+
+    def rpc_call(self, payload, peer):
+        """{app?, method?, args?, kwargs?} -> deployment result (pickled
+        by the wire). `method` targets a named method on the ingress
+        deployment; omitted = its __call__."""
+        app, ingress = self._resolve(payload.get("app"))
+        handle = self._handle_for(app, ingress)
+        if payload.get("method"):
+            handle = getattr(handle, payload["method"])
+        response = handle.remote(*payload.get("args", ()),
+                                 **payload.get("kwargs", {}))
+        return response.result(timeout_s=payload.get("timeout", 120.0))
+
+    def rpc_routes(self, payload, peer):
+        return dict(self._route_cache.get())
+
+    def shutdown(self) -> None:
+        self.rpc.stop()
+
+
+def rpc_ingress_call(addr: tuple, *args, app: Optional[str] = None,
+                     method: Optional[str] = None, timeout: float = 120.0,
+                     **kwargs):
+    """Client helper: one structured call against an RpcIngress."""
+    c = RpcClient(addr[0], addr[1], timeout=timeout + 10).connect()
+    try:
+        return c.call(
+            "call",
+            {"app": app, "method": method, "args": args, "kwargs": kwargs,
+             "timeout": timeout},
+        )
+    finally:
+        c.close()

@@ -235,3 +235,25 @@ def test_http_proxy_end_to_end(serve_instance):
     assert r.json() == {"sum": 5}
     r = requests.get(f"{base}/nope", timeout=5)
     assert r.status_code == 404
+
+
+def test_rpc_ingress_binary_front_door(serve_instance):
+    """The gRPC-proxy role: structured calls over the framed RPC plane,
+    routed through the same controller route table as HTTP."""
+    from ray_tpu.serve.rpc_ingress import rpc_ingress_call
+
+    @serve.deployment
+    class Calc:
+        def __call__(self, x):
+            return {"doubled": x * 2}
+
+        def add(self, a, b):
+            return a + b
+
+    serve.run(Calc.bind(), name="rpcapp", route_prefix="/calc")
+    ingress = serve.start_rpc_ingress(port=0)
+    assert rpc_ingress_call(ingress.addr, 21, app="rpcapp") == {"doubled": 42}
+    assert rpc_ingress_call(ingress.addr, 2, 3, app="rpcapp", method="add") == 5
+    # single-app deployments resolve without naming the app
+    assert rpc_ingress_call(ingress.addr, 5)["doubled"] == 10
+    serve.delete("rpcapp")
