@@ -73,6 +73,22 @@ class EngineConfig:
     decode_chunk: int = 8
 
     def __post_init__(self):
+        if isinstance(self.model, str):
+            # registry name ("llama3-8b", "mistral-7b", ...) — the vLLM
+            # model-id ergonomics (models/registry.py)
+            from ray_tpu.models.registry import get_model_config
+
+            self.model = get_model_config(self.model)
+        from ray_tpu.models.moe import MoEConfig
+
+        if isinstance(self.model, MoEConfig):
+            # the serving decoder is the dense llama path; accepting a
+            # MoEConfig (a LlamaConfig subclass) would silently serve a
+            # dense model with the experts' hyperparameters
+            raise ValueError(
+                "LLMEngine serves dense llama-family models; MoE serving "
+                "is not implemented (training-side MoE lives in models/moe.py)"
+            )
         # a prefill bucket longer than the context window can never be
         # used; clamping keeps bucket compilation bounded by the model
         self.max_prefill_len = min(self.max_prefill_len, self.model.max_seq)

@@ -1,0 +1,122 @@
+"""Model registry: named presets + HuggingFace config mapping.
+
+Reference analog: the reference serves any HF model id by delegating to
+vLLM's model loader (llm/_internal/serve/deployments/llm/vllm/
+vllm_models.py model_id plumbing). This framework's compute path is the
+llama-family decoder (models/llama.py — which covers Llama 1/2/3,
+Mistral, Qwen2, TinyLlama, ... since they share the architecture) and
+the MoE variant (models/moe.py — Mixtral-style). The registry gives
+users the same two entry points they expect:
+
+  * `get_model_config("llama3-8b")` — named presets;
+  * `config_from_hf(json.load(open("config.json")))` — map a HF
+    transformers config dict onto LlamaConfig/MoEConfig (no downloads;
+    weight conversion is a separate concern).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+from ray_tpu.models import llama, moe
+
+_REGISTRY: dict[str, Any] = {}
+
+
+def register_model(name: str, config) -> None:
+    key = name.lower()
+    if key in _REGISTRY:
+        raise ValueError(f"model {name!r} already registered")
+    _REGISTRY[key] = config
+
+
+def get_model_config(name: str):
+    """Named preset lookup (case-insensitive); returns a frozen config."""
+    try:
+        return _REGISTRY[name.lower()]
+    except KeyError:
+        raise KeyError(
+            f"unknown model {name!r}; registered: {sorted(_REGISTRY)}"
+        ) from None
+
+
+def list_models() -> list[str]:
+    return sorted(_REGISTRY)
+
+
+# -- presets (architecture hyperparameters from the public model cards) ------
+
+for _name, _cfg in {
+    "llama3-8b": llama.LLAMA3_8B,
+    "llama3-1b": llama.LLAMA3_1B,
+    "llama-400m": llama.LLAMA_400M,
+    "llama-tiny": llama.LLAMA_TINY,
+    "llama3-70b": dataclasses.replace(
+        llama.LLAMA3_8B, d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8,
+        d_ff=28672,
+    ),
+    "mistral-7b": dataclasses.replace(
+        llama.LLAMA3_8B, vocab_size=32000, d_model=4096, n_layers=32,
+        n_heads=32, n_kv_heads=8, d_ff=14336, rope_theta=10000.0,
+        max_seq=32768,
+    ),
+    "qwen2-7b": dataclasses.replace(
+        llama.LLAMA3_8B, vocab_size=152064, d_model=3584, n_layers=28,
+        n_heads=28, n_kv_heads=4, d_ff=18944, rope_theta=1000000.0,
+        max_seq=32768,
+    ),
+    "tinyllama-1.1b": dataclasses.replace(
+        llama.LLAMA3_8B, vocab_size=32000, d_model=2048, n_layers=22,
+        n_heads=32, n_kv_heads=4, d_ff=5632, rope_theta=10000.0,
+        max_seq=2048,
+    ),
+    "mixtral-8x7b": moe.MIXTRAL_8X7B,
+    "moe-tiny": moe.MOE_TINY,
+}.items():
+    register_model(_name, _cfg)
+
+
+# -- HF transformers config.json mapping -------------------------------------
+
+_HF_LLAMA_ARCHS = {
+    "LlamaForCausalLM", "MistralForCausalLM", "Qwen2ForCausalLM",
+}
+_HF_MOE_ARCHS = {"MixtralForCausalLM"}
+
+
+def config_from_hf(hf: dict, **overrides):
+    """Map a HF `config.json` dict to a LlamaConfig/MoEConfig.
+
+    Only architecture hyperparameters travel; framework knobs
+    (dtype/remat/attention_impl) keep their TPU defaults unless
+    overridden. Raises on architectures outside the llama/mixtral
+    families rather than mis-mapping them.
+    """
+    archs = set(hf.get("architectures", ()))
+    is_moe = bool(archs & _HF_MOE_ARCHS) or "num_local_experts" in hf
+    if archs and not is_moe and not (archs & _HF_LLAMA_ARCHS):
+        raise ValueError(
+            f"unsupported architectures {sorted(archs)}; llama-family "
+            f"({sorted(_HF_LLAMA_ARCHS)}) and mixtral-family "
+            f"({sorted(_HF_MOE_ARCHS)}) map onto this framework's decoders"
+        )
+    common = dict(
+        vocab_size=hf["vocab_size"],
+        d_model=hf["hidden_size"],
+        n_layers=hf["num_hidden_layers"],
+        n_heads=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads", hf["num_attention_heads"]),
+        d_ff=hf["intermediate_size"],
+        max_seq=hf.get("max_position_embeddings", 8192),
+        rope_theta=float(hf.get("rope_theta", 10000.0)),
+        rms_eps=float(hf.get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(hf.get("tie_word_embeddings", False)),
+    )
+    if is_moe:
+        common["n_experts"] = hf["num_local_experts"]
+        common["top_k"] = hf.get("num_experts_per_tok", 2)
+        common.update(overrides)  # caller wins on collisions
+        return moe.MoEConfig(**common)
+    common.update(overrides)
+    return llama.LlamaConfig(**common)

@@ -41,9 +41,13 @@ class RpcIngress:
     def _resolve(self, app: Optional[str]):
         apps = {a: ingress for _, (a, ingress) in self._route_cache.get().items()}
         if app is None:
-            if len(apps) != 1:
+            if not apps:
                 raise ValueError(
-                    f"app= required: {sorted(apps)} apps are deployed"
+                    "no applications with a route_prefix are deployed"
+                )
+            if len(apps) > 1:
+                raise ValueError(
+                    f"app= required: multiple apps deployed ({sorted(apps)})"
                 )
             app = next(iter(apps))
         ingress = apps.get(app)

@@ -1,0 +1,88 @@
+"""Model registry: named presets + HF config.json mapping.
+
+Reference analog: serving any HF model id through vLLM's loader; here
+the llama/mixtral families map onto the native decoders and everything
+else is rejected loudly.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ray_tpu.models import llama, moe
+from ray_tpu.models.registry import (
+    config_from_hf,
+    get_model_config,
+    list_models,
+    register_model,
+)
+
+
+def test_presets_resolve_and_are_consistent():
+    assert "llama3-8b" in list_models()
+    cfg = get_model_config("LLAMA3-8B")  # case-insensitive
+    assert cfg.d_model == 4096 and cfg.n_layers == 32
+    m7 = get_model_config("mistral-7b")
+    assert m7.d_ff == 14336 and m7.n_kv_heads == 8
+    mx = get_model_config("mixtral-8x7b")
+    assert isinstance(mx, moe.MoEConfig)
+    with pytest.raises(KeyError):
+        get_model_config("nope-13b")
+    with pytest.raises(ValueError):
+        register_model("llama3-8b", cfg)  # duplicate
+
+
+def test_hf_llama_mapping_runs_forward():
+    hf = {
+        "architectures": ["LlamaForCausalLM"],
+        "vocab_size": 512, "hidden_size": 64, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "intermediate_size": 128, "max_position_embeddings": 128,
+        "rope_theta": 10000.0, "rms_norm_eps": 1e-6,
+        "tie_word_embeddings": True,
+    }
+    cfg = config_from_hf(hf, remat=False)
+    assert cfg.n_kv_heads == 2 and cfg.tie_embeddings
+    params = llama.init_params(cfg, jax.random.key(0))
+    logits = llama.forward(params, jnp.zeros((1, 8), jnp.int32), cfg)
+    assert logits.shape == (1, 8, 512)
+
+
+def test_hf_mixtral_mapping():
+    hf = {
+        "architectures": ["MixtralForCausalLM"],
+        "vocab_size": 512, "hidden_size": 64, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "intermediate_size": 128, "num_local_experts": 4,
+        "num_experts_per_tok": 2,
+    }
+    cfg = config_from_hf(hf)
+    assert isinstance(cfg, moe.MoEConfig)
+    assert cfg.n_experts == 4 and cfg.top_k == 2
+
+
+def test_hf_unknown_architecture_rejected():
+    with pytest.raises(ValueError, match="unsupported architectures"):
+        config_from_hf({
+            "architectures": ["GPTBigCodeForCausalLM"],
+            "vocab_size": 1, "hidden_size": 8, "num_hidden_layers": 1,
+            "num_attention_heads": 1, "intermediate_size": 8,
+        })
+
+
+def test_engine_accepts_model_name():
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine
+    from ray_tpu.llm.sampling import SamplingParams
+
+    cfg = EngineConfig(model="llama-tiny", num_blocks=32, block_size=4,
+                       max_num_seqs=2)
+    assert cfg.model.d_model == 64
+    eng = LLMEngine(cfg)
+    out = eng.generate([[5, 6, 7]],
+                       SamplingParams(max_tokens=4, ignore_eos=True))[0]
+    assert len(out) == 4
