@@ -1,0 +1,136 @@
+"""HuggingFace -> ray_tpu weight conversion for the llama family.
+
+Reference analog: the reference loads any HF checkpoint by delegating
+to vLLM's loader; here the mapping is explicit — a transformers
+LlamaForCausalLM state dict (same layout Mistral/Qwen2/TinyLlama use)
+becomes this framework's stacked-layer param tree:
+
+  * torch Linear weights are [out, in] -> transposed to [in, out];
+  * per-layer tensors stack along a leading layer axis (lax.scan
+    layout, models/llama.py);
+  * RoPE needs no permutation: both sides use the half-split
+    (rotate_half) convention with inv-freq over arange(0, d, 2).
+
+Parity is proven in tests/test_hf_convert.py: a randomly-initialized
+transformers model's logits match this framework's forward on the
+converted weights.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models import llama
+
+
+def _a(x) -> np.ndarray:
+    """torch tensor / array -> fp32 numpy (numpy has no bf16: modern
+    checkpoints are bf16, so the cast must happen torch-side)."""
+    if hasattr(x, "detach"):
+        return x.detach().float().cpu().numpy()
+    return np.asarray(x, dtype=np.float32)
+
+
+def _t(x) -> np.ndarray:
+    """As _a, transposed ([out, in] torch Linear -> [in, out])."""
+    return _a(x).T
+
+
+def params_from_hf_state_dict(
+    state_dict: Mapping[str, Any],
+    config: llama.LlamaConfig,
+    dtype=None,
+) -> llama.Params:
+    """Map a transformers llama-family state dict onto the param tree."""
+    sd = {k.removeprefix("model."): v for k, v in state_dict.items()}
+    dt = dtype or config.param_dtype
+    L = config.n_layers
+    consumed: set = set()
+
+    def layer(name: str, transpose: bool) -> jnp.ndarray:
+        conv = _t if transpose else _a
+        keys = [f"layers.{i}.{name}" for i in range(L)]
+        consumed.update(keys)
+        return jnp.asarray(np.stack([conv(sd[k]) for k in keys]), dt)
+
+    params: llama.Params = {
+        "embed": jnp.asarray(_a(sd["embed_tokens.weight"]), dt),
+        "layers": {
+            "ln1": layer("input_layernorm.weight", transpose=False),
+            "wq": layer("self_attn.q_proj.weight", transpose=True),
+            "wk": layer("self_attn.k_proj.weight", transpose=True),
+            "wv": layer("self_attn.v_proj.weight", transpose=True),
+            "wo": layer("self_attn.o_proj.weight", transpose=True),
+            "ln2": layer("post_attention_layernorm.weight", transpose=False),
+            "w_gate": layer("mlp.gate_proj.weight", transpose=True),
+            "w_up": layer("mlp.up_proj.weight", transpose=True),
+            "w_down": layer("mlp.down_proj.weight", transpose=True),
+        },
+        "final_norm": jnp.asarray(_a(sd["norm.weight"]), dt),
+    }
+    consumed.update({"embed_tokens.weight", "norm.weight"})
+    if config.tie_embeddings:
+        # transformers emits the tied lm_head.weight anyway; a converted
+        # lm_head key would mismatch init_params/logical_axes pytrees
+        consumed.add("lm_head.weight")
+    elif "lm_head.weight" in sd:
+        params["lm_head"] = jnp.asarray(_t(sd["lm_head.weight"]), dt)
+        consumed.add("lm_head.weight")
+    else:
+        raise KeyError(
+            "state dict has no lm_head.weight and config.tie_embeddings "
+            "is False — set tie_embeddings=True for tied checkpoints"
+        )
+    # leftovers mean silently-wrong output (e.g. Qwen2's q/k/v biases,
+    # which this decoder has no parameters for) — refuse, don't mis-map
+    leftovers = {
+        k for k in sd
+        if k not in consumed and not k.endswith(("rotary_emb.inv_freq",))
+    }
+    if leftovers:
+        raise ValueError(
+            f"unmapped checkpoint tensors {sorted(leftovers)[:6]}... — this "
+            "architecture carries parameters the llama-family decoder "
+            "doesn't have (e.g. attention biases); conversion would be "
+            "silently wrong"
+        )
+    return params
+
+
+def load_hf_checkpoint(model_dir: str, config=None):
+    """Convenience: (config, params) from a local HF checkpoint directory
+    (config.json + safetensors/bin). No network access."""
+    import json
+    import os
+
+    from ray_tpu.models.registry import config_from_hf
+
+    with open(os.path.join(model_dir, "config.json")) as f:
+        hf_cfg = json.load(f)
+    if config is None:
+        config = config_from_hf(hf_cfg)
+
+    state: dict = {}
+    st_files = [f for f in os.listdir(model_dir) if f.endswith(".safetensors")]
+    if st_files:
+        from safetensors import safe_open
+
+        for fname in sorted(st_files):
+            with safe_open(os.path.join(model_dir, fname), framework="np") as f:
+                for k in f.keys():
+                    state[k] = f.get_tensor(k)
+    else:
+        import torch
+
+        for fname in sorted(os.listdir(model_dir)):
+            if fname.endswith(".bin"):
+                state.update(
+                    torch.load(os.path.join(model_dir, fname),
+                               map_location="cpu", weights_only=True)
+                )
+    if not state:
+        raise FileNotFoundError(f"no weight files in {model_dir}")
+    return config, params_from_hf_state_dict(state, config)

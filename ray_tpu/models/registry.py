@@ -94,12 +94,32 @@ def config_from_hf(hf: dict, **overrides):
     families rather than mis-mapping them.
     """
     archs = set(hf.get("architectures", ()))
-    is_moe = bool(archs & _HF_MOE_ARCHS) or "num_local_experts" in hf
+    # the num_local_experts heuristic only applies to config dicts with NO
+    # architectures field: PhiMoE/GPT-OSS-style configs also carry it and
+    # must be rejected by the whitelist, not mapped onto Mixtral
+    is_moe = bool(archs & _HF_MOE_ARCHS) or (
+        not archs and "num_local_experts" in hf
+    )
     if archs and not is_moe and not (archs & _HF_LLAMA_ARCHS):
         raise ValueError(
             f"unsupported architectures {sorted(archs)}; llama-family "
             f"({sorted(_HF_LLAMA_ARCHS)}) and mixtral-family "
             f"({sorted(_HF_MOE_ARCHS)}) map onto this framework's decoders"
+        )
+    scaling = hf.get("rope_scaling")
+    if scaling and scaling.get("rope_type", scaling.get("type")) != "default":
+        # llama-3.1-style frequency rescaling changes every position's
+        # rotation; mapping rope_theta alone would diverge silently
+        raise ValueError(
+            f"rope_scaling={scaling!r} is not supported; only default RoPE "
+            "maps onto this decoder"
+        )
+    derived_hd = hf["hidden_size"] // hf["num_attention_heads"]
+    if hf.get("head_dim") not in (None, derived_hd):
+        raise ValueError(
+            f"explicit head_dim={hf['head_dim']} != hidden_size/"
+            f"num_attention_heads={derived_hd}; this decoder derives "
+            "head_dim and would mis-shape the checkpoint"
         )
     common = dict(
         vocab_size=hf["vocab_size"],

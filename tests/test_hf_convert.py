@@ -1,0 +1,109 @@
+"""HF weight-conversion parity: transformers' torch llama vs this
+framework's forward on the converted weights.
+
+This is the strongest correctness evidence the compute path gets — the
+canonical implementation and the TPU-native one agree logit-for-logit
+on the same (random) weights.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from ray_tpu.models import llama
+from ray_tpu.models.convert import params_from_hf_state_dict
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+
+def _tiny_hf_pair(tie=False):
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rope_theta=10000.0, rms_norm_eps=1e-6,
+        tie_word_embeddings=tie, attention_bias=False, mlp_bias=False,
+    )
+    torch.manual_seed(0)
+    model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = llama.LlamaConfig(
+        vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq=128, rope_theta=10000.0, rms_eps=1e-6,
+        dtype=jnp.float32, param_dtype=jnp.float32, remat=False,
+        tie_embeddings=tie,
+    )
+    return model, cfg
+
+
+@pytest.mark.parametrize("tie", [False, True])
+def test_logits_match_transformers(tie):
+    model, cfg = _tiny_hf_pair(tie)
+    params = params_from_hf_state_dict(model.state_dict(), cfg)
+
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg.vocab_size, size=(2, 17))
+    with torch.no_grad():
+        ref = model(torch.asarray(toks)).logits.float().numpy()
+    got = np.asarray(llama.forward(params, jnp.asarray(toks, jnp.int32), cfg))
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_converted_weights_serve(tmp_path):
+    """Converted weights drive the serving engine end to end, and greedy
+    decode agrees with transformers' greedy generate."""
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine
+    from ray_tpu.llm.sampling import SamplingParams
+
+    model, cfg = _tiny_hf_pair(tie=False)
+    params = params_from_hf_state_dict(model.state_dict(), cfg)
+    eng = LLMEngine(
+        EngineConfig(model=cfg, num_blocks=64, block_size=4, max_num_seqs=2),
+        params=params,
+    )
+    prompt = [5, 6, 7, 8, 9]
+    out = eng.generate(
+        [prompt], SamplingParams(max_tokens=6, temperature=0.0, ignore_eos=True)
+    )[0]
+    with torch.no_grad():
+        ref = model.generate(
+            torch.asarray([prompt]), max_new_tokens=6, do_sample=False,
+            pad_token_id=0,
+        )[0, len(prompt):].tolist()
+    assert out == ref
+
+
+def test_unmapped_tensors_rejected():
+    """Qwen2-style q/k/v biases must refuse conversion, not silently drop."""
+    model, cfg = _tiny_hf_pair(tie=False)
+    sd = dict(model.state_dict())
+    sd["model.layers.0.self_attn.q_proj.bias"] = torch.zeros(64)
+    with pytest.raises(ValueError, match="unmapped checkpoint tensors"):
+        params_from_hf_state_dict(sd, cfg)
+
+
+def test_rope_scaling_and_head_dim_rejected():
+    from ray_tpu.models.registry import config_from_hf
+
+    base = {
+        "architectures": ["LlamaForCausalLM"],
+        "vocab_size": 512, "hidden_size": 64, "num_hidden_layers": 2,
+        "num_attention_heads": 4, "num_key_value_heads": 2,
+        "intermediate_size": 128,
+    }
+    with pytest.raises(ValueError, match="rope_scaling"):
+        config_from_hf({**base, "rope_scaling": {"rope_type": "llama3",
+                                                 "factor": 8.0}})
+    with pytest.raises(ValueError, match="head_dim"):
+        config_from_hf({**base, "head_dim": 32})
+    # PhiMoE-style: num_local_experts with a non-whitelisted architecture
+    with pytest.raises(ValueError, match="unsupported architectures"):
+        config_from_hf({**base, "architectures": ["PhimoeForCausalLM"],
+                        "num_local_experts": 16})
+
+
+def test_bf16_state_dict_converts():
+    model, cfg = _tiny_hf_pair(tie=False)
+    sd = {k: v.to(torch.bfloat16) for k, v in model.state_dict().items()}
+    params = params_from_hf_state_dict(sd, cfg)
+    assert params["layers"]["wq"].dtype == cfg.param_dtype
