@@ -74,7 +74,17 @@ def params_from_hf_state_dict(
     consumed.update({"embed_tokens.weight", "norm.weight"})
     if config.tie_embeddings:
         # transformers emits the tied lm_head.weight anyway; a converted
-        # lm_head key would mismatch init_params/logical_axes pytrees
+        # lm_head key would mismatch init_params/logical_axes pytrees.
+        # But dropping an UNTIED head silently mis-maps — verify the tie.
+        if "lm_head.weight" in sd:
+            head = _a(sd["lm_head.weight"])
+            emb = _a(sd["embed_tokens.weight"])
+            if head.shape != emb.shape or not np.allclose(head, emb):
+                raise ValueError(
+                    "config.tie_embeddings=True but the checkpoint's "
+                    "lm_head.weight differs from embed_tokens.weight — "
+                    "this is an untied checkpoint; set tie_embeddings=False"
+                )
         consumed.add("lm_head.weight")
     elif "lm_head.weight" in sd:
         params["lm_head"] = jnp.asarray(_t(sd["lm_head.weight"]), dt)
@@ -126,7 +136,9 @@ def load_hf_checkpoint(model_dir: str, config=None):
         import torch
 
         for fname in sorted(os.listdir(model_dir)):
-            if fname.endswith(".bin"):
+            # only weight shards: Trainer dirs also hold e.g.
+            # training_args.bin, which is not a state dict
+            if fname.startswith("pytorch_model") and fname.endswith(".bin"):
                 state.update(
                     torch.load(os.path.join(model_dir, fname),
                                map_location="cpu", weights_only=True)

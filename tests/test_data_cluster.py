@@ -1,0 +1,57 @@
+"""Data pipelines over the DISTRIBUTED runtime: the same Dataset code
+path that runs on in-process threads executes in worker PROCESSES when
+the driver is attached to a cluster — the reference's 'one runtime'
+property (ray.data tasks scheduled by raylets).
+"""
+
+import os
+import sys
+
+import cloudpickle
+import pytest
+
+from ray_tpu import data as rdata
+from ray_tpu.cluster import LocalCluster
+from ray_tpu.core import api
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def attached_cluster():
+    c = LocalCluster(node_death_timeout_s=2.0)
+    c.start()
+    c.add_node({"num_cpus": 4}, node_id="head")
+    c.add_node({"num_cpus": 4}, node_id="n1")
+    c.wait_for_nodes(2)
+    api.init(address=c.address, ignore_reinit_error=True)
+    yield c
+    api.shutdown()
+    c.shutdown()
+
+
+def test_dataset_map_executes_in_worker_processes(attached_cluster):
+    driver_pid = os.getpid()
+
+    def tag(batch):
+        import os as _os
+
+        vals = [int(v) for v in batch["item"]]
+        return {
+            "x2": [v * 2 for v in vals],
+            "pid": [_os.getpid()] * len(vals),
+            "node": [_os.environ.get("RAY_TPU_NODE_ID", "?")] * len(vals),
+        }
+
+    ds = rdata.range(32, parallelism=4).map_batches(tag)
+    rows = sorted(ds.take_all(), key=lambda r: r["x2"])
+    assert [int(r["x2"]) for r in rows] == [2 * i for i in range(32)]
+    pids = {r["pid"] for r in rows}
+    assert driver_pid not in pids, "map ran in the driver, not workers"
+    assert {r["node"] for r in rows} <= {"head", "n1"}
+
+
+def test_dataset_shuffle_and_reduce_over_cluster(attached_cluster):
+    ds = rdata.range(64, parallelism=4).random_shuffle(seed=7)
+    total = sum(int(r) for r in ds.take_all())
+    assert total == sum(range(64))

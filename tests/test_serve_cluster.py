@@ -1,0 +1,51 @@
+"""Serve over the DISTRIBUTED runtime: controller and replicas are
+cluster actors in worker PROCESSES when the driver is attached — the
+same deployment code that runs on in-process threads, no edits.
+
+Reference analog: serve replicas as Ray actors scheduled by raylets
+(python/ray/serve/_private/deployment_state.py).
+"""
+
+import os
+import sys
+
+import cloudpickle
+import pytest
+
+from ray_tpu import serve
+from ray_tpu.cluster import LocalCluster
+from ray_tpu.core import api
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(scope="module")
+def attached_cluster():
+    c = LocalCluster(node_death_timeout_s=2.0)
+    c.start()
+    c.add_node({"num_cpus": 4}, node_id="head")
+    c.add_node({"num_cpus": 4}, node_id="n1")
+    c.wait_for_nodes(2)
+    api.init(address=c.address, ignore_reinit_error=True)
+    yield c
+    serve.shutdown()
+    api.shutdown()
+    c.shutdown()
+
+
+def test_serve_replicas_are_worker_processes(attached_cluster):
+    @serve.deployment(num_replicas=2)
+    class Doubler:
+        def __call__(self, x):
+            import os as _os
+
+            return {"y": 2 * x, "pid": _os.getpid(),
+                    "node": _os.environ.get("RAY_TPU_NODE_ID")}
+
+    h = serve.run(Doubler.bind(), name="capp", route_prefix=None)
+    outs = [h.remote(i).result(timeout_s=60) for i in range(10)]
+    assert [o["y"] for o in outs] == [2 * i for i in range(10)]
+    pids = {o["pid"] for o in outs}
+    assert os.getpid() not in pids, "replica ran in the driver process"
+    assert all(o["node"] in ("head", "n1") for o in outs)
+    serve.delete("capp")
