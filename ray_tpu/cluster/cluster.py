@@ -55,7 +55,12 @@ class NodeProc:
         self.addr = addr
 
     def kill(self) -> None:
-        """SIGKILL the daemon AND its workers (the whole node dies)."""
+        """SIGKILL the daemon AND its workers (the whole node dies).
+
+        A killed daemon can't unlink its tmpfs object-store file (graceful
+        stop() does); sweep it here or crash-kill tests leak /dev/shm at
+        ~hundreds of MB per run."""
+        self._unlink_store()
         try:
             import signal
 
@@ -65,6 +70,17 @@ class NodeProc:
                 self.proc.kill()
             except Exception:
                 pass
+
+    def _unlink_store(self) -> None:
+        shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else (
+            os.environ.get("TMPDIR", "/tmp")
+        )
+        try:
+            os.unlink(os.path.join(
+                shm_dir, f"ray_tpu-store-{self.node_id}-{self.proc.pid}"
+            ))
+        except OSError:
+            pass
 
 
 class LocalCluster:
