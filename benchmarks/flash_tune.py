@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import functools
 import json
+import os
 import time
 
 import jax
@@ -16,7 +17,9 @@ import jax.numpy as jnp
 from ray_tpu.ops.attention import xla_attention
 from ray_tpu.ops.flash import flash_attention
 
-B, S, H, KV, D = 8, 1024, 16, 8, 64
+B = int(os.environ.get("TUNE_B", 8))
+S = int(os.environ.get("TUNE_S", 1024))
+H, KV, D = 16, 8, 64
 
 
 def bench(fn, q, k, v, iters=30):
@@ -40,20 +43,24 @@ def main():
     v = jax.random.normal(jax.random.key(2), (B, S, KV, D), jnp.bfloat16)
 
     dt = bench(functools.partial(xla_attention, causal=True), q, k, v)
-    print(json.dumps({"tag": "xla", "fwdbwd_ms": round(dt * 1e3, 2)}), flush=True)
+    print(json.dumps({"tag": "xla", "S": S, "fwdbwd_ms": round(dt * 1e3, 2)}),
+          flush=True)
 
-    for bq, bk in [(512, 1024), (1024, 1024), (256, 1024), (128, 1024),
-                   (1024, 256)]:
+    cfgs = [(bq, bk, f) for bk in (1024, 2048, 4096) if bk <= S
+            for bq in (256, 512, 1024) for f in (1, 2)]
+    if S < 1024:
+        cfgs = [(512, S, 1), (512, S, 2)]
+    for bq, bk, fold in cfgs:
         try:
             f = functools.partial(
                 flash_attention, causal=True, block_q=bq, block_k=bk,
-                interpret=False,
+                fold_heads=fold, interpret=False,
             )
             dt = bench(f, q, k, v)
-            print(json.dumps({"tag": f"flash_{bq}x{bk}",
+            print(json.dumps({"tag": f"flash_{bq}x{bk}_f{fold}", "S": S,
                               "fwdbwd_ms": round(dt * 1e3, 2)}), flush=True)
         except Exception as e:  # noqa: BLE001
-            print(json.dumps({"tag": f"flash_{bq}x{bk}",
+            print(json.dumps({"tag": f"flash_{bq}x{bk}_f{fold}", "S": S,
                               "error": repr(e)[:160]}), flush=True)
 
 

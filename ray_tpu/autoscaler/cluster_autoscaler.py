@@ -56,9 +56,38 @@ class LocalClusterNodeProvider(NodeProvider):
         return {}
 
     def is_idle(self, node_id: str) -> bool:
-        for n in self._cluster.client().nodes():
-            if n["node_id"] == node_id:
-                return n.get("available") == n.get("resources")
+        """Idle = no resources in use AND no live leases AND no stored
+        objects. Resource counters alone are not enough: zero-resource
+        actors consume nothing (the node reads available==total), and a
+        resource-idle node can hold the only copy of task-return objects
+        — terminating it would destroy both without drain (reference:
+        the autoscaler counts object-store usage and active workers
+        toward idleness, autoscaler/_private/autoscaler.py)."""
+        client = self._cluster.client()
+        for n in client.nodes():
+            if n["node_id"] != node_id:
+                continue
+            if n.get("available") != n.get("resources"):
+                return False
+            try:
+                # direct short-timeout client, NOT client.pool (the pool
+                # dials with timeout=120s x retries — a hung daemon would
+                # freeze the whole reconcile thread for minutes)
+                from ray_tpu.cluster.rpc import RpcClient
+
+                host, port = tuple(n["addr"])
+                c = RpcClient(host, int(port), timeout=5.0).connect(retries=0)
+                try:
+                    stats = c.call("stats", None, timeout=5)
+                finally:
+                    c.close()
+            except Exception:
+                return False  # unreachable ≠ provably idle; don't kill
+            if stats.get("num_leases", 0) > 0:
+                return False
+            if stats.get("objects", {}).get("num_objects", 0) > 0:
+                return False
+            return True
         return True
 
 

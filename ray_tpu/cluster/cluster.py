@@ -72,9 +72,9 @@ class NodeProc:
                 pass
 
     def _unlink_store(self) -> None:
-        shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else (
-            os.environ.get("TMPDIR", "/tmp")
-        )
+        from ray_tpu.utils.shm import shm_dir as _shm_dir
+
+        shm_dir = _shm_dir()
         try:
             os.unlink(os.path.join(
                 shm_dir, f"ray_tpu-store-{self.node_id}-{self.proc.pid}"

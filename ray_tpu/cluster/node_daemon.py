@@ -456,9 +456,9 @@ class NodeDaemon:
         # reconnecting: the GCS may restart (FT snapshot) and come back at
         # the same address; the daemon must ride through the outage
         self.gcs = ReconnectingRpcClient(*gcs_addr).connect(retries=20)
-        shm_dir = "/dev/shm" if os.path.isdir("/dev/shm") else (
-            os.environ.get("TMPDIR", "/tmp")
-        )
+        from ray_tpu.utils.shm import shm_dir as _shm_dir
+
+        shm_dir = _shm_dir()
         _sweep_stale_stores(shm_dir)
         self.objects = ObjectService(
             self.node_id, self.gcs, self.pool,

@@ -50,7 +50,10 @@ class ShmChannel:
         self.name = name or uuid.uuid4().hex
         self.num_readers = num_readers
         self.maxsize = max(1, maxsize)
-        self.store_path = store_path or f"/dev/shm/ray_tpu-chan-{self.name[:16]}"
+        from ray_tpu.utils.shm import shm_dir
+
+        self.store_path = store_path or os.path.join(
+            shm_dir(), f"ray_tpu-chan-{self.name[:16]}")
         self._capacity = capacity
         self._creator = False
         self._store = None

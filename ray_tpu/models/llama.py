@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from ray_tpu.nn.layers import (
     apply_rope,
     cross_entropy_loss,
+    fused_cross_entropy_loss,
     init_dense,
     rms_norm,
     rope_frequencies,
@@ -200,7 +201,7 @@ def _block(
     return h + swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
 
 
-def forward(
+def hidden_states(
     params: Params,
     tokens: jax.Array,  # [B, S] int32
     config: LlamaConfig,
@@ -208,7 +209,11 @@ def forward(
     positions: Optional[jax.Array] = None,
     segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Full-sequence forward -> logits [B, S, V] (loss-dtype fp32 left to caller)."""
+    """Full-sequence forward up to the final norm -> h [B, S, D].
+
+    The training loss pairs this with nn.layers.fused_cross_entropy_loss
+    so the [T, V] logits never exist as a stored fp32 tensor; serving
+    keeps using forward() -> logits."""
     c = config
     B, S = tokens.shape
     if S > c.max_seq:
@@ -271,12 +276,31 @@ def forward(
     else:
         h, _ = jax.lax.scan(lambda carry, lp: (block(carry, lp), None), h, params["layers"])
 
-    h = rms_norm(h, params["final_norm"], c.rms_eps)
+    return rms_norm(h, params["final_norm"], c.rms_eps)
+
+
+def output_weight(params: Params) -> jax.Array:
+    """[D, V] lm-head weight (tied embedding transpose when untied absent)."""
     w_out = params.get("lm_head", None)
     if w_out is None:
         w_out = params["embed"].T
-    logits = jnp.einsum("bsd,dv->bsv", h, w_out.astype(c.dtype))
-    return logits
+    return w_out
+
+
+def forward(
+    params: Params,
+    tokens: jax.Array,  # [B, S] int32
+    config: LlamaConfig,
+    *,
+    positions: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Full-sequence forward -> logits [B, S, V] (loss-dtype fp32 left to caller)."""
+    h = hidden_states(
+        params, tokens, config, positions=positions, segment_ids=segment_ids
+    )
+    w_out = output_weight(params)
+    return jnp.einsum("bsd,dv->bsv", h, w_out.astype(config.dtype))
 
 
 def loss_fn(
@@ -293,8 +317,25 @@ def loss_and_weight_fn(
     batch: dict[str, jax.Array],
     config: LlamaConfig,
 ) -> tuple[jax.Array, jax.Array]:
-    """(mean_loss, valid_token_count) — the weighted form grad-accum needs."""
-    logits = forward(
+    """(mean_loss, valid_token_count) — the weighted form grad-accum needs.
+
+    Uses the fused lm-head + CE (nn/layers.py fused_cross_entropy_loss):
+    the [T, V] fp32 logits/softmax pipeline was ~36% of the flagship
+    train step before fusion (round-5 profile)."""
+    import os
+
+    # A/B probe hook (benchmarks). Read at TRACE time: flipping it in a
+    # process that already compiled the step has no effect — set it in a
+    # fresh process (the benchmark harnesses fork per variant).
+    if os.environ.get("RAY_TPU_NAIVE_CE"):
+        logits = forward(
+            params, batch["tokens"], config,
+            segment_ids=batch.get("segment_ids"),
+        )
+        return cross_entropy_loss(logits, batch["targets"], batch.get("mask"))
+    h = hidden_states(
         params, batch["tokens"], config, segment_ids=batch.get("segment_ids")
     )
-    return cross_entropy_loss(logits, batch["targets"], batch.get("mask"))
+    return fused_cross_entropy_loss(
+        h, output_weight(params), batch["targets"], batch.get("mask")
+    )

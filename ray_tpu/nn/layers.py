@@ -75,4 +75,96 @@ def cross_entropy_loss(
     return (nll * mask).sum() / total, total
 
 
+# ---------------------------------------------------------------------------
+# fused lm-head + cross entropy
+# ---------------------------------------------------------------------------
+#
+# The naive path (forward() -> [T, V] logits -> cross_entropy_loss) is
+# HBM-bound, not MXU-bound: XLA materializes the fp32 logits, the
+# logsumexp intermediates, the take_along_axis gather, and the softmax
+# in the backward — ~79 ms of the 221 ms flagship step at B=8/S=1024/
+# V=32000 (benchmarks/profile_step2.py, round 5) against an ~8 ms MXU
+# floor for the three head matmuls. This custom-VJP version:
+#   * forward: ONE [T, V] fp32 materialization (the matmul output),
+#     read twice (lse, gold-via-iota-compare); no gather;
+#   * backward: recomputes logits (one extra matmul — cheaper than
+#     storing [T, V]), forms d_logits = (softmax - onehot) * coef in
+#     one fused pass in bf16, then the two grad matmuls;
+#   * residuals are h, w, lse, gold — O(T) not O(T*V).
+# The reference delegates this to torch CE inside vLLM/torch workers;
+# the TPU design needs it fused for the same reason flash attention
+# does (HBM bandwidth is the ceiling, SURVEY §5.7).
+
+
+@jax.custom_vjp
+def _fused_nll(h, w, targets):
+    """Per-token negative log-likelihood of a linear head.
+
+    h: [T, D] (bf16 typical), w: [D, V], targets: [T] int32 -> [T] f32.
+    """
+    nll, _ = _fused_nll_fwd(h, w, targets)
+    return nll
+
+
+def _logits_f32(h, w):
+    return jax.lax.dot_general(
+        h, w.astype(h.dtype), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )  # [T, V] fp32 accumulation off bf16 operands (full-rate MXU)
+
+
+def _fused_nll_fwd(h, w, targets):
+    logits = _logits_f32(h, w)
+    m = jnp.max(logits, axis=-1)
+    lse = m + jnp.log(jnp.sum(jnp.exp(logits - m[:, None]), axis=-1))
+    # gold logit via iota-compare reduction: a [T, V] compare+select
+    # feeding a row sum fuses into one pass; take_along_axis lowers to
+    # a slow TPU gather (and a scatter in the backward)
+    V = w.shape[1]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, V), 1)
+    gold = jnp.sum(
+        jnp.where(iota == targets[:, None], logits, 0.0), axis=-1
+    )
+    return lse - gold, (h, w, targets, lse)
+
+
+def _fused_nll_bwd(res, g):  # g: [T] f32 cotangent of nll
+    h, w, targets, lse = res
+    logits = _logits_f32(h, w)  # recompute: cheaper than storing [T, V]
+    V = w.shape[1]
+    iota = jax.lax.broadcasted_iota(jnp.int32, (1, V), 1)
+    p = jnp.exp(logits - lse[:, None])
+    onehot = (iota == targets[:, None]).astype(jnp.float32)
+    dl = ((p - onehot) * g[:, None]).astype(h.dtype)  # [T, V] bf16
+    dh = jax.lax.dot_general(
+        dl, w.astype(h.dtype), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(h.dtype)
+    dw = jax.lax.dot_general(
+        h, dl, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ).astype(w.dtype)
+    return dh, dw, None
+
+
+_fused_nll.defvjp(_fused_nll_fwd, _fused_nll_bwd)
+
+
+def fused_cross_entropy_loss(
+    h: jax.Array,        # [B, S, D] final hidden states (pre lm-head)
+    w: jax.Array,        # [D, V] lm-head weight
+    targets: jax.Array,  # [B, S] int32
+    mask: jax.Array | None = None,  # [B, S] 0/1
+) -> tuple[jax.Array, jax.Array]:
+    """(mean_nll, total_weight) without materializing fp32 softmax state."""
+    B, S, D = h.shape
+    nll = _fused_nll(h.reshape(B * S, D), w, targets.reshape(B * S))
+    nll = nll.reshape(B, S)
+    if mask is None:
+        mask = jnp.ones_like(nll)
+    mask = mask.astype(jnp.float32)
+    total = jnp.maximum(mask.sum(), 1.0)
+    return (nll * mask).sum() / total, total
+
+
 Initializer = Callable[[jax.Array, tuple[int, ...]], jax.Array]

@@ -44,14 +44,44 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# v5e-tuned: large blocks amortize per-program overhead (the dominant
-# cost at small head_dim — a (128,128) grid at B=8/H=16/S=1024 is 8192
-# near-empty programs) and are clamped to the padded sequence length for
-# short inputs. Sweep on hardware: 128x128 13.1ms, 256x512 5.8ms,
-# 512x1024 4.7ms fwd+bwd vs 8.4ms for XLA attention at that shape.
+# v5e-tuned (round-5 sweep, benchmarks/flash_tune.py at B=8/H=16/KVH=8/
+# D=64). Two structural facts drive the defaults:
+#  * the FUSED backward (whole kv sequence in one block, nk == 1) beats
+#    the two-kernel path at every sequence length once sub-tiling gives
+#    it back block-causal skipping: S=2048 7.06ms vs 8.74, S=4096
+#    12.4 vs 14.5 (fwd+bwd per layer; XLA attention 24.4 / 47.0);
+#  * VMEM bounds the fused block: dk/dv fp32 scratch is block_k*D*8
+#    bytes, so block_k caps at 4096 (S=8192: bk=4096 27.8ms, bk=8192
+#    fails to compile).
 DEFAULT_BLOCK_Q = 512
-DEFAULT_BLOCK_K = 1024
+MAX_BLOCK_K = 4096  # fused whole-sequence kv block, VMEM-capped
 NEG_INF = -1e30  # true -inf breeds NaN via (-inf) - (-inf)
+
+
+def _fold_rows_cap(block_k: int) -> int:
+    """VMEM-safe rows-per-program for a given kv block (measured: rows
+    1024 compiles at bk<=2048, only 512 at bk=4096)."""
+    return 1024 if block_k <= 2048 else 512
+
+
+def _fold_factor(group: int, block_q: int, block_k: int,
+                 override: Optional[int]) -> int:
+    """GQA head folding: process F q-heads sharing one kv head in ONE
+    program, stacked along the row (sublane) dim — the kv tile is
+    fetched once per group instead of once per q-head, and at head_dim
+    64 a lone [Bq, 64] tile wastes half the 128-lane width. F is the
+    largest divisor of `group` keeping F*block_q inside the VMEM-safe
+    row cap (fold=2 at S>=2048 measured 0.9-1.5ms/layer faster)."""
+    if override is not None:
+        if group % override != 0:
+            raise ValueError(f"fold_heads {override} must divide group {group}")
+        return override
+    cap = _fold_rows_cap(block_k)
+    f = 1
+    for cand in range(1, group + 1):
+        if group % cand == 0 and cand * block_q <= cap:
+            f = cand
+    return f
 
 
 def _round_up(x: int, m: int) -> int:
@@ -63,17 +93,58 @@ def _round_up(x: int, m: int) -> int:
 # ---------------------------------------------------------------------------
 
 
+def _block_mask(i, k_base, Bq, Tk, *, causal, q_offset, sq_valid, sk_valid,
+                has_segments, kpad, qpad, qseg_ref, kseg):
+    """[Bq, Tk] validity mask for q-block i vs kv positions starting at
+    k_base, or None.
+
+    Every term depends only on the position WITHIN the q block, so with
+    head folding the folded [F*Bq, Tk] tile reuses one [Bq, Tk] mask
+    broadcast across the F stacked heads. Terms are STATICALLY gated:
+    each skipped term saves VPU passes over the tile and the kernel is
+    VPU-bound — on the common path (causal, no packing, no pad) only
+    the triangle compare survives.
+    """
+    mask = None
+    if causal or kpad:
+        k_pos = k_base + jax.lax.broadcasted_iota(jnp.int32, (1, Tk), 1)
+    if causal or qpad:
+        q_pos = (
+            q_offset + i * Bq
+            + jax.lax.broadcasted_iota(jnp.int32, (Bq, 1), 0)
+        )
+    if kpad:
+        mask = k_pos < sk_valid
+    if qpad:
+        qm = q_pos - q_offset < sq_valid
+        mask = qm if mask is None else mask & qm
+    if causal:
+        cm = q_pos >= k_pos
+        mask = cm if mask is None else mask & cm
+    if has_segments:
+        sm = qseg_ref[0] == kseg  # [Bq,1] == [1,Tk]
+        mask = sm if mask is None else mask & sm
+    return mask
+
+
+def _expand_mask(mask, F, Bq, Bk):
+    """Tile a [Bq, Bk] mask across the F folded heads -> [F*Bq, Bk]."""
+    if mask is None or F == 1:
+        return mask
+    return jnp.broadcast_to(mask[None], (F, Bq, Bk)).reshape(F * Bq, Bk)
+
+
 def _fwd_kernel(
-    q_ref,      # [1, 1, Bq, D]
+    q_ref,      # [1, F, Bq, D]  (F q-heads sharing this kv head)
     k_ref,      # [1, 1, Bk, D]
     v_ref,      # [1, 1, Bk, D]
     qseg_ref,   # [1, Bq, 1]
     kseg_ref,   # [1, 1, Bk]
-    o_ref,      # [1, 1, Bq, D]   (revisited across kv blocks)
-    lse_ref,    # [1, 1, Bq, 1]
-    m_scr,      # [Bq, 1] fp32
-    l_scr,      # [Bq, 1] fp32
-    acc_scr,    # [Bq, D] fp32
+    o_ref,      # [1, F, Bq, D]   (revisited across kv blocks)
+    lse_ref,    # [1, F, Bq, 1]
+    m_scr,      # [F*Bq, 1] fp32
+    l_scr,      # [F*Bq, 1] fp32
+    acc_scr,    # [F*Bq, D] fp32
     *,
     scale: float,
     causal: bool,
@@ -81,12 +152,16 @@ def _fwd_kernel(
     sk_valid: int,
     has_segments: bool,
     kpad: bool,
+    sub_k: int = 512,
 ):
     i = pl.program_id(2)
     j = pl.program_id(3)
     nk = pl.num_programs(3)
-    Bq, D = q_ref.shape[2], q_ref.shape[3]
+    F, Bq, D = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
     Bk = k_ref.shape[2]
+    rows = F * Bq
+    Tk = sub_k if Bk % sub_k == 0 else Bk  # sub-tiles must cover Bk exactly
+    nt = Bk // Tk
 
     @pl.when(j == 0)
     def _():
@@ -94,65 +169,68 @@ def _fwd_kernel(
         l_scr[...] = jnp.zeros_like(l_scr)
         acc_scr[...] = jnp.zeros_like(acc_scr)
 
-    # causal: whole block above the diagonal contributes nothing
-    run = True
-    if causal:
-        run = q_offset + (i + 1) * Bq - 1 >= j * Bk
-
-    @pl.when(run)
-    def _():
-        # matmuls stay in the INPUT dtype (bf16 on the training path) with
-        # fp32 ACCUMULATION: a v5e MXU runs bf16xbf16->f32 at full rate but
-        # f32xf32 several times slower — upcasting operands here was the
-        # single biggest flash-vs-XLA perf gap. Softmax math stays fp32.
-        q = q_ref[0, 0]
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        s = jax.lax.dot_general(
-            q, k,
-            (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [Bq, Bk] fp32
-        # mask terms are STATICALLY gated: every skipped term saves VPU
-        # passes over the [Bq, Bk] tile, and the kernel is VPU-bound —
-        # on the common path (causal, no packing, no pad) only the
-        # triangle compare survives
-        mask = None
-        if causal or kpad:
-            k_pos = j * Bk + jax.lax.broadcasted_iota(jnp.int32, (1, Bk), 1)
-        if kpad:
-            mask = k_pos < sk_valid
+    # The kv block is walked in sub-tiles of Tk with a PER-SUB-TILE
+    # causal skip: with the whole kv sequence in one block (the layout
+    # the fused backward wants), block-level skipping can't act and
+    # ~half the softmax VPU work lands on masked entries — sub-tiling
+    # restores causal-proportional cost while keeping nk == 1.
+    def tile(t: int):
+        lo = t * Tk
+        k_base = j * Bk + lo
+        run = True
         if causal:
-            q_pos = (
-                q_offset + i * Bq
-                + jax.lax.broadcasted_iota(jnp.int32, (Bq, 1), 0)
-            )
-            cm = q_pos >= k_pos
-            mask = cm if mask is None else mask & cm
-        if has_segments:
-            sm = qseg_ref[0] == kseg_ref[0]  # [Bq,1] == [1,Bk]
-            mask = sm if mask is None else mask & sm
-        if mask is not None:
-            s = jnp.where(mask, s, NEG_INF)
+            run = q_offset + (i + 1) * Bq - 1 >= k_base
 
-        m_prev = m_scr[...]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        p = jnp.exp(s - m_new)  # masked entries: exp(NEG_INF - m) == 0
-        alpha = jnp.exp(m_prev - m_new)
-        m_scr[...] = m_new
-        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v,
-            (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )
+        def body():
+            # matmuls stay in the INPUT dtype (bf16 on the training path)
+            # with fp32 ACCUMULATION: a v5e MXU runs bf16xbf16->f32 at full
+            # rate but f32xf32 several times slower — upcasting operands
+            # here was the single biggest flash-vs-XLA perf gap. Softmax
+            # math stays fp32.
+            q = q_ref[0].reshape(rows, D)  # folded heads stacked along rows
+            k = k_ref[0, 0, lo:lo + Tk]
+            v = v_ref[0, 0, lo:lo + Tk]
+            s = jax.lax.dot_general(
+                q, k,
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [rows, Tk] fp32
+            if scale != 1.0:  # hot path pre-scales q; kernel mul only if not
+                s = s * scale
+            mask = _expand_mask(
+                _block_mask(i, k_base, Bq, Tk, causal=causal,
+                            q_offset=q_offset, sq_valid=0, sk_valid=sk_valid,
+                            has_segments=has_segments, kpad=kpad, qpad=False,
+                            qseg_ref=qseg_ref,
+                            kseg=kseg_ref[0, :, lo:lo + Tk]),
+                F, Bq, Tk,
+            )
+            if mask is not None:
+                s = jnp.where(mask, s, NEG_INF)
+
+            m_prev = m_scr[...]
+            m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+            p = jnp.exp(s - m_new)  # masked entries: exp(NEG_INF - m) == 0
+            alpha = jnp.exp(m_prev - m_new)
+            m_scr[...] = m_new
+            l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+            acc_scr[...] = acc_scr[...] * alpha + jax.lax.dot_general(
+                p.astype(v.dtype), v,
+                (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        pl.when(run)(body)
+
+    for t in range(nt):
+        tile(t)
 
     @pl.when(j == nk - 1)
     def _():
         l = l_scr[...]
         safe_l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
-        o_ref[0, 0] = (acc_scr[...] / safe_l).astype(o_ref.dtype)
-        lse_ref[0, 0] = m_scr[...] + jnp.log(safe_l)
+        o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype).reshape(F, Bq, D)
+        lse_ref[0] = (m_scr[...] + jnp.log(safe_l)).reshape(F, Bq, 1)
 
 
 # ---------------------------------------------------------------------------
@@ -162,8 +240,8 @@ def _fwd_kernel(
 
 def _dq_kernel(
     q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref, delta_ref,
-    dq_ref,     # [1, 1, Bq, D] (revisited across kv blocks)
-    dq_scr,     # [Bq, D] fp32
+    dq_ref,     # [1, F, Bq, D] (revisited across kv blocks)
+    dq_scr,     # [F*Bq, D] fp32
     *,
     scale: float,
     causal: bool,
@@ -175,8 +253,9 @@ def _dq_kernel(
     i = pl.program_id(2)
     j = pl.program_id(3)
     nk = pl.num_programs(3)
-    Bq = q_ref.shape[2]
+    F, Bq, D = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
     Bk = k_ref.shape[2]
+    rows = F * Bq
 
     @pl.when(j == 0)
     def _():
@@ -188,42 +267,38 @@ def _dq_kernel(
 
     @pl.when(run)
     def _():
-        q = q_ref[0, 0]
-        do = do_ref[0, 0]
-        lse = lse_ref[0, 0]      # [Bq, 1]
-        delta = delta_ref[0, 0]  # [Bq, 1]
+        q = q_ref[0].reshape(rows, D)
+        do = do_ref[0].reshape(rows, D)
+        lse = lse_ref[0].reshape(rows, 1)
+        delta = delta_ref[0].reshape(rows, 1)
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         # input-dtype matmuls, fp32 accumulation (see _fwd_kernel note)
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale
-        mask = None
-        if causal or kpad:
-            k_pos = j * Bk + jax.lax.broadcasted_iota(jnp.int32, (1, Bk), 1)
-        if kpad:
-            mask = k_pos < sk_valid
-        if causal:
-            q_pos = (
-                q_offset + i * Bq
-                + jax.lax.broadcasted_iota(jnp.int32, (Bq, 1), 0)
-            )
-            cm = q_pos >= k_pos
-            mask = cm if mask is None else mask & cm
-        if has_segments:
-            sm = qseg_ref[0] == kseg_ref[0]
-            mask = sm if mask is None else mask & sm
+        )
+        if scale != 1.0:
+            s = s * scale
         # explicit where: exp(s - lse) is garbage on fully-masked rows
         p = jnp.exp(s - lse)
+        mask = _expand_mask(
+            _block_mask(i, j * Bk, Bq, Bk, causal=causal,
+                        q_offset=q_offset, sq_valid=0, sk_valid=sk_valid,
+                        has_segments=has_segments, kpad=kpad, qpad=False,
+                        qseg_ref=qseg_ref, kseg=kseg_ref[0]),
+            F, Bq, Bk,
+        )
         if mask is not None:
-            p = jnp.where(mask, p, 0.0)  # [Bq, Bk]
+            p = jnp.where(mask, p, 0.0)  # [rows, Bk]
         dp = jax.lax.dot_general(
             do, v,
             (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        ds = p * (dp - delta) * scale
+        ds = p * (dp - delta)
+        if scale != 1.0:
+            ds = ds * scale
         dq_scr[...] += jax.lax.dot_general(
             ds.astype(k.dtype), k,
             (((1,), (0,)), ((), ())),
@@ -232,18 +307,18 @@ def _dq_kernel(
 
     @pl.when(j == nk - 1)
     def _():
-        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype).reshape(F, Bq, D)
 
 
 def _dkv_kernel(
-    q_ref,      # [1, 1, Bq, D]
+    q_ref,      # [1, F, Bq, D]
     k_ref,      # [1, 1, Bk, D]  (resident across the h-group and q blocks)
     v_ref,      # [1, 1, Bk, D]
     qseg_ref,   # [1, Bq, 1]
     kseg_ref,   # [1, 1, Bk]
-    do_ref,     # [1, 1, Bq, D]
-    lse_ref,    # [1, 1, Bq, 1]
-    delta_ref,  # [1, 1, Bq, 1]
+    do_ref,     # [1, F, Bq, D]
+    lse_ref,    # [1, F, Bq, 1]
+    delta_ref,  # [1, F, Bq, 1]
     dk_ref,     # [1, 1, Bk, D]  (revisited: written once per kv block)
     dv_ref,
     dk_scr,     # [Bk, D] fp32
@@ -254,92 +329,102 @@ def _dkv_kernel(
     q_offset: int,
     sq_valid: int,
     sk_valid: int,
-    group: int,
+    group: int,  # head-group PROGRAMS per kv head = G // F
     has_segments: bool,
     kpad: bool,
     qpad: bool,
     fused_dq: bool = False,
-    dq_ref=None,  # fused mode only: [1, 1, Bq, D], written per (h, i)
+    dq_ref=None,  # fused mode only: [1, F, Bq, D], written per (h, i)
+    dq_scr=None,  # fused mode only: [F*Bq, D] fp32 (sub-tile accumulator)
+    sub_k: int = 512,
 ):
-    # grid (B, nk, H, nq): q-blocks fastest, then the q-heads sharing this
-    # kv head; scratch accumulates until both inner dims finish. In FUSED
-    # mode (nk == 1, the whole kv sequence in one block) this kernel also
-    # emits dq — a q-block's dq needs no cross-j accumulation then, which
-    # deletes the separate dq kernel's full s/p/dp recompute.
+    # grid (B, nk, H/F, nq): q-blocks fastest, then the head groups
+    # sharing this kv head; scratch accumulates until both inner dims
+    # finish. With folding the F q-heads of a group ride ONE program
+    # stacked along rows — the p^T@do / ds^T@q contractions then sum
+    # over the group for free. In FUSED mode (nk == 1, the whole kv
+    # sequence in one block) this kernel also emits dq — a q-block's dq
+    # needs no cross-j accumulation then, which deletes the separate dq
+    # kernel's full s/p/dp recompute. Like the forward, the kv block is
+    # walked in causally-skipped sub-tiles (see _fwd_kernel).
     jk = pl.program_id(1)
     h = pl.program_id(2)
     i = pl.program_id(3)
     nq = pl.num_programs(3)
-    Bq = q_ref.shape[2]
+    F, Bq, D = q_ref.shape[1], q_ref.shape[2], q_ref.shape[3]
     Bk = k_ref.shape[2]
+    rows = F * Bq
+    Tk = sub_k if Bk % sub_k == 0 else Bk
+    nt = Bk // Tk
 
     @pl.when((h % group == 0) & (i == 0))
     def _():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
 
-    run = True
-    if causal:
-        run = q_offset + (i + 1) * Bq - 1 >= jk * Bk
-    if fused_dq and causal:
-        # a causally-skipped program must still define its dq block
-        @pl.when(jnp.logical_not(run))
-        def _():
-            dq_ref[0, 0] = jnp.zeros_like(dq_ref[0, 0])
+    if fused_dq:
+        dq_scr[...] = jnp.zeros_like(dq_scr)  # every program owns its dq
 
-    @pl.when(run)
-    def _():
-        # input-dtype matmuls, fp32 accumulation (see _fwd_kernel note)
-        k = k_ref[0, 0]
-        v = v_ref[0, 0]
-        q = q_ref[0, 0]
-        do = do_ref[0, 0]
-        lse = lse_ref[0, 0]      # [Bq, 1]
-        delta = delta_ref[0, 0]  # [Bq, 1]
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        ) * scale  # [Bq, Bk]
-        mask = None
-        if causal or kpad:
-            k_pos = jk * Bk + jax.lax.broadcasted_iota(jnp.int32, (1, Bk), 1)
-        if causal or qpad:
-            q_pos = (
-                q_offset + i * Bq
-                + jax.lax.broadcasted_iota(jnp.int32, (Bq, 1), 0)
-            )
-        if kpad:
-            mask = k_pos < sk_valid
-        if qpad:
-            qm = q_pos - q_offset < sq_valid
-            mask = qm if mask is None else mask & qm
+    def tile(t: int):
+        lo = t * Tk
+        k_base = jk * Bk + lo
+        run = True
         if causal:
-            cm = q_pos >= k_pos
-            mask = cm if mask is None else mask & cm
-        if has_segments:
-            sm = qseg_ref[0] == kseg_ref[0]
-            mask = sm if mask is None else mask & sm
-        p = jnp.exp(s - lse)
-        if mask is not None:
-            p = jnp.where(mask, p, 0.0)
-        dv_scr[...] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [Bk, D]
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [Bq, Bk]
-        ds = p * (dp - delta) * scale
-        dk_scr[...] += jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-        )  # [Bk, D]
-        if fused_dq:
-            dq_ref[0, 0] = jax.lax.dot_general(
-                ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            run = q_offset + (i + 1) * Bq - 1 >= k_base
+
+        def body():
+            # input-dtype matmuls, fp32 accumulation (see _fwd_kernel note)
+            k = k_ref[0, 0, lo:lo + Tk]
+            v = v_ref[0, 0, lo:lo + Tk]
+            q = q_ref[0].reshape(rows, D)
+            do = do_ref[0].reshape(rows, D)
+            lse = lse_ref[0].reshape(rows, 1)
+            delta = delta_ref[0].reshape(rows, 1)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            ).astype(dq_ref.dtype)
+            )  # [rows, Tk]
+            if scale != 1.0:
+                s = s * scale
+            p = jnp.exp(s - lse)
+            mask = _expand_mask(
+                _block_mask(i, k_base, Bq, Tk, causal=causal,
+                            q_offset=q_offset, sq_valid=sq_valid,
+                            sk_valid=sk_valid, has_segments=has_segments,
+                            kpad=kpad, qpad=qpad, qseg_ref=qseg_ref,
+                            kseg=kseg_ref[0, :, lo:lo + Tk]),
+                F, Bq, Tk,
+            )
+            if mask is not None:
+                p = jnp.where(mask, p, 0.0)
+            dv_scr[lo:lo + Tk] += jax.lax.dot_general(
+                p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [Tk, D]
+            dp = jax.lax.dot_general(
+                do, v, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [rows, Tk]
+            ds = p * (dp - delta)
+            if scale != 1.0:
+                ds = ds * scale
+            dk_scr[lo:lo + Tk] += jax.lax.dot_general(
+                ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )  # [Tk, D]
+            if fused_dq:
+                dq_scr[...] += jax.lax.dot_general(
+                    ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )
+
+        pl.when(run)(body)
+
+    for t in range(nt):
+        tile(t)
+
+    if fused_dq:
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype).reshape(F, Bq, D)
 
     @pl.when((h % group == group - 1) & (i == nq - 1))
     def _():
@@ -353,10 +438,12 @@ def _dkv_kernel(
 
 
 def _fwd_call(q, k, v, qseg, kseg, scale, causal, q_offset, block_q, block_k,
-              sk_valid, interpret, has_segments):
+              sk_valid, interpret, has_segments, fold):
     B, H, Sq_pad, D = q.shape
     _, KVH, Sk_pad, _ = k.shape
     G = H // KVH
+    F = fold  # q-heads stacked per program (divides G)
+    HG = H // F
     nq = Sq_pad // block_q
     nk = Sk_pad // block_k
     kernel = functools.partial(
@@ -366,26 +453,26 @@ def _fwd_call(q, k, v, qseg, kseg, scale, causal, q_offset, block_q, block_k,
     )
     return pl.pallas_call(
         kernel,
-        grid=(B, H, nq, nk),
+        grid=(B, HG, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0)),
+            pl.BlockSpec((1, F, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h * F // G, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h * F // G, j, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, h, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, F, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, F, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Sq_pad, D), q.dtype),
             jax.ShapeDtypeStruct((B, H, Sq_pad, 1), jnp.float32),
         ],
         scratch_shapes=[
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((F * block_q, 1), jnp.float32),
+            pltpu.VMEM((F * block_q, 1), jnp.float32),
+            pltpu.VMEM((F * block_q, D), jnp.float32),
         ],
         interpret=interpret,
     )(q, k, v, qseg, kseg)
@@ -393,21 +480,24 @@ def _fwd_call(q, k, v, qseg, kseg, scale, causal, q_offset, block_q, block_k,
 
 def _bwd_fused_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref,
                       lse_ref, delta_ref, dq_ref, dk_ref, dv_ref,
-                      dk_scr, dv_scr, **statics):
+                      dk_scr, dv_scr, dq_scr, **statics):
     """nk == 1 backward: dq needs no cross-kv-block accumulation, so the
     dkv kernel emits it too — one s/p/dp computation instead of two."""
     return _dkv_kernel(
         q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref, lse_ref, delta_ref,
         dk_ref, dv_ref, dk_scr, dv_scr, fused_dq=True, dq_ref=dq_ref,
-        **statics,
+        dq_scr=dq_scr, **statics,
     )
 
 
 def _bwd_call(q, k, v, qseg, kseg, o, lse, do, scale, causal, q_offset,
-              block_q, block_k, sq_valid, sk_valid, interpret, has_segments):
+              block_q, block_k, sq_valid, sk_valid, interpret, has_segments,
+              fold):
     B, H, Sq_pad, D = q.shape
     _, KVH, Sk_pad, _ = k.shape
     G = H // KVH
+    F = fold
+    HG = H // F
     nq = Sq_pad // block_q
     nk = Sk_pad // block_k
     kpad = sk_valid != Sk_pad
@@ -421,23 +511,23 @@ def _bwd_call(q, k, v, qseg, kseg, o, lse, do, scale, causal, q_offset,
             functools.partial(
                 _bwd_fused_kernel, scale=scale, causal=causal,
                 q_offset=q_offset, sq_valid=sq_valid, sk_valid=sk_valid,
-                group=G, has_segments=has_segments, kpad=kpad, qpad=qpad,
+                group=G // F, has_segments=has_segments, kpad=kpad, qpad=qpad,
             ),
-            grid=(B, 1, H, nq),  # q-blocks fastest, then heads of the group
+            grid=(B, 1, HG, nq),  # q-blocks fastest, then groups per kv head
             in_specs=[
-                pl.BlockSpec((1, 1, block_q, D), lambda b, j, h, i: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h // G, j, 0)),
-                pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h // G, j, 0)),
+                pl.BlockSpec((1, F, block_q, D), lambda b, j, h, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h * F // G, j, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h * F // G, j, 0)),
                 pl.BlockSpec((1, block_q, 1), lambda b, j, h, i: (b, i, 0)),
                 pl.BlockSpec((1, 1, block_k), lambda b, j, h, i: (b, 0, j)),
-                pl.BlockSpec((1, 1, block_q, D), lambda b, j, h, i: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_q, 1), lambda b, j, h, i: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_q, 1), lambda b, j, h, i: (b, h, i, 0)),
+                pl.BlockSpec((1, F, block_q, D), lambda b, j, h, i: (b, h, i, 0)),
+                pl.BlockSpec((1, F, block_q, 1), lambda b, j, h, i: (b, h, i, 0)),
+                pl.BlockSpec((1, F, block_q, 1), lambda b, j, h, i: (b, h, i, 0)),
             ],
             out_specs=[
-                pl.BlockSpec((1, 1, block_q, D), lambda b, j, h, i: (b, h, i, 0)),
-                pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h // G, j, 0)),
-                pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h // G, j, 0)),
+                pl.BlockSpec((1, F, block_q, D), lambda b, j, h, i: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h * F // G, j, 0)),
+                pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h * F // G, j, 0)),
             ],
             out_shape=[
                 jax.ShapeDtypeStruct((B, H, Sq_pad, D), q.dtype),
@@ -447,6 +537,7 @@ def _bwd_call(q, k, v, qseg, kseg, o, lse, do, scale, causal, q_offset,
             scratch_shapes=[
                 pltpu.VMEM((block_k, D), jnp.float32),
                 pltpu.VMEM((block_k, D), jnp.float32),
+                pltpu.VMEM((F * block_q, D), jnp.float32),
             ],
             interpret=interpret,
         )(q, k, v, qseg, kseg, do, lse, delta)
@@ -458,45 +549,45 @@ def _bwd_call(q, k, v, qseg, kseg, o, lse, do, scale, causal, q_offset,
             q_offset=q_offset, sk_valid=sk_valid,
             has_segments=has_segments, kpad=kpad,
         ),
-        grid=(B, H, nq, nk),
+        grid=(B, HG, nq, nk),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h // G, j, 0)),
+            pl.BlockSpec((1, F, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h * F // G, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, h, i, j: (b, h * F // G, j, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, h, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, block_k), lambda b, h, i, j: (b, 0, j)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, F, block_q, D), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, F, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, F, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_specs=pl.BlockSpec(
-            (1, 1, block_q, D), lambda b, h, i, j: (b, h, i, 0)
+            (1, F, block_q, D), lambda b, h, i, j: (b, h, i, 0)
         ),
         out_shape=jax.ShapeDtypeStruct((B, H, Sq_pad, D), q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((F * block_q, D), jnp.float32)],
         interpret=interpret,
     )(q, k, v, qseg, kseg, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal,
-            q_offset=q_offset, sq_valid=sq_valid, sk_valid=sk_valid, group=G,
-            has_segments=has_segments, kpad=kpad, qpad=qpad,
+            q_offset=q_offset, sq_valid=sq_valid, sk_valid=sk_valid,
+            group=G // F, has_segments=has_segments, kpad=kpad, qpad=qpad,
         ),
-        grid=(B, nk, H, nq),  # q-blocks fastest, then heads of the group
+        grid=(B, nk, HG, nq),  # q-blocks fastest, then groups per kv head
         in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, j, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h // G, j, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h // G, j, 0)),
+            pl.BlockSpec((1, F, block_q, D), lambda b, j, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h * F // G, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h * F // G, j, 0)),
             pl.BlockSpec((1, block_q, 1), lambda b, j, h, i: (b, i, 0)),
             pl.BlockSpec((1, 1, block_k), lambda b, j, h, i: (b, 0, j)),
-            pl.BlockSpec((1, 1, block_q, D), lambda b, j, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, j, h, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, j, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, F, block_q, D), lambda b, j, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, F, block_q, 1), lambda b, j, h, i: (b, h, i, 0)),
+            pl.BlockSpec((1, F, block_q, 1), lambda b, j, h, i: (b, h, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h // G, j, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h // G, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h * F // G, j, 0)),
+            pl.BlockSpec((1, 1, block_k, D), lambda b, j, h, i: (b, h * F // G, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, KVH, Sk_pad, D), k.dtype),
@@ -516,18 +607,20 @@ def _bwd_call(q, k, v, qseg, kseg, o, lse, do, scale, causal, q_offset,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8, 9))
 def _flash(scale, causal, q_offset, block_q, block_k, sq_valid, sk_valid,
-           interpret, has_segments, q, k, v, qseg, kseg):
+           interpret, has_segments, fold, q, k, v, qseg, kseg):
     o, _ = _flash_fwd(scale, causal, q_offset, block_q, block_k, sq_valid,
-                      sk_valid, interpret, has_segments, q, k, v, qseg, kseg)
+                      sk_valid, interpret, has_segments, fold,
+                      q, k, v, qseg, kseg)
     return o
 
 
 def _flash_fwd(scale, causal, q_offset, block_q, block_k, sq_valid, sk_valid,
-               interpret, has_segments, q, k, v, qseg, kseg):
+               interpret, has_segments, fold, q, k, v, qseg, kseg):
     o, lse = _fwd_call(q, k, v, qseg, kseg, scale, causal, q_offset,
-                       block_q, block_k, sk_valid, interpret, has_segments)
+                       block_q, block_k, sk_valid, interpret, has_segments,
+                       fold)
     # named residuals: under jax.checkpoint, the backward re-runs this
     # whole kernel just to rebuild (o, lse) unless the remat policy can
     # SAVE them — the "dots" policy recognizes dot_general outputs, not a
@@ -538,11 +631,11 @@ def _flash_fwd(scale, causal, q_offset, block_q, block_k, sq_valid, sk_valid,
 
 
 def _flash_bwd(scale, causal, q_offset, block_q, block_k, sq_valid, sk_valid,
-               interpret, has_segments, residuals, do):
+               interpret, has_segments, fold, residuals, do):
     q, k, v, qseg, kseg, o, lse = residuals
     dq, dk, dv = _bwd_call(q, k, v, qseg, kseg, o, lse, do, scale, causal,
                            q_offset, block_q, block_k, sq_valid, sk_valid,
-                           interpret, has_segments)
+                           interpret, has_segments, fold)
     zero_seg = np.zeros(qseg.shape, dtype=jax.dtypes.float0)
     zero_kseg = np.zeros(kseg.shape, dtype=jax.dtypes.float0)
     return dq, dk, dv, zero_seg, zero_kseg
@@ -566,8 +659,9 @@ def flash_attention(
     q_offset: int | jax.Array = 0,
     softmax_scale: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK_Q,
-    block_k: int = DEFAULT_BLOCK_K,
+    block_k: Optional[int] = None,  # None = fused whole-sequence (VMEM-capped)
     interpret: Optional[bool] = None,
+    fold_heads: Optional[int] = None,  # None = auto (largest safe divisor of G)
 ) -> jax.Array:
     """Drop-in for ops.attention.xla_attention with O(S) memory."""
     B, Sq, H, D = q.shape
@@ -586,11 +680,23 @@ def flash_attention(
         interpret = jax.default_backend() != "tpu"
 
     # pad sequence dims to block multiples (sublane-aligned blocks for
-    # short test sequences)
+    # short test sequences). Default kv block = the whole padded
+    # sequence up to MAX_BLOCK_K: nk == 1 selects the fused backward,
+    # and in-kernel sub-tiling keeps causal skipping and VMEM bounded.
+    if block_k is None:
+        block_k = MAX_BLOCK_K
     bq = min(block_q, _round_up(Sq, 16))
     bk = min(block_k, _round_up(Sk, 16))
     Sq_pad = _round_up(Sq, bq)
     Sk_pad = _round_up(Sk, bk)
+
+    # Fold the softmax scale into q OUTSIDE the custom-vjp boundary: the
+    # kernels then skip the [rows, Bk] scale multiplies (one in fwd, two
+    # in bwd — they're VPU-bound), and the chain rule through this mul
+    # restores dq's scale automatically. fp32 mul, then back to input
+    # dtype (for D a power of 4 the scale is a power of two and exact).
+    q = (q.astype(jnp.float32) * scale).astype(q.dtype)
+    kernel_scale = 1.0
 
     # [B, S, H, D] -> [B, H, S, D]
     qt = jnp.transpose(q, (0, 2, 1, 3))
@@ -611,7 +717,8 @@ def flash_attention(
     qseg = qseg2[:, :, None]   # [B, Sq_pad, 1]
     kseg = kseg2[:, None, :]   # [B, 1, Sk_pad]
 
-    o = _flash(scale, causal, q_offset, bq, bk, Sq, Sk, interpret,
-               segment_ids is not None,
+    fold = _fold_factor(H // KVH, bq, bk, fold_heads)
+    o = _flash(kernel_scale, causal, q_offset, bq, bk, Sq, Sk, interpret,
+               segment_ids is not None, fold,
                qt, kt, vt, qseg, kseg)
     return jnp.transpose(o[:, :, :Sq, :], (0, 2, 1, 3))

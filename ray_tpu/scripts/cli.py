@@ -98,6 +98,17 @@ def cmd_start(args) -> int:
         state["procs"].append({"role": "gcs", "pid": gcs.pid})
         print(f"GCS started at {host_port}")
         address = host_port
+        if args.dashboard_port:
+            cmd = [
+                sys.executable, "-m", "ray_tpu.dashboard",
+                "--gcs", address, "--host", args.host,
+                "--port", str(args.dashboard_port),
+            ]
+            dash = _spawn(cmd, env, "dashboard.log")
+            dash_addr = _read_banner(dash, "DASHBOARD_ADDRESS")[0]
+            dash.stdout.close()
+            state["procs"].append({"role": "dashboard", "pid": dash.pid})
+            print(f"dashboard started at http://{dash_addr}")
     else:
         if not args.address:
             print("worker mode needs --address HOST:PORT", file=sys.stderr)
@@ -185,6 +196,8 @@ def main(argv: Optional[list] = None) -> int:
     ps.add_argument("--resources", default="num_cpus=1")
     ps.add_argument("--node-id", default=None)
     ps.add_argument("--persist", default=None, help="GCS snapshot path (FT)")
+    ps.add_argument("--dashboard-port", type=int, default=0,
+                    help="also start the dashboard on this port (head mode)")
     ps.add_argument("--object-capacity", type=int, default=None)
     ps.add_argument("--death-timeout", type=float, default=5.0)
     ps.set_defaults(fn=cmd_start)

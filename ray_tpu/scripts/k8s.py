@@ -89,6 +89,7 @@ def generate_manifests(
                                 ["python", "-m", "ray_tpu.scripts.cli", "start",
                                  "--head", "--host", "0.0.0.0",
                                  "--port", str(gcs_port),
+                                 "--dashboard-port", "8265",
                                  "--persist", "/var/lib/ray-tpu/gcs.snapshot",
                                  "--resources", "num_cpus=2"],
                                 {"cpu": "2", "memory": "4Gi"},

@@ -66,6 +66,12 @@ def test_cluster_autoscaler_scales_up_and_down():
             nodes_used = set(client.get(refs, timeout=90))
             assert len(nodes_used) >= 2  # work actually spread
 
+            # drop the task-return refs: a node holding the only copy of
+            # a live object is NOT idle (is_idle checks stored objects —
+            # terminating it would destroy them), so scale-down must wait
+            # for the refs to be freed cluster-wide
+            del refs
+
             # drain: demand gone, nodes idle -> reaped after idle_timeout
             deadline = time.monotonic() + 60
             while time.monotonic() < deadline:

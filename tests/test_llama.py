@@ -134,3 +134,32 @@ def test_grad_accum_matches():
     l1 = jax.tree.leaves(s1.params)[0]
     l2 = jax.tree.leaves(s2.params)[0]
     np.testing.assert_allclose(np.asarray(l1), np.asarray(l2), rtol=1e-4, atol=1e-5)
+
+
+def test_fused_ce_matches_naive():
+    """fused_cross_entropy_loss == lm-head einsum + cross_entropy_loss,
+    in value and in grads (f32 inputs so the only delta is op order)."""
+    import numpy as np
+    from ray_tpu.nn.layers import cross_entropy_loss, fused_cross_entropy_loss
+
+    key = jax.random.key(0)
+    B, S, D, V = 2, 16, 32, 97
+    h = jax.random.normal(key, (B, S, D), jnp.float32)
+    w = jax.random.normal(jax.random.key(1), (D, V), jnp.float32) * 0.1
+    tg = jax.random.randint(jax.random.key(2), (B, S), 0, V)
+    mask = (jax.random.uniform(jax.random.key(3), (B, S)) > 0.3).astype(
+        jnp.float32)
+
+    def naive(h, w):
+        logits = jnp.einsum("bsd,dv->bsv", h, w)
+        return cross_entropy_loss(logits, tg, mask)[0]
+
+    def fused(h, w):
+        return fused_cross_entropy_loss(h, w, tg, mask)[0]
+
+    l0, g0 = jax.value_and_grad(naive, argnums=(0, 1))(h, w)
+    l1, g1 = jax.value_and_grad(fused, argnums=(0, 1))(h, w)
+    np.testing.assert_allclose(l1, l0, rtol=1e-5, atol=1e-5)
+    for a, b, name in zip(g1, g0, ("dh", "dw")):
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4,
+                                   err_msg=f"{name} mismatch")

@@ -158,6 +158,7 @@ def _naive_greedy(params, prompt, n, model_cfg):
     return toks[len(prompt):]
 
 
+@pytest.mark.slow
 def test_engine_greedy_matches_full_forward():
     eng = _engine()
     rng = np.random.default_rng(1)
