@@ -142,8 +142,14 @@ def main():
         return
     for name in PROBES:
         env = dict(os.environ, PROF_ONLY=name)
-        r = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                           env=env, capture_output=True, text=True, timeout=420)
+        try:
+            r = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env, capture_output=True, text=True,
+                               timeout=420)
+        except subprocess.TimeoutExpired:
+            print(json.dumps({"probe": name, "error": "timeout 420s"}),
+                  flush=True)
+            continue
         line = [l for l in r.stdout.splitlines() if l.startswith("{")]
         if line:
             print(line[-1], flush=True)

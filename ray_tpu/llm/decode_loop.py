@@ -43,6 +43,7 @@ def decode_chunk(
     block_size: int,
     trash_slot: int,
     attn_impl: str = "auto",
+    sample_mode: str = "full",  # static sampler fast path (llm.sampling)
     lora=None,
 ):
     """Returns (tokens [n_steps, B], logprobs [n_steps, B], cache).
@@ -78,7 +79,7 @@ def decode_chunk(
         )
         step_keys = jax.vmap(jax.random.fold_in)(keys, starts + s)
         next_tok, logprob = sample_tokens(
-            logits, temperatures, top_ks, top_ps, step_keys
+            logits, temperatures, top_ks, top_ps, step_keys, mode=sample_mode
         )
         return (next_tok, pos + 1, ctx + 1, new_cache), (next_tok, logprob)
 

@@ -72,11 +72,17 @@ def _fold_factor(group: int, block_q: int, block_k: int,
     64 a lone [Bq, 64] tile wastes half the 128-lane width. F is the
     largest divisor of `group` keeping F*block_q inside the VMEM-safe
     row cap (fold=2 at S>=2048 measured 0.9-1.5ms/layer faster)."""
+    cap = _fold_rows_cap(block_k)
     if override is not None:
         if group % override != 0:
             raise ValueError(f"fold_heads {override} must divide group {group}")
+        if override * block_q > cap:
+            raise ValueError(
+                f"fold_heads {override} x block_q {block_q} = "
+                f"{override * block_q} rows exceeds the VMEM-safe cap {cap} "
+                f"at block_k {block_k} (measured Mosaic compile limit)"
+            )
         return override
-    cap = _fold_rows_cap(block_k)
     f = 1
     for cand in range(1, group + 1):
         if group % cand == 0 and cand * block_q <= cap:

@@ -354,3 +354,36 @@ def test_batch_processor(serve_instance):
     rows = processor(ds).take_all()
     assert len(rows) == 6
     assert all("generated_text" in r for r in rows)
+
+
+def test_sample_mode_invariance():
+    """A row's sample must not depend on the batch-level mode fast path:
+    greedy rows agree across all modes; a temperature-only row draws the
+    same token under "categorical" and "full"."""
+    from ray_tpu.llm.sampling import sample_tokens
+
+    key = jax.random.key(7)
+    logits = jax.random.normal(key, (3, 211), jnp.float32) * 3.0
+    temps = jnp.asarray([0.0, 0.8, 1.2])
+    ks = jnp.asarray([0, 0, 0])
+    ps = jnp.asarray([1.0, 1.0, 1.0])
+    keys = jax.vmap(jax.random.fold_in, (None, 0))(key, jnp.arange(3))
+    t_cat, lp_cat = sample_tokens(logits, temps, ks, ps, keys, mode="categorical")
+    t_full, lp_full = sample_tokens(logits, temps, ks, ps, keys, mode="full")
+    np.testing.assert_array_equal(np.asarray(t_cat), np.asarray(t_full))
+    # greedy row agrees with pure-greedy mode
+    t_g, _ = sample_tokens(logits, temps, ks, ps, keys, mode="greedy")
+    assert int(t_cat[0]) == int(t_g[0]) == int(jnp.argmax(logits[0]))
+
+
+def test_topk_filter_exact_at_small_vocab():
+    """top-k=2 on a tiny vocab: only the two largest logits can appear."""
+    from ray_tpu.llm.sampling import sample_tokens
+
+    logits = jnp.asarray([[0.0, 5.0, 4.0, -1.0, 1.0]] * 64, jnp.float32)
+    temps = jnp.full((64,), 1.0)
+    ks = jnp.full((64,), 2, jnp.int32)
+    ps = jnp.ones((64,))
+    keys = jax.vmap(jax.random.fold_in, (None, 0))(jax.random.key(0), jnp.arange(64))
+    toks, _ = sample_tokens(logits, temps, ks, ps, keys, mode="full")
+    assert set(np.asarray(toks).tolist()) <= {1, 2}
