@@ -1,0 +1,75 @@
+"""Compiled-DAG channel hop vs plain .remote round-trip.
+
+Two-stage pipeline over PROCESS actors on separate node daemons; the
+compiled path streams values through channels (shm or TCP), the naive
+path submits a task per hop through the lease/push RPC plane.
+Prints one JSON line per transport.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import cloudpickle
+
+from ray_tpu.cluster import LocalCluster
+from ray_tpu.core import api
+from ray_tpu.dag import InputNode
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+N = 200
+
+
+@api.remote
+class Stage:
+    def __init__(self, add):
+        self.add = add
+
+    def apply(self, x):
+        return x + self.add
+
+
+def main():
+    c = LocalCluster(node_death_timeout_s=5.0)
+    c.start()
+    c.add_node({"num_cpus": 2}, node_id="head")
+    c.add_node({"num_cpus": 2}, node_id="n1")
+    c.wait_for_nodes(2)
+    api.init(address=c.address, ignore_reinit_error=True)
+    try:
+        a = Stage.options(num_cpus=1).remote(1)
+        b = Stage.options(num_cpus=1).remote(10)
+
+        # baseline: plain .remote chain, one result round-trip per item
+        api.get(b.apply.remote(a.apply.remote(0)))  # warm
+        t0 = time.perf_counter()
+        for i in range(N):
+            api.get(b.apply.remote(a.apply.remote(i)))
+        remote_s = (time.perf_counter() - t0) / N
+
+        results = {"remote_roundtrip_ms": round(remote_s * 1e3, 3)}
+        for mode in ("shm", "socket"):
+            with InputNode() as inp:
+                out = b.apply.bind(a.apply.bind(inp))
+            dag = out.experimental_compile(channel_mode=mode)
+            try:
+                assert dag.execute(0).get(timeout=60) == 11  # warm
+                t0 = time.perf_counter()
+                for i in range(N):
+                    assert dag.execute(i).get(timeout=60) == i + 11
+                dt = (time.perf_counter() - t0) / N
+            finally:
+                dag.teardown()
+            results[f"{mode}_channel_ms"] = round(dt * 1e3, 3)
+            results[f"{mode}_speedup_vs_remote"] = round(remote_s / dt, 2)
+        print(json.dumps(results))
+    finally:
+        api.shutdown()
+        c.shutdown()
+
+
+if __name__ == "__main__":
+    main()

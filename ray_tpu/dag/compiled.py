@@ -119,9 +119,17 @@ class CompiledDAGRef:
 
 
 class CompiledDAG:
-    def __init__(self, root: DAGNode, max_in_flight: int = 8):
+    def __init__(self, root: DAGNode, max_in_flight: int = 8,
+                 channel_mode: str = "auto"):
+        """channel_mode: 'auto' (shm on one host, TCP across hosts),
+        'shm', or 'socket' (force TCP — e.g. daemons with divergent
+        TMPDIRs, or tests exercising the cross-node path)."""
         import ray_tpu  # noqa: F401  (runtime must be up for actor calls)
 
+        if channel_mode not in ("auto", "shm", "socket"):
+            raise ValueError(
+                f"channel_mode {channel_mode!r}: 'auto', 'shm' or 'socket'"
+            )
         self._lock = threading.Lock()
         self._max_in_flight = max_in_flight
         self._seq = 0
@@ -185,11 +193,14 @@ class CompiledDAG:
         chan_for: dict[int, Channel] = {}
         reader_idx: dict[tuple, int] = {}  # (node_id, consumer_loop) -> idx
 
+        self._socket_channels = False
         if self._cluster_mode:
             # the shm data plane requires every participant (actors AND
             # the driver, which writes input / reads outputs) to share one
-            # /dev/shm — fail at compile time with a clear message rather
-            # than a "No such file" deep inside a remote exec loop
+            # /dev/shm; when actors span HOSTS the channels become direct
+            # writer->reader TCP streams (dag/socket_channel.py) instead —
+            # reference: cross-node compiled-graph channels,
+            # experimental/channel/shared_memory_channel.py:151
             hosts = set()
             for loop in actor_loops.values():
                 h = loop["handle"]
@@ -202,19 +213,30 @@ class CompiledDAG:
                 if addr:
                     hosts.add(addr[0])
                 hosts.add(h._client.local_daemon_addr[0])
-            if len(hosts) > 1:
-                raise NotImplementedError(
-                    f"compiled DAGs over cluster actors require all actors "
-                    f"and the driver on ONE host (shared-memory channels); "
-                    f"got hosts {sorted(hosts)}. Cross-node DAG edges go "
-                    "through the object plane (plain .remote calls)"
+            if channel_mode == "socket" or (
+                channel_mode == "auto" and len(hosts) > 1
+            ):
+                self._socket_channels = True
+            elif channel_mode == "shm" and len(hosts) > 1:
+                # fail HERE, not with "No such file" deep inside a remote
+                # exec loop attaching a mapping that only exists on one host
+                raise ValueError(
+                    f"channel_mode='shm' requires all actors and the driver "
+                    f"on ONE host; got hosts {sorted(hosts)} — use 'auto' "
+                    "or 'socket'"
                 )
 
         def make_channel(num_readers: int):
             if self._cluster_mode:
-                # PROCESS actors: named single-writer ring over one shared
-                # memory mapping (dag/shm_channel.py) — the plasma-mutable-
-                # object channel role
+                if self._socket_channels:
+                    from ray_tpu.dag.socket_channel import SocketChannel
+
+                    return SocketChannel(
+                        num_readers=num_readers, maxsize=max_in_flight
+                    )
+                # PROCESS actors, one host: named single-writer ring over a
+                # shared memory mapping (dag/shm_channel.py) — the plasma-
+                # mutable-object channel role
                 from ray_tpu.dag.shm_channel import ShmChannel
 
                 return ShmChannel(num_readers=num_readers, maxsize=max_in_flight)

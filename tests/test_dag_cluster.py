@@ -61,6 +61,8 @@ def test_shm_channel_cross_process_pipeline(attached_cluster):
             assert dag.execute(i).get(timeout=60) == i + 11
     finally:
         dag.teardown()
+        api.kill(a)
+        api.kill(b)
 
 
 def test_shm_channel_multi_output(attached_cluster):
@@ -73,3 +75,48 @@ def test_shm_channel_multi_output(attached_cluster):
         assert compiled.execute(100).get(timeout=60) == [101, 102]
     finally:
         compiled.teardown()
+        api.kill(a)
+        api.kill(b)
+
+
+def test_socket_channel_cross_node_pipeline(attached_cluster):
+    """Cross-node data plane: channel_mode='socket' forces the TCP
+    channels a multi-host cluster selects automatically (LocalCluster
+    daemons share one host, so 'auto' would pick shm; the full TCP
+    rendezvous/stream/ack path is what this exercises). Reference:
+    cross-node compiled-graph channels,
+    experimental/channel/shared_memory_channel.py:151."""
+    a = Stage.options(num_cpus=1).remote(1)
+    b = Stage.options(num_cpus=1).remote(10)
+    # make sure both are up and are distinct processes
+    pids = api.get([a.pid.remote(), b.pid.remote()])
+    assert pids[0] != pids[1]
+
+    with InputNode() as inp:
+        mid = a.apply.bind(inp)
+        out = b.apply.bind(mid)
+    dag = out.experimental_compile(channel_mode="socket")
+    try:
+        for i in range(6):
+            assert dag.execute(i).get(timeout=60) == i + 11
+    finally:
+        dag.teardown()
+        api.kill(a)
+        api.kill(b)
+
+
+def test_socket_channel_multi_output_and_close(attached_cluster):
+    a = Stage.options(num_cpus=1).remote(5)
+    b = Stage.options(num_cpus=1).remote(50)
+    with InputNode() as inp:
+        dag = MultiOutputNode([a.apply.bind(inp), b.apply.bind(inp)])
+    compiled = dag.experimental_compile(channel_mode="socket")
+    try:
+        assert compiled.execute(100).get(timeout=60) == [105, 150]
+        assert compiled.execute(1).get(timeout=60) == [6, 51]
+    finally:
+        compiled.teardown()
+        api.kill(a)
+        api.kill(b)
+    # teardown is idempotent and leaves no stuck loops
+    compiled.teardown()
