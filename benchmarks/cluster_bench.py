@@ -132,9 +132,16 @@ def main():
         client.get([client.submit(_noop, resources={"num_cpus": 1})
                     for _ in range(8)], timeout=120)
 
+        # order matters on one core: the put/get benches enqueue thousands
+        # of deferred object frees whose drain outlives quiesce()'s view
+        # (daemon-side LRU/GC work) — run the latency/bandwidth-sensitive
+        # measures BEFORE them
         measures = {
             "cluster_single_client_tasks_async": lambda: bench_tasks_async(
                 client, 2000 // scale, 100
+            ),
+            "cluster_task_return_mb_s": lambda: bench_task_returns(
+                client, 16 // max(1, scale // 4)
             ),
             "cluster_1_1_actor_calls_async": lambda: bench_actor_calls(
                 client, 2000 // scale, 200
@@ -148,11 +155,20 @@ def main():
             "cluster_placement_group_create_removal": lambda: bench_pgs(
                 client, 200 // scale
             ),
-            "cluster_task_return_mb_s": lambda: bench_task_returns(
-                client, 16 // max(1, scale // 4)
-            ),
         }
+        def quiesce():
+            """Drain the accountant's free backlog between measures — a
+            prior bench's thousands of queued object frees otherwise
+            compete for the single core DURING the next measure (the
+            round-5 task-return number was 31 MB/s contaminated vs
+            240 MB/s steady-state)."""
+            deadline = time.time() + 30
+            while client._rc_ops and time.time() < deadline:
+                time.sleep(0.05)
+            time.sleep(0.25)
+
         for name, fn in measures.items():
+            quiesce()
             rate = fn()
             results[name] = {
                 "value": round(rate, 1),

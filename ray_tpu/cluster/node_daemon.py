@@ -182,6 +182,10 @@ class ObjectService:
                 return data
         return self._get_spilled(object_id)
 
+    def in_shm(self, object_id: bytes) -> bool:
+        """Is this object readable straight from the shm mapping?"""
+        return self._shm is not None and object_id in self._shm_held
+
     def local_size(self, object_id: bytes) -> Optional[int]:
         """Size without materializing (chunk-serving metadata)."""
         if self._shm is not None and object_id in self._shm_held:
@@ -258,14 +262,22 @@ class ObjectService:
         """Local hit or remote pull; single-object form of fetch_many."""
         return self.fetch_many([object_id], timeout)[0]
 
-    def fetch_many(self, ids: list, timeout: float = 30.0) -> list:
+    SHM_MARKER = {"__shm__": True}
+
+    def fetch_many(self, ids: list, timeout: float = 30.0,
+                   shm_markers: bool = False) -> list:
         """Batched local-or-remote fetch, the ONE pull implementation.
 
         Local arrivals (the hot path: a worker's put_return racing the
         caller's get) wake waiters via condition variable — no 50 ms poll
         tax on fresh task results. Remote lookups are ONE batched
         locate_many per rate-limited round, not a per-object GCS call per
-        wakeup (GCS thundering herd)."""
+        wakeup (GCS thundering herd).
+
+        shm_markers: the caller has the store mapped (a local driver) —
+        shm-resident objects come back as SHM_MARKER without EVER being
+        materialized into daemon-side bytes (the copy is the point of
+        the fast path, not just the socket)."""
         deadline = time.monotonic() + timeout
         out: dict[bytes, Optional[bytes]] = {oid: None for oid in ids}
         missing = [oid for oid in dict.fromkeys(ids)]  # dedup, keep order
@@ -273,6 +285,9 @@ class ObjectService:
         while missing:
             still = []
             for oid in missing:
+                if shm_markers and self.in_shm(oid):
+                    out[oid] = self.SHM_MARKER
+                    continue
                 data = self.get_local(oid)
                 if data is None:
                     still.append(oid)
@@ -995,9 +1010,16 @@ class NodeDaemon:
     def rpc_fetch_objects(self, payload, peer):
         """Batched fetch in ONE handler thread (a wide batch of blocking
         single fetches would pin one executor thread per ref and starve
-        the daemon's put path — deadlock under load)."""
+        the daemon's put path — deadlock under load).
+
+        shm_direct: the caller has the node's shm store mapped (a local
+        driver) — SEALED shm objects come back as a {"__shm__"} marker
+        it reads zero-RPC from the mapping; the daemon never even
+        materializes the bytes (the large-task-return bandwidth
+        ceiling, round-5 profile)."""
         return self.objects.fetch_many(
-            payload["object_ids"], timeout=payload.get("timeout", 30.0)
+            payload["object_ids"], timeout=payload.get("timeout", 30.0),
+            shm_markers=bool(payload.get("shm_direct")),
         )
 
     def rpc_has_object(self, payload, peer):
@@ -1011,6 +1033,11 @@ class NodeDaemon:
 
     def rpc_ping(self, payload, peer):
         return {"node_id": self.node_id}
+
+    def rpc_shm_info(self, payload, peer):
+        """Local clients (drivers) attach the store read-side with this —
+        the plasma-client role (same handshake workers get in register)."""
+        return {"shm_path": self.objects.shm_path}
 
     def rpc_record_spans(self, payload, peer):
         """Batched execution spans from this node's workers (reference:

@@ -226,6 +226,14 @@ class RpcClient:
                 s = socket.create_connection(self.addr, timeout=self._timeout)
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 self._sock = s
+                self._native = None
+                try:
+                    from ray_tpu.native import framing as _framing
+
+                    if _framing.enabled():
+                        self._native = _framing.load_library()
+                except Exception:  # noqa: BLE001 — toolchain missing
+                    self._native = None
                 self._reader = threading.Thread(
                     target=self._read_loop, name="ray_tpu-rpc-client", daemon=True
                 )
@@ -268,7 +276,15 @@ class RpcClient:
         body = _dump((msg_id, method, payload))
         try:
             with self._wlock:
-                self._sock.sendall(_LEN.pack(len(body)) + body)
+                native = getattr(self, "_native", None)
+                if native is not None:
+                    # one writev of header+payload in C, GIL released
+                    if native.frame_write(
+                        self._sock.fileno(), body, len(body)
+                    ) != 0:
+                        raise OSError("native frame_write failed")
+                else:
+                    self._sock.sendall(_LEN.pack(len(body)) + body)
         except OSError as e:
             with self._plock:
                 self._pending.pop(msg_id, None)
@@ -288,29 +304,44 @@ class RpcClient:
         sock = self._sock
         assert sock is not None
         sock.settimeout(None)
+        native = None
+        try:
+            from ray_tpu.native import framing as _framing
+
+            if _framing.enabled():
+                # opt-in native receive loop: blocks in C with the GIL
+                # released, one malloc per frame (src/framing.cc)
+                native = _framing.FrameReader(sock.fileno())
+        except Exception:  # noqa: BLE001 — build/toolchain missing: Python path
+            native = None
         buf = b""
         try:
             while not self._closed:
-                while len(buf) < _LEN.size:
-                    chunk = sock.recv(1 << 20)
-                    if not chunk:
+                if native is not None:
+                    body = native.read_frame()
+                    if body is None:
                         raise ConnectionError("peer closed")
-                    buf += chunk
-                (n,) = _LEN.unpack(buf[: _LEN.size])
-                while len(buf) < _LEN.size + n:
-                    chunk = sock.recv(1 << 20)
-                    if not chunk:
-                        raise ConnectionError("peer closed")
-                    buf += chunk
-                body = buf[_LEN.size : _LEN.size + n]
-                buf = buf[_LEN.size + n :]
+                else:
+                    while len(buf) < _LEN.size:
+                        chunk = sock.recv(1 << 20)
+                        if not chunk:
+                            raise ConnectionError("peer closed")
+                        buf += chunk
+                    (n,) = _LEN.unpack(buf[: _LEN.size])
+                    while len(buf) < _LEN.size + n:
+                        chunk = sock.recv(1 << 20)
+                        if not chunk:
+                            raise ConnectionError("peer closed")
+                        buf += chunk
+                    body = buf[_LEN.size : _LEN.size + n]
+                    buf = buf[_LEN.size + n :]
                 msg_id, ok, result = pickle.loads(body)
                 with self._plock:
                     ev = self._pending.pop(msg_id, None)
                 if ev is not None:
                     ev[1][:] = [ok, result]
                     ev[0].set()
-        except (ConnectionError, OSError) as e:
+        except (ConnectionError, OSError, MemoryError) as e:
             self._fail_all(RpcError(f"connection to {self.addr} lost: {e}"))
 
     def _fail_all(self, err: RpcError) -> None:

@@ -1,0 +1,90 @@
+"""ctypes binding for the native RPC frame reader (src/framing.cc).
+
+Opt-in (RAY_TPU_NATIVE_FRAMING=1): the cluster RPC client's receive
+loop then blocks inside C with the GIL released — no Python recv loop,
+no bytes concatenation. The task-plane profile
+(benchmarks/PROFILE_taskplane_r05.md) shows per-frame Python overhead
+is a minor term on this host, which is why the flag defaults off; it
+exists so multi-core deployments can measure it honestly.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_LIB_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+
+
+def load_library(build: bool = True) -> ctypes.CDLL:
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        d = os.path.dirname(os.path.abspath(__file__))
+        so = os.path.join(d, "libframing.so")
+        if build:
+            import fcntl
+
+            src = os.path.join(d, "src", "framing.cc")
+            stamp = os.path.join(d, ".framing.srchash")
+            with open(src, "rb") as f:
+                src_hash = hashlib.sha256(f.read()).hexdigest()
+            with open(os.path.join(d, ".build.lock"), "w") as lockf:
+                fcntl.flock(lockf, fcntl.LOCK_EX)
+                try:
+                    stamped = None
+                    if os.path.exists(stamp):
+                        with open(stamp) as f:
+                            stamped = f.read().strip()
+                    if not os.path.exists(so) or stamped != src_hash:
+                        subprocess.run(
+                            ["make", "-s", "-C", d, "libframing.so"],
+                            check=True, capture_output=True,
+                        )
+                        with open(stamp, "w") as f:
+                            f.write(src_hash)
+                finally:
+                    fcntl.flock(lockf, fcntl.LOCK_UN)
+        lib = ctypes.CDLL(so)
+        lib.frame_read.restype = ctypes.c_long
+        lib.frame_read.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte))
+        ]
+        lib.frame_free.argtypes = [ctypes.POINTER(ctypes.c_ubyte)]
+        lib.frame_write.restype = ctypes.c_int
+        lib.frame_write.argtypes = [
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_ulong
+        ]
+        _LIB = lib
+        return lib
+
+
+class FrameReader:
+    """Blocking frame reader over a connected socket's fd."""
+
+    def __init__(self, fileno: int):
+        self._lib = load_library()
+        self._fd = fileno
+
+    def read_frame(self) -> Optional[bytes]:
+        """One complete frame body, or None on EOF/connection loss."""
+        out = ctypes.POINTER(ctypes.c_ubyte)()
+        n = self._lib.frame_read(self._fd, ctypes.byref(out))
+        if n == -1:
+            return None
+        if n < 0:
+            raise MemoryError("native frame_read failed (oversized/alloc)")
+        try:
+            return ctypes.string_at(out, n)
+        finally:
+            self._lib.frame_free(out)
+
+
+def enabled() -> bool:
+    return os.environ.get("RAY_TPU_NATIVE_FRAMING", "") not in ("", "0")

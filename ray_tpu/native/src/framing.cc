@@ -1,0 +1,94 @@
+// Native frame I/O for the RPC plane (ray_tpu/cluster/rpc.py).
+//
+// Reference analog: the gRPC/C++ transport under src/ray/rpc/ — here the
+// wire format stays the framework's length-prefixed frames, but the
+// receive hot loop (read 4-byte length, then exactly `len` payload
+// bytes) runs in C with the GIL released: no Python-level recv loop, no
+// bytes concatenation, one malloc per frame. Enabled from Python with
+// RAY_TPU_NATIVE_FRAMING=1 (see rpc.py RpcClient._read_loop); the
+// single-core profile (benchmarks/PROFILE_taskplane_r05.md) shows the
+// dominant cost is elsewhere, so this stays opt-in.
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdlib>
+#include <cstring>
+#include <sys/socket.h>
+#include <sys/uio.h>
+#include <unistd.h>
+
+namespace {
+
+// Read exactly n bytes; returns 0 on success, -1 on EOF/error.
+int read_exact(int fd, unsigned char* buf, size_t n) {
+  size_t got = 0;
+  while (got < n) {
+    ssize_t r = recv(fd, buf + got, n - got, 0);
+    if (r == 0) return -1;  // orderly EOF
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    got += static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Read one frame. On success returns the payload length (>= 0) and sets
+// *out to a malloc'd buffer the caller releases with frame_free. Returns
+// -1 on EOF / connection error, -2 on allocation failure / oversized
+// frame (> 2^31, matching rpc.py MAX_FRAME).
+long frame_read(int fd, unsigned char** out) {
+  unsigned char hdr[4];
+  if (read_exact(fd, hdr, 4) != 0) return -1;
+  uint32_t len = ntohl(*reinterpret_cast<uint32_t*>(hdr));
+  if (len > (1u << 31)) return -2;
+  unsigned char* buf = static_cast<unsigned char*>(malloc(len ? len : 1));
+  if (buf == nullptr) return -2;
+  if (read_exact(fd, buf, len) != 0) {
+    free(buf);
+    return -1;
+  }
+  *out = buf;
+  return static_cast<long>(len);
+}
+
+void frame_free(unsigned char* p) { free(p); }
+
+// Write header + payload with one writev (no Python-side concat copy).
+// Returns 0 on success, -1 on error.
+int frame_write(int fd, const unsigned char* data, unsigned long len) {
+  unsigned char hdr[4];
+  *reinterpret_cast<uint32_t*>(hdr) = htonl(static_cast<uint32_t>(len));
+  struct iovec iov[2];
+  iov[0].iov_base = hdr;
+  iov[0].iov_len = 4;
+  iov[1].iov_base = const_cast<unsigned char*>(data);
+  iov[1].iov_len = len;
+  size_t total = 4 + len;
+  size_t sent = 0;
+  while (sent < total) {
+    ssize_t r;
+    if (sent < 4) {
+      iov[0].iov_base = hdr + sent;
+      iov[0].iov_len = 4 - sent;
+      iov[1].iov_base = const_cast<unsigned char*>(data);
+      iov[1].iov_len = len;
+      r = writev(fd, iov, 2);
+    } else {
+      r = send(fd, data + (sent - 4), total - sent, 0);
+    }
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -1;
+    }
+    sent += static_cast<size_t>(r);
+  }
+  return 0;
+}
+
+}  // extern "C"
