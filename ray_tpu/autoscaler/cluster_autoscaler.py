@@ -210,6 +210,23 @@ class ClusterAutoscaler:
         for tname in planned_types:
             self._launch(tname, self.config.node_types[tname])
 
+    def _node_idle(self, nid: str) -> bool:
+        """Idleness from the CLUSTER view first, the provider second: a
+        cloud provider (TPUPodProvider) cannot see occupancy, so a busy
+        slice would read idle from is_idle alone. Contract: daemons on
+        provider-launched nodes register with node_id == the provider's
+        node id (the LocalClusterNodeProvider and the TPU startup script
+        both do), so the GCS resource view keys by it."""
+        try:
+            nodes = {n["node_id"]: n for n in self._gcs.call("list_nodes", None)}
+        except Exception:  # noqa: BLE001 — GCS unreachable: don't cull
+            return False
+        rec = nodes.get(nid)
+        if rec is not None and rec.get("alive"):
+            if rec.get("available") != rec.get("resources"):
+                return False  # resources in use on the slice
+        return self.provider.is_idle(nid)
+
     def _scale_down(self) -> None:
         now = time.time()
         # reap bookkeeping for nodes that died on their own (daemon crash):
@@ -229,8 +246,14 @@ class ClusterAutoscaler:
             tname = self._node_type.get(nid)
             if tname is None:
                 continue
+            launching = self._launching.get(nid)
+            if launching is not None and now - launching[1] <= self._launch_grace_s:
+                # a slice still provisioning (cloud create can take
+                # minutes) reads idle — culling it here would thrash
+                # create/delete against the provider
+                continue
             tcfg = self.config.node_types[tname]
-            if not self.provider.is_idle(nid):
+            if not self._node_idle(nid):
                 self._idle_since.pop(nid, None)
                 continue
             first_idle = self._idle_since.setdefault(nid, now)

@@ -323,8 +323,17 @@ class ClusterClient:
         counts: dict[bytes, int] = {}
         owned: set[bytes] = set()
         retries: dict[bytes, tuple[float, int]] = {}  # oid -> (due, attempts)
+        last_sweep = 0.0
         while not self._closed:
             now = time.monotonic()
+            if now - last_sweep > 0.25:
+                # periodic, NOT only-when-idle: sustained refcount traffic
+                # must not starve TTL-expired cached leases of release
+                last_sweep = now
+                try:
+                    self._sweep_lease_cache()
+                except Exception:  # noqa: BLE001
+                    pass
             for oid, (due, attempts) in list(retries.items()):
                 if due <= now:
                     if self._free_everywhere(oid) or attempts >= 120:
@@ -332,10 +341,6 @@ class ClusterClient:
                     else:
                         retries[oid] = (now + 1.0, attempts + 1)
             if not self._rc_ops:
-                try:
-                    self._sweep_lease_cache()
-                except Exception:  # noqa: BLE001 — sweep must never kill rc
-                    pass
                 time.sleep(0.05)
                 continue
             try:

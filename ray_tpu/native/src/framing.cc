@@ -14,6 +14,7 @@
 #include <cstdlib>
 #include <cstring>
 #include <sys/socket.h>
+#include <poll.h>
 #include <sys/uio.h>
 #include <unistd.h>
 
@@ -27,6 +28,11 @@ int read_exact(int fd, unsigned char* buf, size_t n) {
     if (r == 0) return -1;  // orderly EOF
     if (r < 0) {
       if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        struct pollfd pfd = {fd, POLLIN, 0};
+        if (poll(&pfd, 1, -1) < 0 && errno != EINTR) return -1;
+        continue;
+      }
       return -1;
     }
     got += static_cast<size_t>(r);
@@ -60,15 +66,17 @@ long frame_read(int fd, unsigned char** out) {
 void frame_free(unsigned char* p) { free(p); }
 
 // Write header + payload with one writev (no Python-side concat copy).
-// Returns 0 on success, -1 on error.
+// Returns 0 on success, -1 on connection error, -2 on oversized frame
+// (> 2^31, matching the read-side / Python MAX_FRAME bound — silent
+// 32-bit truncation would desync the peer's frame parser).
+// EAGAIN/EWOULDBLOCK (the fd may carry a non-blocking/timeout mode from
+// Python's settimeout) waits for writability instead of failing with a
+// partial frame on the wire.
 int frame_write(int fd, const unsigned char* data, unsigned long len) {
+  if (len > (1ul << 31)) return -2;
   unsigned char hdr[4];
   *reinterpret_cast<uint32_t*>(hdr) = htonl(static_cast<uint32_t>(len));
   struct iovec iov[2];
-  iov[0].iov_base = hdr;
-  iov[0].iov_len = 4;
-  iov[1].iov_base = const_cast<unsigned char*>(data);
-  iov[1].iov_len = len;
   size_t total = 4 + len;
   size_t sent = 0;
   while (sent < total) {
@@ -84,6 +92,11 @@ int frame_write(int fd, const unsigned char* data, unsigned long len) {
     }
     if (r < 0) {
       if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) {
+        struct pollfd pfd = {fd, POLLOUT, 0};
+        if (poll(&pfd, 1, -1) < 0 && errno != EINTR) return -1;
+        continue;
+      }
       return -1;
     }
     sent += static_cast<size_t>(r);
