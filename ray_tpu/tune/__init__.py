@@ -8,6 +8,7 @@ median) or evolve (PBT) trials from streaming results.
 from ray_tpu.train.checkpoint import Checkpoint
 from ray_tpu.train.session import report, get_checkpoint, get_context
 from ray_tpu.tune.schedulers import (
+    PB2,
     AsyncHyperBandScheduler,
     FIFOScheduler,
     MedianStoppingRule,
@@ -15,8 +16,10 @@ from ray_tpu.tune.schedulers import (
     TrialScheduler,
 )
 from ray_tpu.tune.search import (
+    AskTellSearcher,
     BasicVariantGenerator,
     ConcurrencyLimiter,
+    Repeater,
     Searcher,
     choice,
     grid_search,
@@ -34,13 +37,16 @@ ASHAScheduler = AsyncHyperBandScheduler
 
 __all__ = [
     "ASHAScheduler",
+    "AskTellSearcher",
     "AsyncHyperBandScheduler",
     "BasicVariantGenerator",
     "Checkpoint",
     "ConcurrencyLimiter",
     "FIFOScheduler",
     "MedianStoppingRule",
+    "PB2",
     "PopulationBasedTraining",
+    "Repeater",
     "ResultGrid",
     "Searcher",
     "Trainable",

@@ -216,3 +216,100 @@ class ConcurrencyLimiter(Searcher):
     def on_trial_complete(self, trial_id, result):
         self._live.discard(trial_id)
         self.searcher.on_trial_complete(trial_id, result)
+
+
+class Repeater(Searcher):
+    """Run every suggested config `repeat` times and report the MEAN
+    metric to the wrapped searcher (reference: tune/search/repeater.py —
+    variance reduction for noisy objectives; external searchers must see
+    one aggregated result per config, not per seed)."""
+
+    def __init__(self, searcher: Searcher, repeat: int,
+                 metric: Optional[str] = None):
+        if repeat < 1:
+            raise ValueError("repeat must be >= 1")
+        self.searcher = searcher
+        self.repeat = repeat
+        self.metric = metric
+        self._groups: dict[str, dict] = {}   # group id -> state
+        self._trial_group: dict[str, str] = {}
+        self._queue: list[tuple[str, dict]] = []  # (group, config) replicas
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        if self._queue:
+            group, cfg = self._queue.pop(0)
+            self._trial_group[trial_id] = group
+            return dict(cfg)
+        cfg = self.searcher.suggest(trial_id)
+        if cfg is None or cfg == "__pending__":
+            return cfg
+        group = trial_id
+        self._groups[group] = {"config": cfg, "results": [], "want": self.repeat}
+        self._trial_group[trial_id] = group
+        for _ in range(self.repeat - 1):
+            self._queue.append((group, cfg))
+        return dict(cfg)
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict]) -> None:
+        group = self._trial_group.pop(trial_id, None)
+        if group is None or group not in self._groups:
+            return
+        st = self._groups[group]
+        st["results"].append(result)
+        if len(st["results"]) < st["want"]:
+            return
+        del self._groups[group]
+        valid = [r for r in st["results"] if r]
+        if not valid:
+            self.searcher.on_trial_complete(group, None)
+            return
+        keys = self.metric and [self.metric] or [
+            k for k in valid[0]
+            if isinstance(valid[0][k], (int, float)) and not isinstance(valid[0][k], bool)
+        ]
+        agg = dict(valid[-1])
+        for k in keys:
+            vals = [r[k] for r in valid if isinstance(r.get(k), (int, float))]
+            if vals:
+                agg[k] = sum(vals) / len(vals)
+        agg["num_repeats"] = len(valid)
+        self.searcher.on_trial_complete(group, agg)
+
+
+class AskTellSearcher(Searcher):
+    """Adapter for external ask/tell optimizers (optuna, nevergrad,
+    scikit-optimize all speak it). Reference analog: the per-library
+    Searcher integrations under tune/search/{optuna,hyperopt,...} — one
+    seam instead of N wrappers:
+
+        ext = SomeLibStudy(...)
+        Tuner(..., search_alg=AskTellSearcher(
+            ask=ext.ask_dict, tell=ext.tell, metric="loss"))
+
+    `ask()` returns the next config dict (or None when exhausted);
+    `tell(config, value)` reports the RAW final metric for that config —
+    optimization direction is the external optimizer's own configuration
+    (e.g. optuna's study direction), never transformed here.
+    """
+
+    def __init__(self, ask: Callable[[], Optional[dict]],
+                 tell: Callable[[dict, Optional[float]], None],
+                 metric: str):
+        self.ask = ask
+        self.tell = tell
+        self.metric = metric
+        self._live: dict[str, dict] = {}
+
+    def suggest(self, trial_id: str) -> Optional[dict]:
+        cfg = self.ask()
+        if cfg is None:
+            return None
+        self._live[trial_id] = dict(cfg)
+        return dict(cfg)
+
+    def on_trial_complete(self, trial_id: str, result: Optional[dict]) -> None:
+        cfg = self._live.pop(trial_id, None)
+        if cfg is None:
+            return
+        value = None if not result else result.get(self.metric)
+        self.tell(cfg, None if value is None else float(value))

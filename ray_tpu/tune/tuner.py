@@ -261,6 +261,10 @@ class Tuner:
             state = api.get(src.actor.save.remote())
             api.get(rt.actor.restore.remote(state, new_config))
             rt.trial.config = new_config
+            if hasattr(scheduler, "on_exploit"):
+                # the score jump from the checkpoint clone must not be
+                # attributed to the new config (PB2's GP dataset)
+                scheduler.on_exploit(rt.trial.trial_id)
             logger.info(
                 "PBT exploit: %s cloned %s with config %s",
                 rt.trial.trial_id, src_id, new_config,

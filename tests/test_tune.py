@@ -310,3 +310,168 @@ def test_scheduler_inherits_tuneconfig_metric():
     # the top trial (s=10) must survive to the last rung
     best = grid.get_best_result()
     assert best.metrics["score"] == pytest.approx(80.0)
+
+
+def test_repeater_aggregates_before_reporting():
+    """Repeater: each config runs `repeat` times; the wrapped searcher
+    sees ONE averaged result per config (reference: search/repeater.py)."""
+    seen_tells = []
+
+    class RecordingSearcher(tune.Searcher):
+        def __init__(self):
+            self._cfgs = [{"x": 1.0}, {"x": 2.0}]
+
+        def suggest(self, trial_id):
+            return self._cfgs.pop(0) if self._cfgs else None
+
+        def on_trial_complete(self, trial_id, result):
+            seen_tells.append(result)
+
+    import threading
+
+    runs = []
+    counts = {}
+    lock = threading.Lock()
+
+    def train_fn(config):
+        # per-CONFIG replica index under a lock: deterministic regardless
+        # of how concurrently the 6 replicas interleave
+        with lock:
+            idx = counts.get(config["x"], 0)
+            counts[config["x"]] = idx + 1
+            runs.append(config["x"])
+        tune.report({"loss": config["x"] + idx * 0.3})
+
+    tune.Tuner(
+        train_fn,
+        param_space={},
+        tune_config=tune.TuneConfig(
+            search_alg=tune.Repeater(RecordingSearcher(), repeat=3,
+                                     metric="loss"),
+            metric="loss", mode="min",
+        ),
+    ).fit()
+    assert sorted(runs) == [1.0, 1.0, 1.0, 2.0, 2.0, 2.0]
+    assert len(seen_tells) == 2  # one aggregated tell per config
+    assert all(t["num_repeats"] == 3 for t in seen_tells)
+    # mean over replica noises 0.0/0.3/0.6 -> +0.3 over the base value
+    means = sorted(t["loss"] for t in seen_tells)
+    assert means[0] == pytest.approx(1.3) and means[1] == pytest.approx(2.3)
+
+
+def test_ask_tell_external_searcher_contract():
+    """AskTellSearcher drives a fake external optimizer through the full
+    searcher contract: every ask'd config trains, every result is
+    tell'd back with the metric, exhaustion ends the run."""
+
+    class FakeExternalOpt:
+        def __init__(self):
+            self.pending = [{"lr": 0.1}, {"lr": 0.2}, {"lr": 0.3}]
+            self.tells = []
+
+        def ask(self):
+            return self.pending.pop(0) if self.pending else None
+
+        def tell(self, config, value):
+            self.tells.append((config["lr"], value))
+
+    ext = FakeExternalOpt()
+
+    def train_fn(config):
+        tune.report({"loss": config["lr"] * 10})
+
+    grid = tune.Tuner(
+        train_fn,
+        param_space={},
+        tune_config=tune.TuneConfig(
+            search_alg=tune.AskTellSearcher(
+                ask=ext.ask, tell=ext.tell, metric="loss"
+            ),
+            metric="loss", mode="min",
+        ),
+    ).fit()
+    assert len(grid) == 3
+    assert sorted(ext.tells) == [
+        (0.1, pytest.approx(1.0)), (0.2, pytest.approx(2.0)),
+        (0.3, pytest.approx(3.0)),
+    ]
+    assert ext.pending == []  # exhausted cleanly
+
+
+def test_concurrency_limiter_bounds_live_trials():
+    import threading
+
+    live = []
+    peak = []
+    lock = threading.Lock()
+
+    def train_fn(config):
+        with lock:
+            live.append(1)
+            peak.append(len(live))
+        import time as _t
+
+        _t.sleep(0.2)
+        with lock:
+            live.pop()
+        tune.report({"v": 1})
+
+    tune.Tuner(
+        train_fn,
+        param_space={"x": tune.uniform(0, 1)},
+        tune_config=tune.TuneConfig(
+            num_samples=6,
+            search_alg=tune.ConcurrencyLimiter(
+                tune.BasicVariantGenerator({"x": tune.uniform(0, 1)},
+                                           num_samples=6, seed=0),
+                max_concurrent=2,
+            ),
+            metric="v", mode="max",
+        ),
+    ).fit()
+    assert max(peak) <= 2
+
+
+def test_pb2_explores_with_gp_and_improves():
+    """PB2: bottom-quantile trials exploit top ones and the GP-UCB
+    explore proposes lr values INSIDE the declared bounds; the
+    population ends far better than its worst seed."""
+
+    class Learner(tune.Trainable):
+        def setup(self, config):
+            self.weight = 0.0
+
+        def step(self):
+            self.weight += self.config["lr"]
+            return {"score": self.weight}
+
+        def save_checkpoint(self):
+            return {"weight": self.weight}
+
+        def load_checkpoint(self, state):
+            self.weight = state["weight"]
+
+        def reset_config(self, config):
+            self.config = config
+            return True
+
+    sched = tune.PB2(
+        metric="score", mode="max", perturbation_interval=3,
+        hyperparam_bounds={"lr": (0.05, 1.0)}, seed=0,
+    )
+    grid = tune.Tuner(
+        Learner,
+        param_space={"lr": tune.grid_search([0.05, 0.9])},
+        tune_config=tune.TuneConfig(scheduler=sched, metric="score",
+                                    mode="max"),
+        stop={"training_iteration": 12},
+    ).fit()
+    scores = sorted(r.metrics["score"] for r in (grid[0], grid[1]))
+    # without exploit+GP-explore the slow seed ends at 0.6; with PB2 it
+    # clones the fast trial and continues with an in-bounds GP choice
+    assert scores[0] > 1.5
+    assert sched._obs, "GP observation history is empty"
+    # every GP-explored proposal stays inside the declared bounds
+    for _ in range(16):
+        proposal = sched.perturb({"lr": 0.5})
+        assert 0.05 <= proposal["lr"] <= 1.0
