@@ -247,6 +247,49 @@ def _materialize(upstream: Iterator[RefMeta]) -> list[RefMeta]:
     return list(upstream)
 
 
+def _holder_map(all_refs) -> "tuple[dict, dict] | None":
+    """ONE batched locality snapshot for a whole exchange: object id ->
+    holder addrs, plus addr -> node_id. The locality signal for
+    push-based reduce placement (reference:
+    exchange/push_based_shuffle_task_scheduler.py:400 — merges pipeline
+    on the nodes that already hold the map outputs, so partition bytes
+    never transit the driver or a third node). Two GCS RPCs total, not
+    two per partition."""
+    from ray_tpu.core.api import _cluster
+
+    cb = _cluster()
+    if cb is None:
+        return None
+    try:
+        client = cb.client
+        ids = [getattr(r, "id", None) for r in all_refs]
+        ids = [i for i in ids if i is not None]
+        if not ids:
+            return None
+        locs = client.gcs.call("locate_many", {"object_ids": ids}, timeout=5)
+        addr_node = {
+            tuple(n["addr"]): n["node_id"]
+            for n in client.gcs.call("list_nodes", None, timeout=5)
+        }
+        return (locs or {}), addr_node
+    except Exception:  # noqa: BLE001 — locality is an optimization only
+        return None
+
+
+def _majority_holder(refs, holder_map) -> "str | None":
+    """node_id holding the most of these split outputs, or None."""
+    if holder_map is None:
+        return None
+    locs, addr_node = holder_map
+    counts: dict = {}
+    for r in refs:
+        for a in locs.get(getattr(r, "id", None)) or ():
+            counts[tuple(a)] = counts.get(tuple(a), 0) + 1
+    if not counts:
+        return None
+    return addr_node.get(max(counts, key=counts.get))
+
+
 def _exchange(
     inputs: list[RefMeta],
     n_out: int,
@@ -256,9 +299,14 @@ def _exchange(
     name: str,
 ) -> Iterator[RefMeta]:
     """Two-phase all-to-all: split every input block into n_out partitions,
-    then merge partition j across all inputs."""
+    then merge partition j across all inputs. On a cluster, each merge is
+    scheduled (soft affinity) on the node holding most of its partition's
+    split outputs — block bytes move holder -> reducer directly through
+    the object plane, never via the driver."""
     if not inputs:
         return
+    from ray_tpu.core.api import _cluster
+
     split = _remote(_exec_split, num_returns=n_out) if n_out > 1 else None
     parts: list[tuple] = []  # per input: tuple of n_out refs
     for i, (ref, _) in enumerate(inputs):
@@ -269,8 +317,28 @@ def _exchange(
             parts.append(tuple(out))
     stats.record(f"{name}.map", n_tasks=len(inputs))
     merge = _remote(_exec_merge, num_returns=2)
+    holder_map = None
+    if _cluster() is not None and n_out > 1:
+        # the locality lookup needs the split outputs to EXIST; a short
+        # bounded wait trades a little pipelining for placed reduces
+        try:
+            api.wait(
+                [p[0] for p in parts], num_returns=len(parts), timeout=10.0
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        holder_map = _holder_map([r for p in parts for r in p])
     for j in range(n_out):
-        refs = merge.remote(postprocess, j, *[p[j] for p in parts])
+        refs_j = [p[j] for p in parts]
+        node = _majority_holder(refs_j, holder_map)
+        m = merge
+        if node is not None:
+            m = merge.options(
+                scheduling_strategy=api.NodeAffinitySchedulingStrategy(
+                    node, soft=True
+                )
+            )
+        refs = m.remote(postprocess, j, *refs_j)
         stats.record(f"{name}.reduce", n_tasks=1)
         yield _resolve(refs)
 

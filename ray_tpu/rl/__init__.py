@@ -13,7 +13,8 @@ from ray_tpu.rl.module import MLPModule, RLModule, RLModuleSpec
 from ray_tpu.rl.env_runner import EnvRunnerGroup, SingleAgentEnvRunner
 from ray_tpu.rl.learner import Learner, LearnerGroup
 from ray_tpu.rl.replay import PrioritizedReplayBuffer, ReplayBuffer
-from ray_tpu.rl.algorithms import DQN, DQNConfig, IMPALA, IMPALAConfig, PPO, PPOConfig
+from ray_tpu.rl.algorithms import (APPO, APPOConfig, DQN, DQNConfig, IMPALA,
+                                   IMPALAConfig, PPO, PPOConfig)
 
 __all__ = [
     "Algorithm",
@@ -27,6 +28,8 @@ __all__ = [
     "LearnerGroup",
     "ReplayBuffer",
     "PrioritizedReplayBuffer",
+    "APPO",
+    "APPOConfig",
     "PPO",
     "PPOConfig",
     "IMPALA",

@@ -1,9 +1,10 @@
-from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig
-from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rl.algorithms.appo import APPO, APPOConfig
 from ray_tpu.rl.algorithms.dqn import DQN, DQNConfig
+from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig
+from ray_tpu.rl.algorithms.ppo import PPO, PPOConfig
 from ray_tpu.rl.algorithms.sac import SAC, SACConfig
 
 __all__ = [
-    "PPO", "PPOConfig", "IMPALA", "IMPALAConfig", "DQN", "DQNConfig",
-    "SAC", "SACConfig",
+    "APPO", "APPOConfig", "PPO", "PPOConfig", "IMPALA", "IMPALAConfig",
+    "DQN", "DQNConfig", "SAC", "SACConfig",
 ]

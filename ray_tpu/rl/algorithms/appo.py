@@ -1,0 +1,80 @@
+"""APPO: asynchronous PPO on the IMPALA actor-learner topology.
+
+Reference analog: rllib/algorithms/appo/appo.py:1 (+ appo_learner /
+default_appo_rl_module) — PPO's clipped surrogate objective applied to
+ASYNCHRONOUSLY collected, slightly-stale rollouts, with V-trace
+correcting the off-policy gap in both the value targets and the
+policy-gradient advantages. Differences from the reference kept
+deliberate: the target-network smoothing of value bootstraps is
+replaced by stop-gradient V-trace targets from the live params (the
+reference's own "new API stack" APPO moved the same way), and the
+optional KL penalty against the behavior policy is a config switch.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ray_tpu.rl.algorithms.impala import IMPALA, IMPALAConfig
+
+
+class APPOConfig(IMPALAConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = APPO
+        self.clip_param = 0.3
+        self.use_kl_loss = False
+        self.kl_coeff = 0.2
+
+    def training(self, **kwargs):
+        for k in ("clip_param", "use_kl_loss", "kl_coeff"):
+            if k in kwargs:
+                setattr(self, k, kwargs.pop(k))
+        return super().training(**kwargs)
+
+
+class APPO(IMPALA):
+    """Only the LOSS differs from IMPALA — topology, learner wiring, and
+    V-trace targets come from the base class (_make_loss hook)."""
+
+    @classmethod
+    def default_config(cls) -> APPOConfig:
+        return APPOConfig()
+
+    def _make_loss(self, module):
+        cfg = self.config
+        vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
+        clip = cfg.clip_param
+        use_kl, kl_coeff = cfg.use_kl_loss, cfg.kl_coeff
+
+        def loss_fn(params, batch, _key):
+            out = module.forward(params, batch["obs"])  # [T, B, ...]
+            target_logp = module.dist.logp(
+                out["action_dist_inputs"], batch["actions"]
+            )
+            vs, pg_adv = self._vtrace_targets(
+                module, params, batch, out, target_logp
+            )
+            adv = (pg_adv - pg_adv.mean()) / (pg_adv.std() + 1e-8)
+            # PPO clipped surrogate against the BEHAVIOR policy's logp —
+            # the asynchronous staleness IS the "old policy" gap
+            ratio = jnp.exp(target_logp - batch["logp"])
+            surr = jnp.minimum(
+                ratio * adv, jnp.clip(ratio, 1 - clip, 1 + clip) * adv
+            )
+            pg_loss = -surr.mean()
+            vf_loss = 0.5 * jnp.square(out["vf"] - vs).mean()
+            entropy = module.dist.entropy(out["action_dist_inputs"]).mean()
+            loss = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            metrics = {
+                "policy_loss": pg_loss, "vf_loss": vf_loss, "entropy": entropy,
+                "mean_ratio": ratio.mean(),
+            }
+            if use_kl:
+                # sample KL(behavior || target) estimate from logp gap
+                kl = (batch["logp"] - target_logp).mean()
+                loss = loss + kl_coeff * jnp.abs(kl)
+                metrics["kl"] = kl
+            return loss, metrics
+
+        return loss_fn

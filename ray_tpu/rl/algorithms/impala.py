@@ -50,40 +50,9 @@ class IMPALA(Algorithm):
         cfg = self.config
         module = self.module_spec.build()
         self.module = module
-        gamma = cfg.gamma
-        rho, c = cfg.clip_rho_threshold, cfg.clip_c_threshold
-        vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
-
-        def loss_fn(params, batch, _key):
-            # batch is time-major [T, B, ...]
-            out = module.forward(params, batch["obs"])
-            target_logp = module.dist.logp(out["action_dist_inputs"], batch["actions"])
-            # targets must be gradient-free (reference vtrace computes them
-            # outside the tape) — stop final_vf too, not just values/logp
-            final_vf = jax.lax.stop_gradient(
-                module.forward(params, batch["final_obs"])["vf"]
-            )
-            vs, pg_adv = compute_vtrace(
-                batch["logp"],
-                jax.lax.stop_gradient(target_logp),
-                batch["rewards"],
-                jax.lax.stop_gradient(out["vf"]),
-                final_vf,
-                batch["terminateds"],
-                batch["truncateds"],
-                gamma=gamma,
-                clip_rho=rho,
-                clip_c=c,
-            )
-            pg_loss = -(target_logp * pg_adv).mean()
-            vf_loss = 0.5 * jnp.square(out["vf"] - vs).mean()
-            entropy = module.dist.entropy(out["action_dist_inputs"]).mean()
-            loss = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
-            return loss, {"policy_loss": pg_loss, "vf_loss": vf_loss, "entropy": entropy}
-
         self.learner_group = LearnerGroup(
             self.module_spec,
-            loss_fn,
+            self._make_loss(module),
             num_learners=cfg.num_learners,
             lr=cfg.lr,
             grad_clip=cfg.grad_clip,
@@ -92,6 +61,48 @@ class IMPALA(Algorithm):
             batch_axis=lambda name, leaf: 0 if name == "final_obs" else min(1, leaf.ndim - 1),
         )
         self._inflight: list = []
+
+    def _vtrace_targets(self, module, params, batch, out, target_logp):
+        """(vs, pg_adv) with gradient-free targets — the piece every
+        V-trace algorithm shares (APPO subclasses swap only the
+        surrogate, rl/algorithms/appo.py)."""
+        cfg = self.config
+        # targets must be gradient-free (reference vtrace computes them
+        # outside the tape) — stop final_vf too, not just values/logp
+        final_vf = jax.lax.stop_gradient(
+            module.forward(params, batch["final_obs"])["vf"]
+        )
+        return compute_vtrace(
+            batch["logp"],
+            jax.lax.stop_gradient(target_logp),
+            batch["rewards"],
+            jax.lax.stop_gradient(out["vf"]),
+            final_vf,
+            batch["terminateds"],
+            batch["truncateds"],
+            gamma=cfg.gamma,
+            clip_rho=cfg.clip_rho_threshold,
+            clip_c=cfg.clip_c_threshold,
+        )
+
+    def _make_loss(self, module):
+        cfg = self.config
+        vf_coeff, ent_coeff = cfg.vf_loss_coeff, cfg.entropy_coeff
+
+        def loss_fn(params, batch, _key):
+            # batch is time-major [T, B, ...]
+            out = module.forward(params, batch["obs"])
+            target_logp = module.dist.logp(out["action_dist_inputs"], batch["actions"])
+            vs, pg_adv = self._vtrace_targets(
+                module, params, batch, out, target_logp
+            )
+            pg_loss = -(target_logp * pg_adv).mean()
+            vf_loss = 0.5 * jnp.square(out["vf"] - vs).mean()
+            entropy = module.dist.entropy(out["action_dist_inputs"]).mean()
+            loss = pg_loss + vf_coeff * vf_loss - ent_coeff * entropy
+            return loss, {"policy_loss": pg_loss, "vf_loss": vf_loss, "entropy": entropy}
+
+        return loss_fn
 
     def training_step(self) -> dict:
         cfg = self.config

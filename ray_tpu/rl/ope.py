@@ -1,0 +1,250 @@
+"""Off-policy evaluation: IS / WIS / DM / DR estimators + FQE.
+
+Reference analog: rllib/offline/estimators/ — importance_sampling.py,
+weighted_importance_sampling.py, direct_method.py, doubly_robust.py,
+with fqe_torch_model.py providing the Q-model DM/DR need. Redesigned
+functional: estimators are pure numpy over EPISODE dicts, FQE is one
+jitted fitted-Q iteration loop (discrete actions).
+
+An episode dict: {"obs" [T, obs_dim], "actions" [T] int, "rewards" [T],
+"action_prob" [T] (behavior policy's probability of the logged
+action)}. `policy` is anything with `action_probs(obs) -> [T, A]`
+(TargetPolicy wraps an RLModule + params).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class TargetPolicy:
+    """RLModule adapter exposing action probabilities (discrete)."""
+
+    def __init__(self, module, params):
+        self.module = module
+        self.params = params
+        self._probs = jax.jit(
+            lambda p, obs: jax.nn.softmax(
+                module.forward(p, obs)["action_dist_inputs"], axis=-1
+            )
+        )
+
+    def action_probs(self, obs) -> np.ndarray:  # [T, A]
+        return np.asarray(self._probs(self.params, jnp.asarray(obs)))
+
+
+def _ratios(policy, ep) -> np.ndarray:
+    """Per-step rho_t = pi(a_t|s_t) / b(a_t|s_t)."""
+    probs = policy.action_probs(ep["obs"])
+    pi = probs[np.arange(len(ep["actions"])), np.asarray(ep["actions"], int)]
+    b = np.clip(np.asarray(ep["action_prob"], np.float64), 1e-8, None)
+    return pi / b
+
+
+def _behavior_return(ep, gamma: float) -> float:
+    r = np.asarray(ep["rewards"], np.float64)
+    return float((r * gamma ** np.arange(len(r))).sum())
+
+
+class OffPolicyEstimator:
+    def __init__(self, policy, gamma: float = 0.99):
+        self.policy = policy
+        self.gamma = gamma
+
+    def estimate(self, episodes: Sequence[dict]) -> dict:
+        vals = [self.estimate_on_single_episode(ep) for ep in episodes]
+        behav = [_behavior_return(ep, self.gamma) for ep in episodes]
+        v_t = float(np.mean(vals))
+        v_b = float(np.mean(behav))
+        return {
+            "v_target": v_t,
+            "v_behavior": v_b,
+            "v_gain": v_t / v_b if v_b else float("nan"),
+            "v_std": float(np.std(vals) / max(1, len(vals)) ** 0.5),
+        }
+
+    def estimate_on_single_episode(self, ep: dict) -> float:
+        raise NotImplementedError
+
+
+class ImportanceSampling(OffPolicyEstimator):
+    """Per-decision IS (reference: estimators/importance_sampling.py):
+    V = sum_t gamma^t (prod_{k<=t} rho_k) r_t."""
+
+    def estimate_on_single_episode(self, ep: dict) -> float:
+        rho = np.cumprod(_ratios(self.policy, ep))
+        r = np.asarray(ep["rewards"], np.float64)
+        return float((self.gamma ** np.arange(len(r)) * rho * r).sum())
+
+
+class WeightedImportanceSampling(OffPolicyEstimator):
+    """WIS: cumulative weights normalized per TIMESTEP across the
+    dataset (reference: weighted_importance_sampling.py) — biased but
+    far lower variance than plain IS.
+
+    Caveat (inherent to the estimator, reference included): on
+    CONSTANT-reward domains (e.g. CartPole's +1/step) the per-timestep
+    normalization cancels exactly and v_target == v_behavior for any
+    policy — use IS or DR there."""
+
+    def estimate(self, episodes: Sequence[dict]) -> dict:
+        cum = [np.cumprod(_ratios(self.policy, ep)) for ep in episodes]
+        T = max(len(c) for c in cum)
+        # mean cumulative weight at each t over episodes still running
+        sums = np.zeros(T)
+        counts = np.zeros(T)
+        for c in cum:
+            sums[: len(c)] += c
+            counts[: len(c)] += 1
+        w_mean = sums / np.maximum(counts, 1)
+        vals = []
+        for ep, c in zip(episodes, cum):
+            r = np.asarray(ep["rewards"], np.float64)
+            t = np.arange(len(r))
+            w = c / np.clip(w_mean[: len(c)], 1e-12, None)
+            vals.append(float((self.gamma**t * w * r).sum()))
+        behav = [_behavior_return(ep, self.gamma) for ep in episodes]
+        v_t, v_b = float(np.mean(vals)), float(np.mean(behav))
+        return {
+            "v_target": v_t, "v_behavior": v_b,
+            "v_gain": v_t / v_b if v_b else float("nan"),
+            "v_std": float(np.std(vals) / max(1, len(vals)) ** 0.5),
+        }
+
+
+class DirectMethod(OffPolicyEstimator):
+    """DM (reference: direct_method.py): V = E_{a ~ pi}[Q(s_0, a)] from
+    a fitted Q-model (FQE)."""
+
+    def __init__(self, policy, q_model: "FQE", gamma: float = 0.99):
+        super().__init__(policy, gamma)
+        self.q_model = q_model
+
+    def estimate_on_single_episode(self, ep: dict) -> float:
+        q0 = self.q_model.q_values(ep["obs"][:1])[0]        # [A]
+        pi0 = self.policy.action_probs(ep["obs"][:1])[0]    # [A]
+        return float((pi0 * q0).sum())
+
+
+class DoublyRobust(OffPolicyEstimator):
+    """Per-decision DR (reference: doubly_robust.py, Jiang & Li 2016):
+    V_DR^t = Vhat(s_t) + rho_t (r_t + gamma V_DR^{t+1} - Qhat(s_t, a_t)),
+    unbiased when either the model or the behavior probs are right."""
+
+    def __init__(self, policy, q_model: "FQE", gamma: float = 0.99):
+        super().__init__(policy, gamma)
+        self.q_model = q_model
+
+    def estimate_on_single_episode(self, ep: dict) -> float:
+        rho = _ratios(self.policy, ep)
+        q = self.q_model.q_values(ep["obs"])               # [T, A]
+        pi = self.policy.action_probs(ep["obs"])           # [T, A]
+        v_hat = (pi * q).sum(-1)                           # [T]
+        q_a = q[np.arange(len(rho)), np.asarray(ep["actions"], int)]
+        r = np.asarray(ep["rewards"], np.float64)
+        v_dr = 0.0
+        for t in range(len(r) - 1, -1, -1):
+            v_dr = v_hat[t] + rho[t] * (r[t] + self.gamma * v_dr - q_a[t])
+        return float(v_dr)
+
+
+class FQE:
+    """Fitted Q Evaluation for a FIXED target policy (discrete actions).
+
+    Reference analog: offline/estimators/fqe_torch_model.py — an MLP
+    Q(s, .) trained by iterated Bellman regression
+        Q <- r + gamma * (1 - done) * sum_a pi(a|s') Q_tgt(s', a)
+    with a periodically synced target net; one jitted update."""
+
+    def __init__(self, policy, obs_dim: int, num_actions: int,
+                 hidden: tuple = (64, 64), lr: float = 1e-2,
+                 gamma: float = 0.99, target_sync: int = 25, seed: int = 0):
+        from ray_tpu.rl.module import _mlp_apply, _mlp_init
+
+        self.policy = policy
+        self.gamma = gamma
+        self.target_sync = target_sync
+        key = jax.random.key(seed)
+        dims = [obs_dim, *hidden, num_actions]
+        self.params = _mlp_init(key, dims)
+        self.tgt = jax.tree.map(jnp.copy, self.params)
+        self._apply = _mlp_apply
+        import optax
+
+        self.opt = optax.adam(lr)
+        self.opt_state = self.opt.init(self.params)
+
+        def update(params, opt_state, tgt, batch):
+            def loss_fn(p):
+                q = _mlp_apply(p, batch["obs"])  # [N, A]
+                q_a = jnp.take_along_axis(
+                    q, batch["actions"][:, None], axis=-1
+                )[:, 0]
+                qn = _mlp_apply(tgt, batch["next_obs"])
+                v_next = (batch["pi_next"] * qn).sum(-1)
+                target = batch["rewards"] + gamma * (1 - batch["dones"]) * v_next
+                return jnp.square(q_a - jax.lax.stop_gradient(target)).mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            import optax as _optax
+
+            return _optax.apply_updates(params, updates), opt_state, loss
+
+        self._update = jax.jit(update)
+        self._q = jax.jit(_mlp_apply)
+
+    def train(self, episodes: Sequence[dict], iters: int = 200,
+              batch_size: int = 256, seed: int = 0) -> float:
+        obs, actions, rewards, next_obs, dones = [], [], [], [], []
+        for ep in episodes:
+            T = len(ep["rewards"])
+            terminated = ep.get("terminated", True)
+            # a TRUNCATED episode's last transition has no observed
+            # successor state — bootstrapping it from obs[-1] itself
+            # would chase the self-referential fixed point r/(1-gamma);
+            # drop it from the regression set instead
+            keep = T if terminated else T - 1
+            if keep <= 0:
+                continue
+            o = np.asarray(ep["obs"], np.float32)
+            obs.append(o[:keep])
+            actions.append(np.asarray(ep["actions"][:keep], np.int32))
+            rewards.append(np.asarray(ep["rewards"][:keep], np.float32))
+            nxt = np.concatenate([o[1:], o[-1:]], 0)[:keep]
+            next_obs.append(nxt)
+            d = np.zeros(keep, np.float32)
+            if terminated:
+                d[-1] = 1.0
+            dones.append(d)
+        obs = np.concatenate(obs)
+        actions = np.concatenate(actions)
+        rewards = np.concatenate(rewards)
+        next_obs = np.concatenate(next_obs)
+        dones = np.concatenate(dones)
+        pi_next = self.policy.action_probs(next_obs)
+        rng = np.random.default_rng(seed)
+        loss = 0.0
+        for i in range(iters):
+            idx = rng.integers(0, len(obs), size=min(batch_size, len(obs)))
+            batch = {
+                "obs": jnp.asarray(obs[idx]),
+                "actions": jnp.asarray(actions[idx]),
+                "rewards": jnp.asarray(rewards[idx]),
+                "next_obs": jnp.asarray(next_obs[idx]),
+                "dones": jnp.asarray(dones[idx]),
+                "pi_next": jnp.asarray(pi_next[idx]),
+            }
+            self.params, self.opt_state, loss = self._update(
+                self.params, self.opt_state, self.tgt, batch
+            )
+            if (i + 1) % self.target_sync == 0:
+                self.tgt = jax.tree.map(jnp.copy, self.params)
+        return float(loss)
+
+    def q_values(self, obs) -> np.ndarray:
+        return np.asarray(self._q(self.params, jnp.asarray(obs, jnp.float32)))

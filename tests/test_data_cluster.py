@@ -55,3 +55,40 @@ def test_dataset_shuffle_and_reduce_over_cluster(attached_cluster):
     ds = rdata.range(64, parallelism=4).random_shuffle(seed=7)
     total = sum(int(r) for r in ds.take_all())
     assert total == sum(range(64))
+
+
+def test_shuffle_reduces_placed_on_block_holders(attached_cluster):
+    """Locality-aware exchange (reference: push_based_shuffle_task_
+    scheduler.py:400): reduce tasks run with soft affinity to the node
+    holding most of their partition's split outputs, and partition
+    bytes flow holder -> reducer through the object plane — the DRIVER
+    process never touches a block during the exchange."""
+    driver_pid = os.getpid()
+
+    def tag(batch):
+        import os as _os
+
+        return {
+            "item": list(batch["item"]),
+            "pid": [_os.getpid()] * len(batch["item"]),
+            "node": [_os.environ.get("RAY_TPU_NODE_ID", "?")] * len(batch["item"]),
+        }
+
+    ds = (
+        rdata.range(160, parallelism=8)
+        .random_shuffle(seed=3)
+        .map_batches(tag)  # tags the POST-reduce blocks with their host
+    )
+    rows = ds.take_all()
+    assert sorted(int(r["item"]) for r in rows) == list(range(160))
+    pids = {int(r["pid"]) for r in rows}
+    assert driver_pid not in pids, "exchange blocks transited the driver"
+    nodes = {r["node"] for r in rows}
+    assert nodes <= {"head", "n1"} and nodes, nodes
+
+    # placement telemetry: reduce spans ran on real nodes, spread over
+    # the cluster rather than herding one daemon
+    client = api._cluster().client
+    spans = [s for s in client._spans if s.get("desc", "").startswith("_exec_merge")]
+    span_nodes = {s["node"] for s in spans[-8:]}
+    assert span_nodes <= {"head", "n1"} and span_nodes, span_nodes

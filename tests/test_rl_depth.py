@@ -240,3 +240,29 @@ def test_multi_agent_runner_routes_policies_and_learns():
     final = runner.sample(params, num_steps=64)
     for pid, b in final.items():
         assert b["rewards"].mean() > 0.6, (pid, b["rewards"].mean())
+
+
+@pytest.mark.slow
+def test_ppo_reaches_cartpole_400():
+    """Learning-REGRESSION gate (reference: rllib/tuned_examples/ppo
+    cartpole targets ~450): PPO must reach a near-solved return, not
+    just 'better than random'."""
+    from ray_tpu.rl import PPOConfig
+
+    algo = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_envs_per_env_runner=8, rollout_fragment_length=128)
+        .training(lr=3e-4, minibatch_size=256, num_epochs=8,
+                  entropy_coeff=0.01)
+        .debugging(seed=0)
+        .build_algo()
+    )
+    best = 0.0
+    for i in range(60):
+        result = algo.train()
+        best = max(best, result.get("episode_return_mean", 0.0))
+        if best >= 400:
+            break
+    algo.cleanup()
+    assert best >= 400, f"PPO best return {best} < 400 after {i+1} iters"
