@@ -155,6 +155,9 @@ class LocalCluster:
         node_id: Optional[str] = None,
         worker_env: Optional[dict] = None,
         object_capacity_bytes: Optional[int] = None,
+        worker_rss_limit_mb: Optional[int] = None,
+        memory_usage_threshold: Optional[float] = None,
+        memory_monitor_interval_s: Optional[float] = None,
     ) -> NodeProc:
         assert self.gcs_addr is not None, "start() first"
         resources = resources or {"num_cpus": 1}
@@ -166,6 +169,12 @@ class LocalCluster:
         ]
         if object_capacity_bytes is not None:
             cmd += ["--object-capacity", str(object_capacity_bytes)]
+        if worker_rss_limit_mb is not None:
+            cmd += ["--worker-rss-limit-mb", str(worker_rss_limit_mb)]
+        if memory_usage_threshold is not None:
+            cmd += ["--memory-usage-threshold", str(memory_usage_threshold)]
+        if memory_monitor_interval_s is not None:
+            cmd += ["--memory-monitor-interval", str(memory_monitor_interval_s)]
         if node_id:
             cmd += ["--node-id", node_id]
         if worker_env:

@@ -434,6 +434,9 @@ class NodeDaemon:
         worker_env: Optional[dict] = None,
         heartbeat_interval_s: float = 0.5,
         object_capacity_bytes: int = 512 << 20,
+        worker_rss_limit_mb: int = 0,       # 0 = no per-worker cap
+        memory_usage_threshold: float = 0.95,  # node pressure kill point
+        memory_monitor_interval_s: float = 1.0,  # 0 = monitor disabled
     ):
         self.node_id = node_id or f"node-{uuid.uuid4().hex[:8]}"
         self.gcs_addr = gcs_addr
@@ -442,6 +445,10 @@ class NodeDaemon:
         self.labels = labels or {}
         self.worker_env = worker_env or {}
         self._hb_interval = heartbeat_interval_s
+        self._rss_limit_mb = int(worker_rss_limit_mb)
+        self._mem_threshold = float(memory_usage_threshold)
+        self._mem_interval = float(memory_monitor_interval_s)
+        self._oom_kills = 0
         # RLock: PG-bundle reserve is check-then-act over _bundles AND the
         # node availability — the whole sequence must be atomic across
         # handler threads (reference: PlacementGroupResourceManager commits
@@ -504,7 +511,111 @@ class NodeDaemon:
         threading.Thread(
             target=self._granter_loop, name="node-granter", daemon=True
         ).start()
+        if self._mem_interval > 0:
+            threading.Thread(
+                target=self._memory_monitor_loop, name="node-memmon",
+                daemon=True,
+            ).start()
         return self.addr
+
+    # -- memory monitor -------------------------------------------------------
+    # Reference analog: src/ray/raylet/worker_killing_policy.cc — under
+    # node memory pressure the raylet kills workers (retriable tasks
+    # first, newest first) instead of letting the kernel OOM-killer take
+    # out the daemon or an arbitrary process. Two triggers here:
+    #   * per-worker RSS cap (worker_rss_limit_mb): a deterministic cap
+    #     against one runaway task;
+    #   * node usage threshold (memory_usage_threshold over
+    #     /proc/meminfo): kill the NEWEST leased worker — its pusher gets
+    #     a connection error and the task re-leases under max_retries,
+    #     exactly the retriable-FIFO policy's assumption.
+
+    @staticmethod
+    def _worker_rss_mb(pid: int) -> float:
+        try:
+            with open(f"/proc/{pid}/statm") as f:
+                pages = int(f.read().split()[1])
+            return pages * (os.sysconf("SC_PAGE_SIZE") / (1 << 20))
+        except (OSError, ValueError, IndexError):
+            return 0.0
+
+    @staticmethod
+    def _node_memory_usage() -> float:
+        try:
+            info = {}
+            with open("/proc/meminfo") as f:
+                for line in f:
+                    k, v = line.split(":", 1)
+                    info[k] = int(v.strip().split()[0])
+            total = info.get("MemTotal", 0)
+            avail = info.get("MemAvailable", total)
+            return 1.0 - avail / total if total else 0.0
+        except (OSError, ValueError):
+            return 0.0
+
+    def _memory_monitor_loop(self) -> None:
+        while not self._stop.wait(self._mem_interval):
+            try:
+                self._memory_check()
+            except Exception:  # noqa: BLE001 — monitor must never die
+                logger.exception("memory monitor tick failed")
+
+    def _memory_check(self) -> None:
+        # reap corpses first: a worker the monitor killed last tick must
+        # leave _all_workers/_idle_workers, or the newest-first selection
+        # would livelock re-killing the same dead handle every tick while
+        # live workers hold the actual memory
+        with self._wlock:
+            dead = [w for w in self._all_workers.values() if not w.alive()]
+            for w in dead:
+                self._all_workers.pop(w.worker_id, None)
+            for key, pool in list(self._idle_workers.items()):
+                keep = [w for w in pool if w.alive()]
+                if keep:
+                    self._idle_workers[key] = keep
+                else:
+                    self._idle_workers.pop(key, None)
+            workers = list(self._all_workers.values())
+        victims: list[tuple] = []
+        if self._rss_limit_mb > 0:
+            for w in workers:
+                if not w.alive():
+                    continue
+                rss = self._worker_rss_mb(w.proc.pid)
+                if rss > self._rss_limit_mb:
+                    victims.append((w, f"rss {rss:.0f}MB > limit "
+                                       f"{self._rss_limit_mb}MB"))
+        if not victims and self._mem_threshold < 1.0:
+            usage = self._node_memory_usage()
+            if usage > self._mem_threshold:
+                # newest LIVE leased worker first (retriable-FIFO policy);
+                # fall back to the newest idle worker to shed pool memory
+                with self._res_lock:
+                    leased = sorted(
+                        (ls for ls in self._leases.values()
+                         if ls.get("worker") is not None
+                         and ls["worker"].alive()),
+                        key=lambda ls: ls.get("t", 0.0), reverse=True,
+                    )
+                live = [w for w in workers if w.alive()]
+                if leased:
+                    victims.append((
+                        leased[0]["worker"],
+                        f"node memory {usage:.0%} > "
+                        f"{self._mem_threshold:.0%} (newest leased)",
+                    ))
+                elif live:
+                    victims.append((
+                        max(live, key=lambda w: w.idle_since),
+                        f"node memory {usage:.0%} (idle worker)",
+                    ))
+        for w, why in victims:
+            logger.warning(
+                "memory monitor killing worker %s (pid %s): %s",
+                w.worker_id, w.proc.pid, why,
+            )
+            self._oom_kills += 1
+            w.kill()
 
     def stop(self) -> None:
         self._stop.set()
@@ -763,6 +874,7 @@ class NodeDaemon:
             lease_id = uuid.uuid4().hex
             self._leases[lease_id] = {
                 "resources": res, "worker": w, "pg_key": pg_key,
+                "t": time.monotonic(),  # newest-first OOM kill policy
             }
             return {
                 "grant": {
@@ -1058,6 +1170,7 @@ class NodeDaemon:
                 "total": dict(self.total),
                 "available": dict(self.available),
                 "num_leases": len(self._leases),
+                "num_oom_kills": self._oom_kills,
                 "num_workers": len(self._all_workers),
                 "objects": self.objects.stats(),
             }
@@ -1072,6 +1185,13 @@ def main() -> None:
     p.add_argument("--worker-env", default="", help="k=v,... for worker processes")
     p.add_argument("--object-capacity", type=int, default=512 << 20,
                    help="object store memory tier cap in bytes (LRU spills to disk)")
+    p.add_argument("--worker-rss-limit-mb", type=int, default=0,
+                   help="kill any worker whose RSS exceeds this (0 = off)")
+    p.add_argument("--memory-usage-threshold", type=float, default=0.95,
+                   help="node memory fraction that triggers worker kills "
+                        "(>=1.0 disables the pressure trigger)")
+    p.add_argument("--memory-monitor-interval", type=float, default=1.0,
+                   help="memory monitor tick seconds (0 disables entirely)")
     args = p.parse_args()
     host, port = args.gcs.rsplit(":", 1)
     resources: dict[str, float] = {}
@@ -1087,6 +1207,9 @@ def main() -> None:
     daemon = NodeDaemon(
         (host, int(port)), resources, node_id=args.node_id, worker_env=worker_env,
         object_capacity_bytes=args.object_capacity,
+        worker_rss_limit_mb=args.worker_rss_limit_mb,
+        memory_usage_threshold=args.memory_usage_threshold,
+        memory_monitor_interval_s=args.memory_monitor_interval,
     )
     addr = daemon.start()
     print(f"NODE_ADDRESS {addr[0]}:{addr[1]} {daemon.node_id}", flush=True)

@@ -57,6 +57,10 @@ class OffPolicyEstimator:
 
     def estimate(self, episodes: Sequence[dict]) -> dict:
         vals = [self.estimate_on_single_episode(ep) for ep in episodes]
+        return self._summarize(vals, episodes)
+
+    def _summarize(self, vals: Sequence[float],
+                   episodes: Sequence[dict]) -> dict:
         behav = [_behavior_return(ep, self.gamma) for ep in episodes]
         v_t = float(np.mean(vals))
         v_b = float(np.mean(behav))
@@ -107,13 +111,7 @@ class WeightedImportanceSampling(OffPolicyEstimator):
             t = np.arange(len(r))
             w = c / np.clip(w_mean[: len(c)], 1e-12, None)
             vals.append(float((self.gamma**t * w * r).sum()))
-        behav = [_behavior_return(ep, self.gamma) for ep in episodes]
-        v_t, v_b = float(np.mean(vals)), float(np.mean(behav))
-        return {
-            "v_target": v_t, "v_behavior": v_b,
-            "v_gain": v_t / v_b if v_b else float("nan"),
-            "v_std": float(np.std(vals) / max(1, len(vals)) ** 0.5),
-        }
+        return self._summarize(vals, episodes)
 
 
 class DirectMethod(OffPolicyEstimator):
@@ -221,6 +219,12 @@ class FQE:
             if terminated:
                 d[-1] = 1.0
             dones.append(d)
+        if not obs:
+            raise ValueError(
+                "FQE has no usable transitions: every episode was empty or "
+                "a 1-step truncation (truncated finals are excluded from "
+                "the Bellman regression)"
+            )
         obs = np.concatenate(obs)
         actions = np.concatenate(actions)
         rewards = np.concatenate(rewards)

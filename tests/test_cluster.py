@@ -390,3 +390,43 @@ def test_task_returns_ride_shared_memory(cluster):
         if held:  # a node holding shm objects must show shm bytes in use
             assert st["shm"]["used"] > 0
     assert shm_objects >= 4, "results did not land in the shm tier"
+
+
+def test_memory_monitor_kills_runaway_worker_and_task_retries(tmp_path):
+    """Reference: raylet worker_killing_policy.cc — a worker blowing the
+    RSS cap is killed by the daemon's memory monitor; the task's pusher
+    sees the connection drop and RE-LEASES it (max_retries), and the
+    retry (which no longer over-allocates: transient pressure) completes.
+    """
+    marker = str(tmp_path / "attempt.marker")
+
+    def greedy(marker_path):
+        import os as _os
+        import time as _t
+
+        if not _os.path.exists(marker_path):
+            with open(marker_path, "w") as f:
+                f.write("1")
+            # ~600MB over-allocation, far over the cap; park until killed
+            hog = bytearray(600 << 20)
+            hog[::4096] = b"x" * len(hog[::4096])  # touch pages
+            _t.sleep(60)
+            return "survived-over-limit"  # must never happen
+        return "completed-on-retry"
+
+    with LocalCluster(node_death_timeout_s=5.0) as cluster:
+        cluster.start()
+        # cap must clear a worker's BASELINE footprint (~170MB with the
+        # jax import) but sit far under the hog's allocation
+        cluster.add_node({"num_cpus": 1}, node_id="memnode",
+                         worker_rss_limit_mb=400)
+        cluster.wait_for_nodes(1)
+        client = cluster.client()
+        ref = client.submit(
+            greedy, (marker,), resources={"num_cpus": 1}, max_retries=3
+        )
+        out = client.get(ref, timeout=120)
+        assert out == "completed-on-retry"
+        # the daemon recorded the OOM kill
+        stats = client.local_daemon.call("stats", None)
+        assert stats["num_oom_kills"] >= 1, stats
