@@ -591,6 +591,9 @@ class ClusterClient:
             "affinity_node_id": affinity_node_id,
             "affinity_soft": affinity_soft,
             "runtime_env": self._package_runtime_env(runtime_env),
+            # the daemon's memory monitor prefers killing retriable work
+            # (reference: worker_killing_policy retriable-first)
+            "retriable": max_retries > 0,
         }
         if (self.auto_free and max_retries > 0
                 and len(self._lineage) < self._lineage_cap):

@@ -171,8 +171,13 @@ class LocalCluster:
             cmd += ["--object-capacity", str(object_capacity_bytes)]
         if worker_rss_limit_mb is not None:
             cmd += ["--worker-rss-limit-mb", str(worker_rss_limit_mb)]
-        if memory_usage_threshold is not None:
-            cmd += ["--memory-usage-threshold", str(memory_usage_threshold)]
+        # LocalCluster default: DISABLE the machine-wide pressure trigger
+        # (dev/CI hosts are shared — an unrelated tenant pushing the box
+        # past 95% must not make every test cluster kill its workers);
+        # the production `ray start` CLI keeps the raylet-parity 0.95
+        cmd += ["--memory-usage-threshold",
+                str(1.0 if memory_usage_threshold is None
+                    else memory_usage_threshold)]
         if memory_monitor_interval_s is not None:
             cmd += ["--memory-monitor-interval", str(memory_monitor_interval_s)]
         if node_id:

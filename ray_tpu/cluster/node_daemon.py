@@ -588,14 +588,19 @@ class NodeDaemon:
         if not victims and self._mem_threshold < 1.0:
             usage = self._node_memory_usage()
             if usage > self._mem_threshold:
-                # newest LIVE leased worker first (retriable-FIFO policy);
-                # fall back to the newest idle worker to shed pool memory
+                # RETRIABLE leases first, newest first (reference
+                # worker_killing_policy: a max_retries=0 task dies for
+                # good if its worker is killed — only shed it when no
+                # retriable victim exists); fall back to the newest idle
+                # worker to shed pool memory
                 with self._res_lock:
                     leased = sorted(
                         (ls for ls in self._leases.values()
                          if ls.get("worker") is not None
                          and ls["worker"].alive()),
-                        key=lambda ls: ls.get("t", 0.0), reverse=True,
+                        key=lambda ls: (
+                            not ls.get("retriable", True), -ls.get("t", 0.0)
+                        ),
                     )
                 live = [w for w in workers if w.alive()]
                 if leased:
@@ -875,6 +880,7 @@ class NodeDaemon:
             self._leases[lease_id] = {
                 "resources": res, "worker": w, "pg_key": pg_key,
                 "t": time.monotonic(),  # newest-first OOM kill policy
+                "retriable": bool(payload.get("retriable", True)),
             }
             return {
                 "grant": {

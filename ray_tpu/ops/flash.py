@@ -236,6 +236,10 @@ def _fwd_kernel(
         l = l_scr[...]
         safe_l = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> zeros
         o_ref[0] = (acc_scr[...] / safe_l).astype(o_ref.dtype).reshape(F, Bq, D)
+        # fully-masked rows end with m ~= NEG_INF (and rows no tile ever
+        # ran keep l == 0, m == NEG_INF), so lse lands at ~NEG_INF either
+        # way — the "weigh nothing" value ring attention's blockwise
+        # (o, lse) merge requires
         lse_ref[0] = (m_scr[...] + jnp.log(safe_l)).reshape(F, Bq, 1)
 
 
@@ -498,7 +502,7 @@ def _bwd_fused_kernel(q_ref, k_ref, v_ref, qseg_ref, kseg_ref, do_ref,
 
 def _bwd_call(q, k, v, qseg, kseg, o, lse, do, scale, causal, q_offset,
               block_q, block_k, sq_valid, sk_valid, interpret, has_segments,
-              fold):
+              fold, dlse=None):
     B, H, Sq_pad, D = q.shape
     _, KVH, Sk_pad, _ = k.shape
     G = H // KVH
@@ -511,6 +515,10 @@ def _bwd_call(q, k, v, qseg, kseg, o, lse, do, scale, causal, q_offset,
     delta = jnp.sum(
         do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1, keepdims=True
     )  # [B, H, Sq_pad, 1]
+    if dlse is not None:
+        # lse cotangent: d s_ij += dlse_i * p_ij, i.e. ds = p*(dp - delta
+        # + dlse) — folded into the delta the kernels already subtract
+        delta = delta - dlse.astype(jnp.float32)
 
     if nk == 1:
         dq, dk, dv = pl.pallas_call(
@@ -650,6 +658,47 @@ def _flash_bwd(scale, causal, q_offset, block_q, block_k, sq_valid, sk_valid,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8, 9))
+def _flash_lse(scale, causal, q_offset, block_q, block_k, sq_valid, sk_valid,
+               interpret, has_segments, fold, q, k, v, qseg, kseg):
+    """(o, lse) variant with a DIFFERENTIABLE lse — ring attention merges
+    per-block results through lse, so its cotangent must reach ds."""
+    (o, lse), _ = _flash_lse_fwd(
+        scale, causal, q_offset, block_q, block_k, sq_valid, sk_valid,
+        interpret, has_segments, fold, q, k, v, qseg, kseg,
+    )
+    return o, lse
+
+
+def _flash_lse_fwd(scale, causal, q_offset, block_q, block_k, sq_valid,
+                   sk_valid, interpret, has_segments, fold, q, k, v, qseg,
+                   kseg):
+    o, lse = _fwd_call(q, k, v, qseg, kseg, scale, causal, q_offset,
+                       block_q, block_k, sk_valid, interpret, has_segments,
+                       fold)
+    # same named residuals as _flash_fwd: under jax.checkpoint with
+    # save_only_these_names the ring's per-block forwards must be SAVED,
+    # not re-run n times per layer in the backward
+    o = jax.ad_checkpoint.checkpoint_name(o, "attn_out")
+    lse = jax.ad_checkpoint.checkpoint_name(lse, "attn_lse")
+    return (o, lse), (q, k, v, qseg, kseg, o, lse)
+
+
+def _flash_lse_bwd(scale, causal, q_offset, block_q, block_k, sq_valid,
+                   sk_valid, interpret, has_segments, fold, residuals, cts):
+    do, dlse = cts
+    q, k, v, qseg, kseg, o, lse = residuals
+    dq, dk, dv = _bwd_call(q, k, v, qseg, kseg, o, lse, do, scale, causal,
+                           q_offset, block_q, block_k, sq_valid, sk_valid,
+                           interpret, has_segments, fold, dlse=dlse)
+    zero_seg = np.zeros(qseg.shape, dtype=jax.dtypes.float0)
+    zero_kseg = np.zeros(kseg.shape, dtype=jax.dtypes.float0)
+    return dq, dk, dv, zero_seg, zero_kseg
+
+
+_flash_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)
+
+
 # ---------------------------------------------------------------------------
 # public API
 # ---------------------------------------------------------------------------
@@ -662,14 +711,22 @@ def flash_attention(
     *,
     causal: bool = True,
     segment_ids: Optional[jax.Array] = None,  # [B, S] (requires Sq == Sk)
+    kv_segment_ids: Optional[jax.Array] = None,  # [B, Sk] (k/v side override)
     q_offset: int | jax.Array = 0,
     softmax_scale: Optional[float] = None,
     block_q: int = DEFAULT_BLOCK_Q,
     block_k: Optional[int] = None,  # None = fused whole-sequence (VMEM-capped)
     interpret: Optional[bool] = None,
     fold_heads: Optional[int] = None,  # None = auto (largest safe divisor of G)
-) -> jax.Array:
-    """Drop-in for ops.attention.xla_attention with O(S) memory."""
+    return_lse: bool = False,
+) -> "jax.Array | tuple[jax.Array, jax.Array]":
+    """Drop-in for ops.attention.xla_attention with O(S) memory.
+
+    kv_segment_ids: when the k/v block carries DIFFERENT segments than q
+    (ring attention's rotating kv shards), pass them here; segment_ids
+    then applies to q only. return_lse: also return the per-row
+    log-sum-exp [B, Sq, H] (differentiable) — the merge quantity for
+    blockwise/ring composition."""
     B, Sq, H, D = q.shape
     _, Sk, KVH, _ = k.shape
     if H % KVH != 0:
@@ -679,8 +736,14 @@ def flash_attention(
             "flash_attention requires a static int q_offset (traced offsets "
             "belong to the paged decode path, ops/paged_attention.py)"
         )
-    if segment_ids is not None and Sq != Sk:
-        raise ValueError("segment_ids requires Sq == Sk")
+    if segment_ids is not None and kv_segment_ids is None and Sq != Sk:
+        raise ValueError("segment_ids requires Sq == Sk "
+                         "(or pass kv_segment_ids separately)")
+    if kv_segment_ids is not None and segment_ids is None and Sq != Sk:
+        raise ValueError(
+            "kv_segment_ids with Sq != Sk needs an explicit q-side "
+            "segment_ids (the kv array cannot stand in for it)"
+        )
     scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(D)
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -714,17 +777,29 @@ def flash_attention(
         kt = jnp.pad(kt, ((0, 0), (0, 0), (0, Sk_pad - Sk), (0, 0)))
         vt = jnp.pad(vt, ((0, 0), (0, 0), (0, Sk_pad - Sk), (0, 0)))
 
-    if segment_ids is None:
+    has_segments = segment_ids is not None or kv_segment_ids is not None
+    if not has_segments:
         qseg2 = jnp.zeros((B, Sq_pad), jnp.int32)
         kseg2 = jnp.zeros((B, Sk_pad), jnp.int32)
     else:
-        qseg2 = jnp.pad(segment_ids.astype(jnp.int32), ((0, 0), (0, Sq_pad - Sq)))
-        kseg2 = jnp.pad(segment_ids.astype(jnp.int32), ((0, 0), (0, Sk_pad - Sk)))
+        q_side = segment_ids if segment_ids is not None else kv_segment_ids
+        k_side = kv_segment_ids if kv_segment_ids is not None else segment_ids
+        # padding gets segment -1: never equal to a real segment, so
+        # padded kv rows mask out even when the q side padding matches
+        qseg2 = jnp.pad(q_side.astype(jnp.int32), ((0, 0), (0, Sq_pad - Sq)),
+                        constant_values=-1)
+        kseg2 = jnp.pad(k_side.astype(jnp.int32), ((0, 0), (0, Sk_pad - Sk)),
+                        constant_values=-2)
     qseg = qseg2[:, :, None]   # [B, Sq_pad, 1]
     kseg = kseg2[:, None, :]   # [B, 1, Sk_pad]
 
     fold = _fold_factor(H // KVH, bq, bk, fold_heads)
-    o = _flash(kernel_scale, causal, q_offset, bq, bk, Sq, Sk, interpret,
-               segment_ids is not None, fold,
-               qt, kt, vt, qseg, kseg)
+    statics = (kernel_scale, causal, q_offset, bq, bk, Sq, Sk, interpret,
+               has_segments, fold)
+    if return_lse:
+        o, lse = _flash_lse(*statics, qt, kt, vt, qseg, kseg)
+        o = jnp.transpose(o[:, :, :Sq, :], (0, 2, 1, 3))
+        lse = jnp.transpose(lse[:, :, :Sq, 0], (0, 2, 1))  # [B, Sq, H]
+        return o, lse
+    o = _flash(*statics, qt, kt, vt, qseg, kseg)
     return jnp.transpose(o[:, :, :Sq, :], (0, 2, 1, 3))

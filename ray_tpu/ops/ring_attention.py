@@ -33,7 +33,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ray_tpu.ops.attention import NEG_INF, _repeat_kv_heads, xla_attention
+from ray_tpu.ops.attention import xla_attention
 
 
 def ring_attention_spmd(
@@ -57,8 +57,6 @@ def ring_attention_spmd(
         q_segment_ids = kv_segment_ids
     B, Sq, H, D = q.shape
     Sk = k.shape[1]
-    group = _repeat_kv_heads(q, k)
-    Kh = k.shape[2]
     scale = softmax_scale if softmax_scale is not None else 1.0 / math.sqrt(D)
 
     n = jax.lax.axis_size(axis_name)
@@ -67,72 +65,82 @@ def ring_attention_spmd(
     # local buffer holds block (my + t) mod n.
     perm = [(i, (i - 1) % n) for i in range(n)]
 
-    qg = (q * scale).reshape(B, Sq, Kh, group, D)
-    q_pos = my * Sq + jnp.arange(Sq)  # global positions of local queries
+    # Per-block compute is the FLASH kernel (ops/flash.py) returning
+    # (o, lse); blocks merge through log-sum-exp. The round-5 chip
+    # measurement of the previous raw-XLA online-softmax body was 17x
+    # slower than flash at S=4096 (benchmarks/RINGBENCH_r05.json) — the
+    # ring's job is rotation + merge, the MXU work belongs in the kernel.
+    from ray_tpu.ops.flash import NEG_INF as FLASH_NEG_INF, flash_attention
 
-    def compute_block(o, m, l, k_cur, v_cur, seg_cur, src):
-        # fp32 scores for this block: [B, Kh, G, Sq, Sk]
-        s = jnp.einsum(
-            "bqkgd,bskd->bkgqs", qg, k_cur, preferred_element_type=jnp.float32
-        )
-        k_pos = src * Sk + jnp.arange(Sk)
-        mask = jnp.ones((Sq, Sk), bool)
-        if causal:
-            mask = q_pos[:, None] >= k_pos[None, :]
-        mask = jnp.broadcast_to(mask[None, None, None], s.shape)
+    def flash_block(k_cur, v_cur, seg_cur, *, block_causal: bool):
+        kw = {}
         if seg_cur is not None:
-            seg = q_segment_ids[:, :, None] == seg_cur[:, None, :]  # [B, Sq, Sk]
-            mask = jnp.logical_and(mask, seg[:, None, None, :, :])
-        s = jnp.where(mask, s, NEG_INF)
-
-        m_new = jnp.maximum(m, s.max(axis=-1))
-        # exp under explicit mask: a fully-masked block must contribute 0,
-        # not exp(NEG_INF - NEG_INF) = 1.
-        p = jnp.where(mask, jnp.exp(s - m_new[..., None]), 0.0)
-        corr = jnp.exp(m - m_new)
-        l_new = l * corr + p.sum(axis=-1)
-        pv = jnp.einsum("bkgqs,bskd->bkgqd", p.astype(v_cur.dtype), v_cur)
-        o_new = o * corr[..., None] + pv.astype(jnp.float32)
-        return o_new, m_new, l_new
-
-    def masked_compute(o, m, l, k_cur, v_cur, seg_cur, src):
-        if not causal:
-            return compute_block(o, m, l, k_cur, v_cur, seg_cur, src)
-        # Blocks strictly in the future (src > my under contiguous
-        # sharding) are fully masked — skip their matmuls entirely.
-        # Average saving is ~2x attention FLOPs at large sp; the
-        # remaining rank imbalance (rank i computes i+1 blocks) is a
-        # known cost of contiguous sharding — zigzag/striped layouts
-        # would balance it at the price of position bookkeeping.
-        return jax.lax.cond(
-            src > my,
-            lambda *_: (o, m, l),
-            compute_block,
-            o, m, l, k_cur, v_cur, seg_cur, src,
+            kw = {"segment_ids": q_segment_ids, "kv_segment_ids": seg_cur}
+        return flash_attention(
+            q, k_cur, v_cur, causal=block_causal, softmax_scale=scale,
+            return_lse=True, **kw,
         )
+
+    def compute_block(k_cur, v_cur, seg_cur, src):
+        # diagonal block (src == my): causal within the block; blocks
+        # strictly behind (src < my): full attention. Both are compiled;
+        # the traced src picks one. (Non-causal rings are all "full".)
+        if not causal:
+            return flash_block(k_cur, v_cur, seg_cur, block_causal=False)
+        return jax.lax.cond(
+            src == my,
+            lambda kc, vc: flash_block(kc, vc, seg_cur, block_causal=True),
+            lambda kc, vc: flash_block(kc, vc, seg_cur, block_causal=False),
+            k_cur, v_cur,
+        )
+
+    def merge(o_run, lse_run, o_t, lse_t):
+        m = jnp.maximum(lse_run, lse_t)
+        w1 = jnp.exp(lse_run - m)
+        w2 = jnp.exp(lse_t - m)
+        denom = w1 + w2
+        o = (
+            o_run * w1[..., None] + o_t.astype(jnp.float32) * w2[..., None]
+        ) / denom[..., None]
+        return o, m + jnp.log(denom)
+
+    def masked_compute(o_run, lse_run, k_cur, v_cur, seg_cur, src):
+        if causal:
+            # blocks strictly in the future (src > my under contiguous
+            # sharding) are fully masked — skip their matmuls entirely.
+            # Average saving is ~2x attention FLOPs at large sp; the
+            # remaining rank imbalance is the known cost of contiguous
+            # sharding (zigzag layouts would balance it).
+            def skip(*_):
+                return o_run, lse_run
+
+            def run(kc, vc):
+                o_t, lse_t = compute_block(kc, vc, seg_cur, src)
+                return merge(o_run, lse_run, o_t, lse_t)
+
+            return jax.lax.cond(src > my, skip, run, k_cur, v_cur)
+        o_t, lse_t = compute_block(k_cur, v_cur, seg_cur, src)
+        return merge(o_run, lse_run, o_t, lse_t)
 
     def body(carry, t):
-        o, m, l, k_cur, v_cur, seg_cur = carry
-        o, m, l = masked_compute(o, m, l, k_cur, v_cur, seg_cur, (my + t) % n)
+        o, lse, k_cur, v_cur, seg_cur = carry
+        o, lse = masked_compute(o, lse, k_cur, v_cur, seg_cur, (my + t) % n)
         k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         seg_nxt = (
             jax.lax.ppermute(seg_cur, axis_name, perm) if seg_cur is not None else None
         )
-        return (o, m, l, k_nxt, v_nxt, seg_nxt), None
+        return (o, lse, k_nxt, v_nxt, seg_nxt), None
 
-    o0 = jnp.zeros((B, Kh, group, Sq, D), jnp.float32)
-    m0 = jnp.full((B, Kh, group, Sq), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, Kh, group, Sq), jnp.float32)
+    o0 = jnp.zeros((B, Sq, H, D), jnp.float32)
+    lse0 = jnp.full((B, Sq, H), FLASH_NEG_INF, jnp.float32)
     # n-1 rotations in the scan; the last block needs no onward ppermute,
     # so it is folded in as an epilogue (saves one dead KV rotation).
-    (o, m, l, k_last, v_last, seg_last), _ = jax.lax.scan(
-        body, (o0, m0, l0, k, v, kv_segment_ids), jnp.arange(n - 1)
+    (o, lse, k_last, v_last, seg_last), _ = jax.lax.scan(
+        body, (o0, lse0, k, v, kv_segment_ids), jnp.arange(n - 1)
     )
-    o, _, l = masked_compute(o, m, l, k_last, v_last, seg_last, (my + n - 1) % n)
-    o = o / jnp.where(l == 0.0, 1.0, l)[..., None]
-    # [B, Kh, G, Sq, D] -> [B, Sq, H, D]
-    return o.transpose(0, 3, 1, 2, 4).reshape(B, Sq, H, D).astype(q.dtype)
+    o, _ = masked_compute(o, lse, k_last, v_last, seg_last, (my + n - 1) % n)
+    return o.astype(q.dtype)
 
 
 def ulysses_attention_spmd(
