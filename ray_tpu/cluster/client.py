@@ -794,7 +794,13 @@ class ClusterClient:
             or spec.get("runtime_env")
         ):
             return None
-        return tuple(sorted((spec.get("resources") or {}).items()))
+        # retriable-ness is part of the key: the daemon records the flag
+        # per LEASE, so a non-retriable task must not inherit a cached
+        # lease the OOM policy would treat as retriable
+        return (
+            spec.get("retriable", True),
+            tuple(sorted((spec.get("resources") or {}).items())),
+        )
 
     def _pop_cached_lease(self, key, exclude=()):
         if key is None:
@@ -1020,6 +1026,8 @@ class ClusterClient:
             "pg_id": pg_id,
             "bundle_index": bundle_index,
             "runtime_env": self._package_runtime_env(runtime_env),
+            # OOM victim policy: a max_restarts=0 actor is NOT retriable
+            "retriable": max_restarts > 0,
         }
         grant, daemon = self._lease(spec, [])
         worker_addr = tuple(grant["worker_addr"])

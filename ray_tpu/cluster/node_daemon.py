@@ -604,10 +604,12 @@ class NodeDaemon:
                     )
                 live = [w for w in workers if w.alive()]
                 if leased:
+                    pick = leased[0]
                     victims.append((
-                        leased[0]["worker"],
+                        pick["worker"],
                         f"node memory {usage:.0%} > "
-                        f"{self._mem_threshold:.0%} (newest leased)",
+                        f"{self._mem_threshold:.0%} "
+                        f"({'retriable' if pick.get('retriable', True) else 'NON-retriable (no retriable victim)'} lease)",
                     ))
                 elif live:
                     victims.append((

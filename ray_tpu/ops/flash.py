@@ -621,47 +621,13 @@ def _bwd_call(q, k, v, qseg, kseg, o, lse, do, scale, causal, q_offset,
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8, 9))
-def _flash(scale, causal, q_offset, block_q, block_k, sq_valid, sk_valid,
-           interpret, has_segments, fold, q, k, v, qseg, kseg):
-    o, _ = _flash_fwd(scale, causal, q_offset, block_q, block_k, sq_valid,
-                      sk_valid, interpret, has_segments, fold,
-                      q, k, v, qseg, kseg)
-    return o
-
-
-def _flash_fwd(scale, causal, q_offset, block_q, block_k, sq_valid, sk_valid,
-               interpret, has_segments, fold, q, k, v, qseg, kseg):
-    o, lse = _fwd_call(q, k, v, qseg, kseg, scale, causal, q_offset,
-                       block_q, block_k, sk_valid, interpret, has_segments,
-                       fold)
-    # named residuals: under jax.checkpoint, the backward re-runs this
-    # whole kernel just to rebuild (o, lse) unless the remat policy can
-    # SAVE them — the "dots" policy recognizes dot_general outputs, not a
-    # pallas_call's (llama.py pairs this with save_only_these_names)
-    o = jax.ad_checkpoint.checkpoint_name(o, "attn_out")
-    lse = jax.ad_checkpoint.checkpoint_name(lse, "attn_lse")
-    return o, (q, k, v, qseg, kseg, o, lse)
-
-
-def _flash_bwd(scale, causal, q_offset, block_q, block_k, sq_valid, sk_valid,
-               interpret, has_segments, fold, residuals, do):
-    q, k, v, qseg, kseg, o, lse = residuals
-    dq, dk, dv = _bwd_call(q, k, v, qseg, kseg, o, lse, do, scale, causal,
-                           q_offset, block_q, block_k, sq_valid, sk_valid,
-                           interpret, has_segments, fold)
-    zero_seg = np.zeros(qseg.shape, dtype=jax.dtypes.float0)
-    zero_kseg = np.zeros(kseg.shape, dtype=jax.dtypes.float0)
-    return dq, dk, dv, zero_seg, zero_kseg
-
-
-_flash.defvjp(_flash_fwd, _flash_bwd)
-
-
+# ONE custom-vjp pair serves both public forms: flash_attention with
+# return_lse=False simply drops the lse output (its cotangent arrives
+# as zeros and `delta - 0` is a no-op in the backward).
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4, 5, 6, 7, 8, 9))
 def _flash_lse(scale, causal, q_offset, block_q, block_k, sq_valid, sk_valid,
                interpret, has_segments, fold, q, k, v, qseg, kseg):
-    """(o, lse) variant with a DIFFERENTIABLE lse — ring attention merges
+    """(o, lse) with a DIFFERENTIABLE lse — ring attention merges
     per-block results through lse, so its cotangent must reach ds."""
     (o, lse), _ = _flash_lse_fwd(
         scale, causal, q_offset, block_q, block_k, sq_valid, sk_valid,
@@ -676,9 +642,10 @@ def _flash_lse_fwd(scale, causal, q_offset, block_q, block_k, sq_valid,
     o, lse = _fwd_call(q, k, v, qseg, kseg, scale, causal, q_offset,
                        block_q, block_k, sk_valid, interpret, has_segments,
                        fold)
-    # same named residuals as _flash_fwd: under jax.checkpoint with
-    # save_only_these_names the ring's per-block forwards must be SAVED,
-    # not re-run n times per layer in the backward
+    # named residuals: under jax.checkpoint, the backward re-runs this
+    # whole kernel just to rebuild (o, lse) unless the remat policy can
+    # SAVE them — the "dots" policy recognizes dot_general outputs, not a
+    # pallas_call's (llama.py pairs this with save_only_these_names)
     o = jax.ad_checkpoint.checkpoint_name(o, "attn_out")
     lse = jax.ad_checkpoint.checkpoint_name(lse, "attn_lse")
     return (o, lse), (q, k, v, qseg, kseg, o, lse)
@@ -796,10 +763,9 @@ def flash_attention(
     fold = _fold_factor(H // KVH, bq, bk, fold_heads)
     statics = (kernel_scale, causal, q_offset, bq, bk, Sq, Sk, interpret,
                has_segments, fold)
+    o, lse = _flash_lse(*statics, qt, kt, vt, qseg, kseg)
+    o = jnp.transpose(o[:, :, :Sq, :], (0, 2, 1, 3))
     if return_lse:
-        o, lse = _flash_lse(*statics, qt, kt, vt, qseg, kseg)
-        o = jnp.transpose(o[:, :, :Sq, :], (0, 2, 1, 3))
         lse = jnp.transpose(lse[:, :, :Sq, 0], (0, 2, 1))  # [B, Sq, H]
         return o, lse
-    o = _flash(*statics, qt, kt, vt, qseg, kseg)
-    return jnp.transpose(o[:, :, :Sq, :], (0, 2, 1, 3))
+    return o
