@@ -365,6 +365,11 @@ class GcsService:
     def rpc_kv_put(self, payload, peer):
         with self._lock:
             ns = self._kv.setdefault(payload.get("ns", "default"), {})
+            if payload.get("nx") and payload["key"] in ns:
+                # set-if-absent: atomic claim primitive (job submission
+                # ids, leader election) — check-then-put at the caller
+                # races between clients
+                return {"ok": False}
             ns[payload["key"]] = payload["value"]
             self._mark_dirty()
             self._events_cv.notify_all()  # wake kv_wait long-pollers

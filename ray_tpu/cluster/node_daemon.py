@@ -698,6 +698,17 @@ class NodeDaemon:
         worker_id = f"w-{uuid.uuid4().hex[:8]}"
         env = dict(os.environ)
         env.update(self.worker_env)
+        # the worker must import ray_tpu REGARDLESS of its cwd: a
+        # runtime_env working_dir changes cwd to the materialized
+        # package, dropping any implicit cwd-based import the daemon
+        # itself relied on — pin the framework root explicitly
+        import ray_tpu as _rt
+
+        fw_root = os.path.dirname(os.path.dirname(os.path.abspath(_rt.__file__)))
+        env["PYTHONPATH"] = (
+            fw_root + os.pathsep + env["PYTHONPATH"]
+            if env.get("PYTHONPATH") else fw_root
+        )
         env["RAY_TPU_WORKER_ID"] = worker_id
         env["RAY_TPU_NODE_ID"] = self.node_id
         # the host workers should advertise for cross-host rendezvous

@@ -203,4 +203,16 @@ class JobSubmissionClient:
         return info
 
 
-__all__ = ["JobInfo", "JobStatus", "JobSubmissionClient"]
+def __getattr__(name):
+    # cluster-backed client lives in its own module (imports the cluster
+    # plane; the local manager must stay import-light)
+    if name == "ClusterJobSubmissionClient":
+        from ray_tpu.job_submission.cluster_jobs import ClusterJobSubmissionClient
+
+        return ClusterJobSubmissionClient
+    raise AttributeError(name)
+
+
+__all__ = [
+    "ClusterJobSubmissionClient", "JobInfo", "JobStatus", "JobSubmissionClient",
+]

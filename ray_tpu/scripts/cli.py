@@ -184,6 +184,44 @@ def cmd_status(args) -> int:
     return 0
 
 
+def cmd_submit(args) -> int:
+    address = args.address or _load_state().get("gcs_address")
+    if not address:
+        print("no cluster state found; pass --address HOST:PORT", file=sys.stderr)
+        return 2
+    import shlex
+
+    entry = list(args.entrypoint)
+    if entry and entry[0] == "--":  # only the LEADING separator is ours
+        entry = entry[1:]
+    if not entry:
+        print("submit needs an entrypoint command", file=sys.stderr)
+        return 2
+    from ray_tpu.job_submission import ClusterJobSubmissionClient, JobStatus
+
+    renv: dict = {}
+    if args.working_dir:
+        renv["working_dir"] = args.working_dir
+    env_vars = {}
+    for kv in args.env:
+        if "=" not in kv:
+            print(f"--env expects K=V, got {kv!r}", file=sys.stderr)
+            return 2
+        k, v = kv.split("=", 1)
+        env_vars[k] = v
+    if env_vars:
+        renv["env_vars"] = env_vars
+    jc = ClusterJobSubmissionClient(address)
+    sid = jc.submit_job(entrypoint=shlex.join(entry), runtime_env=renv or None)
+    print(f"submitted {sid}")
+    if args.no_wait:
+        return 0
+    st = jc.wait_until_finish(sid, timeout=24 * 3600)
+    print(jc.get_job_logs(sid), end="")
+    print(f"job {sid}: {st}")
+    return 0 if st == JobStatus.SUCCEEDED else 1
+
+
 def main(argv: Optional[list] = None) -> int:
     p = argparse.ArgumentParser(prog="ray_tpu", description=__doc__)
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -208,6 +246,20 @@ def main(argv: Optional[list] = None) -> int:
     pu = sub.add_parser("status", help="print the cluster view")
     pu.add_argument("--address", default=None)
     pu.set_defaults(fn=cmd_status)
+
+    pj = sub.add_parser(
+        "submit", help="run a driver command ON the cluster (`ray job submit`)"
+    )
+    pj.add_argument("--address", default=None)
+    pj.add_argument("--working-dir", default=None,
+                    help="directory packaged to the cluster as the job cwd")
+    pj.add_argument("--env", action="append", default=[],
+                    metavar="K=V", help="environment for the driver")
+    pj.add_argument("--no-wait", action="store_true",
+                    help="return after submission instead of streaming status")
+    pj.add_argument("entrypoint", nargs=argparse.REMAINDER,
+                    help="command to run (prefix with -- to pass flags)")
+    pj.set_defaults(fn=cmd_submit)
 
     from ray_tpu.scripts.k8s import cmd_k8s
 

@@ -67,3 +67,41 @@ def test_cli_start_status_stop(state_dir):
     r = _run(env, "stop")
     assert r.returncode == 0
     assert "stopped" in r.stdout
+
+
+def test_cli_submit_runs_driver_on_cluster(tmp_path):
+    """`cli submit` = the `ray job submit` analog: drivers execute on the
+    cluster with streamed logs and an exit code mirroring the job's."""
+    import os
+    import subprocess
+    import sys
+
+    env = dict(
+        os.environ,
+        RAY_TPU_STATE_DIR=str(tmp_path / "state"),
+        JAX_PLATFORMS="cpu",
+    )
+    wd = tmp_path / "app"
+    wd.mkdir()
+    (wd / "main.py").write_text("print('driver-ran-on-cluster')\n")
+
+    def cli(*argv, timeout=300):
+        return subprocess.run(
+            [sys.executable, "-m", "ray_tpu.scripts.cli", *argv],
+            env=env, capture_output=True, text=True, timeout=timeout,
+        )
+
+    r = cli("start", "--head", "--resources", "num_cpus=2")
+    assert r.returncode == 0, r.stderr
+    try:
+        r = cli("submit", "--working-dir", str(wd), "--env", "X=1",
+                "--", "python", "main.py")
+        assert r.returncode == 0, (r.stdout, r.stderr)
+        assert "driver-ran-on-cluster" in r.stdout
+        assert "SUCCEEDED" in r.stdout
+        # failing drivers propagate a nonzero exit
+        r = cli("submit", "--", "python", "-c", "raise SystemExit(3)")
+        assert r.returncode == 1, (r.stdout, r.stderr)
+        assert "FAILED" in r.stdout
+    finally:
+        cli("stop")

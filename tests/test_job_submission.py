@@ -66,3 +66,51 @@ def test_duplicate_id_rejected(client):
     with pytest.raises(ValueError):
         client.submit_job(entrypoint="true", submission_id="dup")
     client.wait_until_finish(sid, timeout=30)
+
+
+def test_cluster_job_submission_with_working_dir(tmp_path):
+    """Drivers run ON the cluster: working_dir is packaged through the
+    object plane, status/logs live in the GCS KV (any client sees them),
+    stop_job works cross-process (reference: dashboard job_manager)."""
+    from ray_tpu.cluster import LocalCluster
+    from ray_tpu.core import api
+    from ray_tpu.job_submission import ClusterJobSubmissionClient, JobStatus
+
+    wd = tmp_path / "pkg"
+    wd.mkdir()
+    (wd / "main.py").write_text(
+        "import os\n"
+        "print('job sees file:', os.path.exists('data.txt'))\n"
+        "print('jobid:', os.environ['RAY_TPU_JOB_ID'])\n"
+    )
+    (wd / "data.txt").write_text("payload")
+
+    with LocalCluster(node_death_timeout_s=5.0) as cluster:
+        cluster.start()
+        cluster.add_node({"num_cpus": 2}, node_id="jobs0")
+        cluster.wait_for_nodes(1)
+        api.init(address=cluster.address, ignore_reinit_error=True)
+        try:
+            jc = ClusterJobSubmissionClient(cluster.address)
+            sid = jc.submit_job(
+                entrypoint="python main.py",
+                runtime_env={"working_dir": str(wd),
+                             "env_vars": {"MARKER": "42"}},
+            )
+            st = jc.wait_until_finish(sid, timeout=120)
+            logs = jc.get_job_logs(sid)
+            assert st == JobStatus.SUCCEEDED, (st, logs)
+            assert "job sees file: True" in logs
+            assert f"jobid: {sid}" in logs
+            assert any(j.submission_id == sid for j in jc.list_jobs())
+
+            # stop: a long-running job terminates via the KV flag
+            sid2 = jc.submit_job(entrypoint="python -c 'import time; time.sleep(60)'")
+            deadline = __import__("time").time() + 60
+            while jc.get_job_status(sid2) == JobStatus.PENDING:
+                assert __import__("time").time() < deadline
+                __import__("time").sleep(0.2)
+            assert jc.stop_job(sid2)
+            assert jc.wait_until_finish(sid2, timeout=60) == JobStatus.STOPPED
+        finally:
+            api.shutdown()
