@@ -576,7 +576,7 @@ class ClusterClient:
         payload = {
             "task_id": _new_id(),
             "desc": desc,
-            "func": cloudpickle.dumps(func),
+            "func": self._dumps_func(func),
             "args": dumps_value((args, dict(kwargs or {})), arg_refs.append),
             "return_ids": return_ids,
             "num_returns": num_returns,
@@ -660,6 +660,34 @@ class ClusterClient:
 
         fut.add_done_callback(_done)
         return True
+
+    _FUNC_PICKLE_CACHE_MAX = 256
+
+    def _dumps_func(self, func) -> bytes:
+        """Memoized cloudpickle of the task function: a task storm over
+        one function pays the (closure-walking) pickle once, not per
+        submit. Keyed by identity — a redefined function is a new
+        object.
+
+        Semantics note (matches the reference): ray exports a remote
+        function ONCE and reuses the pickled form, so globals/closure
+        cells are snapshotted at first submission — mutating a captured
+        global between submits does not reach later tasks. Pass changing
+        values as ARGUMENTS."""
+        cache = getattr(self, "_func_pickles", None)
+        if cache is None:
+            cache = self._func_pickles = {}
+        key = id(func)
+        hit = cache.get(key)
+        # id() recycles after GC: keep a strong ref to the function in
+        # the cache entry so the key can't be reused by a different one
+        if hit is not None and hit[0] is func:
+            return hit[1]
+        data = cloudpickle.dumps(func)
+        if len(cache) >= self._FUNC_PICKLE_CACHE_MAX:
+            cache.clear()
+        cache[key] = (func, data)
+        return data
 
     def _drive_task(self, payload: dict, spec: dict, max_retries: int,
                     arg_refs: Sequence[bytes] = ()) -> None:

@@ -700,15 +700,10 @@ class NodeDaemon:
         env.update(self.worker_env)
         # the worker must import ray_tpu REGARDLESS of its cwd: a
         # runtime_env working_dir changes cwd to the materialized
-        # package, dropping any implicit cwd-based import the daemon
-        # itself relied on — pin the framework root explicitly
-        import ray_tpu as _rt
+        # package, dropping any implicit cwd-based import
+        from ray_tpu.utils.env import inject_framework_pythonpath
 
-        fw_root = os.path.dirname(os.path.dirname(os.path.abspath(_rt.__file__)))
-        env["PYTHONPATH"] = (
-            fw_root + os.pathsep + env["PYTHONPATH"]
-            if env.get("PYTHONPATH") else fw_root
-        )
+        inject_framework_pythonpath(env)
         env["RAY_TPU_WORKER_ID"] = worker_id
         env["RAY_TPU_NODE_ID"] = self.node_id
         # the host workers should advertise for cross-host rendezvous

@@ -75,31 +75,84 @@ def _run_loop_iteration(instance, plan, input_value, local: dict):
             op.out_channel.write(out)
 
 
+_POISON = object()
+
+
 def _actor_exec_loop(instance, plan, input_source):
     """Runs on the actor's executor thread until channels close.
-    input_source: None | ("chan", channel, reader_idx)."""
-    while True:
-        try:
-            input_value = (
-                input_source[1].read(input_source[2])
-                if input_source is not None
-                else None
-            )
-            _run_loop_iteration(instance, plan, input_value, {})
-        except ChannelClosedError:
-            # propagate the poison downstream: close OUR out channels too,
-            # else a mid-pipeline failure only unblocks immediate consumers
-            for op in plan:
-                if op.out_channel is not None:
-                    op.out_channel.close()
-            return "dag-loop-exit"
-        except Exception:
-            # poison the pipeline: close our out channels so peers unblock
-            logger.exception("compiled DAG actor loop failed")
-            for op in plan:
-                if op.out_channel is not None:
-                    op.out_channel.close()
-            raise
+    input_source: None | ("chan", channel, reader_idx).
+
+    Input reads OVERLAP compute (reference: compiled-graph operation
+    scheduling interleaves channel reads with execution,
+    dag_node_operation.py): a prefetch thread keeps up to 2 upcoming
+    input values decoded while iteration N runs, so the channel wait +
+    unpickle of iteration N+1 hides behind N's method calls. Mid-plan
+    channel reads (op args fed by peer actors) still happen inline —
+    they carry data dependencies the schedule must respect anyway.
+    """
+    import queue as _q
+
+    prefetch = None
+    dead = [False]  # set by the main loop so the prefetch thread exits
+    if input_source is not None:
+        prefetch = _q.Queue(maxsize=2)
+
+        def _put(item) -> bool:
+            while True:
+                try:
+                    prefetch.put(item, timeout=0.2)
+                    return True
+                except _q.Full:
+                    if dead[0]:
+                        return False  # consumer gone: drop and exit
+
+        def _read_ahead():
+            while not dead[0]:
+                try:
+                    v = input_source[1].read(input_source[2])
+                except ChannelClosedError:
+                    _put(_POISON)
+                    return
+                except Exception as e:  # noqa: BLE001 — surface in main loop
+                    _put(("__err__", e))
+                    return
+                if not _put((None, v)):
+                    return
+
+        threading.Thread(
+            target=_read_ahead, name="dag-input-prefetch", daemon=True
+        ).start()
+
+    try:
+        while True:
+            try:
+                if prefetch is not None:
+                    item = prefetch.get()
+                    if item is _POISON:
+                        raise ChannelClosedError("input channel closed")
+                    tag, input_value = item
+                    if tag == "__err__":
+                        raise input_value
+                else:
+                    input_value = None
+                _run_loop_iteration(instance, plan, input_value, {})
+            except ChannelClosedError:
+                # propagate the poison downstream: close OUR out channels
+                # too, else a mid-pipeline failure only unblocks immediate
+                # consumers
+                for op in plan:
+                    if op.out_channel is not None:
+                        op.out_channel.close()
+                return "dag-loop-exit"
+            except Exception:
+                # poison the pipeline: close out channels so peers unblock
+                logger.exception("compiled DAG actor loop failed")
+                for op in plan:
+                    if op.out_channel is not None:
+                        op.out_channel.close()
+                raise
+    finally:
+        dead[0] = True  # the prefetch thread must not outlive the loop
 
 
 class CompiledDAGRef:

@@ -85,14 +85,9 @@ class JobSubmissionClient:
         # jobs must always be able to import the framework, wherever their
         # entrypoint script lives (the reference relies on ray being
         # pip-installed; the equivalent here is PYTHONPATH injection)
-        import ray_tpu
+        from ray_tpu.utils.env import inject_framework_pythonpath
 
-        fw_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
-        env["PYTHONPATH"] = (
-            fw_root + os.pathsep + env["PYTHONPATH"]
-            if env.get("PYTHONPATH")
-            else fw_root
-        )
+        inject_framework_pythonpath(env)
         cwd = renv.get("working_dir") or os.getcwd()
 
         def supervise():

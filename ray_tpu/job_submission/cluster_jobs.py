@@ -82,8 +82,14 @@ def _job_runner(sid: str, entrypoint: str, env_vars: dict) -> str:
 
         def watch():
             pushed = -1
-            while not stop.wait(1.0):
+            while not stop.wait(2.0):
                 try:
+                    # liveness heartbeat: clients infer a dead driver
+                    # (node loss) from staleness, independent of the
+                    # submitting process surviving
+                    client.kv_put(
+                        _kv_key(sid, "hb"), str(time.time()).encode(), ns=_NS
+                    )
                     size = os.path.getsize(log_path)
                     if size != pushed:  # skip identical re-pushes
                         with open(log_path, "rb") as f:
@@ -215,11 +221,29 @@ class ClusterJobSubmissionClient:
 
     # -- queries (KV-backed: any client sees the same state) ------------------
 
+    HEARTBEAT_STALE_S = 30.0
+
     def _status_doc(self, sid: str) -> dict:
         raw = self._client.kv_get(_kv_key(sid, "status"), ns=_NS)
         if raw is None:
             raise ValueError(f"unknown job {sid!r}")
-        return json.loads(bytes(raw).decode())
+        doc = json.loads(bytes(raw).decode())
+        if doc.get("status") == JobStatus.RUNNING:
+            # a RUNNING job whose runner heartbeat went stale died with
+            # its worker/node — ANY client can detect and record it
+            # (the submitter's task-ref watcher may itself be gone)
+            hb = self._client.kv_get(_kv_key(sid, "hb"), ns=_NS)
+            if hb is not None:
+                age = time.time() - float(bytes(hb).decode())
+                if age > self.HEARTBEAT_STALE_S:
+                    doc = {**doc, "status": JobStatus.FAILED,
+                           "end_time": time.time(),
+                           "message": f"driver heartbeat stale ({age:.0f}s)"}
+                    self._client.kv_put(
+                        _kv_key(sid, "status"),
+                        json.dumps(doc).encode(), ns=_NS,
+                    )
+        return doc
 
     def get_job_status(self, submission_id: str) -> str:
         return self._status_doc(submission_id)["status"]

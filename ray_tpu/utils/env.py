@@ -1,0 +1,22 @@
+"""Process-environment helpers shared by every process-spawning site."""
+
+from __future__ import annotations
+
+import os
+
+
+def inject_framework_pythonpath(env: dict) -> dict:
+    """Prepend the framework root to env's PYTHONPATH (in place).
+
+    Every spawned process (workers, job drivers, dashboards) must import
+    ray_tpu regardless of its cwd — a runtime_env working_dir or an
+    arbitrary entrypoint directory drops the implicit cwd-based import.
+    """
+    import ray_tpu
+
+    fw_root = os.path.dirname(os.path.dirname(os.path.abspath(ray_tpu.__file__)))
+    env["PYTHONPATH"] = (
+        fw_root + os.pathsep + env["PYTHONPATH"]
+        if env.get("PYTHONPATH") else fw_root
+    )
+    return env

@@ -95,12 +95,12 @@ def test_cli_submit_runs_driver_on_cluster(tmp_path):
     assert r.returncode == 0, r.stderr
     try:
         r = cli("submit", "--working-dir", str(wd), "--env", "X=1",
-                "--", "python", "main.py")
+                "--", sys.executable, "main.py")
         assert r.returncode == 0, (r.stdout, r.stderr)
         assert "driver-ran-on-cluster" in r.stdout
         assert "SUCCEEDED" in r.stdout
         # failing drivers propagate a nonzero exit
-        r = cli("submit", "--", "python", "-c", "raise SystemExit(3)")
+        r = cli("submit", "--", sys.executable, "-c", "raise SystemExit(3)")
         assert r.returncode == 1, (r.stdout, r.stderr)
         assert "FAILED" in r.stdout
     finally:

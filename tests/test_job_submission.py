@@ -93,7 +93,7 @@ def test_cluster_job_submission_with_working_dir(tmp_path):
         try:
             jc = ClusterJobSubmissionClient(cluster.address)
             sid = jc.submit_job(
-                entrypoint="python main.py",
+                entrypoint=f"{sys.executable} main.py",
                 runtime_env={"working_dir": str(wd),
                              "env_vars": {"MARKER": "42"}},
             )
@@ -105,7 +105,7 @@ def test_cluster_job_submission_with_working_dir(tmp_path):
             assert any(j.submission_id == sid for j in jc.list_jobs())
 
             # stop: a long-running job terminates via the KV flag
-            sid2 = jc.submit_job(entrypoint="python -c 'import time; time.sleep(60)'")
+            sid2 = jc.submit_job(entrypoint=f"{sys.executable} -c 'import time; time.sleep(60)'")
             deadline = __import__("time").time() + 60
             while jc.get_job_status(sid2) == JobStatus.PENDING:
                 assert __import__("time").time() < deadline
