@@ -34,6 +34,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ray_tpu.ops.attention import xla_attention
+from ray_tpu.ops.flash import NEG_INF as FLASH_NEG_INF, flash_attention
 
 
 def ring_attention_spmd(
@@ -70,8 +71,6 @@ def ring_attention_spmd(
     # measurement of the previous raw-XLA online-softmax body was 17x
     # slower than flash at S=4096 (benchmarks/RINGBENCH_r05.json) — the
     # ring's job is rotation + merge, the MXU work belongs in the kernel.
-    from ray_tpu.ops.flash import NEG_INF as FLASH_NEG_INF, flash_attention
-
     def flash_block(k_cur, v_cur, seg_cur, *, block_causal: bool):
         kw = {}
         if seg_cur is not None:
@@ -167,8 +166,11 @@ def ulysses_attention_spmd(
         if segment_ids is not None
         else None
     )
-    o = xla_attention(
-        qf, kf, vf, causal=causal, segment_ids=seg_full, softmax_scale=softmax_scale
+    # local full-sequence attention runs the FLASH kernel (2-3x XLA
+    # attention on v5e at these shapes; ring took the same step round 5)
+    o = flash_attention(
+        qf, kf, vf, causal=causal, segment_ids=seg_full,
+        softmax_scale=softmax_scale,
     )
     return jax.lax.all_to_all(o, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
@@ -193,6 +195,11 @@ def _cp_wrapper(spmd_fn, seg_kwargs):
         heads_axis: str = "tp",
     ) -> jax.Array:
         if mesh.shape[axis] == 1:
+            # sp=1 degrades to the XLA composite, NOT the flash kernel:
+            # this call sits OUTSIDE shard_map on global arrays, and a
+            # pallas_call has no GSPMD partitioning rule — on a dp/tp
+            # mesh XLA would replicate it (all-gathering the batch)
+            # instead of partitioning like the composite does
             return xla_attention(
                 q, k, v, causal=causal, segment_ids=segment_ids,
                 softmax_scale=softmax_scale,
