@@ -16,6 +16,7 @@ from ray_tpu.serve.api import (
     run,
     shutdown,
     start,
+    start_grpc_ingress,
     start_rpc_ingress,
     status,
 )
@@ -39,6 +40,7 @@ __all__ = [
     "run",
     "shutdown",
     "start",
+    "start_grpc_ingress",
     "start_rpc_ingress",
     "status",
 ]

@@ -188,30 +188,50 @@ def start(http_options: Optional[HTTPOptions] = None, **kwargs) -> None:
 
 
 _rpc_ingress = None
+_grpc_ingress = None
+
+
+def _get_or_create_ingress(kind: str, factory, host: str, port: int):
+    """Singleton-per-kind ingress with rebind-conflict detection:
+    silently returning an ingress on a DIFFERENT address than requested
+    would strand external clients on a dead port."""
+    global _rpc_ingress, _grpc_ingress
+    controller = _get_controller_handle()
+    with _lock:
+        current = _grpc_ingress if kind == "grpc" else _rpc_ingress
+        if current is None:
+            current = factory(host, port, controller)
+            if kind == "grpc":
+                _grpc_ingress = current
+            else:
+                _rpc_ingress = current
+        elif (host, port) != ("127.0.0.1", 0) and (
+            current.addr[0] != host
+            or (port != 0 and current.addr[1] != port)
+        ):
+            raise RuntimeError(
+                f"{kind} ingress already bound at {current.addr}; "
+                f"cannot rebind to ({host}, {port}) — serve.shutdown() first"
+            )
+        return current
+
+
+def start_grpc_ingress(host: str = "127.0.0.1", port: int = 0):
+    """Start the standards-based gRPC front door (reference: Serve's
+    gRPCProxy) — any generated client stub works; deployments exchange
+    serialized protobuf bytes. Returns the ingress with its `.addr`."""
+    from ray_tpu.serve.grpc_ingress import GrpcIngress
+
+    return _get_or_create_ingress("grpc", GrpcIngress, host, port)
 
 
 def start_rpc_ingress(host: str = "127.0.0.1", port: int = 0):
-    """Start the binary RPC front door next to (or instead of) HTTP — the
-    gRPC-proxy role (reference: serve gRPC ingress); returns the ingress
-    with its bound `.addr`."""
-    global _rpc_ingress
-    controller = _get_controller_handle()
-    with _lock:
-        if _rpc_ingress is None:
-            from ray_tpu.serve.rpc_ingress import RpcIngress
+    """Start the binary RPC front door next to (or instead of) HTTP (the
+    framed-TCP sibling of the gRPC ingress); returns the ingress with
+    its bound `.addr`."""
+    from ray_tpu.serve.rpc_ingress import RpcIngress
 
-            _rpc_ingress = RpcIngress(host, port, controller)
-        elif (host, port) != ("127.0.0.1", 0) and (
-            _rpc_ingress.addr[0] != host
-            or (port != 0 and _rpc_ingress.addr[1] != port)
-        ):
-            # silently returning an ingress on a DIFFERENT address than
-            # requested strands external clients on a dead port
-            raise RuntimeError(
-                f"RPC ingress already bound at {_rpc_ingress.addr}; "
-                f"cannot rebind to ({host}, {port}) — serve.shutdown() first"
-            )
-        return _rpc_ingress
+    return _get_or_create_ingress("rpc", RpcIngress, host, port)
 
 
 def _collect_deployments(app: Application):
@@ -351,7 +371,7 @@ def delete(name: str) -> None:
 
 
 def shutdown() -> None:
-    global _controller_handle, _proxy, _rpc_ingress
+    global _controller_handle, _proxy, _rpc_ingress, _grpc_ingress
     import ray_tpu
     from ray_tpu.serve.handle import _drop_routers
 
@@ -359,7 +379,10 @@ def shutdown() -> None:
     with _lock:
         proxy, _proxy = _proxy, None
         ingress, _rpc_ingress = _rpc_ingress, None
+        gingress, _grpc_ingress = _grpc_ingress, None
         controller, _controller_handle = _controller_handle, None
+    if gingress is not None:
+        gingress.shutdown()
     if ingress is not None:
         ingress.shutdown()
     if proxy is not None:

@@ -1,0 +1,139 @@
+"""gRPC ingress: the standards-based front door next to HTTP and the
+framed-RPC ingress.
+
+Reference analog: Serve's gRPCProxy (python/ray/serve/_private/
+proxy.py:532) — user-defined protobuf service methods routed to
+deployments. Redesigned without a protoc step on the SERVER side: a
+`grpc.GenericRpcHandler` accepts ANY ``/package.Service/Method`` call,
+routes it through the same controller route table the HTTP proxy uses,
+and passes the request's raw serialized bytes to the deployment. The
+contract mirrors the reference's:
+
+  * the app is selected with the ``application`` request metadata key
+    (single deployed app = default, like the reference);
+  * the deployment method invoked is the gRPC method name (``Predict``
+    for ``/user.Inference/Predict``); ``Call`` or ``__call__`` target
+    the ingress deployment's ``__call__``;
+  * deployments receive the request message's serialized bytes and
+    return bytes (parse/serialize with their own generated protobuf
+    classes — clients use their normal generated stubs unchanged).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.serve.grpc_ingress")
+
+
+class GrpcIngress:
+    def __init__(self, host: str, port: int, controller_handle,
+                 max_workers: int = 16):
+        import grpc
+        from concurrent import futures
+
+        from ray_tpu.serve.routes import RouteTableCache
+
+        self._route_cache = RouteTableCache(controller_handle)
+        self._handles: dict[tuple, Any] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class _Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                method = handler_call_details.method
+                md = dict(handler_call_details.invocation_metadata or ())
+                return grpc.unary_unary_rpc_method_handler(
+                    lambda request, ctx: outer._dispatch(
+                        method, md, request, ctx
+                    ),
+                    # (de)serializers None: raw message bytes in and out
+                )
+
+        self._grpc = grpc
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(
+                max_workers=max_workers, thread_name_prefix="serve-grpc"
+            )
+        )
+        self._server.add_generic_rpc_handlers((_Handler(),))
+        bound = self._server.add_insecure_port(f"{host}:{port}")
+        if bound == 0:
+            raise RuntimeError(f"gRPC ingress failed to bind {host}:{port}")
+        self.addr = (host, bound)
+        self._server.start()
+
+    # -- routing (same table the HTTP proxy consumes) -------------------------
+
+    def _resolve(self, app: Optional[str]):
+        apps = {a: ingress for _, (a, ingress) in self._route_cache.get().items()}
+        if app is None:
+            if not apps:
+                raise KeyError("no applications with a route_prefix deployed")
+            if len(apps) > 1:
+                raise KeyError(
+                    "metadata 'application' required: multiple apps "
+                    f"deployed ({sorted(apps)})"
+                )
+            app = next(iter(apps))
+        ingress = apps.get(app)
+        if ingress is None:
+            raise KeyError(f"no deployed app {app!r}; have {sorted(apps)}")
+        return app, ingress
+
+    def _handle_for(self, app: str, ingress: str):
+        with self._lock:
+            h = self._handles.get((app, ingress))
+            if h is None:
+                from ray_tpu.serve.handle import DeploymentHandle
+
+                h = DeploymentHandle(ingress, app)
+                self._handles[(app, ingress)] = h
+            return h
+
+    def _dispatch(self, method: str, metadata: dict, request: bytes, ctx):
+        grpc = self._grpc
+        try:
+            # ROUTING errors only in this block: a deployment's own
+            # KeyError must not masquerade as NOT_FOUND (clients key
+            # retry/re-resolve behavior on that status)
+            app, ingress = self._resolve(metadata.get("application"))
+            handle = self._handle_for(app, ingress)
+        except KeyError as e:
+            ctx.abort(grpc.StatusCode.NOT_FOUND, str(e))
+        try:
+            mname = method.rsplit("/", 1)[-1]
+            if mname not in ("Call", "__call__"):
+                handle = getattr(handle, mname)
+            timeout = float(metadata.get("request_timeout_s", 120.0))
+            out = handle.remote(request).result(timeout_s=timeout)
+        except Exception as e:  # noqa: BLE001 — deployment-level failure
+            # both timeout types: core GetTimeoutError subclasses
+            # TimeoutError, the cluster one is a plain Exception
+            from ray_tpu.cluster.client import GetTimeoutError as _CGTE
+
+            if isinstance(e, (TimeoutError, _CGTE)):
+                ctx.abort(grpc.StatusCode.DEADLINE_EXCEEDED, str(e))
+            logger.exception("gRPC ingress call failed")
+            ctx.abort(grpc.StatusCode.INTERNAL, repr(e))
+        if out is None:
+            return b""
+        if isinstance(out, (bytes, bytearray, memoryview)):
+            return bytes(out)
+        serialize = getattr(out, "SerializeToString", None)
+        if serialize is not None:  # a protobuf message object
+            return serialize()
+        ctx.abort(
+            grpc.StatusCode.INTERNAL,
+            f"deployment returned {type(out).__name__}; gRPC responses must "
+            "be bytes or protobuf messages",
+        )
+
+    def shutdown(self) -> None:
+        # wait out the grace window: serve.shutdown() kills the
+        # controller right after this returns, and draining RPCs must
+        # finish against a live control plane
+        self._server.stop(grace=1.0).wait()

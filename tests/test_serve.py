@@ -257,3 +257,49 @@ def test_rpc_ingress_binary_front_door(serve_instance):
     # single-app deployments resolve without naming the app
     assert rpc_ingress_call(ingress.addr, 5)["doubled"] == 10
     serve.delete("rpcapp")
+
+
+def test_grpc_ingress_standards_front_door(serve_instance):
+    """Standards-based gRPC ingress (reference: gRPCProxy): a PLAIN grpc
+    channel + generated-stub-shaped method path reaches the deployment,
+    which exchanges serialized message bytes; metadata selects the app,
+    the gRPC method name selects the deployment method."""
+    import grpc
+
+    @serve.deployment
+    class Infer:
+        def __call__(self, data: bytes) -> bytes:
+            return b"default:" + data
+
+        def Predict(self, data: bytes) -> bytes:
+            return data.upper()
+
+    serve.run(Infer.bind(), name="grpcapp", route_prefix="/grpc")
+    ingress = serve.start_grpc_ingress(port=0)
+    chan = grpc.insecure_channel(f"{ingress.addr[0]}:{ingress.addr[1]}")
+
+    def unary(method):
+        return chan.unary_unary(
+            method,
+            request_serializer=lambda b: b,
+            response_deserializer=lambda b: b,
+        )
+
+    # named method, explicit app
+    out = unary("/user.Inference/Predict")(
+        b"hello", metadata=(("application", "grpcapp"),), timeout=60
+    )
+    assert out == b"HELLO"
+    # Call -> __call__, single-app default resolution
+    out = unary("/user.Inference/Call")(b"x", timeout=60)
+    assert out == b"default:x"
+    # unknown app -> NOT_FOUND status
+    try:
+        unary("/user.Inference/Predict")(
+            b"x", metadata=(("application", "ghost"),), timeout=30
+        )
+        raise AssertionError("expected NOT_FOUND")
+    except grpc.RpcError as e:
+        assert e.code() == grpc.StatusCode.NOT_FOUND
+    chan.close()
+    serve.delete("grpcapp")
