@@ -205,3 +205,92 @@ class CQL:
 
     def compute_actions(self, obs) -> np.ndarray:
         return np.asarray(self._infer(self.sac.params, jnp.asarray(obs)))
+
+
+class MARWILConfig(BCConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = MARWIL
+        self.beta = 1.0        # 0 = plain BC
+        self.vf_coeff = 1.0
+        self.gamma = 0.99
+        self.adv_clip = 20.0   # cap on exp-advantage weights
+
+    def training(self, **kwargs):
+        for k in ("beta", "vf_coeff", "adv_clip"):
+            if k in kwargs:
+                setattr(self, k, kwargs.pop(k))
+        return super().training(**kwargs)
+
+
+class MARWIL(BC):
+    """Monotonic Advantage Re-Weighted Imitation Learning.
+
+    Reference analog: rllib/algorithms/marwil (BC is its beta=0 case):
+    imitation weighted by exp(beta * normalized advantage), advantage =
+    monte-carlo return-to-go minus a learned value baseline — cloning
+    leans toward the dataset's BETTER-than-average actions instead of
+    imitating everything uniformly.
+
+    Dataset needs obs/actions plus either a precomputed "returns"
+    column or rewards (+ terminateds/dones episode boundaries, rows in
+    trajectory order) from which discounted return-to-go is derived.
+    """
+
+    @classmethod
+    def default_config(cls) -> MARWILConfig:
+        return MARWILConfig()
+
+    def __init__(self, config: Optional["MARWILConfig"] = None,
+                 module_spec: Optional[RLModuleSpec] = None):
+        super().__init__(config, module_spec)
+        cols = self.dataset.columns
+        if "returns" not in cols:
+            if "rewards" not in cols:
+                raise ValueError(
+                    "MARWIL needs a 'returns' column, or 'rewards' "
+                    "(+ 'terminateds'/'dones') to derive return-to-go"
+                )
+            dones = cols.get("terminateds", cols.get("dones"))
+            if dones is None:
+                raise ValueError("MARWIL needs 'terminateds'/'dones' with rewards")
+            r = np.asarray(cols["rewards"], np.float32)
+            d = np.asarray(dones, np.float32)
+            g = np.zeros_like(r)
+            acc = 0.0
+            for i in range(len(r) - 1, -1, -1):
+                acc = r[i] + self.config.gamma * acc * (1.0 - d[i])
+                g[i] = acc
+            # an algorithm-OWNED dataset view: the derived column is
+            # gamma-specific, and the caller's object must not mutate
+            # (a second MARWIL at another gamma would silently reuse it)
+            self.dataset = OfflineData({**cols, "returns": g})
+
+    def _build_update(self):
+        module = self.module
+        cfg = self.config
+        beta, vf_coeff, clip = cfg.beta, cfg.vf_coeff, cfg.adv_clip
+
+        @jax.jit
+        def update(params, opt_state, batch):
+            def loss_fn(p):
+                out = module.forward(p, batch["obs"])
+                logp = module.dist.logp(
+                    out["action_dist_inputs"], batch["actions"]
+                )
+                v = out["vf"]
+                returns = batch["returns"].astype(jnp.float32)
+                vf_loss = jnp.square(v - returns).mean()
+                adv = returns - jax.lax.stop_gradient(v)
+                # batch-normalized advantage inside the exp (reference
+                # normalizes by a running estimate of E[adv^2])
+                scale = jnp.sqrt(jnp.mean(jnp.square(adv)) + 1e-8)
+                w = jnp.clip(jnp.exp(beta * adv / scale), 0.0, clip)
+                bc_loss = -(jax.lax.stop_gradient(w) * logp).mean()
+                return bc_loss + vf_coeff * vf_loss
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        self._update = update

@@ -35,11 +35,9 @@ class GrpcIngress:
         import grpc
         from concurrent import futures
 
-        from ray_tpu.serve.routes import RouteTableCache
+        from ray_tpu.serve.routes import AppResolver
 
-        self._route_cache = RouteTableCache(controller_handle)
-        self._handles: dict[tuple, Any] = {}
-        self._lock = threading.Lock()
+        self._resolver = AppResolver(controller_handle, error_cls=KeyError)
         outer = self
 
         class _Handler(grpc.GenericRpcHandler):
@@ -66,42 +64,14 @@ class GrpcIngress:
         self.addr = (host, bound)
         self._server.start()
 
-    # -- routing (same table the HTTP proxy consumes) -------------------------
-
-    def _resolve(self, app: Optional[str]):
-        apps = {a: ingress for _, (a, ingress) in self._route_cache.get().items()}
-        if app is None:
-            if not apps:
-                raise KeyError("no applications with a route_prefix deployed")
-            if len(apps) > 1:
-                raise KeyError(
-                    "metadata 'application' required: multiple apps "
-                    f"deployed ({sorted(apps)})"
-                )
-            app = next(iter(apps))
-        ingress = apps.get(app)
-        if ingress is None:
-            raise KeyError(f"no deployed app {app!r}; have {sorted(apps)}")
-        return app, ingress
-
-    def _handle_for(self, app: str, ingress: str):
-        with self._lock:
-            h = self._handles.get((app, ingress))
-            if h is None:
-                from ray_tpu.serve.handle import DeploymentHandle
-
-                h = DeploymentHandle(ingress, app)
-                self._handles[(app, ingress)] = h
-            return h
-
     def _dispatch(self, method: str, metadata: dict, request: bytes, ctx):
         grpc = self._grpc
         try:
             # ROUTING errors only in this block: a deployment's own
             # KeyError must not masquerade as NOT_FOUND (clients key
             # retry/re-resolve behavior on that status)
-            app, ingress = self._resolve(metadata.get("application"))
-            handle = self._handle_for(app, ingress)
+            app, ingress = self._resolver.resolve(metadata.get("application"))
+            handle = self._resolver.handle_for(app, ingress)
         except KeyError as e:
             ctx.abort(grpc.StatusCode.NOT_FOUND, str(e))
         try:
@@ -109,6 +79,13 @@ class GrpcIngress:
             if mname not in ("Call", "__call__"):
                 handle = getattr(handle, mname)
             timeout = float(metadata.get("request_timeout_s", 120.0))
+            # honor the CLIENT's gRPC deadline: once the caller gives up
+            # there is no point pinning a worker thread for the rest of
+            # the server-side budget (16 abandoned calls would wedge the
+            # whole pool)
+            remaining = ctx.time_remaining()
+            if remaining is not None:
+                timeout = min(timeout, max(0.1, remaining))
             out = handle.remote(request).result(timeout_s=timeout)
         except Exception as e:  # noqa: BLE001 — deployment-level failure
             # both timeout types: core GetTimeoutError subclasses

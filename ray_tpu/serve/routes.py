@@ -37,3 +37,48 @@ class RouteTableCache:
                 if best is None or len(norm) > len(best[0]):
                     best = (norm, prefix, app, ingress)
         return best
+
+
+class AppResolver:
+    """Shared app-name resolution + DeploymentHandle cache for the
+    non-HTTP ingresses (framed-RPC and gRPC front doors): both route by
+    app name with a single-app default and memoize handles per
+    (app, ingress). One implementation, one drift surface."""
+
+    def __init__(self, controller_handle, error_cls: type = KeyError):
+        import threading
+
+        self.route_cache = RouteTableCache(controller_handle)
+        self._handles: dict = {}
+        self._lock = threading.Lock()
+        self._error_cls = error_cls
+
+    def resolve(self, app: "str | None") -> tuple:
+        apps = {a: ingress for _, (a, ingress) in self.route_cache.get().items()}
+        if app is None:
+            if not apps:
+                raise self._error_cls(
+                    "no applications with a route_prefix are deployed"
+                )
+            if len(apps) > 1:
+                raise self._error_cls(
+                    f"app selection required: multiple apps deployed "
+                    f"({sorted(apps)})"
+                )
+            app = next(iter(apps))
+        ingress = apps.get(app)
+        if ingress is None:
+            raise self._error_cls(
+                f"no deployed app {app!r}; have {sorted(apps)}"
+            )
+        return app, ingress
+
+    def handle_for(self, app: str, ingress: str):
+        with self._lock:
+            h = self._handles.get((app, ingress))
+            if h is None:
+                from ray_tpu.serve.handle import DeploymentHandle
+
+                h = DeploymentHandle(ingress, app)
+                self._handles[(app, ingress)] = h
+            return h

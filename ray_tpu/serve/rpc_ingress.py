@@ -28,42 +28,11 @@ class RpcIngress:
 
     def __init__(self, host: str, port: int, controller_handle):
         self._controller = controller_handle
-        self._handles: dict[tuple, Any] = {}
-        from ray_tpu.serve.routes import RouteTableCache
+        from ray_tpu.serve.routes import AppResolver
 
-        self._route_cache = RouteTableCache(controller_handle)
-        self._lock = threading.Lock()
+        self._resolver = AppResolver(controller_handle, error_cls=ValueError)
         self.rpc = RpcServer(self, host=host, port=port)
         self.addr = self.rpc.start()
-
-    # -- routing (same table the HTTP proxy consumes) -------------------------
-
-    def _resolve(self, app: Optional[str]):
-        apps = {a: ingress for _, (a, ingress) in self._route_cache.get().items()}
-        if app is None:
-            if not apps:
-                raise ValueError(
-                    "no applications with a route_prefix are deployed"
-                )
-            if len(apps) > 1:
-                raise ValueError(
-                    f"app= required: multiple apps deployed ({sorted(apps)})"
-                )
-            app = next(iter(apps))
-        ingress = apps.get(app)
-        if ingress is None:
-            raise KeyError(f"no deployed app {app!r}; have {sorted(apps)}")
-        return app, ingress
-
-    def _handle_for(self, app: str, ingress: str):
-        with self._lock:
-            h = self._handles.get((app, ingress))
-            if h is None:
-                from ray_tpu.serve.handle import DeploymentHandle
-
-                h = DeploymentHandle(ingress, app)
-                self._handles[(app, ingress)] = h
-            return h
 
     # -- RPC surface ----------------------------------------------------------
 
@@ -71,8 +40,8 @@ class RpcIngress:
         """{app?, method?, args?, kwargs?} -> deployment result (pickled
         by the wire). `method` targets a named method on the ingress
         deployment; omitted = its __call__."""
-        app, ingress = self._resolve(payload.get("app"))
-        handle = self._handle_for(app, ingress)
+        app, ingress = self._resolver.resolve(payload.get("app"))
+        handle = self._resolver.handle_for(app, ingress)
         if payload.get("method"):
             handle = getattr(handle, payload["method"])
         response = handle.remote(*payload.get("args", ()),
@@ -80,7 +49,7 @@ class RpcIngress:
         return response.result(timeout_s=payload.get("timeout", 120.0))
 
     def rpc_routes(self, payload, peer):
-        return dict(self._route_cache.get())
+        return dict(self._resolver.route_cache.get())
 
     def shutdown(self) -> None:
         self.rpc.stop()
