@@ -177,9 +177,35 @@ def run_bench():
             mfu=mfu, tokens_per_sec=tokens_per_sec,
         )
 
+    # -- optional roofline attribution (--profile / RAY_TPU_BENCH_PROFILE) ----
+    profile_summary = {}
+    if os.environ.get("RAY_TPU_BENCH_PROFILE"):
+        try:
+            from ray_tpu.profiler import profile_train_step
+
+            prof = profile_train_step(
+                cfg, llama.init_params(cfg, jax.random.key(0)), batch,
+                opt, iters=6, warmup=2,
+            )
+            out_path = os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "benchmarks", "PROFILE_trainstep_r06.json",
+            )
+            prof.save(out_path)
+            profile_summary = {
+                "profile_out": out_path,
+                "profile_coverage_pct": prof.coverage_pct,
+                "profile_segments_ms": {
+                    s.name: s.ms for s in prof.segments if s.in_step
+                },
+            }
+        except Exception as e:  # noqa: BLE001 — the MFU capture still counts
+            profile_summary = {"profile_error": repr(e)[:300]}
+
     result = {
         "metric": "llama400m_train_mfu" if on_tpu else "llama_tiny_train_smoke",
         "value": round(mfu * 100, 2),
+        **profile_summary,
         "unit": "%MFU",
         "vs_baseline": round(mfu / 0.45, 4),
         "tokens_per_sec": round(tokens_per_sec, 1),
@@ -293,6 +319,11 @@ def _extract_json_line(out: str):
 def main():
     want = os.environ.get("JAX_PLATFORMS", "")
     force_cpu = bool(want) and "axon" not in want and "tpu" not in want
+
+    # --profile: the timed capture also runs the ray_tpu.profiler
+    # roofline attribution and writes benchmarks/PROFILE_trainstep_r06.json
+    if "--profile" in sys.argv[1:]:
+        os.environ["RAY_TPU_BENCH_PROFILE"] = "1"
 
     if os.environ.get("RAY_TPU_BENCH_CHILD"):
         # child mode: honor an explicit non-TPU platform request

@@ -2,21 +2,35 @@
 
 Continuous-batching decode throughput (tokens/s) for the paged-KV
 engine at a fixed concurrency — the serving-side counterpart of
-bench.py's training MFU. Prints one JSON line.
-
-Reference headline analog: vLLM-style tokens/s serving benchmarks.
+bench.py's training MFU. Prints one JSON line. --profile additionally
+runs the engine's roofline-attributed decode profile
+(ray_tpu.profiler) and writes it to benchmarks/PROFILE_decode_r06.json
+— the serving analog of PROFILE_taskplane_r05.md the roadmap lacked.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
+import os as _os
 import time
+
+_PROFILE_OUT = _os.path.join(
+    _os.path.dirname(_os.path.abspath(__file__)), "PROFILE_decode_r06.json"
+)
 
 
 def main():
     import os
 
     import jax
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile", action="store_true",
+                    help="also write the roofline-attributed decode "
+                    "StepProfile (ray_tpu.profiler)")
+    ap.add_argument("--profile-out", default=_PROFILE_OUT)
+    args = ap.parse_args()
 
     want = os.environ.get("JAX_PLATFORMS", "")
     if want and "axon" not in want and "tpu" not in want:
@@ -90,6 +104,23 @@ def main():
     }
     if generated < expected * 0.9:
         result["warning"] = "fewer tokens than expected (early stops?)"
+
+    if args.profile:
+        # steady-state engine, same weights/config: where does one decode
+        # step go, and how far off the HBM roofline is it?
+        prof = engine.profile_decode(
+            batch_size=min(n_requests, 16),
+            context_len=min(prompt_len + max_new, cfg.max_seq - 1),
+            iters=8 if on_tpu else 6,
+        )
+        prof.save(args.profile_out)
+        result["profile_out"] = args.profile_out
+        result["profile_coverage_pct"] = prof.coverage_pct
+        result["profile_top_segment"] = max(
+            (s for s in prof.segments if s.in_step), key=lambda s: s.ms
+        ).name
+        print(prof.to_markdown(), flush=True)
+
     print(json.dumps(result))
 
 

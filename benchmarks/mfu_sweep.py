@@ -1,7 +1,10 @@
 """MFU sweep on the local accelerator: remat policy x attention impl x batch.
 
 Prints one JSON line per config. Used to pick the flagship bench config;
-not part of the driver bench path.
+not part of the driver bench path. --profile additionally runs the
+ray_tpu.profiler ladder per config and appends the segment breakdown to
+each line — the sweep then says not just WHICH shape wins but WHERE each
+loser's step time goes.
 """
 
 from __future__ import annotations
@@ -20,7 +23,7 @@ from ray_tpu.train.step import TrainState, make_train_step
 PEAK = {"tpu": 197e12}
 
 
-def bench_config(cfg, B, S, iters=10, tag=""):
+def bench_config(cfg, B, S, iters=10, tag="", profile=False):
     params = llama.init_params(cfg, jax.random.key(0))
     opt = optax.adamw(3e-4)
     state = TrainState.create(params, opt)
@@ -45,20 +48,38 @@ def bench_config(cfg, B, S, iters=10, tag=""):
     tok_s = B * S / dt
     peak = PEAK.get(jax.devices()[0].platform, 1e12)
     mfu = tok_s * 3.0 * cfg.flops_per_token() / peak
-    print(
-        json.dumps(
-            {
-                "tag": tag,
-                "ms_per_step": round(dt * 1e3, 2),
-                "tok_s": round(tok_s, 0),
-                "mfu_pct": round(mfu * 100, 2),
+    row = {
+        "tag": tag,
+        "ms_per_step": round(dt * 1e3, 2),
+        "tok_s": round(tok_s, 0),
+        "mfu_pct": round(mfu * 100, 2),
+    }
+    if profile:
+        try:
+            from ray_tpu.profiler import profile_train_step
+
+            prof = profile_train_step(
+                cfg, llama.init_params(cfg, jax.random.key(0)), batch, opt,
+                iters=5, warmup=2, export_observability=False,
+            )
+            row["segments_ms"] = {
+                s.name: s.ms for s in prof.segments if s.in_step
             }
-        ),
-        flush=True,
-    )
+            row["coverage_pct"] = prof.coverage_pct
+        except Exception as e:  # noqa: BLE001 — the sweep row still counts
+            row["profile_error"] = repr(e)[:200]
+    print(json.dumps(row), flush=True)
 
 
 def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--profile", action="store_true",
+                    help="append per-config segment attribution "
+                    "(ray_tpu.profiler) to every row")
+    args = ap.parse_args()
+
     base = llama.LLAMA_400M
     flash = dataclasses.replace(base, attention_impl="flash",
                                 remat_policy="dots", max_seq=8192)
@@ -79,7 +100,7 @@ def main():
         ("flash_b1_s8192", flash, 1, 8192),
     ]
     for tag, cfg, B, S in configs:
-        bench_config(cfg, B, S, tag=tag)
+        bench_config(cfg, B, S, tag=tag, profile=args.profile)
 
 
 if __name__ == "__main__":

@@ -1,12 +1,19 @@
-"""Bisect the train-step wall time: matmul peak, fwd, fwd+bwd, full step.
+"""Bisect the train-step wall time: matmul peak, fwd, fwd+bwd, full step —
+then the full roofline attribution (ray_tpu.profiler).
 
-Diagnostic harness for MFU work; prints one JSON line per probe.
+Diagnostic harness for MFU work; prints one JSON line per probe, then
+writes the segment-attributed StepProfile to
+benchmarks/PROFILE_trainstep_r06.json (--out to override, --no-roofline
+to skip). Platform-aware: the flagship LLAMA_400M shapes on TPU, the
+smoke LLAMA_TINY shapes under JAX_PLATFORMS=cpu.
 """
 
 from __future__ import annotations
 
+import argparse
 import dataclasses
 import json
+import os
 import time
 
 import jax
@@ -36,18 +43,33 @@ def timeit(fn, *args, iters=10, warmup=2):
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--no-roofline", action="store_true",
+                    help="skip the ray_tpu.profiler attribution pass")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "PROFILE_trainstep_r06.json",
+    ))
+    args = ap.parse_args()
+
+    on_tpu = jax.devices()[0].platform == "tpu"
     out = {}
     # 1) achievable bf16 matmul peak through this backend
-    for n in (2048, 4096, 8192):
+    for n in ((2048, 4096, 8192) if on_tpu else (512, 1024)):
         a = jnp.ones((n, n), jnp.bfloat16)
         b = jnp.ones((n, n), jnp.bfloat16)
         f = jax.jit(lambda a, b: a @ b)
-        dt = timeit(f, a, b, iters=20)
+        dt = timeit(f, a, b, iters=20 if on_tpu else 5)
         out[f"matmul{n}_tflops"] = round(2 * n**3 / dt / 1e12, 1)
 
     # 2) model-shaped probes
-    cfg = dataclasses.replace(llama.LLAMA_400M, attention_impl="xla", remat_policy="dots")
-    B, S = 8, 1024
+    if on_tpu:
+        cfg = dataclasses.replace(
+            llama.LLAMA_400M, attention_impl="xla", remat_policy="dots"
+        )
+        B, S = 8, 1024
+    else:
+        cfg, B, S = llama.LLAMA_TINY, 4, 64
     params = llama.init_params(cfg, jax.random.key(0))
     tokens = jax.random.randint(jax.random.key(1), (B, S + 1), 0, cfg.vocab_size, jnp.int32)
     batch = {"tokens": tokens[:, :-1], "targets": tokens[:, 1:]}
@@ -75,13 +97,15 @@ def main():
     v = jnp.ones((B, S, cfg.n_kv_heads, cfg.head_dim), jnp.bfloat16)
     att = jax.jit(lambda q, k, v: attention(q, k, v, causal=True, impl="xla"))
     out["xla_attn_layer_ms"] = round(1e3 * timeit(att, q, k, v, iters=20), 2)
-    att_f = jax.jit(lambda q, k, v: attention(q, k, v, causal=True, impl="flash"))
-    try:
-        out["flash_attn_layer_ms"] = round(1e3 * timeit(att_f, q, k, v, iters=20), 2)
-    except Exception as e:  # noqa: BLE001
-        out["flash_attn_layer_error"] = repr(e)[:200]
+    if on_tpu:
+        att_f = jax.jit(lambda q, k, v: attention(q, k, v, causal=True, impl="flash"))
+        try:
+            out["flash_attn_layer_ms"] = round(1e3 * timeit(att_f, q, k, v, iters=20), 2)
+        except Exception as e:  # noqa: BLE001
+            out["flash_attn_layer_error"] = repr(e)[:200]
 
-    # 5) full donated train step LAST (donation deletes `params`)
+    # 5) full donated train step (donation deletes `params` — the
+    # roofline pass below copies internally, so run this first)
     opt = optax.adamw(3e-4)
     state = TrainState.create(params, opt)
     step = make_train_step(lambda p, b: llama.loss_fn(p, b, cfg), opt)
@@ -93,6 +117,23 @@ def main():
         state, m = step(state, batch)
         float(m["loss"])
     out["step_ms"] = round(1e3 * (time.perf_counter() - t0) / 10, 2)
+
+    # 6) roofline attribution: the op-level breakdown the bisection
+    # above can't give — every ms named, classified, and serialized
+    if not args.no_roofline:
+        from ray_tpu.profiler import profile_train_step
+
+        prof = profile_train_step(
+            cfg, llama.init_params(cfg, jax.random.key(0)), batch, opt,
+            iters=6 if on_tpu else 8, warmup=2,
+        )
+        prof.save(args.out)
+        out["roofline_out"] = args.out
+        out["roofline_coverage_pct"] = prof.coverage_pct
+        out["roofline_top_segment"] = max(
+            (s for s in prof.segments if s.in_step), key=lambda s: s.ms
+        ).name
+        print(prof.to_markdown(), flush=True)
 
     print(json.dumps(out))
 

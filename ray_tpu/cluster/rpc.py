@@ -278,11 +278,15 @@ class RpcClient:
             with self._wlock:
                 native = getattr(self, "_native", None)
                 if native is not None:
-                    # one writev of header+payload in C, GIL released
+                    # one writev of header+payload in C, GIL released.
+                    # Bounded poll derived from the client timeout: a
+                    # stalled peer must not wedge _wlock (and with it
+                    # every thread on this connection) forever
                     if native.frame_write(
-                        self._sock.fileno(), body, len(body)
+                        self._sock.fileno(), body, len(body),
+                        int(self._timeout * 1000),
                     ) != 0:
-                        raise OSError("native frame_write failed")
+                        raise OSError("native frame_write failed or timed out")
                 else:
                     self._sock.sendall(_LEN.pack(len(body)) + body)
         except OSError as e:
@@ -310,8 +314,15 @@ class RpcClient:
 
             if _framing.enabled():
                 # opt-in native receive loop: blocks in C with the GIL
-                # released, one malloc per frame (src/framing.cc)
-                native = _framing.FrameReader(sock.fileno())
+                # released, one malloc per frame (src/framing.cc). Idle
+                # polls are bounded so the loop re-checks _closed; a
+                # mid-frame stall past the client timeout reads as
+                # connection loss instead of wedging the reader thread
+                native = _framing.FrameReader(
+                    sock.fileno(),
+                    timeout_ms=int(self._timeout * 1000),
+                    should_stop=lambda: self._closed,
+                )
         except Exception:  # noqa: BLE001 — build/toolchain missing: Python path
             native = None
         buf = b""

@@ -57,12 +57,15 @@ class TaskEventBuffer:
         actor_id=None,
         error: Optional[str] = None,
         worker: str = "",
+        ts: Optional[float] = None,
     ) -> None:
+        # explicit ts: reconstructed spans (profiler segment attribution)
+        # land at their measured offsets instead of the record() call time
         ev = TaskEvent(
             task_id=str(task_id),
             name=name,
             state=state,
-            ts=time.time(),
+            ts=time.time() if ts is None else ts,
             kind=kind,
             actor_id=str(actor_id) if actor_id is not None else None,
             error=error,

@@ -60,6 +60,18 @@ def _job_runner(sid: str, entrypoint: str, env_vars: dict) -> str:
         os.environ.get("TMPDIR", "/tmp"), f"ray_tpu-job-{sid}.log"
     )
     start = time.time()
+    # a stop raised while we were still QUEUED (client stop_job, or the
+    # PENDING-staleness failover that already recorded FAILED): exit
+    # without running — putting RUNNING here would flip a terminal status
+    if client.kv_get(_kv_key(sid, "stop"), ns=_NS) is not None:
+        raw = client.kv_get(_kv_key(sid, "status"), ns=_NS)
+        doc = json.loads(bytes(raw).decode()) if raw is not None else {}
+        if doc.get("status") not in JobStatus.TERMINAL:
+            put("status", {"status": JobStatus.STOPPED,
+                           "start_time": doc.get("start_time", start),
+                           "end_time": time.time(),
+                           "message": "stopped before start"})
+        return JobStatus.STOPPED
     put("status", {"status": JobStatus.RUNNING, "start_time": start,
                    "node": os.environ.get("RAY_TPU_NODE_ID", "?")})
     with open(log_path, "wb") as logf:
@@ -222,6 +234,9 @@ class ClusterJobSubmissionClient:
     # -- queries (KV-backed: any client sees the same state) ------------------
 
     HEARTBEAT_STALE_S = 30.0
+    # generous: covers queueing + runtime_env staging + worker spawn on a
+    # loaded cluster before the runner's first status/heartbeat put
+    PENDING_STALE_S = 300.0
 
     def _status_doc(self, sid: str) -> dict:
         raw = self._client.kv_get(_kv_key(sid, "status"), ns=_NS)
@@ -239,6 +254,41 @@ class ClusterJobSubmissionClient:
                     doc = {**doc, "status": JobStatus.FAILED,
                            "end_time": time.time(),
                            "message": f"driver heartbeat stale ({age:.0f}s)"}
+                    self._client.kv_put(
+                        _kv_key(sid, "status"),
+                        json.dumps(doc).encode(), ns=_NS,
+                    )
+        elif doc.get("status") == JobStatus.PENDING:
+            # a PENDING job whose runner never heartbeat at all died
+            # before its first put (submitter crashed pre-reconcile, or
+            # the driver task was lost with its node): without this, the
+            # KV reads PENDING forever for every other client
+            hb = self._client.kv_get(_kv_key(sid, "hb"), ns=_NS)
+            if hb is None:
+                spec_raw = self._client.kv_get(_kv_key(sid, "spec"), ns=_NS)
+                submitted = None
+                if spec_raw is not None:
+                    try:
+                        submitted = json.loads(
+                            bytes(spec_raw).decode()
+                        ).get("submit_time")
+                    except (ValueError, AttributeError):
+                        submitted = None
+                if submitted is None:
+                    submitted = doc.get("start_time")
+                age = time.time() - submitted if submitted else 0.0
+                if age > self.PENDING_STALE_S:
+                    doc = {**doc, "status": JobStatus.FAILED,
+                           "end_time": time.time(),
+                           "message": (
+                               f"job pending with no driver heartbeat for "
+                               f"{age:.0f}s (driver task lost before start)"
+                           )}
+                    # also raise the stop flag: if the driver task was
+                    # merely QUEUED (not lost) and gets a slot later, the
+                    # runner's stop check kills it immediately instead of
+                    # re-running a job every client already saw FAILED
+                    self._client.kv_put(_kv_key(sid, "stop"), b"1", ns=_NS)
                     self._client.kv_put(
                         _kv_key(sid, "status"),
                         json.dumps(doc).encode(), ns=_NS,

@@ -24,6 +24,54 @@ from ray_tpu.llm.sampling import sample_tokens
 from ray_tpu.models.llama_decode import decode_step
 
 
+_chunk_hist = None
+
+
+def chunk_histogram():
+    """Per-chunk wall-time histogram (engine hook, EngineConfig.profile):
+    one observation per decode round trip, tagged by device-side step
+    count and sampler mode, on the dashboard /metrics endpoint. Cached —
+    re-registering per chunk would take the process-wide registry lock
+    on the decode hot path."""
+    global _chunk_hist
+    if _chunk_hist is None:
+        from ray_tpu.util.metrics import Histogram
+
+        _chunk_hist = Histogram(
+            "llm_decode_chunk_ms",
+            description="profiler: wall ms per decode chunk round trip "
+            "(dispatch + device steps + host sync)",
+            boundaries=[0.5, 1, 2, 5, 10, 25, 50, 100, 250, 500, 1000, 5000],
+            tag_keys=("n_steps", "mode"),
+        )
+    return _chunk_hist
+
+
+def record_chunk(ms: float, n_steps: int, mode: str, batch_size: int) -> None:
+    """Publish one decode-chunk measurement: histogram + timeline span.
+    Observability must not break decode: every failure mode (metric name
+    registered with another type, runtime init, ...) is swallowed."""
+    try:
+        chunk_histogram().observe(
+            ms, tags={"n_steps": str(n_steps), "mode": mode}
+        )
+        import time
+
+        from ray_tpu.core import runtime as rt
+        from ray_tpu.core.events import TaskState
+
+        buf = rt.get_runtime().task_events
+        end = time.time()
+        span = f"profile-decode-chunk-{time.monotonic_ns()}"
+        name = f"profile:decode_chunk:{n_steps}x{batch_size}"
+        buf.record(span, name, TaskState.RUNNING, kind="profile",
+                   worker="llm-engine", ts=end - ms / 1e3)
+        buf.record(span, name, TaskState.FINISHED, kind="profile",
+                   worker="llm-engine", ts=end)
+    except Exception:  # noqa: BLE001 — observability must not break decode
+        pass
+
+
 def decode_chunk(
     params,
     tokens: jax.Array,        # [B] current tokens

@@ -71,6 +71,10 @@ class EngineConfig:
     # one-sync-per-token stepping. Chunks shrink automatically near a
     # request's max_tokens/max_seq; EOS overshoot is discarded host-side.
     decode_chunk: int = 8
+    # profile=True: every decode round trip lands in the
+    # llm_decode_chunk_ms histogram + timeline (ray_tpu.profiler
+    # surfaces); profile_decode() gives the full roofline breakdown
+    profile: bool = False
 
     def __post_init__(self):
         if isinstance(self.model, str):
@@ -266,7 +270,9 @@ class LLMEngine:
     def _sample_mode(batch) -> str:
         """STATIC sampler fast path for this batch (llm.sampling): the
         full top-k/top-p machinery costs a per-step lax.top_k; greedy
-        and plain-temperature batches skip it entirely."""
+        and plain-temperature batches skip it entirely. A request with
+        top_k > TOP_CAP forces the exact full-vocab sort — the capped
+        path would silently clamp it (ADVICE r05)."""
         if all(r.sampling_params.greedy for r in batch):
             return "greedy"
         if all(
@@ -274,6 +280,8 @@ class LLMEngine:
             for r in batch
         ):
             return "categorical"
+        if any(r.sampling_params.needs_full_sort for r in batch):
+            return "full_sort"
         return "full"
 
     # -- LoRA multiplexing ----------------------------------------------------
@@ -457,6 +465,40 @@ class LLMEngine:
             "total_blocks": self.config.num_blocks,
         }
 
+    def profile_decode(
+        self,
+        *,
+        batch_size: Optional[int] = None,
+        context_len: Optional[int] = None,
+        iters: int = 8,
+        warmup: int = 2,
+        include_prefill: bool = True,
+        export_observability: bool = True,
+    ):
+        """Roofline-attributed StepProfile of one decode step of THIS
+        engine (its weights, block size, attention impl), over a scratch
+        paged cache — live sequences and the real KV cache are untouched.
+
+        Segments: embed / qkv_rope / kv_write / kv_read_attn / block_mlp
+        / lm_head / sampling / host_sync (+ standalone prefill probe).
+        The report is the serving-side counterpart of the train-step
+        profile: it shows how far decode sits from the HBM roofline and
+        which slice to attack first."""
+        from ray_tpu.profiler import profile_decode_step
+
+        c = self.config
+        B = batch_size or min(4, c.max_num_seqs)
+        ctx = context_len or min(32, c.model.max_seq - 1)
+        return profile_decode_step(
+            c.model, self.params,
+            batch_size=B, context_len=ctx, block_size=c.block_size,
+            attn_impl=c.attn_impl, iters=iters, warmup=warmup,
+            include_prefill=include_prefill,
+            export_observability=export_observability,
+            meta={"engine_num_blocks": c.num_blocks,
+                  "engine_decode_chunk": c.decode_chunk},
+        )
+
     # -- scheduling internals -------------------------------------------------
 
     def _pad_to_bucket(self, n: int, buckets: list) -> int:
@@ -592,6 +634,7 @@ class LLMEngine:
 
     def _decode_step(self) -> list[RequestOutput]:
         c = self.config
+        t0 = time.perf_counter() if c.profile else None
         n_steps = self._chunk_steps()
         # grow each sequence by the chunk's slots it can actually USE —
         # overshoot steps past a request's max_tokens write the trash page
@@ -647,6 +690,13 @@ class LLMEngine:
                 self._lora_arg(lora_ids),
             )
             tok, logprob = self._sample_batch(logits[:B], batch)
+            if t0 is not None:
+                from ray_tpu.llm.decode_loop import record_chunk
+
+                record_chunk(
+                    1e3 * (time.perf_counter() - t0), 1,
+                    self._sample_mode(batch), B,
+                )
             return self._append_tokens(batch, tok, logprob)
 
         # multi-step chunk: decode+sample n_steps times on device, one sync
@@ -685,7 +735,16 @@ class LLMEngine:
             jnp.asarray(remaining),
             self._lora_arg(lora_ids),
         )
-        return self._append_chunk(batch, np.asarray(toks), np.asarray(logprobs))
+        toks_np, logprobs_np = np.asarray(toks), np.asarray(logprobs)
+        if t0 is not None:
+            from ray_tpu.llm.decode_loop import record_chunk
+
+            # np.asarray is the host sync: this is the full round trip
+            record_chunk(
+                1e3 * (time.perf_counter() - t0), n_steps,
+                self._sample_mode(batch), B,
+            )
+        return self._append_chunk(batch, toks_np, logprobs_np)
 
     # -- sampling + bookkeeping ----------------------------------------------
 

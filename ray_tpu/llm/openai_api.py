@@ -259,8 +259,24 @@ class LLMServer:
             ],
         }
 
+    @staticmethod
+    def _invalid_request(e: Exception) -> dict:
+        """OpenAI-style error payload for bad sampling knobs: admission
+        validation (SamplingParams) must surface as a client error, not
+        an unhandled 500 from the serve layer."""
+        return {
+            "error": {
+                "message": str(e),
+                "type": "invalid_request_error",
+                "code": 400,
+            }
+        }
+
     async def completions(self, body: dict) -> Any:
-        sp = self._sampling_from_body(body)
+        try:
+            sp = self._sampling_from_body(body)
+        except (ValueError, TypeError) as e:
+            return self._invalid_request(e)
         prompts = body.get("prompt", "")
         if not isinstance(prompts, list):
             prompts = [prompts]
@@ -297,7 +313,10 @@ class LLMServer:
         return payload
 
     async def chat_completions(self, body: dict) -> Any:
-        sp = self._sampling_from_body(body)
+        try:
+            sp = self._sampling_from_body(body)
+        except (ValueError, TypeError) as e:
+            return self._invalid_request(e)
         messages = body.get("messages", [])
         prompt = default_chat_template(messages)
         ids = self.tokenizer.encode(prompt)

@@ -28,9 +28,33 @@ class SamplingParams:
     seed: Optional[int] = None
     logprobs: bool = False
 
+    def __post_init__(self):
+        # validate at admission, not inside the jitted sampler: a bad
+        # knob must 400 the request, not poison a whole decode batch
+        if self.max_tokens < 1:
+            raise ValueError(f"max_tokens must be >= 1, got {self.max_tokens}")
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0, got {self.temperature}"
+            )
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0 (0 = off), got {self.top_k}")
+        # top_p = 0 is accepted (OpenAI clients send it) and means the
+        # smallest possible nucleus: the single most likely token
+        if not (0.0 <= self.top_p <= 1.0):
+            raise ValueError(
+                f"top_p must be in [0, 1], got {self.top_p}"
+            )
+
     @property
     def greedy(self) -> bool:
         return self.temperature == 0.0
+
+    @property
+    def needs_full_sort(self) -> bool:
+        """top_k beyond the TOP_CAP fast path: the capped sampler would
+        silently clamp it, so the batch must take the full-sort path."""
+        return self.top_k > TOP_CAP
 
 
 # top-k/top-p filtering is applied on the TOP_CAP largest logits only:
@@ -39,6 +63,9 @@ class SamplingParams:
 # sorts of 32k on the VPU. lax.top_k(256) is ~100x less work; exact for
 # top_k <= 256 and for any nucleus that fits in the top 256 tokens
 # (beyond that the tail carries negligible mass at sane temperatures).
+# Batches containing a request with top_k > TOP_CAP take mode
+# "full_sort" (the engine derives it per batch): exact over the whole
+# vocab at the old full-sort price, instead of silently clamping.
 TOP_CAP = 256
 
 
@@ -49,7 +76,7 @@ def sample_tokens(
     top_ks: jax.Array,        # [B] int32 (0 = off)
     top_ps: jax.Array,        # [B] (1.0 = off)
     keys: jax.Array,          # [B] PRNG keys
-    mode: str = "full",       # static: "greedy" | "categorical" | "full"
+    mode: str = "full",       # static: "greedy" | "categorical" | "full" | "full_sort"
 ) -> tuple[jax.Array, jax.Array]:
     """Returns (tokens [B], logprobs [B]). All knobs vectorized per row.
 
@@ -58,7 +85,10 @@ def sample_tokens(
       * greedy: every row has temperature 0 — argmax only;
       * categorical: temperature sampling, no top-k/top-p — gumbel-max
         via jax.random.categorical, no sort;
-      * full: top-k/top-p filtering on the TOP_CAP largest logits.
+      * full: top-k/top-p filtering on the TOP_CAP largest logits;
+      * full_sort: exact filtering over the whole vocab — required when
+        any row's top_k exceeds TOP_CAP (the capped path would clamp
+        it and truncate any nucleus wider than TOP_CAP).
     """
     if mode == "greedy":
         tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
@@ -78,16 +108,18 @@ def sample_tokens(
         tok = jnp.where(temperatures <= 0.0, greedy_tok, sampled)
     else:
         V = logits.shape[-1]
-        cap = min(TOP_CAP, V)
+        cap = V if mode == "full_sort" else min(TOP_CAP, V)
         top_vals, top_idx = jax.lax.top_k(scaled, cap)  # [B, cap] descending
         pos = jnp.arange(cap)[None, :]
         # top-k: keep positions < k (k = 0/off or > cap keeps all)
         k = jnp.where((top_ks <= 0) | (top_ks > cap), cap, top_ks)[:, None]
         vals = jnp.where(pos < k, top_vals, -jnp.inf)
-        # top-p: smallest prefix of the (sorted) probs with mass >= p
+        # top-p: smallest prefix of the (sorted) probs with mass >= p.
+        # The explicit pos==0 term makes "first token always kept" hold
+        # at top_p = 0 too (where cum - probs < 0 is false everywhere)
         probs = jax.nn.softmax(vals, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
-        keep = (cum - probs) < top_ps[:, None]  # first token always kept
+        keep = ((cum - probs) < top_ps[:, None]) | (pos == 0)
         vals = jnp.where(keep, vals, -jnp.inf)
         choice = jax.vmap(jax.random.categorical)(keys, vals)  # [B] in [0, cap)
         filtered = jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0]

@@ -54,36 +54,52 @@ def load_library(build: bool = True) -> ctypes.CDLL:
         lib = ctypes.CDLL(so)
         lib.frame_read.restype = ctypes.c_long
         lib.frame_read.argtypes = [
-            ctypes.c_int, ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte))
+            ctypes.c_int, ctypes.POINTER(ctypes.POINTER(ctypes.c_ubyte)),
+            ctypes.c_int,
         ]
         lib.frame_free.argtypes = [ctypes.POINTER(ctypes.c_ubyte)]
         lib.frame_write.restype = ctypes.c_int
         lib.frame_write.argtypes = [
-            ctypes.c_int, ctypes.c_char_p, ctypes.c_ulong
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_ulong, ctypes.c_int
         ]
         _LIB = lib
         return lib
 
 
 class FrameReader:
-    """Blocking frame reader over a connected socket's fd."""
+    """Blocking frame reader over a connected socket's fd.
 
-    def __init__(self, fileno: int):
+    ``timeout_ms`` bounds every C-side poll: a peer that stalls
+    MID-FRAME surfaces as connection loss (None) instead of wedging the
+    reader forever; an IDLE expiry (no frame started) just loops —
+    after calling ``should_stop`` so the owner can shut the loop down.
+    """
+
+    def __init__(self, fileno: int, timeout_ms: int = -1,
+                 should_stop=None):
         self._lib = load_library()
         self._fd = fileno
+        self._timeout_ms = int(timeout_ms)
+        self._should_stop = should_stop
 
     def read_frame(self) -> Optional[bytes]:
-        """One complete frame body, or None on EOF/connection loss."""
+        """One complete frame body, or None on EOF/connection loss/stop."""
         out = ctypes.POINTER(ctypes.c_ubyte)()
-        n = self._lib.frame_read(self._fd, ctypes.byref(out))
-        if n == -1:
-            return None
-        if n < 0:
-            raise MemoryError("native frame_read failed (oversized/alloc)")
-        try:
-            return ctypes.string_at(out, n)
-        finally:
-            self._lib.frame_free(out)
+        while True:
+            n = self._lib.frame_read(self._fd, ctypes.byref(out),
+                                     self._timeout_ms)
+            if n == -3:  # idle: nothing consumed, safe to keep waiting
+                if self._should_stop is not None and self._should_stop():
+                    return None
+                continue
+            if n == -1:
+                return None
+            if n < 0:
+                raise MemoryError("native frame_read failed (oversized/alloc)")
+            try:
+                return ctypes.string_at(out, n)
+            finally:
+                self._lib.frame_free(out)
 
 
 def enabled() -> bool:

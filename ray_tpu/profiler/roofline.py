@@ -1,0 +1,196 @@
+"""Roofline attribution: join measured segment wall time with static
+cost into a StepProfile report.
+
+Each segment lands at a point (operational intensity, achieved FLOP/s)
+under the chip's roofline (peak FLOPs capped by peak HBM bandwidth x
+intensity): segments left of the ridge are bandwidth-bound, right of it
+compute-bound; attainment is achieved/attainable for the segment's own
+regime. The report also carries the largest unattributed residual — the
+profiler's own honesty metric — so a follow-up PR knows whether to
+optimize a named segment or go find the missing time first.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional
+
+from ray_tpu.profiler.costs import ChipPeaks, chip_peaks
+from ray_tpu.profiler.segments import SegmentTiming
+
+COMPUTE_BOUND = "compute"
+BANDWIDTH_BOUND = "bandwidth"
+UNKNOWN_BOUND = "unknown"
+
+
+@dataclasses.dataclass
+class SegmentProfile:
+    name: str
+    ms: float
+    pct_of_step: float
+    flops: float
+    bytes_accessed: float
+    intensity: Optional[float]        # FLOPs / byte
+    achieved_tflops: Optional[float]
+    achieved_gbps: Optional[float]
+    attainment_pct: Optional[float]   # achieved / attainable in its regime
+    bound: str
+    in_step: bool = True
+
+    @classmethod
+    def build(
+        cls, seg: SegmentTiming, step_ms: float, peaks: ChipPeaks
+    ) -> "SegmentProfile":
+        sec = seg.ms / 1e3
+        pct = 100.0 * seg.ms / step_ms if step_ms > 0 else 0.0
+        if not seg.cost.populated:
+            return cls(
+                name=seg.name, ms=round(seg.ms, 4), pct_of_step=round(pct, 2),
+                flops=seg.cost.flops, bytes_accessed=seg.cost.bytes_accessed,
+                intensity=None, achieved_tflops=None, achieved_gbps=None,
+                attainment_pct=None, bound=UNKNOWN_BOUND, in_step=seg.in_step,
+            )
+        flops, byts = seg.cost.flops, seg.cost.bytes_accessed
+        intensity = flops / byts if byts > 0 else None
+        # bound classification is STATIC (cost model vs ridge) — valid
+        # even when the measured slice is too small to rate
+        if intensity is None:
+            bound = COMPUTE_BOUND if flops > 0 else UNKNOWN_BOUND
+        elif intensity >= peaks.ridge_intensity:
+            bound = COMPUTE_BOUND
+        else:
+            bound = BANDWIDTH_BOUND
+        # below ~10us the ladder diff is noise-floor; achieved-rate math
+        # on it produces fiction (e.g. >100% attainment)
+        if sec <= 1e-5:
+            ach_fl = ach_bw = attain = None
+        else:
+            ach_fl = flops / sec
+            ach_bw = byts / sec
+            if bound == COMPUTE_BOUND:
+                attain = 100.0 * ach_fl / peaks.flops
+            elif bound == BANDWIDTH_BOUND:
+                attain = 100.0 * ach_bw / peaks.hbm_bytes_s
+            else:
+                attain = None
+        return cls(
+            name=seg.name,
+            ms=round(seg.ms, 4),
+            pct_of_step=round(pct, 2),
+            flops=flops,
+            bytes_accessed=byts,
+            intensity=round(intensity, 3) if intensity is not None else None,
+            achieved_tflops=round(ach_fl / 1e12, 4) if ach_fl is not None else None,
+            achieved_gbps=round(ach_bw / 1e9, 2) if ach_bw is not None else None,
+            attainment_pct=round(attain, 2) if attain is not None else None,
+            bound=bound,
+            in_step=seg.in_step,
+        )
+
+
+@dataclasses.dataclass
+class StepProfile:
+    step: str                      # "train_step" | "decode_step" | ...
+    device_kind: str
+    platform: str
+    peak_tflops: float
+    peak_hbm_gbps: float
+    measured_step_ms: float        # independently measured whole step
+    attributed_ms: float           # sum of in-step segment times
+    residual_ms: float             # measured - attributed (can be < 0)
+    coverage_pct: float            # attributed / measured
+    segments: list[SegmentProfile]
+    largest_unattributed: str      # residual, or the biggest unknown-bound seg
+    meta: dict = dataclasses.field(default_factory=dict)
+
+    # -- construction --------------------------------------------------------
+
+    @classmethod
+    def build(
+        cls,
+        step: str,
+        segments: list[SegmentTiming],
+        measured_step_ms: float,
+        *,
+        peaks: Optional[ChipPeaks] = None,
+        meta: Optional[dict] = None,
+    ) -> "StepProfile":
+        import jax
+
+        peaks = peaks or chip_peaks()
+        attributed = sum(s.ms for s in segments if s.in_step)
+        residual = measured_step_ms - attributed
+        profs = [
+            SegmentProfile.build(s, measured_step_ms, peaks) for s in segments
+        ]
+        # honesty pointer: the biggest slice of time with no roofline
+        # story — either the unattributed residual or an unknown-bound
+        # segment (cost model came back empty)
+        candidates = {"residual": max(residual, 0.0)}
+        for p in profs:
+            if p.in_step and p.bound == UNKNOWN_BOUND:
+                candidates[p.name] = p.ms
+        largest = max(candidates, key=candidates.get)
+        return cls(
+            step=step,
+            device_kind=peaks.device_kind,
+            platform=jax.devices()[0].platform,
+            peak_tflops=round(peaks.flops / 1e12, 2),
+            peak_hbm_gbps=round(peaks.hbm_bytes_s / 1e9, 2),
+            measured_step_ms=round(measured_step_ms, 4),
+            attributed_ms=round(attributed, 4),
+            residual_ms=round(residual, 4),
+            coverage_pct=round(100.0 * attributed / measured_step_ms, 2)
+            if measured_step_ms > 0 else 0.0,
+            segments=profs,
+            largest_unattributed=largest,
+            meta=dict(meta or {}),
+        )
+
+    # -- rendering -----------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["segments"] = [dataclasses.asdict(s) for s in self.segments]
+        return d
+
+    def to_json(self, indent: int = 2) -> str:
+        return json.dumps(self.to_dict(), indent=indent)
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            f.write(self.to_json() + "\n")
+        return path
+
+    def to_markdown(self) -> str:
+        lines = [
+            f"# {self.step} profile — {self.device_kind} ({self.platform})",
+            "",
+            f"Peaks: {self.peak_tflops} TFLOP/s, {self.peak_hbm_gbps} GB/s "
+            f"(ridge {self.peak_tflops * 1e12 / (self.peak_hbm_gbps * 1e9):.1f} "
+            "FLOPs/byte)",
+            f"Whole step: {self.measured_step_ms:.3f} ms measured; "
+            f"{self.attributed_ms:.3f} ms attributed "
+            f"({self.coverage_pct:.1f}% coverage, "
+            f"residual {self.residual_ms:+.3f} ms)",
+            f"Largest unattributed: {self.largest_unattributed}",
+            "",
+            "| segment | ms | % of step | GFLOPs | MB | FLOPs/B | bound "
+            "| attainment |",
+            "|---|---:|---:|---:|---:|---:|---|---:|",
+        ]
+        for s in self.segments:
+            tag = "" if s.in_step else " (standalone)"
+            lines.append(
+                f"| {s.name}{tag} | {s.ms:.3f} | {s.pct_of_step:.1f} "
+                f"| {s.flops / 1e9:.3f} | {s.bytes_accessed / 1e6:.2f} "
+                f"| {s.intensity if s.intensity is not None else '—'} "
+                f"| {s.bound} "
+                f"| {f'{s.attainment_pct:.1f}%' if s.attainment_pct is not None else '—'} |"
+            )
+        if self.meta:
+            lines.append("")
+            for k, v in self.meta.items():
+                lines.append(f"- {k}: {v}")
+        return "\n".join(lines) + "\n"

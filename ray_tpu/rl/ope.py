@@ -64,10 +64,14 @@ class OffPolicyEstimator:
         behav = [_behavior_return(ep, self.gamma) for ep in episodes]
         v_t = float(np.mean(vals))
         v_b = float(np.mean(behav))
+        # v_gain is only meaningful for positive behavior value: dividing
+        # by a NEGATIVE v_behavior sign-flips the ratio (a better target
+        # policy reads as gain < 1), and by ~0 it explodes — report NaN
+        # and let callers compare v_target - v_behavior instead
         return {
             "v_target": v_t,
             "v_behavior": v_b,
-            "v_gain": v_t / v_b if v_b else float("nan"),
+            "v_gain": v_t / v_b if v_b > 0 else float("nan"),
             "v_std": float(np.std(vals) / max(1, len(vals)) ** 0.5),
         }
 

@@ -28,6 +28,9 @@ class TrainContext:
     group_name: str = "train"
     stop_event: Optional[threading.Event] = None
     dataset_shards: dict = dataclasses.field(default_factory=dict)
+    # set by JaxTrainer(profile=True): user loops check
+    # session.profiling_enabled() to turn on make_train_step(profile=...)
+    profile: bool = False
 
 
 def _set_session(ctx: TrainContext) -> None:
@@ -62,6 +65,13 @@ def get_checkpoint() -> Optional[Checkpoint]:
     return get_context().latest_checkpoint
 
 
+def profiling_enabled() -> bool:
+    """True when the driving JaxTrainer was built with profile=True —
+    the worker-side signal to build its step via
+    make_train_step(..., profile=True) and publish a StepProfile."""
+    return bool(get_context().profile)
+
+
 def get_dataset_shard(name: str = "train"):
     """This worker's split of a Dataset passed to JaxTrainer(datasets=...)
     (reference: ray.train.get_dataset_shard backed by streaming_split).
@@ -77,8 +87,11 @@ def get_dataset_shard(name: str = "train"):
 
 def report(metrics: dict, checkpoint: Optional[Checkpoint] = None) -> None:
     ctx = get_context()
+    import time
+
     ctx.report_queue.put(
-        {"rank": ctx.world_rank, "metrics": dict(metrics), "checkpoint": checkpoint}
+        {"rank": ctx.world_rank, "metrics": dict(metrics),
+         "checkpoint": checkpoint, "ts": time.time()}
     )
     if ctx.stop_event is not None and ctx.stop_event.is_set():
         raise StopIteration("controller requested stop")

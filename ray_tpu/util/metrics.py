@@ -123,11 +123,15 @@ class Histogram(Metric):
         boundaries: Optional[Sequence[float]] = None,
         tag_keys: Optional[Sequence[str]] = None,
     ):
-        super().__init__(name, description, tag_keys)
+        # set BEFORE super().__init__: the base class's same-name sharing
+        # branch replaces these with the registered instance's storage —
+        # assigning after it would clobber the share and this instance
+        # would read/write a private empty histogram
         self.boundaries = sorted(boundaries or DEFAULT_HISTOGRAM_BOUNDARIES)
         self._buckets: dict[tuple, list] = {}
         self._sums: dict[tuple, float] = {}
         self._counts: dict[tuple, int] = {}
+        super().__init__(name, description, tag_keys)
 
     def observe(self, value: float, tags: Optional[dict] = None) -> None:
         k = self._key(tags)
@@ -175,14 +179,16 @@ def prometheus_text() -> str:
                 cum = 0
                 for b, n in zip(m.boundaries, buckets):
                     cum += n
+                    le = f'le="{b}"'
                     lines.append(
                         f"{m.name}_bucket"
-                        f"{_fmt_tags(m.tag_keys, k, f'le=\"{b}\"')} {cum}"
+                        f"{_fmt_tags(m.tag_keys, k, le)} {cum}"
                     )
                 cum += buckets[-1]
+                le_inf = 'le="+Inf"'
                 lines.append(
                     f"{m.name}_bucket"
-                    f"{_fmt_tags(m.tag_keys, k, 'le=\"+Inf\"')} {cum}"
+                    f"{_fmt_tags(m.tag_keys, k, le_inf)} {cum}"
                 )
                 lines.append(f"{m.name}_sum{_fmt_tags(m.tag_keys, k)} {total}")
                 lines.append(f"{m.name}_count{_fmt_tags(m.tag_keys, k)} {count}")

@@ -387,3 +387,56 @@ def test_topk_filter_exact_at_small_vocab():
     keys = jax.vmap(jax.random.fold_in, (None, 0))(jax.random.key(0), jnp.arange(64))
     toks, _ = sample_tokens(logits, temps, ks, ps, keys, mode="full")
     assert set(np.asarray(toks).tolist()) <= {1, 2}
+
+
+def test_sampling_params_validation():
+    """Bad knobs 400 at admission instead of poisoning a decode batch."""
+    with pytest.raises(ValueError):
+        SamplingParams(max_tokens=0)
+    with pytest.raises(ValueError):
+        SamplingParams(temperature=-0.1)
+    with pytest.raises(ValueError):
+        SamplingParams(top_k=-1)
+    with pytest.raises(ValueError):
+        SamplingParams(top_p=-0.1)
+    with pytest.raises(ValueError):
+        SamplingParams(top_p=1.5)
+    SamplingParams(top_p=0.0)  # OpenAI clients send 0: top-1 nucleus
+    assert SamplingParams(top_k=500).needs_full_sort
+    assert not SamplingParams(top_k=256).needs_full_sort
+
+
+def test_topk_beyond_cap_takes_full_sort_path():
+    """top_k > TOP_CAP must not silently clamp: the full-sort mode keeps
+    every token inside the requested k reachable, and the engine derives
+    that mode for batches containing such a request."""
+    from ray_tpu.llm.sampling import TOP_CAP, sample_tokens
+
+    V = TOP_CAP + 64
+    # descending logits with a gentle slope: under the capped path
+    # positions >= TOP_CAP would be unreachable even for top_k = V
+    logits = jnp.tile(-0.01 * jnp.arange(V, dtype=jnp.float32), (128, 1))
+    temps = jnp.full((128,), 5.0)
+    ks = jnp.full((128,), V, jnp.int32)  # "keep everything" via top_k
+    ps = jnp.ones((128,))
+    keys = jax.vmap(jax.random.fold_in, (None, 0))(
+        jax.random.key(3), jnp.arange(128)
+    )
+    toks, _ = sample_tokens(logits, temps, ks, ps, keys, mode="full_sort")
+    toks = np.asarray(toks)
+    assert toks.max() >= TOP_CAP, "tail tokens unreachable: still clamped"
+    # top-k still filters exactly in full_sort mode
+    ks2 = jnp.full((128,), 3, jnp.int32)
+    toks2, _ = sample_tokens(logits, temps, ks2, ps, keys, mode="full_sort")
+    assert set(np.asarray(toks2).tolist()) <= {0, 1, 2}
+
+    # the engine's batch-mode derivation picks the fallback
+    from ray_tpu.llm.engine import LLMEngine
+
+    class _R:
+        def __init__(self, sp):
+            self.sampling_params = sp
+
+    batch = [_R(SamplingParams(top_k=5)), _R(SamplingParams(top_k=TOP_CAP + 1))]
+    assert LLMEngine._sample_mode(batch) == "full_sort"
+    assert LLMEngine._sample_mode([_R(SamplingParams(top_k=5))]) == "full"

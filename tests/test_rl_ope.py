@@ -137,3 +137,39 @@ def test_ope_on_logged_cartpole_episodes():
     out = est.estimate(eps)
     # estimates exist, are finite, and behavior value matches the logs
     assert np.isfinite(out["v_target"]) and out["v_behavior"] > 5
+
+
+def test_v_gain_nan_for_nonpositive_behavior_value():
+    """v_gain = v_target / v_behavior sign-flips when the behavior value
+    is negative (a better policy would read as gain < 1) — it must be
+    NaN for v_behavior <= 0; compare v_target - v_behavior instead."""
+    rng = np.random.default_rng(0)
+    pi = _BanditPolicy(0.9)
+
+    def episodes_with_rewards(r0, r1, n=50):
+        eps = _bandit_episodes(n, 0.5, rng)
+        for ep in eps:
+            ep["rewards"] = np.array([r0 if ep["actions"][0] == 0 else r1])
+        return eps
+
+    # all-negative rewards: v_behavior < 0
+    out = ImportanceSampling(pi, gamma=1.0).estimate(
+        episodes_with_rewards(-1.0, -5.0)
+    )
+    assert out["v_behavior"] < 0
+    assert np.isnan(out["v_gain"])
+    # the target policy IS better (prefers the -1 arm); the difference
+    # still carries the signal the ratio would have inverted
+    assert out["v_target"] > out["v_behavior"]
+
+    # zero behavior value: NaN, not inf
+    out0 = ImportanceSampling(pi, gamma=1.0).estimate(
+        episodes_with_rewards(0.0, 0.0)
+    )
+    assert np.isnan(out0["v_gain"])
+
+    # positive behavior value: ratio still reported
+    outp = ImportanceSampling(pi, gamma=1.0).estimate(
+        episodes_with_rewards(1.0, 0.2)
+    )
+    assert outp["v_behavior"] > 0 and outp["v_gain"] > 0
