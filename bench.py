@@ -320,6 +320,26 @@ def main():
     want = os.environ.get("JAX_PLATFORMS", "")
     force_cpu = bool(want) and "axon" not in want and "tpu" not in want
 
+    # --spec: delegate to the speculative-decoding serving benchmark
+    # (benchmarks/llm_serving_bench.py --spec) in a subprocess — the
+    # parent keeps its no-backend-init discipline, and the child writes
+    # benchmarks/SPEC_decode_r07.json. Extra args pass through
+    # (--spec-out, --spec-k, --profile).
+    if "--spec" in sys.argv[1:]:
+        repo = os.path.dirname(os.path.abspath(__file__))
+        child = os.path.join(repo, "benchmarks", "llm_serving_bench.py")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        rc, out, err = _run_sub(
+            [sys.executable, child] + sys.argv[1:], env, FALLBACK_TIMEOUT_S,
+        )
+        result = _extract_json_line(out)
+        if result is None:
+            fail("spec benchmark produced no JSON line",
+                 error_tail=(err or out).strip()[-800:])
+        print(json.dumps(result))
+        sys.exit(0 if rc == 0 else 1)
+
     # --profile: the timed capture also runs the ray_tpu.profiler
     # roofline attribution and writes benchmarks/PROFILE_trainstep_r06.json
     if "--profile" in sys.argv[1:]:

@@ -6,6 +6,14 @@ bench.py's training MFU. Prints one JSON line. --profile additionally
 runs the engine's roofline-attributed decode profile
 (ray_tpu.profiler) and writes it to benchmarks/PROFILE_decode_r06.json
 — the serving analog of PROFILE_taskplane_r05.md the roadmap lacked.
+
+--spec runs the SPECULATIVE-decoding benchmark instead: a tiny model is
+briefly overfit on repetitive text (so greedy generation actually
+continues patterns — acceptance against a random-weight model would
+measure nothing), then the same prompts are decoded by a baseline
+engine and a prompt-lookup spec engine. Reports tokens/s for both,
+token identity (greedy spec must be lossless), and the acceptance-rate
+stats from engine.stats(); writes benchmarks/SPEC_decode_r07.json.
 """
 
 from __future__ import annotations
@@ -18,6 +26,130 @@ import time
 _PROFILE_OUT = _os.path.join(
     _os.path.dirname(_os.path.abspath(__file__)), "PROFILE_decode_r06.json"
 )
+_SPEC_OUT = _os.path.join(
+    _os.path.dirname(_os.path.abspath(__file__)), "SPEC_decode_r07.json"
+)
+
+
+def run_spec_bench(args) -> dict:
+    """Spec-vs-baseline decode on repetitive prompts. CPU-safe (the
+    tier-1 smoke test runs it under JAX_PLATFORMS=cpu)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine
+    from ray_tpu.llm.sampling import SamplingParams
+    from ray_tpu.llm.spec import SpecConfig
+    from ray_tpu.models import llama
+    from ray_tpu.train.step import TrainState, make_train_step
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    smoke = bool(_os.environ.get("RAY_TPU_SPEC_SMOKE")) or not on_tpu
+    cfg = dataclasses.replace(llama.LLAMA_TINY, dtype=jnp.float32)
+    n_requests = 4 if smoke else 16
+    max_new = 32 if smoke else 128
+    train_steps = int(
+        _os.environ.get("RAY_TPU_SPEC_TRAIN_STEPS", 80 if smoke else 200)
+    )
+    k = args.spec_k
+
+    # teach the model to continue short repeated patterns: acceptance
+    # length then measures real drafter/verifier agreement, not noise
+    rng = np.random.default_rng(0)
+    B, S = 8, 64
+
+    def make_seq():
+        p = rng.integers(3, 120, size=rng.integers(4, 9)).tolist()
+        return (p * (S // len(p) + 2))[: S + 1]
+
+    params = llama.init_params(cfg, jax.random.key(0))
+    opt = optax.adamw(1e-2)
+    state = TrainState.create(params, opt)
+    step = make_train_step(lambda p, b: llama.loss_fn(p, b, cfg), opt)
+    t_train = time.perf_counter()
+    for _ in range(train_steps):
+        toks = np.asarray([make_seq() for _ in range(B)], np.int32)
+        state, m = step(state, {"tokens": jnp.asarray(toks[:, :-1]),
+                                "targets": jnp.asarray(toks[:, 1:])})
+    final_loss = float(m["loss"])
+    t_train = time.perf_counter() - t_train
+
+    prompts = []
+    for _ in range(n_requests):
+        p = rng.integers(3, 120, size=rng.integers(4, 9)).tolist()
+        prompts.append((p * 8)[:32])
+    sp = SamplingParams(max_tokens=max_new, temperature=0.0, ignore_eos=True)
+
+    def engine_cfg(spec=None):
+        return EngineConfig(
+            model=cfg, num_blocks=512, block_size=8,
+            max_num_seqs=min(n_requests, 16), max_prefill_len=64, spec=spec,
+        )
+
+    def timed_generate(engine):
+        # warmup compiles every shape, then a steady-state timed pass
+        engine.generate(prompts[: max(2, n_requests // 2)], sp)
+        t0 = time.perf_counter()
+        outs = engine.generate(prompts, sp)
+        dt = time.perf_counter() - t0
+        return outs, sum(len(o) for o in outs), dt
+
+    base = LLMEngine(engine_cfg(), params=state.params, seed=0)
+    base_out, base_toks, base_dt = timed_generate(base)
+
+    spec_cfg = SpecConfig(num_draft_tokens=k, method="prompt_lookup")
+    eng = LLMEngine(engine_cfg(spec_cfg), params=state.params, seed=0)
+    spec_out, spec_toks, spec_dt = timed_generate(eng)
+
+    stats = eng.stats()["spec"]
+    result = {
+        "metric": "llm_spec_decode_tok_s" if on_tpu else "llm_spec_smoke_tok_s",
+        "value": round(spec_toks / spec_dt, 1),
+        "unit": "tok/s",
+        "vs_baseline": round((spec_toks / spec_dt) / (base_toks / base_dt), 3),
+        "baseline_tok_s": round(base_toks / base_dt, 1),
+        "token_identical": spec_out == base_out,
+        "num_draft_tokens": k,
+        "mean_accepted_len": stats["mean_accepted_len"],
+        "acceptance_rate": stats["acceptance_rate"],
+        "spec_steps": stats["steps"],
+        "drafted_tokens": stats["drafted_tokens"],
+        "accepted_tokens": stats["accepted_tokens"],
+        "n_requests": n_requests,
+        "max_new": max_new,
+        "train_steps": train_steps,
+        "train_s": round(t_train, 2),
+        "final_train_loss": round(final_loss, 3),
+        "model_params": cfg.num_params(),
+        "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+    }
+    if not result["token_identical"]:
+        result["warning"] = "greedy spec output diverged from baseline"
+    if not on_tpu:
+        # at tiny-model CPU scale the decode step is dispatch-dominated,
+        # not HBM-bandwidth-dominated, so the tokens/s ratio is noise;
+        # mean_accepted_len / acceptance_rate are the deterministic
+        # signals a CPU capture carries
+        result["note"] = (
+            "CPU smoke: vs_baseline wall-clock is dispatch-bound noise; "
+            "acceptance stats are the capture's contract"
+        )
+    if args.profile:
+        prof = eng.profile_spec_decode(
+            batch_size=min(n_requests, 8), iters=6,
+        )
+        result["spec_profile_segments_ms"] = {
+            s.name: s.ms for s in prof.segments if s.in_step
+        }
+        result["spec_profile_coverage_pct"] = prof.coverage_pct
+    with open(args.spec_out, "w") as f:
+        f.write(json.dumps(result, indent=2) + "\n")
+    result["spec_out"] = args.spec_out
+    return result
 
 
 def main():
@@ -30,6 +162,12 @@ def main():
                     help="also write the roofline-attributed decode "
                     "StepProfile (ray_tpu.profiler)")
     ap.add_argument("--profile-out", default=_PROFILE_OUT)
+    ap.add_argument("--spec", action="store_true",
+                    help="run the speculative-decoding benchmark "
+                    "(spec vs baseline on repetitive prompts) instead")
+    ap.add_argument("--spec-out", default=_SPEC_OUT)
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="drafted tokens per verify pass")
     args = ap.parse_args()
 
     want = os.environ.get("JAX_PLATFORMS", "")
@@ -37,6 +175,10 @@ def main():
         # the axon plugin registers via sitecustomize regardless of the
         # env var; only the config pin actually keeps this off the TPU
         jax.config.update("jax_platforms", want)
+
+    if args.spec:
+        print(json.dumps(run_spec_bench(args)))
+        return
 
     from ray_tpu.llm.engine import EngineConfig, LLMEngine, SamplingParams
     from ray_tpu.models import llama

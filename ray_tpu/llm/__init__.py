@@ -12,6 +12,7 @@ from ray_tpu.llm.engine import EngineConfig, LLMEngine, Request, RequestOutput
 from ray_tpu.llm.kv_cache import BlockAllocator, KVCacheConfig
 from ray_tpu.llm.openai_api import ByteTokenizer, LLMConfig, LLMServer, build_openai_app
 from ray_tpu.llm.sampling import SamplingParams
+from ray_tpu.llm.spec import SpecConfig
 
 __all__ = [
     "BlockAllocator",
@@ -25,6 +26,7 @@ __all__ = [
     "Request",
     "RequestOutput",
     "SamplingParams",
+    "SpecConfig",
     "build_openai_app",
     "build_processor",
 ]

@@ -75,6 +75,13 @@ class EngineConfig:
     # llm_decode_chunk_ms histogram + timeline (ray_tpu.profiler
     # surfaces); profile_decode() gives the full roofline breakdown
     profile: bool = False
+    # speculative decoding (llm/spec/): a SpecConfig turns each decode
+    # round into draft -> one batched verify pass (k+1 tokens per row
+    # through the paged prefill path) -> distribution-preserving
+    # accept/resample. Rows whose drafter proposes nothing degenerate to
+    # a plain decode step inside the same program; if NO row has a
+    # draft, the round falls back to the classic decode/chunk path.
+    spec: Any = None
 
     def __post_init__(self):
         if isinstance(self.model, str):
@@ -96,6 +103,15 @@ class EngineConfig:
         # a prefill bucket longer than the context window can never be
         # used; clamping keeps bucket compilation bounded by the model
         self.max_prefill_len = min(self.max_prefill_len, self.model.max_seq)
+        if self.spec is not None:
+            from ray_tpu.llm.spec import SpecConfig
+
+            if isinstance(self.spec, dict):
+                self.spec = SpecConfig(**self.spec)
+            if not isinstance(self.spec, SpecConfig):
+                raise ValueError(
+                    f"EngineConfig.spec must be a SpecConfig, got {type(self.spec)}"
+                )
 
     def prefill_buckets(self) -> list[int]:
         out, b = [], 16
@@ -245,6 +261,16 @@ class LLMEngine:
         )
         self._decode_chunks: dict[tuple, Any] = {}  # (n_steps, mode) -> jitted
 
+        # speculative decoding: drafter + verify program cache + stats
+        self.drafter = None
+        self.spec_stats = None
+        self._verify_fns: dict[int, Any] = {}  # suffix width K+1 -> jitted
+        if c.spec is not None:
+            from ray_tpu.llm.spec.stats import SpecStats
+
+            self.drafter = c.spec.build_drafter(c.model)
+            self.spec_stats = SpecStats()
+
     def _decode_chunk_fn(self, n_steps: int, sample_mode: str = "full"):
         c = self.config
         fn = self._decode_chunks.get((n_steps, sample_mode))
@@ -266,21 +292,47 @@ class LLMEngine:
             self._decode_chunks[(n_steps, sample_mode)] = fn
         return fn
 
+    def _verify_fn(self, width: int):
+        """Jitted spec verifier for a [B_pad, width] suffix (width = k+1,
+        a compile-time bucket like decode_buckets)."""
+        c = self.config
+        fn = self._verify_fns.get(width)
+        if fn is None:
+            from ray_tpu.models.llama_decode import verify_tokens
+
+            fn = jax.jit(
+                lambda params, t, p, sm, bt, cl, cache, lora: verify_tokens(
+                    params, t, p, sm, bt, cl, cache, c.model,
+                    block_size=c.block_size, lora=lora,
+                ),
+                donate_argnums=(6,),
+            )
+            self._verify_fns[width] = fn
+        return fn
+
     @staticmethod
     def _sample_mode(batch) -> str:
         """STATIC sampler fast path for this batch (llm.sampling): the
         full top-k/top-p machinery costs a per-step lax.top_k; greedy
         and plain-temperature batches skip it entirely. A request with
         top_k > TOP_CAP forces the exact full-vocab sort — the capped
-        path would silently clamp it (ADVICE r05)."""
-        if all(r.sampling_params.greedy for r in batch):
+        path would silently clamp it (ADVICE r05).
+
+        Per-row greedy short-circuit: top-k/top-p cannot change an
+        argmax (the most-likely token always survives both filters), so
+        a greedy request's knobs are IGNORED when deriving the mode —
+        clients routinely send temperature=0 together with top_k/top_p,
+        and before this, one such request dragged the whole batch onto a
+        sort path nobody sampled from."""
+        sampled = [r for r in batch if not r.sampling_params.greedy]
+        if not sampled:
             return "greedy"
         if all(
             r.sampling_params.top_k <= 0 and r.sampling_params.top_p >= 1.0
-            for r in batch
+            for r in sampled
         ):
             return "categorical"
-        if any(r.sampling_params.needs_full_sort for r in batch):
+        if any(r.sampling_params.needs_full_sort for r in sampled):
             return "full_sort"
         return "full"
 
@@ -412,6 +464,8 @@ class LLMEngine:
         req.status = RequestStatus.ABORTED
         req.finish_reason = "abort"
         self.requests.pop(request_id, None)
+        if self.drafter is not None:
+            self.drafter.release(request_id)
 
     def has_unfinished(self) -> bool:
         return bool(self.waiting or self.running)
@@ -458,12 +512,15 @@ class LLMEngine:
         return [finals[r] for r in rids]
 
     def stats(self) -> dict:
-        return {
+        out = {
             "num_waiting": len(self.waiting),
             "num_running": len(self.running),
             "free_blocks": self.allocator.num_free,
             "total_blocks": self.config.num_blocks,
         }
+        if self.spec_stats is not None:
+            out["spec"] = self.spec_stats.to_dict()
+        return out
 
     def profile_decode(
         self,
@@ -497,6 +554,36 @@ class LLMEngine:
             export_observability=export_observability,
             meta={"engine_num_blocks": c.num_blocks,
                   "engine_decode_chunk": c.decode_chunk},
+        )
+
+    def profile_spec_decode(
+        self,
+        *,
+        batch_size: Optional[int] = None,
+        context_len: Optional[int] = None,
+        iters: int = 6,
+        warmup: int = 2,
+        export_observability: bool = True,
+    ):
+        """Roofline-attributed StepProfile of one SPECULATIVE round of
+        this engine (draft -> verify -> accept -> kv_rollback rungs),
+        over a scratch paged cache + allocator — live state untouched.
+        Requires EngineConfig.spec."""
+        if self.config.spec is None:
+            raise ValueError("EngineConfig.spec is None: spec decoding disabled")
+        from ray_tpu.profiler import profile_spec_decode_step
+
+        c = self.config
+        B = batch_size or min(4, c.max_num_seqs)
+        ctx = context_len or min(
+            32, c.model.max_seq - c.spec.num_draft_tokens - 2
+        )
+        return profile_spec_decode_step(
+            c.model, self.params, c.spec,
+            batch_size=B, context_len=ctx, block_size=c.block_size,
+            iters=iters, warmup=warmup,
+            export_observability=export_observability,
+            meta={"engine_num_blocks": c.num_blocks},
         )
 
     # -- scheduling internals -------------------------------------------------
@@ -596,6 +683,10 @@ class LLMEngine:
         victim.num_preemptions += 1
         self.num_preemptions += 1
         self.waiting.appendleft(victim)
+        if self.drafter is not None:
+            # re-admission recomputes from scratch; stale draft-cache
+            # state would desync from the recomputed sequence
+            self.drafter.release(victim.request_id)
         logger.info("preempted %s (recompute)", victim.request_id)
         return True
 
@@ -633,6 +724,172 @@ class LLMEngine:
         return max(1, r.sampling_params.max_tokens - len(r.output_token_ids))
 
     def _decode_step(self) -> list[RequestOutput]:
+        if self.config.spec is not None:
+            return self._spec_decode_step()
+        return self._plain_decode_step()
+
+    def _spec_decode_step(self) -> list[RequestOutput]:
+        """One speculative round: draft -> one batched verify pass ->
+        distribution-preserving accept -> KV rollback.
+
+        Per-row fallback is IN-BATCH: a row whose drafter proposed
+        nothing feeds only its current token (draft_len 0), its verify
+        logits at column 0 are exactly a decode step's, and acceptance
+        emits 1 token sampled from them. Only when no row at all has a
+        draft does the round fall back to the plain decode/chunk path —
+        paying the (k+1)-wide program for zero drafts would be pure
+        overhead."""
+        c = self.config
+        t0 = time.perf_counter() if c.profile else None
+        k = c.spec.num_draft_tokens
+        batch = list(self.running)
+
+        # draft first (host-side): capacity needs depend on draft lengths
+        draft_by_rid: dict[str, list] = {}
+        for r in batch:
+            # positions fed this round reach num_tokens-1+L and the pass
+            # emits up to L+1 tokens: cap L by the max_tokens budget and
+            # the hard max_seq wall (RoPE table)
+            cap = min(k, self._remaining(r) - 1,
+                      c.model.max_seq - r.num_tokens)
+            d = (
+                self.drafter.propose(
+                    r.request_id, r.prompt_token_ids + r.output_token_ids, cap
+                )
+                if cap > 0 else []
+            )
+            draft_by_rid[r.request_id] = list(d)
+        if not any(draft_by_rid.values()):
+            return self._plain_decode_step()
+
+        # reserve KV for the drafted positions (verify scatters K/V at
+        # num_tokens-1 .. num_tokens-1+L); preempt on real pressure only
+        while True:
+            try:
+                for r in self.running:
+                    r.seq.ensure_capacity(
+                        r.num_tokens + len(draft_by_rid[r.request_id])
+                    )
+                break
+            except NoFreeBlocksError:
+                if not self._preempt_one():
+                    raise
+
+        batch = list(self.running)
+        drafts = [draft_by_rid[r.request_id] for r in batch]
+        B = len(batch)
+        B_pad = self._pad_to_bucket(B, c.decode_buckets())
+        K1 = k + 1
+        num_slots = c.num_blocks * c.block_size
+
+        tokens = np.zeros((B_pad, K1), np.int32)
+        positions = np.zeros((B_pad, K1), np.int32)
+        slots = np.full((B_pad, K1), num_slots, np.int32)  # trash by default
+        context_lens = np.zeros(B_pad, np.int32)
+        draft_tokens = np.zeros((B_pad, k), np.int32)
+        draft_lens = np.zeros(B_pad, np.int32)
+        lora_ids = np.zeros(B_pad, np.int32)
+        bt = np.zeros(
+            (B_pad, self._bt_width([len(r.seq.blocks) for r in batch])),
+            np.int32,
+        )
+        for i, r in enumerate(batch):
+            d = drafts[i]
+            last_tok = (
+                r.output_token_ids[-1] if r.output_token_ids
+                else r.prompt_token_ids[-1]
+            )
+            pos0 = r.num_tokens - 1  # position of the token being fed
+            row = [last_tok] + d
+            tokens[i, : len(row)] = row
+            positions[i, : len(row)] = np.arange(pos0, pos0 + len(row))
+            for j in range(len(row)):
+                slots[i, j] = r.seq.slot(pos0 + j)
+            context_lens[i] = r.num_tokens + len(d)
+            draft_tokens[i, : len(d)] = d
+            draft_lens[i] = len(d)
+            lora_ids[i] = r.lora_slot
+            bt[i, : len(r.seq.blocks)] = r.seq.blocks
+
+        logits, self.cache = self._verify_fn(K1)(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray(slots),
+            jnp.asarray(bt),
+            jnp.asarray(context_lens),
+            self.cache,
+            self._lora_arg(lora_ids),
+        )
+
+        from ray_tpu.llm.spec.accept import accept_draft
+
+        # acceptance fast paths follow the batch's sampler mode: greedy ->
+        # pure argmax comparisons; categorical -> tempered softmax, no
+        # full-vocab sort; anything with top-k/top-p -> exact filtering
+        batch_mode = self._sample_mode(batch)
+        mode = batch_mode if batch_mode in ("greedy", "categorical") else "sample"
+        temps = np.array(
+            [r.sampling_params.temperature for r in batch] + [1.0] * (B_pad - B),
+            np.float32,
+        )
+        top_ks = np.array(
+            [r.sampling_params.top_k for r in batch] + [0] * (B_pad - B), np.int32
+        )
+        top_ps = np.array(
+            [r.sampling_params.top_p for r in batch] + [1.0] * (B_pad - B),
+            np.float32,
+        )
+        keys = [
+            jax.random.fold_in(r._key, len(r.output_token_ids)) for r in batch
+        ] + [jax.random.key(0)] * (B_pad - B)
+        out_toks, out_lps, accepted = accept_draft(
+            logits,
+            jnp.asarray(draft_tokens),
+            jnp.asarray(draft_lens),
+            jnp.asarray(temps),
+            jnp.asarray(top_ks),
+            jnp.asarray(top_ps),
+            jnp.stack(keys),
+            mode=mode,
+        )
+        out_toks = np.asarray(out_toks)   # host sync
+        out_lps = np.asarray(out_lps)
+        accepted = np.asarray(accepted)
+
+        # keep accepted+1 tokens per row, run the usual stop ladder
+        counts = (accepted[:B] + 1).tolist()
+        outputs = self._append_chunk(
+            batch, out_toks[:B].T, out_lps[:B].T, row_counts=counts
+        )
+
+        # KV rollback: blocks reserved for rejected draft positions are
+        # returned; the stale K/V device-side is masked by context_lens
+        # and rewritten when a real token reaches that position
+        for r in batch:
+            if r.status == RequestStatus.RUNNING and r.seq is not None:
+                r.seq.truncate_to(r.num_tokens)
+
+        # stats + observability
+        st = self.spec_stats
+        n_drafted = int(draft_lens[:B].sum())
+        n_accepted = int(accepted[:B].sum())
+        n_emitted = sum(len(o.new_token_ids) for o in outputs)
+        st.steps += 1
+        st.rows += B
+        st.drafted += n_drafted
+        st.accepted += n_accepted
+        st.emitted += n_emitted
+        from ray_tpu.llm.spec.stats import export_spec_stats, record_spec_chunk
+
+        export_spec_stats(st, n_drafted, n_accepted, n_emitted)
+        if t0 is not None:
+            record_spec_chunk(
+                1e3 * (time.perf_counter() - t0), k, n_accepted, B
+            )
+        return outputs
+
+    def _plain_decode_step(self) -> list[RequestOutput]:
         c = self.config
         t0 = time.perf_counter() if c.profile else None
         n_steps = self._chunk_steps()
@@ -769,11 +1026,14 @@ class LLMEngine:
         )
         return np.asarray(toks), np.asarray(logprobs)
 
-    def _append_chunk(self, batch: list, toks, logprobs) -> list[RequestOutput]:
+    def _append_chunk(self, batch: list, toks, logprobs,
+                      row_counts: Optional[list] = None) -> list[RequestOutput]:
         """Host bookkeeping after a device-side chunk: walk each request's
         token column in order, keep until a stop condition fires, discard
         the overshoot (its KV sits in the request's own unsealed blocks,
-        released with the sequence). One RequestOutput per request."""
+        released with the sequence). One RequestOutput per request.
+        ``row_counts`` caps the walk per row (speculative decoding: row i
+        emitted accepted_i + 1 tokens, the rest of its column is pad)."""
         c = self.config
         outputs = []
         n = toks.shape[0]
@@ -781,7 +1041,7 @@ class LLMEngine:
             sp = r.sampling_params
             new_toks: list[int] = []
             finished = False
-            for s in range(n):
+            for s in range(n if row_counts is None else min(n, row_counts[i])):
                 t = int(toks[s, i])
                 lp = float(logprobs[s, i])
                 new_toks.append(t)
@@ -808,6 +1068,8 @@ class LLMEngine:
                     r.seq.seal_full_blocks(written)
                 r.seq.release()
                 self.requests.pop(r.request_id, None)
+                if self.drafter is not None:
+                    self.drafter.release(r.request_id)
             else:
                 if c.enable_prefix_caching:
                     # seals only blocks fully covered by `written`; a

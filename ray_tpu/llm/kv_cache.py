@@ -216,6 +216,33 @@ class SequenceBlocks:
         self.chain = h
         self.num_sealed_tokens = n_full * bs
 
+    def truncate_to(self, num_tokens: int) -> int:
+        """Roll the sequence back to ``num_tokens`` (speculative-decoding
+        KV rollback: rejected draft positions sit in blocks past the
+        accepted length). Whole blocks beyond the new length are freed;
+        a freed block that carries a content hash stays resurrectable in
+        the allocator's zero-ref pool, so the prefix cache is never
+        corrupted — only over-reserved capacity is returned.
+
+        Draft positions are never sealed (the engine seals accepted
+        tokens only), so rolling back INTO the sealed prefix is a logic
+        error: those blocks may be shared via the prefix cache and the
+        chain hash cannot be recomputed without the token history.
+        Returns the number of blocks freed."""
+        if num_tokens < self.num_sealed_tokens:
+            raise ValueError(
+                f"cannot truncate to {num_tokens} tokens: {self.num_sealed_tokens} "
+                "tokens are sealed into the prefix cache (rollback must stay "
+                "past the accepted/sealed prefix)"
+            )
+        keep = self.allocator.blocks_needed(num_tokens) if num_tokens > 0 else 0
+        dropped = self.blocks[keep:]
+        if dropped:
+            self.allocator.free(dropped)
+            del self.blocks[keep:]
+        self.num_tokens = num_tokens
+        return len(dropped)
+
     def adopt_prefix(self, blocks: list[int], chain: int, num_tokens: int) -> None:
         """Start from a prefix-cache hit (refs already taken by match_prefix)."""
         self.blocks = list(blocks)

@@ -240,11 +240,21 @@ class LLMServer:
         path, method = request.path, request.method
         if path.rstrip("/") == "/v1/models" and method == "GET":
             return self.models()
+        if path.rstrip("/") == "/v1/stats" and method == "GET":
+            return self.stats()
         if path.rstrip("/") == "/v1/completions" and method == "POST":
             return await self.completions(request.json())
         if path.rstrip("/") == "/v1/chat/completions" and method == "POST":
             return await self.chat_completions(request.json())
         return {"error": {"message": f"no route {method} {path}", "code": 404}}
+
+    def stats(self) -> dict:
+        """Engine scheduling/KV state + (when speculative decoding is on)
+        acceptance-rate stats — the serving-side view of
+        LLMEngine.stats(), so operators can read draft quality without
+        scraping Prometheus."""
+        with self.runner.lock:
+            return {"model_id": self.config.model_id, **self.engine.stats()}
 
     def models(self) -> dict:
         return {

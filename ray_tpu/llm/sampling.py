@@ -109,7 +109,13 @@ def sample_tokens(
     else:
         V = logits.shape[-1]
         cap = V if mode == "full_sort" else min(TOP_CAP, V)
-        top_vals, top_idx = jax.lax.top_k(scaled, cap)  # [B, cap] descending
+        if cap == V:
+            # full sort: argsort, NOT lax.top_k(V) — top_k's partial
+            # selection is O(V*cap), quadratic when cap reaches V
+            top_idx = jnp.flip(jnp.argsort(scaled, axis=-1), axis=-1)
+            top_vals = jnp.take_along_axis(scaled, top_idx, axis=-1)
+        else:
+            top_vals, top_idx = jax.lax.top_k(scaled, cap)  # [B, cap] descending
         pos = jnp.arange(cap)[None, :]
         # top-k: keep positions < k (k = 0/off or > cap keeps all)
         k = jnp.where((top_ks <= 0) | (top_ks > cap), cap, top_ks)[:, None]
@@ -125,9 +131,11 @@ def sample_tokens(
         filtered = jnp.take_along_axis(top_idx, choice[:, None], axis=-1)[:, 0]
         # rows with no filtering active sample over the FULL vocab with
         # the same draw the "categorical" mode makes — a seeded request's
-        # stream must not depend on whether a batch-mate uses top-k/p
+        # stream must not depend on whether a batch-mate uses top-k/p.
+        # Greedy rows short-circuit per row: their token is argmax no
+        # matter the knobs, so they never take the filtered branch
         plain = jax.vmap(jax.random.categorical)(keys, scaled)
-        needs = (top_ks > 0) | (top_ps < 1.0)
+        needs = ((top_ks > 0) | (top_ps < 1.0)) & (temperatures > 0.0)
         sampled = jnp.where(needs, filtered, plain)
         tok = jnp.where(temperatures <= 0.0, greedy_tok, sampled.astype(jnp.int32))
 
@@ -135,3 +143,41 @@ def sample_tokens(
         jax.nn.log_softmax(logits, axis=-1), tok[:, None], axis=-1
     )[:, 0]
     return tok, logprob
+
+
+def target_probs(
+    logits: jax.Array,        # [B, V] fp32
+    temperatures: jax.Array,  # [B] (<= 0 treated as 1.0; greedy is the
+                              # caller's short-circuit, not a distribution)
+    top_ks: jax.Array,        # [B] int32 (0 = off)
+    top_ps: jax.Array,        # [B] (1.0 = off)
+) -> jax.Array:
+    """The normalized full-vocab distribution `sample_tokens` draws from,
+    with temperature + top-k + top-p applied EXACTLY (descending sort
+    over the whole vocab, no TOP_CAP approximation).
+
+    This is the speculative-decoding acceptance sampler's view of the
+    target: acceptance runs once per K drafted tokens instead of once
+    per decode step, so the full-vocab sort it pays is already amortized
+    ~K-fold vs the per-step sampler (which is why the per-step path gets
+    the capped approximation and this one gets the exact filter).
+    Filtering mirrors sample_tokens: top-k keeps the k most likely, then
+    top-p keeps the smallest prefix of the surviving (sorted) probs with
+    mass >= p, first token always kept."""
+    V = logits.shape[-1]
+    t = jnp.where(temperatures <= 0.0, 1.0, temperatures)[:, None]
+    scaled = logits / t
+    # full descending sort: argsort, NOT lax.top_k(V) — top_k's partial
+    # selection is O(V*k), quadratic at k=V (measured ~50x slower here)
+    idx = jnp.flip(jnp.argsort(scaled, axis=-1), axis=-1)  # [B, V] descending
+    vals = jnp.take_along_axis(scaled, idx, axis=-1)
+    pos = jnp.arange(V)[None, :]
+    k = jnp.where((top_ks <= 0) | (top_ks > V), V, top_ks)[:, None]
+    vals = jnp.where(pos < k, vals, -jnp.inf)
+    probs = jax.nn.softmax(vals, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = ((cum - probs) < top_ps[:, None]) | (pos == 0)
+    p_sorted = jax.nn.softmax(jnp.where(keep, vals, -jnp.inf), axis=-1)
+    # scatter back to vocab order
+    B = logits.shape[0]
+    return jnp.zeros_like(scaled).at[jnp.arange(B)[:, None], idx].set(p_sorted)

@@ -1,0 +1,133 @@
+"""Distribution-preserving acceptance/rejection for drafted tokens.
+
+Standard speculative-sampling rule (Leviathan et al. / Chen et al.)
+specialized to DETERMINISTIC drafters (both of ours propose point
+distributions): accept drafted token x_j with probability
+p_j(x_j) — the target probability of the drafted token — and on the
+first rejection resample from the residual max(p_j - onehot(x_j), 0)
+renormalized. If every draft survives, a BONUS token is sampled from
+p_k (the logits position after the last drafted token), so a verify
+pass always emits accepted + 1 tokens: the k=0 row degenerates to a
+plain decode step. The marginal distribution of every emitted token is
+exactly the target sampling distribution.
+
+Target distributions come from sampling.target_probs — temperature +
+top-k/top-p applied EXACTLY over the full vocab (no TOP_CAP clamp: the
+sort is paid once per k tokens, so exactness is affordable here).
+
+Greedy short-circuit (`mode="greedy"`): accept iff the target argmax
+equals the drafted token; the rejection resample and the bonus token
+are both the position's argmax, so spec output is token-identical to
+plain greedy decode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.llm.sampling import target_probs
+
+
+@functools.partial(jax.jit, static_argnames=("mode",))
+def accept_draft(
+    logits: jax.Array,        # [B, K+1, V] fp32 target logits; position j
+                              # conditions on fed tokens 0..j
+    draft_tokens: jax.Array,  # [B, K] int32 (pad arbitrary past draft_lens)
+    draft_lens: jax.Array,    # [B] int32, 0..K
+    temperatures: jax.Array,  # [B]
+    top_ks: jax.Array,        # [B]
+    top_ps: jax.Array,        # [B]
+    keys: jax.Array,          # [B] PRNG keys (unused in greedy mode)
+    mode: str = "sample",     # static: "greedy" | "categorical" | "sample"
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Returns (out_tokens [B, K+1], out_logprobs [B, K+1], accepted [B]).
+
+    Row semantics: columns 0..accepted-1 are the accepted drafted tokens,
+    column `accepted` is the bonus/resample token — the caller keeps
+    accepted + 1 tokens per row and ignores the rest. Logprobs are
+    log-softmax of the raw logits at the emitted token (the same
+    convention sample_tokens uses).
+    """
+    B, K1, V = logits.shape
+    K = K1 - 1
+    assert K >= 1, "spec verify needs at least one drafted column"
+    jpos = jnp.arange(K)[None, :]
+    cols = jnp.arange(K1)[None, :]
+    logp_all = jax.nn.log_softmax(logits, axis=-1)  # [B, K+1, V]
+
+    if mode == "greedy":
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+        ok = (greedy[:, :K] == draft_tokens) & (jpos < draft_lens[:, None])
+        accepted = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)
+        # accepted cols equal the draft (== argmax there); the col at
+        # `accepted` is the bonus (all accepted) or the corrected argmax
+        # (rejection residual's argmax == argmax, since the rejected
+        # draft token was by definition not the argmax)
+        out = greedy
+        lp = jnp.take_along_axis(logp_all, out[..., None], axis=-1)[..., 0]
+        return out, lp, accepted
+
+    # per-position target distributions with per-row knobs [B, K+1, V].
+    # STATIC fast path mirroring the engine's _sample_mode: a batch with
+    # no top-k/top-p active among its sampled rows ("categorical") needs
+    # no full-vocab sort — plain tempered softmax is the exact target
+    if mode == "categorical":
+        t = jnp.where(temperatures <= 0.0, 1.0, temperatures)[:, None, None]
+        p = jax.nn.softmax(logits / t, axis=-1)
+    else:
+        p = jax.vmap(
+            lambda lg: target_probs(lg, temperatures, top_ks, top_ps),
+            in_axes=1, out_axes=1,
+        )(logits)
+
+    p_draft = jnp.take_along_axis(
+        p[:, :K], draft_tokens[..., None], axis=-1
+    )[..., 0]  # [B, K]
+    ukeys = jax.vmap(jax.random.fold_in)(keys, jnp.zeros((B,), jnp.int32))
+    u = jax.vmap(lambda k_: jax.random.uniform(k_, (K,)))(ukeys)
+    # per-row greedy short-circuit (mirrors sample_tokens): a greedy row
+    # in a mixed batch accepts iff the draft IS the argmax, and emits
+    # argmax at the rejection/bonus position — its temperature was
+    # remapped to 1.0 above only to keep the math NaN-free, so without
+    # this mask it would silently receive temp-1.0 samples
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+    is_greedy = temperatures <= 0.0  # [B]
+    ok = jnp.where(
+        is_greedy[:, None], greedy_tok[:, :K] == draft_tokens, u < p_draft
+    ) & (jpos < draft_lens[:, None])
+    accepted = jnp.cumprod(ok.astype(jnp.int32), axis=1).sum(axis=1)  # [B]
+
+    # distribution at the emit position: bonus (ran out of drafts —
+    # sample the target directly) or residual (first rejection)
+    p_a = jnp.take_along_axis(p, accepted[:, None, None], axis=1)[:, 0]  # [B, V]
+    d_a = jnp.take_along_axis(
+        draft_tokens, jnp.clip(accepted, 0, K - 1)[:, None], axis=1
+    )[:, 0]
+    resid = jnp.maximum(p_a - jax.nn.one_hot(d_a, V, dtype=p_a.dtype), 0.0)
+    rs = resid.sum(axis=-1, keepdims=True)
+    # an all-zero residual means p_a was entirely on the drafted token,
+    # which is then accepted with probability 1 — unreachable, but the
+    # fallback keeps the kernel NaN-free
+    resid = jnp.where(rs > 0.0, resid / jnp.maximum(rs, 1e-20), p_a)
+    rejected = accepted < draft_lens
+    final_dist = jnp.where(rejected[:, None], resid, p_a)
+    bkeys = jax.vmap(jax.random.fold_in)(keys, jnp.ones((B,), jnp.int32))
+    final_tok = jax.vmap(jax.random.categorical)(
+        bkeys, jnp.log(jnp.maximum(final_dist, 1e-38))
+    ).astype(jnp.int32)
+    # greedy rows: bonus = argmax; rejection resample = argmax too (the
+    # rejected draft was by definition not the argmax)
+    final_tok = jnp.where(
+        is_greedy,
+        jnp.take_along_axis(greedy_tok, accepted[:, None], axis=1)[:, 0],
+        final_tok,
+    )
+
+    draft_pad = jnp.pad(draft_tokens, ((0, 0), (0, 1)))  # [B, K+1]
+    out = jnp.where(cols < accepted[:, None], draft_pad, 0)
+    out = jnp.where(cols == accepted[:, None], final_tok[:, None], out)
+    lp = jnp.take_along_axis(logp_all, out[..., None], axis=-1)[..., 0]
+    return out.astype(jnp.int32), lp, accepted

@@ -1,0 +1,87 @@
+"""SpecConfig: the EngineConfig.spec knob block.
+
+Reference shape: vLLM's SpeculativeConfig (method="ngram" vs a draft
+model id). Validation happens at engine construction, not inside the
+decode hot path — a bad knob must fail loudly at startup.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from ray_tpu.llm.kv_cache import KVCacheConfig
+
+
+@dataclasses.dataclass
+class SpecConfig:
+    # k: drafted tokens per verification pass. COMPILE-TIME bucket: the
+    # verifier always runs a [B_pad, k+1]-shaped program (rows with
+    # shorter/empty drafts pad with trash-slot columns), so one value of
+    # k means one compiled verify program per decode-batch bucket.
+    num_draft_tokens: int = 4
+    method: str = "prompt_lookup"  # "prompt_lookup" | "draft_model"
+
+    # prompt-lookup drafting: longest suffix n-gram of the request's
+    # (prompt + generated) history that occurred earlier; propose the
+    # tokens that followed. Model-free — wins on repetitive/extractive
+    # workloads (code edits, RAG quoting, summarization).
+    max_ngram: int = 3
+    min_ngram: int = 1
+    max_history: int = 4096  # lookup window (host-side cost cap)
+
+    # draft-model drafting: a smaller llama run through the same
+    # models/llama_decode paths with its OWN paged KV cache (draft_kv
+    # sizes it; head/layer dims always follow the draft model config).
+    draft_model: Any = None          # LlamaConfig or registry name
+    draft_params: Any = None         # weights pytree; random-init if None
+    draft_kv: Optional[KVCacheConfig] = None
+    draft_seed: int = 0
+
+    def __post_init__(self):
+        if self.num_draft_tokens < 1:
+            raise ValueError(
+                f"num_draft_tokens must be >= 1, got {self.num_draft_tokens}"
+            )
+        if self.method not in ("prompt_lookup", "draft_model"):
+            raise ValueError(
+                f"spec method must be 'prompt_lookup' or 'draft_model', "
+                f"got {self.method!r}"
+            )
+        if not (1 <= self.min_ngram <= self.max_ngram):
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"{self.min_ngram}/{self.max_ngram}"
+            )
+        if isinstance(self.draft_model, str):
+            from ray_tpu.models.registry import get_model_config
+
+            self.draft_model = get_model_config(self.draft_model)
+        if self.method == "draft_model" and self.draft_model is None:
+            raise ValueError("method='draft_model' requires draft_model")
+
+    def build_drafter(self, target_config) -> "Any":
+        """Construct the drafter for an engine serving `target_config`."""
+        from ray_tpu.llm.spec.drafter import (
+            DraftModelDrafter,
+            PromptLookupDrafter,
+        )
+
+        if self.method == "prompt_lookup":
+            return PromptLookupDrafter(
+                max_ngram=self.max_ngram,
+                min_ngram=self.min_ngram,
+                max_history=self.max_history,
+            )
+        if self.draft_model.vocab_size != target_config.vocab_size:
+            # drafted ids are fed straight to the target verifier
+            raise ValueError(
+                f"draft model vocab {self.draft_model.vocab_size} != target "
+                f"vocab {target_config.vocab_size}"
+            )
+        return DraftModelDrafter(
+            self.draft_model,
+            params=self.draft_params,
+            kv=self.draft_kv,
+            seed=self.draft_seed,
+        )

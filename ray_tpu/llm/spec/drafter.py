@@ -1,0 +1,249 @@
+"""Draft-token proposal sources for speculative decoding.
+
+Both drafters are DETERMINISTIC (a proposal is a point distribution),
+which keeps the acceptance math simple: accept token x with probability
+p_target(x), resample-on-reject from the residual (accept.py). That is
+the same modeling choice vLLM makes for its ngram proposer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ray_tpu.llm.kv_cache import (
+    BlockAllocator,
+    KVCacheConfig,
+    NoFreeBlocksError,
+    SequenceBlocks,
+)
+
+
+class Drafter:
+    """Interface: propose up to k continuation tokens for a request.
+
+    ``tokens`` is the request's full visible history (prompt + generated)
+    — every token the next real decode step would condition on.
+    ``release`` drops any per-request state (finish/abort/preempt)."""
+
+    def propose(self, request_id: str, tokens: list, k: int) -> list:
+        raise NotImplementedError
+
+    def release(self, request_id: str) -> None:  # stateless by default
+        return None
+
+
+class PromptLookupDrafter(Drafter):
+    """Model-free prompt-lookup (n-gram) drafting.
+
+    Find the longest suffix n-gram (max_ngram down to min_ngram) of the
+    history that occurred earlier, and propose the k tokens that
+    followed its MOST RECENT earlier occurrence. Zero device work: wins
+    whenever generation quotes its own context (retrieval answers, code
+    edits, repetitive structure) and costs only a bounded host scan when
+    it misses — exactly the regime where a draft model's extra HBM
+    traffic is hardest to justify.
+    """
+
+    def __init__(self, max_ngram: int = 3, min_ngram: int = 1,
+                 max_history: int = 4096):
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+        self.max_history = max_history
+
+    def propose(self, request_id: str, tokens: list, k: int) -> list:
+        toks = tokens[-self.max_history:]
+        n_tok = len(toks)
+        if n_tok < 2:
+            return []
+        # vectorized scan: this runs per row per decode round on the
+        # decode critical path, so the window match is numpy over an int
+        # array, not a Python list-slice loop (miss cost at the default
+        # 4096-token window was milliseconds per round, serialized
+        # before the verify dispatch)
+        arr = np.asarray(toks, dtype=np.int64)
+        for n in range(min(self.max_ngram, n_tok - 1), self.min_ngram - 1, -1):
+            pat = arr[n_tok - n:]
+            # windows over arr[:-1]: starts 0..n_tok-n-1, i.e. every
+            # occurrence strictly before the suffix itself (overlap with
+            # the suffix is fine — that is exactly a short cycle)
+            wins = np.lib.stride_tricks.sliding_window_view(arr[:-1], n)
+            hits = np.flatnonzero((wins == pat).all(axis=1))
+            if hits.size:
+                # most recent earlier occurrence: recency beats frequency
+                # for continuation quality (the cycle being generated NOW
+                # matters more than one from 1000 tokens ago)
+                i = int(hits[-1])
+                return [int(t) for t in toks[i + n : i + n + k]]
+        return []
+
+
+class DraftModelDrafter(Drafter):
+    """Greedy drafting with a smaller model over its OWN paged KV cache.
+
+    Reuses models/llama_decode end to end (prefill to ingest history
+    deltas, decode_step to extend greedily), with a private
+    BlockAllocator/SequenceBlocks per request sized by a KVCacheConfig.
+    Sync with the target engine is by longest-common-prefix: accepted
+    draft tokens are already in the draft cache; a rejected/resampled
+    token shows up as a history mismatch and rolls the draft sequence
+    back with the same truncate_to the engine uses.
+    """
+
+    def __init__(
+        self,
+        model_config,
+        params=None,
+        *,
+        kv: Optional[KVCacheConfig] = None,
+        seed: int = 0,
+    ):
+        import jax
+
+        from ray_tpu.models import llama
+        from ray_tpu.models.llama_decode import decode_step, init_cache, prefill
+
+        c = model_config
+        self.config = c
+        self.params = (
+            params if params is not None
+            else llama.init_params(c, jax.random.key(seed))
+        )
+        kv = kv or KVCacheConfig(num_blocks=256, block_size=16)
+        # head/layer dims always follow the draft model; only capacity
+        # knobs (num_blocks/block_size/dtype) come from the caller's kv
+        self.kv = KVCacheConfig(
+            num_blocks=kv.num_blocks, block_size=kv.block_size,
+            n_layers=c.n_layers, n_kv_heads=c.n_kv_heads,
+            head_dim=c.head_dim, dtype=kv.dtype,
+        )
+        self.allocator = BlockAllocator(self.kv.num_blocks, self.kv.block_size)
+        self.cache = init_cache(
+            c, self.kv.num_slots, dtype=self.kv.dtype,
+            trash_slots=self.kv.block_size,
+        )
+        self._states: dict[str, dict] = {}  # rid -> {"seq", "hist"}
+        bs = self.kv.block_size
+        self._prefill = jax.jit(
+            lambda params, t, p, sl, sm, bt, cl, cache: prefill(
+                params, t, p, sl, sm, bt, cl, cache, c, block_size=bs,
+            ),
+            donate_argnums=(7,),
+        )
+        self._decode = jax.jit(
+            lambda params, t, p, sm, bt, cl, cache: decode_step(
+                params, t, p, sm, bt, cl, cache, c, block_size=bs,
+                attn_impl="xla",
+            ),
+            donate_argnums=(6,),
+        )
+
+    # -- internals ------------------------------------------------------------
+
+    def _bt(self, seq: SequenceBlocks) -> "np.ndarray":
+        w = max(1, 1 << (max(1, len(seq.blocks)) - 1).bit_length())
+        bt = np.zeros((1, w), np.int32)
+        bt[0, : len(seq.blocks)] = seq.blocks
+        return bt
+
+    def _feed_chunk(self, seq: SequenceBlocks, chunk: list, start: int):
+        """Prefill `chunk` at absolute positions start.. -> last logits."""
+        import jax.numpy as jnp
+
+        num_slots = self.kv.num_slots
+        S_pad = max(8, 1 << (len(chunk) - 1).bit_length())
+        tokens = np.zeros((1, S_pad), np.int32)
+        tokens[0, : len(chunk)] = chunk
+        positions = np.zeros((1, S_pad), np.int32)
+        positions[0, : len(chunk)] = np.arange(start, start + len(chunk))
+        slots = np.full((1, S_pad), num_slots, np.int32)
+        for i, p in enumerate(range(start, start + len(chunk))):
+            slots[0, i] = seq.slot(p)
+        logits, self.cache = self._prefill(
+            self.params,
+            jnp.asarray(tokens),
+            jnp.asarray(positions),
+            jnp.asarray([len(chunk)], jnp.int32),
+            jnp.asarray(slots),
+            jnp.asarray(self._bt(seq)),
+            jnp.asarray([start + len(chunk)], jnp.int32),
+            self.cache,
+        )
+        return logits
+
+    # -- Drafter API ----------------------------------------------------------
+
+    def propose(self, request_id: str, tokens: list, k: int) -> list:
+        import jax.numpy as jnp
+
+        c = self.config
+        if len(tokens) + k >= c.max_seq:
+            k = c.max_seq - 1 - len(tokens)
+        if k <= 0:
+            return []
+        st = self._states.get(request_id)
+        if st is None:
+            st = {"seq": SequenceBlocks(self.allocator), "hist": []}
+            self._states[request_id] = st
+        seq, hist = st["seq"], st["hist"]
+
+        # sync by longest common prefix: a rejected draft shows up here
+        # as a mismatch and rolls the draft KV back with truncate_to
+        common = 0
+        for a, b in zip(hist, tokens):
+            if a != b:
+                break
+            common += 1
+        if common == len(tokens):
+            # everything already fed (shouldn't happen: the engine always
+            # appends >=1 new token per step) — re-feed the last token
+            common = len(tokens) - 1
+        if common < len(hist):
+            seq.truncate_to(common)
+            del hist[common:]
+
+        try:
+            seq.ensure_capacity(len(tokens) + k)
+        except NoFreeBlocksError:
+            # draft cache full: drop this request's draft state entirely —
+            # drafting is best-effort, the target engine never blocks on it
+            self.release(request_id)
+            return []
+
+        # feed the history delta (bounded chunks keep pad buckets small)
+        logits = None
+        pos = common
+        missing = tokens[common:]
+        while missing:
+            chunk = missing[:128]
+            logits = self._feed_chunk(seq, chunk, pos)
+            hist.extend(chunk)
+            pos += len(chunk)
+            missing = missing[len(chunk):]
+        seq.num_tokens = len(tokens)
+
+        # greedy extension: k decode steps on the draft cache
+        drafted: list = []
+        tok = int(jnp.argmax(logits[0]))
+        for _ in range(k):
+            drafted.append(tok)
+            p = len(tokens) + len(drafted) - 1
+            logits, self.cache = self._decode(
+                self.params,
+                jnp.asarray([tok], jnp.int32),
+                jnp.asarray([p], jnp.int32),
+                jnp.asarray([seq.slot(p)], jnp.int32),
+                jnp.asarray(self._bt(seq)),
+                jnp.asarray([p + 1], jnp.int32),
+                self.cache,
+            )
+            tok = int(jnp.argmax(logits[0]))
+        hist.extend(drafted)
+        seq.num_tokens = len(tokens) + len(drafted)
+        return drafted
+
+    def release(self, request_id: str) -> None:
+        st = self._states.pop(request_id, None)
+        if st is not None:
+            st["seq"].release()

@@ -95,11 +95,10 @@ def _apply_lora(q, k, v, x, lora_l, ids, c: LlamaConfig):
     return q, k, v
 
 
-def prefill(
+def _paged_forward(
     params: Params,
     tokens: jax.Array,       # [B, S_pad] suffix tokens (right-padded)
     positions: jax.Array,    # [B, S_pad] absolute positions (pad = 0)
-    suffix_lens: jax.Array,  # [B] valid suffix tokens per row
     slot_mapping: jax.Array, # [B, S_pad] cache slots (pad -> trash slot)
     block_tables: jax.Array, # [B, MB]
     context_lens: jax.Array, # [B] prefix + suffix length
@@ -107,9 +106,13 @@ def prefill(
     config: LlamaConfig,
     *,
     block_size: int,
-    lora: "dict | None" = None,  # {"ids": [B], "<t>_A": [L,n,d,r], "<t>_B": [L,n,r,o]}
+    lora: "dict | None" = None,
 ) -> tuple[jax.Array, Cache]:
-    """Returns (last-valid-token logits [B, V], updated cache)."""
+    """Shared multi-token transformer body over the paged cache: scatter
+    the suffix K/V into pages, attend over (cached prefix + suffix) per
+    layer, return the final hidden states [B, S, D] + updated cache.
+    Both `prefill` (last-position logits) and `verify_tokens` (all-
+    position logits, speculative-decoding verification) sit on top."""
     c = config
     B, S = tokens.shape
     if S > c.max_seq:
@@ -162,14 +165,70 @@ def prefill(
         xs = xs + (lora_stacks,)
     (h,), (new_k, new_v) = jax.lax.scan(layer_step, (h,), xs)
     h = rms_norm(h, params["final_norm"], c.rms_eps)
-    # only the last valid suffix position's logits matter per row
-    last = jnp.clip(suffix_lens - 1, 0, S - 1)  # [B]
-    h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]  # [B, D]
+    return h, {"k": new_k, "v": new_v}
+
+
+def _lm_head(params: Params, h: jax.Array, c: LlamaConfig) -> jax.Array:
     w_out = params.get("lm_head", None)
     if w_out is None:
         w_out = params["embed"].T
-    logits = jnp.einsum("bd,dv->bv", h_last, w_out.astype(c.dtype))
-    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+    return jnp.einsum("...d,dv->...v", h, w_out.astype(c.dtype)).astype(jnp.float32)
+
+
+def prefill(
+    params: Params,
+    tokens: jax.Array,       # [B, S_pad] suffix tokens (right-padded)
+    positions: jax.Array,    # [B, S_pad] absolute positions (pad = 0)
+    suffix_lens: jax.Array,  # [B] valid suffix tokens per row
+    slot_mapping: jax.Array, # [B, S_pad] cache slots (pad -> trash slot)
+    block_tables: jax.Array, # [B, MB]
+    context_lens: jax.Array, # [B] prefix + suffix length
+    cache: Cache,
+    config: LlamaConfig,
+    *,
+    block_size: int,
+    lora: "dict | None" = None,  # {"ids": [B], "<t>_A": [L,n,d,r], "<t>_B": [L,n,r,o]}
+) -> tuple[jax.Array, Cache]:
+    """Returns (last-valid-token logits [B, V], updated cache)."""
+    h, new_cache = _paged_forward(
+        params, tokens, positions, slot_mapping, block_tables, context_lens,
+        cache, config, block_size=block_size, lora=lora,
+    )
+    S = tokens.shape[1]
+    # only the last valid suffix position's logits matter per row
+    last = jnp.clip(suffix_lens - 1, 0, S - 1)  # [B]
+    h_last = jnp.take_along_axis(h, last[:, None, None], axis=1)[:, 0]  # [B, D]
+    return _lm_head(params, h_last, config), new_cache
+
+
+def verify_tokens(
+    params: Params,
+    tokens: jax.Array,       # [B, K+1] current token + K drafted (right-padded)
+    positions: jax.Array,    # [B, K+1] absolute positions (pad = 0)
+    slot_mapping: jax.Array, # [B, K+1] cache slots (pad -> trash slot)
+    block_tables: jax.Array, # [B, MB]
+    context_lens: jax.Array, # [B] prefix + valid suffix length
+    cache: Cache,
+    config: LlamaConfig,
+    *,
+    block_size: int,
+    lora: "dict | None" = None,
+) -> tuple[jax.Array, Cache]:
+    """Speculative-decoding verification: score a short drafted suffix in
+    ONE pass through the paged-KV prefill path and return logits at EVERY
+    suffix position [B, K+1, V] (position j conditions on the fed tokens
+    0..j — exactly the distributions the acceptance sampler needs).
+
+    This converts K bandwidth-bound decode steps into one compute-dense
+    multi-token pass: the weights stream from HBM once per K+1 tokens
+    instead of once per token. Rows with an empty draft degenerate to a
+    plain decode step (suffix = just the current token, pad columns write
+    the trash slot and their logits are ignored)."""
+    h, new_cache = _paged_forward(
+        params, tokens, positions, slot_mapping, block_tables, context_lens,
+        cache, config, block_size=block_size, lora=lora,
+    )
+    return _lm_head(params, h, config), new_cache
 
 
 def _page_attend_prefill(
@@ -273,8 +332,4 @@ def decode_step(
         xs = xs + (lora_stacks,)
     (h,), (new_k, new_v) = jax.lax.scan(layer_step, (h,), xs)
     h = rms_norm(h[:, 0], params["final_norm"], c.rms_eps)  # [B, D]
-    w_out = params.get("lm_head", None)
-    if w_out is None:
-        w_out = params["embed"].T
-    logits = jnp.einsum("bd,dv->bv", h, w_out.astype(c.dtype))
-    return logits.astype(jnp.float32), {"k": new_k, "v": new_v}
+    return _lm_head(params, h, c), {"k": new_k, "v": new_v}

@@ -31,6 +31,7 @@ from ray_tpu.profiler.segments import (
     profile_segments,
     register_segments,
     segment_builders,
+    spec_decode_segments,
     train_step_segments,
 )
 from ray_tpu.profiler.trace import emit_spans, export, export_metrics
@@ -51,9 +52,11 @@ __all__ = [
     "export_metrics",
     "profile_decode_step",
     "profile_segments",
+    "profile_spec_decode_step",
     "profile_train_step",
     "register_segments",
     "segment_builders",
+    "spec_decode_segments",
     "train_step_segments",
 ]
 
@@ -155,6 +158,54 @@ def profile_decode_step(
             "model_params": config.num_params(),
             "attn_impl": attn_impl,
             "sample_mode": sample_mode,
+            **(meta or {}),
+        },
+    )
+    if export_observability:
+        export(profile)
+    return profile
+
+
+def profile_spec_decode_step(
+    config,
+    params,
+    spec,
+    *,
+    batch_size: int = 4,
+    context_len: int = 32,
+    block_size: int = 16,
+    iters: int = 6,
+    warmup: int = 2,
+    export_observability: bool = True,
+    meta: Optional[dict] = None,
+) -> StepProfile:
+    """Roofline-attributed profile of one SPECULATIVE decode round.
+
+    Segments: draft (host n-gram lookup) / verify (batched k+1-token
+    paged pass) / accept (distribution-preserving sampler) /
+    kv_rollback (host block truncate/refill). Rungs mix host and device
+    work, so cost-model fields are empty (unknown-bound) — the profile's
+    value is the wall-time split: is the win from fewer decode passes
+    being eaten by drafting or host bookkeeping?
+    """
+    parts, whole_fn = spec_decode_segments(
+        config, params, spec,
+        batch_size=batch_size, context_len=context_len,
+        block_size=block_size, iters=iters, warmup=warmup,
+    )
+    segments = profile_segments(
+        parts, iters=iters, warmup=warmup, with_costs=False,
+    )
+    whole_ms = whole_fn()
+    profile = StepProfile.build(
+        "spec_decode_step", segments, whole_ms,
+        meta={
+            "batch_size": batch_size,
+            "context_len": context_len,
+            "block_size": block_size,
+            "num_draft_tokens": spec.num_draft_tokens,
+            "spec_method": spec.method,
+            "model_params": config.num_params(),
             **(meta or {}),
         },
     )

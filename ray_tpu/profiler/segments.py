@@ -670,3 +670,165 @@ def decode_step_segments(
         return chained * 1e3, synced * 1e3
 
     return parts, whole_fn
+
+
+# -- speculative-decode ladder ------------------------------------------------
+
+
+@register_segments("spec_decode_step")
+def spec_decode_segments(
+    config,
+    params,
+    spec,
+    *,
+    batch_size: int = 4,
+    context_len: int = 32,
+    block_size: int = 16,
+    iters: int = 6,
+    warmup: int = 2,
+) -> tuple[list[FnPart], Callable]:
+    """Ladder for one SPECULATIVE decode round of the serving engine:
+    draft (host n-gram lookup) -> +verify (one batched k+1-token pass
+    through the paged prefill path) -> +accept (distribution-preserving
+    acceptance/rejection) -> +kv_rollback (host block truncate/refill).
+
+    Rungs mix host and device work, so every part is ``prejitted`` (the
+    device pieces are jitted inside; cost-model fields stay empty and the
+    segments classify unknown-bound — coverage is still measured against
+    an independently-timed straight-line composition, which is the
+    honesty property the regression gate guards). Histories are
+    periodic, so the prompt-lookup drafter proposes a full k every round
+    and the verify/accept rungs exercise their real shapes."""
+    from ray_tpu.llm.kv_cache import BlockAllocator, SequenceBlocks
+    from ray_tpu.llm.spec.accept import accept_draft
+    from ray_tpu.models.llama_decode import init_cache, verify_tokens
+
+    import numpy as np
+
+    c = config
+    B = batch_size
+    k = spec.num_draft_tokens
+    K1 = k + 1
+    ctx = min(context_len, c.max_seq - K1 - 1)
+    blocks_per_seq = -(-(ctx + K1 + 1) // block_size)
+    num_blocks = B * blocks_per_seq + B  # headroom for the rollback churn
+    num_slots = num_blocks * block_size
+
+    # the CONFIGURED drafter, not a hardcoded lookup: with
+    # method='draft_model' the draft rung must time the draft model's
+    # prefill+decode (the dominant drafting cost), or the report would
+    # attribute the wrong mechanism while meta claims spec_method
+    drafter = spec.build_drafter(c)
+    rng = np.random.default_rng(0)
+    histories = []
+    for _ in range(B):
+        pat = rng.integers(3, c.vocab_size - 1, size=4).tolist()
+        histories.append((pat * (ctx // 4 + 1))[:ctx])
+
+    allocator = BlockAllocator(num_blocks, block_size)
+    seqs = []
+    for _ in range(B):
+        s = SequenceBlocks(allocator)
+        s.ensure_capacity(ctx + K1)
+        s.num_tokens = ctx
+        seqs.append(s)
+    bt_w = max(len(s.blocks) for s in seqs)
+    bt = np.zeros((B, bt_w), np.int32)
+    for i, s in enumerate(seqs):
+        bt[i, : len(s.blocks)] = s.blocks
+    bt = jnp.asarray(bt)
+    cache = init_cache(c, num_slots, trash_slots=block_size)
+
+    # knobs with filtering active, matching the decode ladder's sampler
+    # probe — mode "sample" then measures the exact-filter accept path
+    # (the engine derives the cheaper categorical/greedy modes itself)
+    temps = jnp.ones((B,), jnp.float32)
+    top_ks = jnp.full((B,), 8, jnp.int32)
+    top_ps = jnp.full((B,), 0.9, jnp.float32)
+    keys = jax.vmap(jax.random.key)(jnp.arange(B, dtype=jnp.uint32))
+
+    jverify = jax.jit(
+        lambda t, p, sm, cl, acc: verify_tokens(
+            params, t + (acc * 0).astype(jnp.int32), p, sm, bt, cl, cache,
+            c, block_size=block_size,
+        )[0]
+    )
+
+    def _draft():
+        return [drafter.propose(str(i), histories[i], k) for i in range(B)]
+
+    def _build(drafts):
+        tokens = np.zeros((B, K1), np.int32)
+        positions = np.zeros((B, K1), np.int32)
+        slots = np.full((B, K1), num_slots, np.int32)
+        ctx_lens = np.zeros(B, np.int32)
+        d_toks = np.zeros((B, k), np.int32)
+        d_lens = np.zeros(B, np.int32)
+        for i, d in enumerate(drafts):
+            row = [histories[i][-1]] + d
+            tokens[i, : len(row)] = row
+            positions[i, : len(row)] = np.arange(ctx - 1, ctx - 1 + len(row))
+            for j in range(len(row)):
+                slots[i, j] = seqs[i].slot(ctx - 1 + j)
+            ctx_lens[i] = ctx + len(d)
+            d_toks[i, : len(d)] = d
+            d_lens[i] = len(d)
+        return tokens, positions, slots, ctx_lens, d_toks, d_lens
+
+    def r_draft(acc):
+        drafts = _draft()
+        return acc + 0.0 * float(len(drafts[0]))
+
+    def r_verify(acc):
+        t, p, sm, cl, _, _ = _build(_draft())
+        logits = jverify(jnp.asarray(t), jnp.asarray(p), jnp.asarray(sm),
+                         jnp.asarray(cl), acc)
+        return _token(logits) * 1e-30
+
+    def r_accept(acc):
+        t, p, sm, cl, dt, dl = _build(_draft())
+        logits = jverify(jnp.asarray(t), jnp.asarray(p), jnp.asarray(sm),
+                         jnp.asarray(cl), acc)
+        out, lp, a = accept_draft(
+            logits, jnp.asarray(dt), jnp.asarray(dl), temps, top_ks, top_ps,
+            keys, mode="sample",
+        )
+        # chain on tokens+accepts only: lp legitimately contains -inf for
+        # zero-probability pad columns and would NaN the chain token
+        return _token(a) * 1e-30 + _token(out) * 0.0
+
+    def r_rollback(acc):
+        t, p, sm, cl, dt, dl = _build(_draft())
+        logits = jverify(jnp.asarray(t), jnp.asarray(p), jnp.asarray(sm),
+                         jnp.asarray(cl), acc)
+        out, lp, a = accept_draft(
+            logits, jnp.asarray(dt), jnp.asarray(dl), temps, top_ks, top_ps,
+            keys, mode="sample",
+        )
+        a_host = [int(x) for x in jnp.asarray(a)]
+        for i, s in enumerate(seqs):
+            s.num_tokens = ctx + int(dl[i])
+            s.truncate_to(ctx + a_host[i])
+            s.ensure_capacity(ctx + K1)
+            s.num_tokens = ctx
+        return _token(a) * 1e-30
+
+    def mk_carry():
+        return jnp.zeros((), jnp.float32)
+
+    parts = [
+        FnPart("draft", r_draft, mk_carry, prejitted=True),
+        FnPart("verify", r_verify, mk_carry, prejitted=True),
+        FnPart("accept", r_accept, mk_carry, prejitted=True),
+        FnPart("kv_rollback", r_rollback, mk_carry, prejitted=True),
+    ]
+
+    def whole_fn(*, iters_=iters, warmup_=warmup, repeats_=3) -> float:
+        """Per-round ms of the straight-line draft->verify->accept->
+        rollback composition (independent of the ladder variants)."""
+        return 1e3 * chained_seconds(
+            r_rollback, mk_carry, iters=iters_, warmup=warmup_,
+            repeats=repeats_, prejitted=True,
+        )
+
+    return parts, whole_fn

@@ -193,6 +193,34 @@ def test_segment_registry():
 
     builders = segment_builders()
     assert "train_step" in builders and "decode_step" in builders
+    assert "spec_decode_step" in builders
+
+
+def test_checked_in_captures_keep_coverage():
+    """Coverage regression gate (ROADMAP item): the checked-in CPU
+    captures of the train and decode ladders must keep >= 90% of the
+    measured step attributed to named segments — segment attribution
+    must never rot silently. Regenerate with `python bench.py --profile`
+    and `python benchmarks/llm_serving_bench.py --profile` after any
+    ladder change."""
+    import os
+
+    bench_dir = os.path.join(os.path.dirname(os.path.dirname(__file__)),
+                             "benchmarks")
+    for name, step in [
+        ("PROFILE_trainstep_r06.json", "train_step"),
+        ("PROFILE_decode_r06.json", "decode_step"),
+    ]:
+        path = os.path.join(bench_dir, name)
+        assert os.path.exists(path), f"missing checked-in capture {name}"
+        doc = json.loads(open(path).read())
+        assert doc["step"] == step
+        assert doc["coverage_pct"] >= 90.0, (
+            f"{name}: coverage fell to {doc['coverage_pct']}% — segment "
+            "attribution is rotting; fix the ladder before optimizing"
+        )
+        in_step = [s for s in doc["segments"] if s["in_step"]]
+        assert len(in_step) >= 7  # the named ladders, not a stub
 
 
 def test_chip_peaks_cpu_fallback():
