@@ -14,6 +14,13 @@ measure nothing), then the same prompts are decoded by a baseline
 engine and a prompt-lookup spec engine. Reports tokens/s for both,
 token identity (greedy spec must be lossless), and the acceptance-rate
 stats from engine.stats(); writes benchmarks/SPEC_decode_r07.json.
+
+--trace additionally writes the per-REQUEST latency breakdown from the
+ray_tpu.obs flight recorder (queue_wait / prefill / decode-chunk phase
+distributions, TTFT/TPOT/queue/e2e SLO percentiles, span-coverage
+honesty) to benchmarks/TRACE_serving_r08.json — --profile answers
+"what is one step bound by", --trace answers "where did request X's
+wall-clock go".
 """
 
 from __future__ import annotations
@@ -29,6 +36,64 @@ _PROFILE_OUT = _os.path.join(
 _SPEC_OUT = _os.path.join(
     _os.path.dirname(_os.path.abspath(__file__)), "SPEC_decode_r07.json"
 )
+_TRACE_OUT = _os.path.join(
+    _os.path.dirname(_os.path.abspath(__file__)), "TRACE_serving_r08.json"
+)
+
+
+def _dist(vals: list) -> dict:
+    vals = sorted(float(v) for v in vals)
+    if not vals:
+        return {}
+
+    def pct(p):
+        return vals[min(len(vals) - 1, int(len(vals) * p))]
+
+    return {
+        "n": len(vals),
+        "mean": round(sum(vals) / len(vals), 4),
+        "p50": round(pct(0.5), 4),
+        "p95": round(pct(0.95), 4),
+        "max": round(vals[-1], 4),
+        "total": round(sum(vals), 3),
+    }
+
+
+def build_trace_report(recorder) -> dict:
+    """Per-phase latency breakdown from the flight recorder: where did
+    the benchmark's requests spend their wall-clock (queue_wait /
+    prefill / decode chunks / spec rounds), per-request SLOs
+    (TTFT/TPOT/queue/e2e distributions), and span-coverage honesty —
+    the --profile report says what one STEP is bound by, this says
+    where each REQUEST's time went."""
+    phases: dict[str, list] = {}
+    slos: dict[str, list] = {}
+    coverages = []
+    n_requests = 0
+    for meta in recorder.traces(limit=100_000):
+        summary = recorder.summary(meta["trace_id"])
+        if summary is None:
+            continue
+        for span in recorder.get(meta["trace_id"]):
+            if span.name.startswith("engine.") and span.name != "engine.preempt":
+                phases.setdefault(span.name, []).append(span.duration_s * 1e3)
+        attrs = summary.get("attrs", {})
+        if "e2e_s" in attrs:  # a finished llm.request root
+            n_requests += 1
+            coverages.append(summary["coverage_pct"])
+            for key in ("ttft_s", "tpot_s", "queue_wait_s", "e2e_s"):
+                if key in attrs:
+                    slos.setdefault(key, []).append(attrs[key])
+    return {
+        "requests": n_requests,
+        "phases_ms": {k: _dist(v) for k, v in sorted(phases.items())},
+        "slo_s": {k: _dist(v) for k, v in sorted(slos.items())},
+        "coverage_pct_mean": (
+            round(sum(coverages) / len(coverages), 2) if coverages else 0.0
+        ),
+        "dropped_traces": recorder.num_dropped_traces,
+        "dropped_spans": recorder.num_dropped_spans,
+    }
 
 
 def run_spec_bench(args) -> dict:
@@ -168,6 +233,10 @@ def main():
     ap.add_argument("--spec-out", default=_SPEC_OUT)
     ap.add_argument("--spec-k", type=int, default=4,
                     help="drafted tokens per verify pass")
+    ap.add_argument("--trace", action="store_true",
+                    help="also write the per-phase request-latency "
+                    "breakdown from the ray_tpu.obs flight recorder")
+    ap.add_argument("--trace-out", default=_TRACE_OUT)
     args = ap.parse_args()
 
     want = os.environ.get("JAX_PLATFORMS", "")
@@ -228,6 +297,12 @@ def main():
     # through a remote-compile tunnel each shape costs ~10-20s and would
     # otherwise be billed to throughput; serving numbers are steady-state
     run(min(n_requests, 16))
+    if args.trace:
+        # the report should describe the steady-state timed pass only,
+        # not the compile-heavy warmup traces
+        from ray_tpu.obs import get_recorder
+
+        get_recorder().clear()
     generated, dt, ttft = run(n_requests)
 
     expected = n_requests * max_new
@@ -246,6 +321,27 @@ def main():
     }
     if generated < expected * 0.9:
         result["warning"] = "fewer tokens than expected (early stops?)"
+
+    if args.trace:
+        from ray_tpu.obs import get_recorder
+
+        report = {
+            "metric": "llm_serving_trace" if on_tpu else "llm_serving_trace_smoke",
+            "decode_chunk": engine.config.decode_chunk,
+            "concurrency": min(n_requests, 16),
+            "max_new": max_new,
+            "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+            **build_trace_report(get_recorder()),
+        }
+        with open(args.trace_out, "w") as f:
+            f.write(json.dumps(report, indent=2) + "\n")
+        result["trace_out"] = args.trace_out
+        result["trace_coverage_pct_mean"] = report["coverage_pct_mean"]
+        if report["phases_ms"]:
+            result["trace_top_phase_ms"] = max(
+                report["phases_ms"].items(),
+                key=lambda kv: kv[1].get("total", 0.0),
+            )[0]
 
     if args.profile:
         # steady-state engine, same weights/config: where does one decode

@@ -55,6 +55,18 @@ def _new_id() -> bytes:
     return uuid.uuid4().bytes
 
 
+def _current_trace_dict() -> Optional[dict]:
+    """Ambient TraceContext as an envelope-ready dict (None when the
+    caller isn't tracing). Tracing must never break submission."""
+    try:
+        from ray_tpu.obs import context as trace_context
+
+        ctx = trace_context.current()
+        return ctx.to_dict() if ctx is not None else None
+    except Exception:  # noqa: BLE001
+        return None
+
+
 class ClusterObjectRef:
     """A future for an object living in some node's store.
 
@@ -580,6 +592,10 @@ class ClusterClient:
             "args": dumps_value((args, dict(kwargs or {})), arg_refs.append),
             "return_ids": return_ids,
             "num_returns": num_returns,
+            # trace context rides the envelope: captured HERE (the caller
+            # thread) because _drive_task runs on the submitter pool where
+            # the contextvar is gone
+            "trace": _current_trace_dict(),
         }
         for oid in arg_refs:
             self._incref(oid)
@@ -957,7 +973,7 @@ class ClusterClient:
         finally:
             self._record_span(
                 payload.get("desc", "task"), grant.get("node_id"), t0,
-                t_leased, time.monotonic(),
+                t_leased, time.monotonic(), trace=payload.get("trace"),
             )
             daemon_addr = tuple(grant.get("node_addr") or self.local_daemon_addr)
             if kill or key is None:
@@ -973,15 +989,17 @@ class ClusterClient:
     # -- tracing --------------------------------------------------------------
 
     def _record_span(self, desc: str, node_id, t0: float, t_leased: float,
-                     t_done: float) -> None:
+                     t_done: float, trace: Optional[dict] = None) -> None:
         """Per-task spans (lease wait + execution), bounded buffer.
         Reference analog: per-task ProfileEvents batched into
         GcsTaskManager powering `ray timeline` (core_worker/
         task_event_buffer.h); here driver-side, exported Chrome-trace."""
-        self._spans.append(
-            {"desc": desc, "node": node_id, "start": t0,
-             "leased": t_leased, "end": t_done}
-        )
+        span = {"desc": desc, "node": node_id, "start": t0,
+                "leased": t_leased, "end": t_done}
+        if trace:
+            span["trace_id"] = trace.get("trace_id")
+            span["span_id"] = trace.get("span_id")
+        self._spans.append(span)
 
     def timeline(self) -> list:
         """Chrome-trace events (chrome://tracing / Perfetto) for this
@@ -991,6 +1009,10 @@ class ClusterClient:
         spans = list(getattr(self, "_spans", ()))
         events = []
         for i, s in enumerate(spans):
+            trace_args = (
+                {"trace_id": s["trace_id"], "span_id": s.get("span_id")}
+                if s.get("trace_id") else {}
+            )
             for name, a, b in (("lease", "start", "leased"),
                                ("exec", "leased", "end")):
                 events.append({
@@ -1001,6 +1023,7 @@ class ClusterClient:
                     "pid": s["node"] or "cluster",
                     "tid": i % 64,
                     "cat": name,
+                    **({"args": trace_args} if trace_args else {}),
                 })
         return events
 
@@ -1137,6 +1160,7 @@ class ClusterClient:
             "args": dumps_value((args, dict(kwargs or {})), arg_refs.append),
             "return_ids": return_ids,
             "num_returns": num_returns,
+            "trace": _current_trace_dict(),
         }
         for oid in arg_refs:
             self._incref(oid)

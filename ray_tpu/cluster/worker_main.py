@@ -158,38 +158,52 @@ class WorkerRuntime:
 
     # -- task execution -------------------------------------------------------
 
+    @staticmethod
+    def _trace_ids(tctx) -> dict:
+        """Span-dict fields for the attached trace context (empty when
+        the envelope carried no trace)."""
+        if tctx is None:
+            return {}
+        return {"trace_id": tctx.trace_id, "span_id": tctx.span_id}
+
     def _execute(self, payload) -> dict:
         import time as _time
+
+        from ray_tpu.obs import context as trace_context
 
         desc = payload.get("desc", "task")
         return_ids = payload["return_ids"]
         t0 = _time.time()
-        try:
-            func = cloudpickle.loads(payload["func"])
-            args, kwargs = loads_value(payload["args"], self.resolve_ref)
-            result = func(*args, **kwargs)
-            self._store_returns(return_ids, result, payload.get("num_returns", 1))
-            self._spans.append({
-                "desc": desc, "task_id": payload.get("task_id", b"").hex(),
-                "worker_id": self.worker_id, "start": t0, "end": _time.time(),
-                "ok": True,
-            })
-            return {"ok": True}
-        except BaseException as e:  # noqa: BLE001
-            tb = traceback.format_exc()
-            err = _ErrorValue(e, tb, desc)
-            for rid in return_ids:
-                try:
-                    self.put_return(rid, err)
-                except Exception:
-                    pass
-            self._spans.append({
-                "desc": desc, "task_id": payload.get("task_id", b"").hex(),
-                "worker_id": self.worker_id, "start": t0, "end": _time.time(),
-                "ok": False,
-            })
-            return {"ok": False, "error": repr(e), "tb": tb,
-                    "retryable": not isinstance(e, (SystemExit,))}
+        # restore the envelope's trace so task code and nested submits on
+        # this worker stay in the caller's trace
+        with trace_context.use_from(payload.get("trace")) as tctx:
+            trace_ids = self._trace_ids(tctx)
+            try:
+                func = cloudpickle.loads(payload["func"])
+                args, kwargs = loads_value(payload["args"], self.resolve_ref)
+                result = func(*args, **kwargs)
+                self._store_returns(return_ids, result, payload.get("num_returns", 1))
+                self._spans.append({
+                    "desc": desc, "task_id": payload.get("task_id", b"").hex(),
+                    "worker_id": self.worker_id, "start": t0, "end": _time.time(),
+                    "ok": True, **trace_ids,
+                })
+                return {"ok": True}
+            except BaseException as e:  # noqa: BLE001
+                tb = traceback.format_exc()
+                err = _ErrorValue(e, tb, desc)
+                for rid in return_ids:
+                    try:
+                        self.put_return(rid, err)
+                    except Exception:
+                        pass
+                self._spans.append({
+                    "desc": desc, "task_id": payload.get("task_id", b"").hex(),
+                    "worker_id": self.worker_id, "start": t0, "end": _time.time(),
+                    "ok": False, **trace_ids,
+                })
+                return {"ok": False, "error": repr(e), "tb": tb,
+                        "retryable": not isinstance(e, (SystemExit,))}
 
     def _store_returns(self, return_ids, result, num_returns: int) -> None:
         if num_returns == 1:
@@ -247,41 +261,50 @@ class WorkerRuntime:
         desc = f"{type(actor).__name__}.{payload['method']}"
         import time as _time
 
+        from ray_tpu.obs import context as trace_context
+
         t0 = _time.time()
-        try:
-            # only METHOD EXECUTION needs the FIFO lock (per-caller order);
-            # storing the result is an independent RPC to the daemon and
-            # serializing it under the lock would cap the actor's call rate
-            # at the store round-trip
-            async with lock:
-                result = await loop.run_in_executor(None, _invoke)
-            await loop.run_in_executor(
-                None,
-                self._store_returns,
-                payload["return_ids"], result, payload.get("num_returns", 1),
-            )
-            # span only after the returns landed: a store failure takes the
-            # except path and must record ONE ok=False span, not both
-            self._spans.append({
-                "desc": desc, "worker_id": self.worker_id,
-                "actor_id": actor_id.hex(), "start": t0, "end": _time.time(),
-                "ok": True,
-            })
-            return {"ok": True}
-        except BaseException as e:  # noqa: BLE001
-            tb = traceback.format_exc()
-            err = _ErrorValue(e, tb, desc)
-            for rid in payload["return_ids"]:
-                try:
-                    self.put_return(rid, err)
-                except Exception:
-                    pass
-            self._spans.append({
-                "desc": desc, "worker_id": self.worker_id,
-                "actor_id": actor_id.hex(), "start": t0, "end": _time.time(),
-                "ok": False,
-            })
-            return {"ok": False, "error": repr(e), "tb": tb}
+        with trace_context.use_from(payload.get("trace")) as tctx:
+            trace_ids = self._trace_ids(tctx)
+            try:
+                # only METHOD EXECUTION needs the FIFO lock (per-caller
+                # order); storing the result is an independent RPC to the
+                # daemon and serializing it under the lock would cap the
+                # actor's call rate at the store round-trip
+                import contextvars as _cv
+
+                # run_in_executor does not propagate contextvars: ship the
+                # coroutine's context (with the attached trace) to the pool
+                call_ctx = _cv.copy_context()
+                async with lock:
+                    result = await loop.run_in_executor(None, call_ctx.run, _invoke)
+                await loop.run_in_executor(
+                    None,
+                    self._store_returns,
+                    payload["return_ids"], result, payload.get("num_returns", 1),
+                )
+                # span only after the returns landed: a store failure takes
+                # the except path and must record ONE ok=False span, not both
+                self._spans.append({
+                    "desc": desc, "worker_id": self.worker_id,
+                    "actor_id": actor_id.hex(), "start": t0, "end": _time.time(),
+                    "ok": True, **trace_ids,
+                })
+                return {"ok": True}
+            except BaseException as e:  # noqa: BLE001
+                tb = traceback.format_exc()
+                err = _ErrorValue(e, tb, desc)
+                for rid in payload["return_ids"]:
+                    try:
+                        self.put_return(rid, err)
+                    except Exception:
+                        pass
+                self._spans.append({
+                    "desc": desc, "worker_id": self.worker_id,
+                    "actor_id": actor_id.hex(), "start": t0, "end": _time.time(),
+                    "ok": False, **trace_ids,
+                })
+                return {"ok": False, "error": repr(e), "tb": tb}
 
     async def rpc_destroy_actor(self, payload, peer):
         self.actors.pop(payload["actor_id"], None)

@@ -194,6 +194,15 @@ class Actor:
         return None
 
     def _execute(self, spec: TaskSpec) -> None:
+        # caller's context restored around execution: actor-task events
+        # carry the trace, and user code in the method inherits it
+        # (nested calls, obs.span blocks, serve replicas)
+        from ray_tpu.obs import context as trace_context
+
+        with trace_context.use_from(spec.trace):
+            return self._execute_body(spec)
+
+    def _execute_body(self, spec: TaskSpec) -> None:
         from ray_tpu.core.events import TaskState
 
         self.runtime.task_events.record(
@@ -219,6 +228,14 @@ class Actor:
         self._store(spec, result)
 
     async def _execute_async(self, spec: TaskSpec, sem: asyncio.Semaphore) -> None:
+        # contextvar set inside the coroutine is task-local (asyncio
+        # copies the context per task), so concurrent calls don't leak
+        from ray_tpu.obs import context as trace_context
+
+        with trace_context.use_from(spec.trace):
+            await self._execute_async_body(spec, sem)
+
+    async def _execute_async_body(self, spec: TaskSpec, sem: asyncio.Semaphore) -> None:
         from ray_tpu.core.events import TaskState
 
         async with sem:

@@ -291,7 +291,9 @@ class ActorHandle:
         streaming = num_returns == "streaming"
         n = 1 if streaming else int(num_returns)
         from ray_tpu.core.task import TaskSpec
+        from ray_tpu.obs import context as trace_context
 
+        ctx = trace_context.current()
         spec = TaskSpec(
             task_id=task_id,
             func=actor.cls,  # carrier for describe(); not called
@@ -302,6 +304,7 @@ class ActorHandle:
             actor_id=actor.actor_id,
             method_name=method_name,
             streaming=streaming,
+            trace=ctx.to_dict() if ctx is not None else None,
         )
         runtime._retain_arg_refs(spec)
         with runtime._lock:

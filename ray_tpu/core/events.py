@@ -34,6 +34,10 @@ class TaskEvent:
     actor_id: Optional[str] = None
     error: Optional[str] = None
     worker: str = ""            # thread name / worker pid
+    # request tracing (ray_tpu.obs): set when the task ran under a
+    # TraceContext, so timeline() nests cluster work under the request
+    trace_id: Optional[str] = None
+    span_id: Optional[str] = None
 
 
 class TaskEventBuffer:
@@ -58,7 +62,18 @@ class TaskEventBuffer:
         error: Optional[str] = None,
         worker: str = "",
         ts: Optional[float] = None,
+        trace_id: Optional[str] = None,
+        span_id: Optional[str] = None,
     ) -> None:
+        if trace_id is None:
+            # auto-capture the ambient trace context: execution paths
+            # attach the submitter's context around the task body, so
+            # every record() call site tags events without plumbing
+            from ray_tpu.obs import context as _trace_context
+
+            ctx = _trace_context.current()
+            if ctx is not None:
+                trace_id, span_id = ctx.trace_id, ctx.span_id
         # explicit ts: reconstructed spans (profiler segment attribution)
         # land at their measured offsets instead of the record() call time
         ev = TaskEvent(
@@ -70,6 +85,8 @@ class TaskEventBuffer:
             actor_id=str(actor_id) if actor_id is not None else None,
             error=error,
             worker=worker or threading.current_thread().name,
+            trace_id=trace_id,
+            span_id=span_id,
         )
         with self._lock:
             self._events.append(ev)
@@ -113,6 +130,8 @@ class TaskEventBuffer:
                 span = spans.pop(ev.task_id, None)
                 if span is None:
                     continue
+                tid_ = ev.trace_id or span["ev"].trace_id
+                sid = ev.span_id or span["ev"].span_id
                 out.append(
                     {
                         "name": ev.name,
@@ -126,6 +145,7 @@ class TaskEventBuffer:
                             "task_id": ev.task_id,
                             "state": ev.state,
                             **({"error": ev.error} if ev.error else {}),
+                            **({"trace_id": tid_, "span_id": sid} if tid_ else {}),
                         },
                     }
                 )

@@ -155,6 +155,9 @@ class Runtime:
         task_id = TaskID.from_random()
         streaming = options.num_returns == "streaming"
         n = 1 if streaming else int(options.num_returns)
+        from ray_tpu.obs import context as trace_context
+
+        ctx = trace_context.current()
         spec = TaskSpec(
             task_id=task_id,
             func=func,
@@ -163,6 +166,7 @@ class Runtime:
             options=options,
             return_ids=[ObjectID.for_task_return(task_id, i) for i in range(n)],
             streaming=streaming,
+            trace=ctx.to_dict() if ctx is not None else None,
         )
         self._retain_arg_refs(spec)
         with self._lock:

@@ -176,6 +176,13 @@ class LocalScheduler:
     # -- process-mode execution (crash isolation + retries) -----------------
 
     def _run_in_process(self, spec: TaskSpec, pool: NodeResources, req: ResourceSet) -> None:
+        from ray_tpu.obs import context as trace_context
+
+        with trace_context.use_from(spec.trace):
+            return self._run_in_process_body(spec, pool, req)
+
+    def _run_in_process_body(self, spec: TaskSpec, pool: NodeResources,
+                             req: ResourceSet) -> None:
         from ray_tpu.core.events import TaskState
 
         runtime = self._runtime
@@ -262,7 +269,18 @@ def resolve_args(runtime: "Runtime", args: tuple, kwargs: dict) -> tuple[tuple, 
 
 
 def execute_task(runtime: "Runtime", spec: TaskSpec) -> None:
-    """Run a task inline on the current thread and store its results."""
+    """Run a task inline on the current thread and store its results.
+
+    Runs under the submitter's trace context (when the spec carries
+    one): task events carry the caller's trace/span ids, nested submits
+    chain further."""
+    from ray_tpu.obs import context as trace_context
+
+    with trace_context.use_from(spec.trace):
+        return _execute_task_body(runtime, spec)
+
+
+def _execute_task_body(runtime: "Runtime", spec: TaskSpec) -> None:
     from ray_tpu.core.events import TaskState
 
     runtime.task_events.record(spec.task_id, spec.describe(), TaskState.RUNNING)

@@ -76,6 +76,9 @@ class TaskSpec:
     # bookkeeping
     attempt: int = 0
     streaming: bool = False
+    # submitter's TraceContext as a dict (ray_tpu.obs.context): attached
+    # around execution so task events + nested calls carry the trace
+    trace: Optional[dict] = None
 
     def describe(self) -> str:
         # cached: called on every event record / error message

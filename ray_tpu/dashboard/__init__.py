@@ -7,7 +7,10 @@ aiohttp server exposing
   /api/tasks /api/actors /api/objects /api/nodes /api/placement_groups
   /api/summary /api/cluster_status   — JSON state (util/state.py)
   /metrics                           — Prometheus text (util/metrics.py)
-  /timeline                          — Chrome trace JSON
+  /timeline                          — Chrome trace JSON (task events)
+  /api/trace[?trace_id=]             — task timeline merged with request
+                                       spans (ray_tpu.obs flight recorder)
+  /api/requests                      — flight-recorder trace listing
   /healthz                           — liveness
 
 A React UI is out of scope; the JSON surface is the contract the
@@ -102,6 +105,31 @@ class Dashboard:
 
         async def timeline(_req):
             return web.json_response(await offload(state.timeline))
+
+        async def api_trace(req):
+            """Request spans (ray_tpu.obs flight recorder) merged with the
+            task/profiler timeline as one Chrome trace; ?trace_id= narrows
+            both halves to one request."""
+            trace_id = req.query.get("trace_id")
+
+            def build():
+                from ray_tpu.obs import get_recorder
+
+                events = state.timeline()
+                if trace_id:
+                    events = [
+                        e for e in events
+                        if e.get("args", {}).get("trace_id") == trace_id
+                    ]
+                events += get_recorder().chrome_trace(trace_id=trace_id)
+                return events
+
+            return web.json_response(await offload(build))
+
+        async def api_requests(_req):
+            from ray_tpu.obs import get_recorder
+
+            return web.json_response(get_recorder().traces())
 
         # -- cluster view: GCS tables + live per-daemon agent stats --------
         # one cached connection per address (reference: rpc client pools);
@@ -207,6 +235,9 @@ class Dashboard:
                         "pid": node_id,
                         "tid": s.get("worker_id", "worker"),
                         "cat": "exec" if s.get("ok", True) else "error",
+                        **({"args": {"trace_id": s["trace_id"],
+                                     "span_id": s.get("span_id")}}
+                           if s.get("trace_id") else {}),
                     })
             return events
 
@@ -230,6 +261,8 @@ class Dashboard:
         app.router.add_get("/api/cluster_status", cluster_status)
         app.router.add_get("/metrics", metrics)
         app.router.add_get("/timeline", timeline)
+        app.router.add_get("/api/trace", api_trace)
+        app.router.add_get("/api/requests", api_requests)
 
         runner = web.AppRunner(app, access_log=None)
 

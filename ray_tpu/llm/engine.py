@@ -37,6 +37,8 @@ from ray_tpu.llm.kv_cache import (
 from ray_tpu.llm.sampling import SamplingParams, sample_tokens
 from ray_tpu.models import llama
 from ray_tpu.models.llama_decode import decode_step, init_cache, prefill
+from ray_tpu.obs import context as trace_context
+from ray_tpu.obs import recorder as trace_recorder
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("ray_tpu.llm.engine")
@@ -156,6 +158,20 @@ class Request:
     token_logprobs: list = dataclasses.field(default_factory=list)
     lora_slot: int = 0
     _key: Any = None
+    # request tracing (ray_tpu.obs): the submitter's TraceContext; every
+    # lifecycle span below records as its child. Timestamps: queue_start
+    # resets on preemption (each wait is its own queue_wait span);
+    # first_prefill/first_token survive preemption (they ARE the SLOs);
+    # span_cursor tiles decode-round spans so per-request phase spans
+    # cover arrival -> finish without gaps (scheduler gaps land inside a
+    # round span and are priced by its sched_gap_ms attr, not hidden)
+    trace: Any = None
+    t_queue_start: float = 0.0
+    t_first_prefill: Optional[float] = None
+    t_prefill_start: Optional[float] = None
+    t_first_token: Optional[float] = None
+    t_span_cursor: Optional[float] = None
+    _prefill_cached: int = 0
 
     @property
     def num_tokens(self) -> int:
@@ -220,6 +236,9 @@ class LLMEngine:
         self.num_preemptions = 0
         self._counter = itertools.count()
         self._root_key = jax.random.key(seed ^ 0x5EED)
+        # serving SLO label (llm_ttft_seconds{model=...}); the OpenAI app
+        # stamps its model_id here after construction
+        self.model_tag = "engine"
 
         # LoRA adapter stacks: slot 0 is the zero adapter ("no lora");
         # per-target A [L, n_slots, d_in, r], B [L, n_slots, r, d_out]
@@ -417,6 +436,7 @@ class LLMEngine:
         sampling_params: Optional[SamplingParams] = None,
         request_id: Optional[str] = None,
         lora_id: Optional[str] = None,
+        trace: Optional[trace_context.TraceContext] = None,
     ) -> str:
         sp = sampling_params or SamplingParams()
         rid = request_id or f"req-{next(self._counter)}"
@@ -445,6 +465,11 @@ class LLMEngine:
             )
         req = Request(rid, list(map(int, prompt_token_ids)), sp)
         req.lora_slot = lora_slot
+        # every request is traced: explicit ctx from the serving layer, the
+        # ambient contextvar (submitter thread), or a fresh root — the
+        # flight recorder is bounded, so always-on costs a dict per request
+        req.trace = trace or trace_context.current() or trace_context.new_context()
+        req.t_queue_start = req.arrival
         key = self._root_key if sp.seed is None else jax.random.key(sp.seed)
         req._key = jax.random.fold_in(key, hash(rid) & 0x7FFFFFFF)
         self.requests[rid] = req
@@ -463,6 +488,23 @@ class LLMEngine:
             req.seq.release()
         req.status = RequestStatus.ABORTED
         req.finish_reason = "abort"
+        now = time.time()
+        self._obs_span(
+            req, "llm.request", req.arrival, now,
+            {"request_id": req.request_id, "finish_reason": "abort",
+             "prompt_tokens": len(req.prompt_token_ids),
+             "output_tokens": len(req.output_token_ids),
+             "e2e_s": round(max(0.0, now - req.arrival), 6)},
+        )
+        try:
+            from ray_tpu.obs import slo
+
+            slo.record_request_slo(
+                self.model_tag, ttft_s=None, tpot_s=None, queue_wait_s=None,
+                e2e_s=max(0.0, now - req.arrival), finish_reason="abort",
+            )
+        except Exception:  # noqa: BLE001
+            pass
         self.requests.pop(request_id, None)
         if self.drafter is not None:
             self.drafter.release(request_id)
@@ -488,7 +530,22 @@ class LLMEngine:
                 reqs = [r for r, _ in admitted]
                 logits = jnp.concatenate([l for _, l in admitted], axis=0)
                 tok, logprob = self._sample_batch(logits, reqs)
-                return self._append_tokens(reqs, tok, logprob)
+                t1 = time.time()  # host sync done: first token exists
+                outputs = self._append_tokens(reqs, tok, logprob)
+                for r in reqs:
+                    self._obs_span(
+                        r, "engine.prefill",
+                        r.t_prefill_start if r.t_prefill_start is not None else t1,
+                        t1,
+                        {"prompt_tokens": len(r.prompt_token_ids),
+                         "cached_tokens": r._prefill_cached,
+                         "recompute": r.num_preemptions > 0},
+                    )
+                    if r.t_first_token is None:
+                        r.t_first_token = t1
+                    r.t_span_cursor = t1
+                self._obs_finalize(reqs, t1)
+                return outputs
         if self.running:
             return self._decode_step()
         return []
@@ -586,6 +643,101 @@ class LLMEngine:
             meta={"engine_num_blocks": c.num_blocks},
         )
 
+    # -- request tracing (ray_tpu.obs) ---------------------------------------
+    # Per-request lifecycle spans into the flight recorder + SLO
+    # histograms. Phases tile: queue_wait [arrival/preempt -> prefill
+    # dispatch], prefill [dispatch -> first token], then one span per
+    # decode round (chunk or spec) from the request's span cursor — so a
+    # retrieved trace covers the full e2e wall-clock; host scheduling
+    # gaps are priced inside each round span as sched_gap_ms, never
+    # hidden. Every hook swallows failures: observability must not
+    # break decode.
+
+    def _obs_span(self, req, name: str, t0: float, t1: float,
+                  attrs: Optional[dict] = None, status: str = "ok") -> None:
+        try:
+            trace_recorder.get_recorder().record(
+                name, t0, t1, ctx=req.trace, attrs=attrs, status=status
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _obs_decode_round(self, batch: list, outputs: list, wall0: float,
+                          name: str, n_steps: int,
+                          extra: Optional[dict] = None) -> list:
+        """Record one decode round for every participating request, then
+        finalize the ones that finished. ``extra`` maps request_id ->
+        additional span attrs (spec rounds attach draft/accept counts)."""
+        try:
+            t1 = time.time()
+            active_ms = round((t1 - wall0) * 1e3, 3)
+            by_rid = {o.request_id: o for o in outputs}
+            for r in batch:
+                out = by_rid.get(r.request_id)
+                start = r.t_span_cursor if r.t_span_cursor is not None else wall0
+                start = min(start, wall0)
+                attrs = {
+                    "n_steps": n_steps,
+                    "new_tokens": len(out.new_token_ids) if out else 0,
+                    "active_ms": active_ms,
+                }
+                gap_ms = (wall0 - start) * 1e3
+                if gap_ms > 0.05:
+                    attrs["sched_gap_ms"] = round(gap_ms, 3)
+                if extra:
+                    attrs.update(extra.get(r.request_id, ()))
+                self._obs_span(r, name, start, t1, attrs)
+                r.t_span_cursor = t1
+            self._obs_finalize(batch, t1)
+        except Exception:  # noqa: BLE001
+            pass
+        return outputs
+
+    def _obs_finalize(self, reqs: list, t_end: float) -> None:
+        """Root span + SLO observations for requests that just finished."""
+        for r in reqs:
+            if r.status != RequestStatus.FINISHED:
+                continue
+            try:
+                n_out = len(r.output_token_ids)
+                e2e = max(0.0, t_end - r.arrival)
+                ttft = (
+                    max(0.0, r.t_first_token - r.arrival)
+                    if r.t_first_token is not None else None
+                )
+                tpot = (
+                    (t_end - r.t_first_token) / (n_out - 1)
+                    if r.t_first_token is not None and n_out > 1 else None
+                )
+                queue_wait = (
+                    max(0.0, r.t_first_prefill - r.arrival)
+                    if r.t_first_prefill is not None else None
+                )
+                attrs = {
+                    "request_id": r.request_id,
+                    "finish_reason": r.finish_reason,
+                    "prompt_tokens": len(r.prompt_token_ids),
+                    "output_tokens": n_out,
+                    "num_preemptions": r.num_preemptions,
+                    "e2e_s": round(e2e, 6),
+                }
+                if ttft is not None:
+                    attrs["ttft_s"] = round(ttft, 6)
+                if tpot is not None:
+                    attrs["tpot_s"] = round(tpot, 6)
+                if queue_wait is not None:
+                    attrs["queue_wait_s"] = round(queue_wait, 6)
+                self._obs_span(r, "llm.request", r.arrival, t_end, attrs)
+                from ray_tpu.obs import slo
+
+                slo.record_request_slo(
+                    self.model_tag,
+                    ttft_s=ttft, tpot_s=tpot, queue_wait_s=queue_wait,
+                    e2e_s=e2e, finish_reason=r.finish_reason or "",
+                )
+            except Exception:  # noqa: BLE001
+                pass
+
     # -- scheduling internals -------------------------------------------------
 
     def _pad_to_bucket(self, n: int, buckets: list) -> int:
@@ -632,6 +784,15 @@ class LLMEngine:
                 seq.release()
             return None  # no room: fall through to decode; retry later
         self.waiting.popleft()
+        t_admit = time.time()
+        self._obs_span(
+            req, "engine.queue_wait", req.t_queue_start, t_admit,
+            {"recompute": req.num_preemptions > 0},
+        )
+        req.t_prefill_start = t_admit
+        if req.t_first_prefill is None:
+            req.t_first_prefill = t_admit
+        req._prefill_cached = matched
 
         num_slots = c.num_blocks * c.block_size
         bt = np.zeros((1, self._bt_width([len(seq.blocks)])), np.int32)
@@ -683,6 +844,11 @@ class LLMEngine:
         victim.num_preemptions += 1
         self.num_preemptions += 1
         self.waiting.appendleft(victim)
+        now = time.time()
+        self._obs_span(victim, "engine.preempt", now, now,
+                       {"num_preemptions": victim.num_preemptions})
+        victim.t_queue_start = now  # next queue_wait span starts here
+        victim.t_span_cursor = None
         if self.drafter is not None:
             # re-admission recomputes from scratch; stale draft-cache
             # state would desync from the recomputed sequence
@@ -741,6 +907,7 @@ class LLMEngine:
         overhead."""
         c = self.config
         t0 = time.perf_counter() if c.profile else None
+        wall0 = time.time()
         k = c.spec.num_draft_tokens
         batch = list(self.running)
 
@@ -759,6 +926,7 @@ class LLMEngine:
                 if cap > 0 else []
             )
             draft_by_rid[r.request_id] = list(d)
+        t_drafted = time.time()
         if not any(draft_by_rid.values()):
             return self._plain_decode_step()
 
@@ -856,6 +1024,7 @@ class LLMEngine:
         out_toks = np.asarray(out_toks)   # host sync
         out_lps = np.asarray(out_lps)
         accepted = np.asarray(accepted)
+        t_verified = time.time()
 
         # keep accepted+1 tokens per row, run the usual stop ladder
         counts = (accepted[:B] + 1).tolist()
@@ -887,11 +1056,26 @@ class LLMEngine:
             record_spec_chunk(
                 1e3 * (time.perf_counter() - t0), k, n_accepted, B
             )
-        return outputs
+        draft_ms = round((t_drafted - wall0) * 1e3, 3)
+        verify_ms = round((t_verified - t_drafted) * 1e3, 3)
+        extra = {
+            r.request_id: {
+                "k": k,
+                "drafted": int(draft_lens[i]),
+                "accepted": int(accepted[i]),
+                "draft_ms": draft_ms,
+                "verify_ms": verify_ms,
+            }
+            for i, r in enumerate(batch)
+        }
+        return self._obs_decode_round(
+            batch, outputs, wall0, "engine.spec_round", k, extra=extra
+        )
 
     def _plain_decode_step(self) -> list[RequestOutput]:
         c = self.config
         t0 = time.perf_counter() if c.profile else None
+        wall0 = time.time()
         n_steps = self._chunk_steps()
         # grow each sequence by the chunk's slots it can actually USE —
         # overshoot steps past a request's max_tokens write the trash page
@@ -954,7 +1138,10 @@ class LLMEngine:
                     1e3 * (time.perf_counter() - t0), 1,
                     self._sample_mode(batch), B,
                 )
-            return self._append_tokens(batch, tok, logprob)
+            return self._obs_decode_round(
+                batch, self._append_tokens(batch, tok, logprob), wall0,
+                "engine.decode_chunk", 1,
+            )
 
         # multi-step chunk: decode+sample n_steps times on device, one sync
         temps = np.ones(B_pad, np.float32)
@@ -1001,7 +1188,10 @@ class LLMEngine:
                 1e3 * (time.perf_counter() - t0), n_steps,
                 self._sample_mode(batch), B,
             )
-        return self._append_chunk(batch, toks_np, logprobs_np)
+        return self._obs_decode_round(
+            batch, self._append_chunk(batch, toks_np, logprobs_np), wall0,
+            "engine.decode_chunk", n_steps,
+        )
 
     # -- sampling + bookkeeping ----------------------------------------------
 

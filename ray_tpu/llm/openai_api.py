@@ -9,7 +9,11 @@ asyncio futures resolved as the loop emits tokens.
 
 Endpoints: /v1/models, /v1/completions, /v1/chat/completions
 (stream=true returns a complete SSE transcript; token-level streaming
-is available via serve handles — get_app_handle(...).options(stream=True)).
+is available via serve handles — get_app_handle(...).options(stream=True)),
+/v1/stats, and the request-tracing surface (ray_tpu.obs): /v1/requests
+(flight-recorder listing) + /v1/requests/{id}/trace (per-request span
+tree with TTFT/TPOT/queue-wait and span-coverage honesty). Completion
+payloads carry the trace_id.
 """
 
 from __future__ import annotations
@@ -23,6 +27,7 @@ import uuid
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from ray_tpu import obs
 from ray_tpu.llm.engine import EngineConfig, LLMEngine, RequestOutput
 from ray_tpu.llm.sampling import SamplingParams
 from ray_tpu.utils.logging import get_logger
@@ -86,7 +91,13 @@ class _EngineRunner:
         )
         self._thread.start()
 
-    def submit(self, prompt_ids: list, sp: SamplingParams) -> tuple[str, queue.Queue]:
+    def submit(
+        self,
+        prompt_ids: list,
+        sp: SamplingParams,
+        request_id: Optional[str] = None,
+        trace=None,
+    ) -> tuple[str, queue.Queue]:
         q: queue.Queue = queue.Queue()
         with self.lock:
             # checked under the lock: the death handler drains _queues under
@@ -95,7 +106,9 @@ class _EngineRunner:
                 raise RuntimeError(
                     f"engine loop died: {self._dead!r}"
                 ) from self._dead
-            rid = self.engine.add_request(prompt_ids, sp)
+            rid = self.engine.add_request(
+                prompt_ids, sp, request_id=request_id, trace=trace
+            )
             self._queues[rid] = q
         self._wake.set()
         return rid, q
@@ -167,6 +180,7 @@ class LLMServer:
         )
         config.engine.eos_token_id = getattr(self.tokenizer, "eos_token_id", 2)
         self.engine = LLMEngine(config.engine, params=config.params, seed=config.seed)
+        self.engine.model_tag = config.model_id  # SLO histogram label
         self.runner = _EngineRunner(self.engine)
 
     def __del__(self):
@@ -187,10 +201,16 @@ class LLMServer:
             logprobs=bool(body.get("logprobs", False)),
         )
 
-    async def _run(self, prompt_ids: list, sp: SamplingParams):
-        """Async generator of RequestOutput."""
+    async def _run(self, prompt_ids: list, sp: SamplingParams,
+                   request_id: Optional[str] = None):
+        """Async generator of RequestOutput. The ambient TraceContext is
+        captured HERE (the caller's asyncio task) and handed to the
+        engine explicitly — the engine loop is a separate thread where
+        the contextvar is invisible."""
         loop = asyncio.get_running_loop()
-        rid, q = self.runner.submit(prompt_ids, sp)
+        rid, q = self.runner.submit(
+            prompt_ids, sp, request_id=request_id, trace=obs.current()
+        )
         try:
             while True:
                 out: Optional[RequestOutput] = await loop.run_in_executor(None, q.get)
@@ -204,9 +224,10 @@ class LLMServer:
         finally:
             self.runner.abort(rid)
 
-    async def _generate_text(self, prompt_ids: list, sp: SamplingParams):
+    async def _generate_text(self, prompt_ids: list, sp: SamplingParams,
+                             request_id: Optional[str] = None):
         toks, reason = [], None
-        async for out in self._run(prompt_ids, sp):
+        async for out in self._run(prompt_ids, sp, request_id=request_id):
             toks = out.output_token_ids
             reason = out.finish_reason
         # strip eos token from the visible text
@@ -221,6 +242,7 @@ class LLMServer:
         sp = self._sampling_from_body(kwargs)
         ids = self.tokenizer.encode(prompt)
         sent = ""
+        first_mark = False
         async for out in self._run(ids, sp):
             toks = out.output_token_ids
             if toks and toks[-1] == self.engine.config.eos_token_id:
@@ -231,6 +253,20 @@ class LLMServer:
             if not out.finished:
                 text = text.rstrip("�")
             if text.startswith(sent) and len(text) > len(sent):
+                if not first_mark:
+                    # streaming first-token mark: the client-visible TTFT
+                    # point (engine TTFT excludes queue/decoding overhead
+                    # this side of the loop thread)
+                    first_mark = True
+                    if obs.current() is not None:
+                        now = time.time()
+                        try:
+                            obs.get_recorder().record(
+                                "api.stream_first_token", now, now,
+                                attrs={"tokens": len(toks)},
+                            )
+                        except Exception:  # noqa: BLE001
+                            pass
                 yield text[len(sent):]
                 sent = text
 
@@ -242,11 +278,51 @@ class LLMServer:
             return self.models()
         if path.rstrip("/") == "/v1/stats" and method == "GET":
             return self.stats()
+        if path.rstrip("/") == "/v1/requests" and method == "GET":
+            return self.list_requests()
+        parts = [p for p in path.split("/") if p]
+        if (len(parts) == 4 and parts[:2] == ["v1", "requests"]
+                and parts[3] == "trace" and method == "GET"):
+            return self.request_trace(parts[2])
         if path.rstrip("/") == "/v1/completions" and method == "POST":
             return await self.completions(request.json())
         if path.rstrip("/") == "/v1/chat/completions" and method == "POST":
             return await self.chat_completions(request.json())
         return {"error": {"message": f"no route {method} {path}", "code": 404}}
+
+    # -- flight recorder surface ----------------------------------------------
+
+    def list_requests(self, limit: int = 100) -> dict:
+        """Flight-recorder listing: the last N traced requests (newest
+        first) with trace ids, root span, e2e, span counts."""
+        rec = obs.get_recorder()
+        return {
+            "object": "list",
+            "data": rec.traces(limit=limit),
+            "dropped_traces": rec.num_dropped_traces,
+            "dropped_spans": rec.num_dropped_spans,
+        }
+
+    def request_trace(self, request_id: str) -> dict:
+        """Full span tree for one request (by engine/completion request
+        id, or directly by trace id), plus e2e + span-coverage honesty."""
+        rec = obs.get_recorder()
+        trace_id = rec.find_by_request(request_id) or request_id
+        spans = rec.get(trace_id)
+        if not spans:
+            return {"error": {
+                "message": f"no recorded trace for request {request_id!r} "
+                "(evicted from the flight recorder, or never traced)",
+                "type": "not_found_error",
+                "code": 404,
+            }}
+        summary = rec.summary(trace_id) or {}
+        return {
+            "request_id": request_id,
+            "trace_id": trace_id,
+            **{k: v for k, v in summary.items() if k != "trace_id"},
+            "spans": [s.to_dict() for s in spans],
+        }
 
     def stats(self) -> dict:
         """Engine scheduling/KV state + (when speculative decoding is on)
@@ -290,34 +366,49 @@ class LLMServer:
         prompts = body.get("prompt", "")
         if not isinstance(prompts, list):
             prompts = [prompts]
-        id_lists = [self.tokenizer.encode(str(p)) for p in prompts]
-        # one choice per prompt, generated concurrently through the engine
-        results = await asyncio.gather(
-            *[self._generate_text(ids, sp) for ids in id_lists]
-        )
         rid = f"cmpl-{uuid.uuid4().hex[:24]}"
-        n_prompt = sum(len(ids) for ids in id_lists)
-        n_out = sum(len(toks) for _, toks, _ in results)
-        payload = {
-            "id": rid,
-            "object": "text_completion",
-            "created": int(time.time()),
+        # request root span: engine request ids derive from the completion
+        # id, so GET /v1/requests/{id}/trace resolves the whole trace
+        with obs.span("api.completions", attrs={
+            "request_id": rid,
             "model": body.get("model", self.config.model_id),
-            "choices": [
-                {
-                    "index": i,
-                    "text": text,
-                    "finish_reason": reason,
-                    "logprobs": None,
-                }
-                for i, (text, _toks, reason) in enumerate(results)
-            ],
-            "usage": {
-                "prompt_tokens": n_prompt,
-                "completion_tokens": n_out,
-                "total_tokens": n_prompt + n_out,
-            },
-        }
+            "endpoint": "/v1/completions",
+            "num_prompts": len(prompts),
+        }) as ctx:
+            id_lists = [self.tokenizer.encode(str(p)) for p in prompts]
+            # one choice per prompt, generated concurrently via the engine
+            results = await asyncio.gather(
+                *[
+                    self._generate_text(
+                        ids, sp,
+                        request_id=rid if len(id_lists) == 1 else f"{rid}-{i}",
+                    )
+                    for i, ids in enumerate(id_lists)
+                ]
+            )
+            n_prompt = sum(len(ids) for ids in id_lists)
+            n_out = sum(len(toks) for _, toks, _ in results)
+            payload = {
+                "id": rid,
+                "object": "text_completion",
+                "created": int(time.time()),
+                "model": body.get("model", self.config.model_id),
+                "trace_id": ctx.trace_id,
+                "choices": [
+                    {
+                        "index": i,
+                        "text": text,
+                        "finish_reason": reason,
+                        "logprobs": None,
+                    }
+                    for i, (text, _toks, reason) in enumerate(results)
+                ],
+                "usage": {
+                    "prompt_tokens": n_prompt,
+                    "completion_tokens": n_out,
+                    "total_tokens": n_prompt + n_out,
+                },
+            }
         if body.get("stream"):
             return _sse_transcript(payload, "text_completion")
         return payload
@@ -328,27 +419,34 @@ class LLMServer:
         except (ValueError, TypeError) as e:
             return self._invalid_request(e)
         messages = body.get("messages", [])
-        prompt = default_chat_template(messages)
-        ids = self.tokenizer.encode(prompt)
-        text, toks, reason = await self._generate_text(ids, sp)
-        payload = {
-            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
-            "object": "chat.completion",
-            "created": int(time.time()),
+        rid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        with obs.span("api.chat_completions", attrs={
+            "request_id": rid,
             "model": body.get("model", self.config.model_id),
-            "choices": [
-                {
-                    "index": 0,
-                    "message": {"role": "assistant", "content": text},
-                    "finish_reason": reason,
-                }
-            ],
-            "usage": {
-                "prompt_tokens": len(ids),
-                "completion_tokens": len(toks),
-                "total_tokens": len(ids) + len(toks),
-            },
-        }
+            "endpoint": "/v1/chat/completions",
+        }) as ctx:
+            prompt = default_chat_template(messages)
+            ids = self.tokenizer.encode(prompt)
+            text, toks, reason = await self._generate_text(ids, sp, request_id=rid)
+            payload = {
+                "id": rid,
+                "object": "chat.completion",
+                "created": int(time.time()),
+                "model": body.get("model", self.config.model_id),
+                "trace_id": ctx.trace_id,
+                "choices": [
+                    {
+                        "index": 0,
+                        "message": {"role": "assistant", "content": text},
+                        "finish_reason": reason,
+                    }
+                ],
+                "usage": {
+                    "prompt_tokens": len(ids),
+                    "completion_tokens": len(toks),
+                    "total_tokens": len(ids) + len(toks),
+                },
+            }
         if body.get("stream"):
             return _sse_transcript(payload, "chat.completion.chunk")
         return payload

@@ -1,0 +1,41 @@
+"""ray_tpu.obs — end-to-end request tracing + flight recorder + SLO metrics.
+
+Three pieces:
+
+ * context — ``TraceContext`` (W3C-traceparent shaped), carried by
+   contextvar within a process and serialized into TaskSpecs, cluster
+   RPC envelopes, and serve dispatch so one trace_id follows a request
+   across API -> router -> engine -> cluster workers;
+ * recorder — ``SpanRecorder``, a bounded flight recorder of the last N
+   requests' spans (``obs.span(...)`` records + propagates in one call);
+ * slo — serving SLO histograms (TTFT / TPOT / queue-wait / e2e +
+   router dispatch latency) on the util/metrics Prometheus registry.
+
+Instrumented surfaces: ``GET /api/trace`` on the dashboard (request
+spans merged with the task/profiler timeline), ``GET /v1/requests`` +
+``GET /v1/requests/{rid}/trace`` on the OpenAI app, and
+``llm_serving_bench.py --trace``.
+"""
+
+from ray_tpu.obs.context import (
+    TraceContext,
+    attach,
+    current,
+    detach,
+    new_context,
+    use,
+)
+from ray_tpu.obs.recorder import Span, SpanRecorder, get_recorder, span
+
+__all__ = [
+    "TraceContext",
+    "attach",
+    "current",
+    "detach",
+    "new_context",
+    "use",
+    "Span",
+    "SpanRecorder",
+    "get_recorder",
+    "span",
+]

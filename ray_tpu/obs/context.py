@@ -1,0 +1,137 @@
+"""Request-scoped trace context (W3C traceparent shaped).
+
+A ``TraceContext`` is (trace_id, span_id): the trace_id names one
+end-to-end request, the span_id names the current operation within it.
+It travels three ways:
+
+ * contextvar — within a thread / asyncio task (``use``/``attach``);
+ * dict — inside RPC envelopes and TaskSpecs (``to_dict``/``from_dict``),
+   pickle-free so it crosses the cluster plane unchanged;
+ * header — ``traceparent: 00-<trace>-<span>-01`` for HTTP interop.
+
+Deliberately dependency-free: core/, cluster/ and serve/ all import it
+on their hot paths.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import dataclasses
+import os
+import re
+from typing import Optional
+
+_TRACEPARENT_RE = re.compile(
+    r"^(?P<version>[0-9a-f]{2})-(?P<trace_id>[0-9a-f]{32})"
+    r"-(?P<span_id>[0-9a-f]{16})-(?P<flags>[0-9a-f]{2})$"
+)
+
+
+def _rand_hex(nbytes: int) -> str:
+    return os.urandom(nbytes).hex()
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    trace_id: str          # 32 lowercase hex chars (16 bytes)
+    span_id: str           # 16 lowercase hex chars (8 bytes)
+    sampled: bool = True
+
+    def child(self) -> "TraceContext":
+        """Same trace, fresh span id — the context a sub-operation runs
+        under (its spans record this span as parent)."""
+        return TraceContext(self.trace_id, _rand_hex(8), self.sampled)
+
+    # -- wire formats ---------------------------------------------------------
+
+    def to_dict(self) -> dict:
+        return {"trace_id": self.trace_id, "span_id": self.span_id,
+                "sampled": self.sampled}
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> Optional["TraceContext"]:
+        if not d or not d.get("trace_id"):
+            return None
+        return cls(
+            trace_id=str(d["trace_id"]),
+            span_id=str(d.get("span_id") or _rand_hex(8)),
+            sampled=bool(d.get("sampled", True)),
+        )
+
+    def to_traceparent(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-{'01' if self.sampled else '00'}"
+
+    @classmethod
+    def from_traceparent(cls, header: Optional[str]) -> Optional["TraceContext"]:
+        if not header:
+            return None
+        m = _TRACEPARENT_RE.match(header.strip().lower())
+        if m is None:
+            return None
+        return cls(
+            trace_id=m.group("trace_id"),
+            span_id=m.group("span_id"),
+            sampled=bool(int(m.group("flags"), 16) & 1),
+        )
+
+
+_CURRENT: contextvars.ContextVar[Optional[TraceContext]] = contextvars.ContextVar(
+    "ray_tpu_trace_context", default=None
+)
+
+
+def current() -> Optional[TraceContext]:
+    return _CURRENT.get()
+
+
+def new_context() -> TraceContext:
+    """Fresh root: new trace_id + span_id."""
+    return TraceContext(_rand_hex(16), _rand_hex(8))
+
+
+def attach(ctx: Optional[TraceContext]):
+    """Set the ambient context; returns a token for ``detach``."""
+    return _CURRENT.set(ctx)
+
+
+def detach(token) -> None:
+    _CURRENT.reset(token)
+
+
+@contextlib.contextmanager
+def use(ctx: Optional[TraceContext]):
+    token = _CURRENT.set(ctx)
+    try:
+        yield ctx
+    finally:
+        try:
+            _CURRENT.reset(token)
+        except ValueError:
+            # unwound in a different Context (e.g. an abandoned async
+            # generator finalized by the event loop in a fresh task);
+            # that transient context dies anyway — nothing to restore
+            pass
+
+
+@contextlib.contextmanager
+def use_from(trace_dict: Optional[dict]):
+    """Attach a serialized context around an execution body — the one
+    helper every task-execution plane (thread scheduler, actor runtimes,
+    cluster workers) wraps with. The context is attached AS-IS, not as a
+    fresh child: the envelope's span_id names a span the SUBMITTER
+    records (serve.request, an obs.span block), so spans recorded inside
+    the body parent to a span that actually exists in the recorder — a
+    per-execution child id would leave them dangling off a span nobody
+    recorded. No-ops when the envelope carries no (valid) trace, and
+    never raises: tracing must never break task execution. Yields the
+    attached context or None."""
+    try:
+        ctx = TraceContext.from_dict(trace_dict)
+    except Exception:  # noqa: BLE001
+        ctx = None
+    if ctx is None:
+        yield None
+        return
+    with use(ctx):
+        yield ctx

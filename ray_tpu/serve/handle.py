@@ -18,31 +18,55 @@ from ray_tpu.serve.router import Router
 class _RouteSlot:
     """One dispatch's inflight accounting; shared with a GC finalizer so
     fire-and-forget calls (response dropped without .result()) still
-    decrement the router's count exactly once."""
+    decrement the router's count exactly once. When the caller carried a
+    TraceContext, completion also records the `serve.request` span
+    (dispatch -> result consumed) into the flight recorder."""
 
-    def __init__(self, router: Router, rid: str):
+    def __init__(self, router: Router, rid: str, span_info: Optional[tuple] = None):
         self._router = router
         self._rid = rid
+        self._span_info = span_info  # (ctx, parent_span_id, t0, attrs)
         self._done = False
         self._lock = threading.Lock()
 
-    def complete(self):
+    def complete(self, record_span: bool = True):
         with self._lock:
             if self._done:
                 return
             self._done = True
         self._router.complete(self._rid)
+        # record_span=False on the GC-finalizer path: a fire-and-forget
+        # response may be collected seconds after the call finished, and
+        # stamping end=now there would invent phantom request latency
+        if record_span and self._span_info is not None:
+            try:
+                import time
+
+                from ray_tpu.obs import Span, get_recorder
+
+                ctx, parent_span_id, t0, attrs = self._span_info
+                get_recorder().add(Span(
+                    trace_id=ctx.trace_id,
+                    span_id=ctx.span_id,
+                    parent_id=parent_span_id,
+                    name="serve.request",
+                    start=t0,
+                    end=time.time(),
+                    attrs=attrs,
+                ))
+            except Exception:  # noqa: BLE001 — tracing must not fail calls
+                pass
 
 
 class DeploymentResponse:
     """Future for one unary handle call."""
 
-    def __init__(self, router: Router, rid: str, ref):
+    def __init__(self, router: Router, rid: str, ref, span_info=None):
         import weakref
 
-        self._slot = _RouteSlot(router, rid)
+        self._slot = _RouteSlot(router, rid, span_info)
         self._ref = ref
-        weakref.finalize(self, self._slot.complete)
+        weakref.finalize(self, self._slot.complete, False)
 
     def _complete(self):
         self._slot.complete()
@@ -74,12 +98,12 @@ class DeploymentResponse:
 class DeploymentResponseGenerator:
     """Iterator over a streaming handle call."""
 
-    def __init__(self, router: Router, rid: str, gen):
+    def __init__(self, router: Router, rid: str, gen, span_info=None):
         import weakref
 
-        self._slot = _RouteSlot(router, rid)
+        self._slot = _RouteSlot(router, rid, span_info)
         self._gen = gen
-        weakref.finalize(self, self._slot.complete)
+        weakref.finalize(self, self._slot.complete, False)
 
     def __iter__(self):
         import ray_tpu
@@ -199,7 +223,31 @@ class DeploymentHandle:
     def remote(self, *args, **kwargs):
         args, kwargs = _substitute_responses(args, kwargs)
         router = self._get_router()
-        rid, ref = router.dispatch(self._method_name, args, kwargs, self._streaming)
+        from ray_tpu.obs import context as trace_context
+
+        parent = trace_context.current()
+        span_info = None
+        if parent is not None:
+            # dispatch under a child context: the actor envelope the router
+            # submits captures it, so the replica's spans nest under this
+            # call's serve.request span (recorded when the response is
+            # consumed — _RouteSlot.complete)
+            import time
+
+            child = parent.child()
+            span_info = (child, parent.span_id, time.time(), {
+                "app": self.app_name,
+                "deployment": self.deployment_name,
+                "method": self._method_name or "__call__",
+            })
+            with trace_context.use(child):
+                rid, ref = router.dispatch(
+                    self._method_name, args, kwargs, self._streaming
+                )
+        else:
+            rid, ref = router.dispatch(
+                self._method_name, args, kwargs, self._streaming
+            )
         if self._streaming:
-            return DeploymentResponseGenerator(router, rid, ref)
-        return DeploymentResponse(router, rid, ref)
+            return DeploymentResponseGenerator(router, rid, ref, span_info)
+        return DeploymentResponse(router, rid, ref, span_info)

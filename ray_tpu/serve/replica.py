@@ -153,23 +153,46 @@ class Replica:
         }
         return args, kwargs
 
+    def _request_span(self, method_name: Optional[str]):
+        """`serve.replica` span (queue-wait + execution) when the call
+        arrived under a trace; no-op context otherwise so untraced traffic
+        doesn't fill the flight recorder."""
+        import contextlib
+
+        from ray_tpu import obs
+
+        if obs.current() is None:
+            return contextlib.nullcontext()
+        return obs.span("serve.replica", attrs={
+            "deployment": self._deployment_name,
+            "app": self._app_name,
+            "method": method_name or "__call__",
+        })
+
     async def handle_request(self, method_name: Optional[str], args, kwargs):
         """Unary request path. _num_ongoing counts queued + executing — the
         autoscaling signal wants in-replica load, not just active slots."""
         self._num_ongoing += 1
         try:
             async with self._request_sem:
-                args, kwargs = await self._resolve_refs(args, kwargs)
-                target = self._resolve_target(method_name)
-                if inspect.iscoroutinefunction(target):
-                    return await target(*args, **kwargs)
-                # Sync callable: run off-loop so long computations don't
-                # starve the replica's event loop.
-                loop = asyncio.get_running_loop()
-                out = await loop.run_in_executor(None, lambda: target(*args, **kwargs))
-                if inspect.isawaitable(out):
-                    out = await out
-                return out
+                with self._request_span(method_name):
+                    args, kwargs = await self._resolve_refs(args, kwargs)
+                    target = self._resolve_target(method_name)
+                    if inspect.iscoroutinefunction(target):
+                        return await target(*args, **kwargs)
+                    # Sync callable: run off-loop so long computations don't
+                    # starve the replica's event loop. copy_context ships
+                    # the trace contextvar to the executor thread.
+                    import contextvars
+
+                    loop = asyncio.get_running_loop()
+                    call_ctx = contextvars.copy_context()
+                    out = await loop.run_in_executor(
+                        None, lambda: call_ctx.run(target, *args, **kwargs)
+                    )
+                    if inspect.isawaitable(out):
+                        out = await out
+                    return out
         finally:
             self._num_ongoing -= 1
             self._num_processed += 1
@@ -180,19 +203,20 @@ class Replica:
         self._num_ongoing += 1
         try:
             async with self._request_sem:  # same cap as the unary path
-                args, kwargs = await self._resolve_refs(args, kwargs)
-                target = self._resolve_target(method_name)
-                out = target(*args, **kwargs)
-                if inspect.isawaitable(out):
-                    out = await out
-                if hasattr(out, "__aiter__"):
-                    async for item in out:
-                        yield item
-                elif hasattr(out, "__iter__"):
-                    for item in out:
-                        yield item
-                else:
-                    yield out
+                with self._request_span(method_name):
+                    args, kwargs = await self._resolve_refs(args, kwargs)
+                    target = self._resolve_target(method_name)
+                    out = target(*args, **kwargs)
+                    if inspect.isawaitable(out):
+                        out = await out
+                    if hasattr(out, "__aiter__"):
+                        async for item in out:
+                            yield item
+                    elif hasattr(out, "__iter__"):
+                        for item in out:
+                            yield item
+                    else:
+                        yield out
         finally:
             self._num_ongoing -= 1
             self._num_processed += 1

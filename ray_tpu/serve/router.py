@@ -103,7 +103,15 @@ class Router:
             return sum(self._inflight.values())
 
     def dispatch(self, method_name: Optional[str], args, kwargs, streaming: bool):
-        """Route one request; returns (replica_id, ObjectRef-or-generator)."""
+        """Route one request; returns (replica_id, ObjectRef-or-generator).
+
+        The dispatch wall-clock (refresh + pick + submit — the router's
+        own contribution to request latency) lands in the
+        serve_router_dispatch_seconds histogram; the trace context, when
+        the caller carries one, rides the actor-task envelope the
+        `.remote()` below captures, so the replica executes inside the
+        request's trace."""
+        t0 = time.perf_counter()
         self._refresh()
         if self._max_queued >= 0 and self.total_inflight() >= self._max_queued + len(
             self._replicas
@@ -126,6 +134,12 @@ class Router:
             with self._lock:
                 self._inflight[rid] = max(0, self._inflight.get(rid, 1) - 1)
             raise
+        finally:
+            from ray_tpu.obs import slo
+
+            slo.record_dispatch(
+                self._app, self._deployment, time.perf_counter() - t0
+            )
         return rid, ref
 
     def complete(self, rid: str) -> None:

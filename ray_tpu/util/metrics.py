@@ -160,8 +160,22 @@ def clear_registry() -> None:
         _REGISTRY.clear()
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus exposition escaping for label values: backslash, double
+    quote, and newline must be escaped or one prompt/path-derived tag
+    value corrupts every line after it in the scrape."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
 def _fmt_tags(keys: Sequence[str], vals: tuple, extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in zip(keys, vals) if v != ""]
+    # empty values are emitted explicitly (`k=""`): dropping them made a
+    # series tagged {model: ""} collide with an untagged sibling series
+    parts = [f'{k}="{_escape_label_value(v)}"' for k, v in zip(keys, vals)]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""

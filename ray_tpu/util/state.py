@@ -22,6 +22,7 @@ class TaskRow:
     actor_id: Optional[str]
     ts: float
     error: Optional[str]
+    trace_id: Optional[str] = None  # ray_tpu.obs request trace, if any
 
 
 def list_tasks(state: Optional[str] = None, limit: int = 1000) -> list[TaskRow]:
@@ -30,6 +31,7 @@ def list_tasks(state: Optional[str] = None, limit: int = 1000) -> list[TaskRow]:
         TaskRow(
             task_id=e.task_id, name=e.name, state=e.state, kind=e.kind,
             actor_id=e.actor_id, ts=e.ts, error=e.error,
+            trace_id=getattr(e, "trace_id", None),
         )
         for e in runtime.task_events.tasks(state=state, limit=limit)
     ]

@@ -370,6 +370,39 @@ def test_cluster_task_tracing(cluster):
     assert all(e["ph"] == "X" and e["dur"] >= 0 for e in events)
 
 
+@pytest.mark.trace
+def test_trace_context_rides_task_envelope(cluster):
+    """ray_tpu.obs: a TraceContext active at submit time travels inside
+    the task envelope — the worker process executes under (a child of)
+    the caller's trace, and the driver-side timeline spans carry the
+    trace id so cluster work nests under the originating request."""
+    from ray_tpu import obs
+
+    client = cluster.client()
+
+    def traced_work():
+        from ray_tpu.obs import context as tc
+
+        cur = tc.current()
+        return cur.trace_id if cur else None
+
+    with obs.span("cluster.request_root") as ctx:
+        got = client.get(client.submit(traced_work), timeout=60)
+    assert got == ctx.trace_id, "worker executed outside the caller's trace"
+    # the driver span lands on the submitter thread's finally AFTER the
+    # return object is readable: poll briefly
+    deadline = time.time() + 5
+    events = []
+    while time.time() < deadline and not events:
+        events = [
+            e for e in client.timeline()
+            if e.get("args", {}).get("trace_id") == ctx.trace_id
+        ]
+        if not events:
+            time.sleep(0.05)
+    assert events, "driver lease/exec spans lost the trace id"
+
+
 def test_task_returns_ride_shared_memory(cluster):
     """Task results are sealed into the C++ shared-memory store by the
     WORKER and adopted (pinned) by the daemon — the bytes never cross the

@@ -52,6 +52,38 @@ def test_counter_gauge_histogram_and_prometheus():
     assert "ray_tpu_latency_s_count 3" in text
 
 
+def test_prometheus_label_value_escaping():
+    """A quote/backslash/newline in a tag value must not corrupt the
+    exposition format (satellite r08: _fmt_tags escaping)."""
+    metrics_mod.clear_registry()
+    c = Counter("escape_total", "escaping", tag_keys=("path",))
+    c.inc(tags={"path": 'say "hi"\\n'})
+    c.inc(tags={"path": "line1\nline2"})
+    text = metrics_mod.prometheus_text()
+    assert 'path="say \\"hi\\"\\\\n"' in text
+    assert 'path="line1\\nline2"' in text
+    # every sample line stays single-line and parseable
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        assert line.count(" ") >= 1 and line.rsplit(" ", 1)[1], line
+
+
+def test_prometheus_empty_tag_value_no_collision():
+    """An empty-string tag value must be emitted explicitly: dropping it
+    made {model=""} collide with an untagged sibling series (satellite
+    r08)."""
+    metrics_mod.clear_registry()
+    g = Gauge("tagged_series", "with tag", tag_keys=("model",))
+    g.set(1.0, tags={"model": ""})
+    g.set(2.0, tags={"model": "m1"})
+    text = metrics_mod.prometheus_text()
+    assert 'ray_tpu_tagged_series{model=""} 1.0' in text
+    assert 'ray_tpu_tagged_series{model="m1"} 2.0' in text
+    # the empty-valued series must NOT render as a bare untagged line
+    assert "\nray_tpu_tagged_series 1.0" not in "\n" + text
+
+
 # ---------------------------------------------------------------------------
 # state API + timeline
 # ---------------------------------------------------------------------------
