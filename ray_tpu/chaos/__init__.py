@@ -1,0 +1,81 @@
+"""ray_tpu.chaos — deterministic fault injection for a runtime that must
+survive preemptible fleets.
+
+Usage::
+
+    from ray_tpu import chaos
+
+    sched = chaos.FaultSchedule(seed=7, faults=[
+        chaos.FaultSpec(chaos.DROP_RPC, site="rpc.call",
+                        match={"method": "push_task"}, p=0.25, max_fires=3),
+        chaos.FaultSpec(chaos.PREEMPT_ENGINE, site="llm.engine.step",
+                        start_after=5, max_fires=1),
+    ])
+    chaos.install(sched)             # propagate_env=True for subprocesses
+    try:
+        ...                          # run the workload; faults fire
+        print(sched.decisions())     # the deterministic post-mortem
+    finally:
+        chaos.uninstall()
+
+Hook sites are woven into cluster/rpc.py, cluster/client.py,
+cluster/node_daemon.py, core/process_pool.py, serve/replica.py, and
+llm/engine.py, each behind an ``ACTIVE is None`` fast path — disabled
+chaos costs one attribute load per site. Orchestrated process kills
+(PREEMPT_NODE etc.) run through ``chaos.runner.ChaosRunner``. Fired
+faults are mirrored into the ``ray_tpu.obs`` flight recorder as
+``chaos.<kind>`` event spans.
+"""
+
+from ray_tpu.chaos import harness
+from ray_tpu.chaos.harness import (
+    ENV_VAR,
+    EnginePreempted,
+    FaultInjected,
+    ReplicaCrashed,
+    corrupt_frame,
+    fault_log,
+    fire,
+    install,
+    install_from_env,
+    uninstall,
+)
+from ray_tpu.chaos.schedule import (
+    CORRUPT_FRAME,
+    DELAY_RPC,
+    DROP_RPC,
+    KILL_REPLICA,
+    KILL_WORKER,
+    KINDS,
+    PREEMPT_ENGINE,
+    PREEMPT_NODE,
+    STALL_HEARTBEAT,
+    Fault,
+    FaultSchedule,
+    FaultSpec,
+)
+
+
+def active():
+    """The installed schedule, or None (read harness.ACTIVE for the
+    fast-path guard — this module re-binds lazily)."""
+    return harness.ACTIVE
+
+
+def __getattr__(name):
+    if name == "ACTIVE":  # convenience mirror of harness.ACTIVE
+        return harness.ACTIVE
+    if name == "ChaosRunner":
+        from ray_tpu.chaos.runner import ChaosRunner
+
+        return ChaosRunner
+    raise AttributeError(f"module 'ray_tpu.chaos' has no attribute {name!r}")
+
+
+__all__ = [
+    "CORRUPT_FRAME", "DELAY_RPC", "DROP_RPC", "KILL_REPLICA", "KILL_WORKER",
+    "KINDS", "PREEMPT_ENGINE", "PREEMPT_NODE", "STALL_HEARTBEAT",
+    "Fault", "FaultSchedule", "FaultSpec", "FaultInjected", "ReplicaCrashed",
+    "EnginePreempted", "ChaosRunner", "ENV_VAR", "active", "corrupt_frame",
+    "fault_log", "fire", "harness", "install", "install_from_env", "uninstall",
+]

@@ -1,0 +1,145 @@
+"""Chaos harness: the process-wide install point + hook the runtime calls.
+
+Hook sites in the runtime are guarded by ``if harness.ACTIVE is not
+None`` — ONE module-attribute load and an identity test when chaos is
+disabled, so the production path pays nothing measurable. When a
+schedule is installed, ``fire(site, **attrs)`` asks it which faults hit
+this call; the call site interprets the kinds it understands (drop →
+transport error, delay → sleep, corrupt → byte flip, kill → process
+kill / injected crash).
+
+Fired faults land in two places: the schedule's ``log`` (programmatic
+post-mortem) and the ``ray_tpu.obs`` flight recorder as zero-duration
+``chaos.<kind>`` event spans under the ambient trace — so a request's
+trace shows *which* fault fired inside it and what recovered.
+
+Cross-process: ``install(schedule, propagate_env=True)`` exports the
+schedule as JSON in ``RAY_TPU_CHAOS``; node daemons and cluster workers
+call ``install_from_env()`` at startup, so subprocess planes inherit the
+driver's schedule deterministically (each process holds its own decision
+counters — per-process call order is what determinism is defined over).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+from ray_tpu.chaos.schedule import (  # noqa: F401 — re-exported for hook sites
+    CORRUPT_FRAME,
+    DELAY_RPC,
+    DROP_RPC,
+    KILL_REPLICA,
+    KILL_WORKER,
+    PREEMPT_ENGINE,
+    PREEMPT_NODE,
+    STALL_HEARTBEAT,
+    Fault,
+    FaultSchedule,
+    FaultSpec,
+)
+
+ENV_VAR = "RAY_TPU_CHAOS"
+
+# THE fast-path guard: hook sites read this attribute and skip everything
+# when it is None. Installed schedules are process-wide.
+ACTIVE: Optional[FaultSchedule] = None
+
+
+class FaultInjected(Exception):
+    """Base of injected failures (so tests/retry paths can tell chaos
+    from organic faults when they need to)."""
+
+
+class ReplicaCrashed(FaultInjected):
+    """A serve replica crashed mid-request (KILL_REPLICA, in-process)."""
+
+
+class EnginePreempted(FaultInjected):
+    """The LLM engine was preempted mid-step (PREEMPT_ENGINE)."""
+
+
+def install(schedule: FaultSchedule, *, propagate_env: bool = False) -> FaultSchedule:
+    """Activate a schedule in this process. ``propagate_env`` exports it
+    so subprocesses spawned from here (node daemons, cluster workers)
+    pick it up via ``install_from_env``."""
+    global ACTIVE
+    ACTIVE = schedule
+    if propagate_env:
+        os.environ[ENV_VAR] = schedule.to_wire()
+    return schedule
+
+
+def uninstall() -> None:
+    global ACTIVE
+    ACTIVE = None
+    os.environ.pop(ENV_VAR, None)
+
+
+def active() -> Optional[FaultSchedule]:
+    return ACTIVE
+
+
+def install_from_env() -> Optional[FaultSchedule]:
+    """Subprocess entry hook (node_daemon / worker_main main()): adopt
+    the driver's schedule if one rode in on the environment."""
+    global ACTIVE
+    if ACTIVE is not None:
+        return ACTIVE
+    wire = os.environ.get(ENV_VAR)
+    if not wire:
+        return None
+    try:
+        ACTIVE = FaultSchedule.from_wire(wire)
+    except Exception:  # noqa: BLE001 — a bad env var must not kill the daemon
+        return None
+    return ACTIVE
+
+
+def fire(site: str, kinds=None, **attrs) -> list[FaultSpec]:
+    """Ask the active schedule which faults hit this call, mirroring each
+    into the obs flight recorder. ``kinds``: the fault kinds this hook
+    site implements (specs of other kinds are not eligible here, so they
+    can't burn their budget at a site that would ignore them). Returns
+    [] when chaos is disabled."""
+    sched = ACTIVE
+    if sched is None:
+        return []
+    hits = sched.fire(site, kinds=kinds, **attrs)
+    for spec in hits:
+        _record_obs_event(site, spec.kind, attrs)
+    return hits
+
+
+def _record_obs_event(site: str, kind: str, attrs: dict) -> None:
+    """Zero-duration ``chaos.<kind>`` span under the ambient trace (or a
+    fresh root): the post-mortem trail. Never breaks the faulted path."""
+    try:
+        from ray_tpu.obs import recorder as _recorder
+
+        now = time.time()
+        _recorder.get_recorder().record(
+            f"chaos.{kind}", now, now,
+            attrs={"site": site, **{k: str(v) for k, v in attrs.items()}},
+            status="error",
+        )
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def corrupt_frame(body: bytes) -> bytes:
+    """Deterministic byte corruption for CORRUPT_FRAME: flip a span in
+    the middle of the frame (header length stays intact so the peer
+    reads a full frame and fails in deserialization, the realistic
+    torn-payload failure mode)."""
+    if not body:
+        return body
+    mid = len(body) // 2
+    span = max(1, min(8, len(body) - mid))
+    return body[:mid] + bytes(b ^ 0xFF for b in body[mid:mid + span]) + body[mid + span:]
+
+
+def fault_log() -> list[Fault]:
+    sched = ACTIVE
+    return list(sched.log) if sched is not None else []

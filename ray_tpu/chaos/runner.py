@@ -1,0 +1,152 @@
+"""ChaosRunner: executes a schedule's orchestrated faults on a timeline.
+
+In-process faults fire inline at hook sites; process-level faults
+(``PREEMPT_NODE``, and ``KILL_WORKER`` / ``KILL_REPLICA`` specs given an
+``at_s`` offset) need an executor with a handle on the blast radius.
+The runner walks ``schedule.orchestrated()`` sorted by ``at_s`` on a
+daemon thread, picking targets deterministically from the spec's seeded
+RNG when the spec names none:
+
+ * ``PREEMPT_NODE``  → ``LocalCluster.kill_node`` (SIGKILL daemon +
+   workers; GCS learns by heartbeat timeout — the real preemption path);
+ * ``KILL_WORKER``   → the target node daemon's ``chaos_kill_worker``
+   RPC (newest leased worker dies mid-task);
+ * ``KILL_REPLICA``  → serve controller ``kill_replica`` (actor killed
+   out from under its router entry; health sweep replaces it).
+
+Every executed fault is appended to the schedule ``log`` via a direct
+record, so post-mortems read one merged sequence.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ray_tpu.chaos.schedule import (
+    KILL_REPLICA,
+    KILL_WORKER,
+    PREEMPT_NODE,
+    Fault,
+    FaultSchedule,
+)
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.chaos.runner")
+
+
+class ChaosRunner:
+    def __init__(
+        self,
+        schedule: FaultSchedule,
+        *,
+        cluster=None,           # ray_tpu.cluster.LocalCluster (node faults)
+        controller_handle=None,  # serve controller (replica faults)
+    ):
+        self.schedule = schedule
+        self.cluster = cluster
+        self.controller = controller_handle
+        self.executed: list[Fault] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> "ChaosRunner":
+        self._thread = threading.Thread(
+            target=self._run, name="chaos-runner", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    # -- execution ------------------------------------------------------------
+
+    def _run(self) -> None:
+        t0 = time.monotonic()
+        for idx, spec in self.schedule.orchestrated():
+            wait = spec.at_s - (time.monotonic() - t0)
+            if wait > 0 and self._stop.wait(wait):
+                return
+            if self._stop.is_set():
+                return
+            try:
+                self._execute(idx, spec)
+            except Exception:  # noqa: BLE001 — one failed kill must not end the run
+                logger.exception("orchestrated fault %s failed", spec.kind)
+
+    def _execute(self, idx, spec) -> None:
+        attrs: dict = {}
+        if spec.kind == PREEMPT_NODE:
+            attrs = self._preempt_node(idx, spec)
+        elif spec.kind == KILL_WORKER:
+            attrs = self._kill_worker(idx, spec)
+        elif spec.kind == KILL_REPLICA:
+            attrs = self._kill_replica(idx, spec)
+        else:
+            return
+        with self.schedule._lock:
+            fault = Fault(
+                seq=self.schedule._seq, kind=spec.kind, site="runner",
+                spec_index=idx, attrs=attrs, t=time.time(),
+            )
+            self.schedule._seq += 1
+            self.schedule.log.append(fault)
+        self.executed.append(fault)
+        logger.warning("chaos: executed %s %s", spec.kind, attrs)
+
+    def _preempt_node(self, idx, spec) -> dict:
+        if self.cluster is None:
+            raise RuntimeError("PREEMPT_NODE needs a cluster")
+        node_id = spec.target or self.schedule.pick(
+            idx, list(self.cluster.nodes.keys())
+        )
+        self.cluster.kill_node(node_id)
+        return {"node_id": node_id}
+
+    def _kill_worker(self, idx, spec) -> dict:
+        if self.cluster is None:
+            raise RuntimeError("KILL_WORKER (orchestrated) needs a cluster")
+        node_id = spec.target or self.schedule.pick(
+            idx, list(self.cluster.nodes.keys())
+        )
+        node = self.cluster.nodes[node_id]
+        client = self.cluster.client()
+        r = client.pool.get(tuple(node.addr)).call(
+            "chaos_kill_worker", {}, timeout=10
+        )
+        return {"node_id": node_id, **(r or {})}
+
+    def _kill_replica(self, idx, spec) -> dict:
+        if self.controller is None:
+            raise RuntimeError("KILL_REPLICA (orchestrated) needs a controller")
+        import ray_tpu
+
+        app, _, dep = (spec.target or "").partition("/")
+        if not app:
+            # no target: pick the victim app from the spec's seeded RNG
+            # (same contract as _preempt_node), not a silent no-op
+            st = ray_tpu.get(self.controller.status.remote())
+            apps = sorted(st.get("applications", {}))
+            if not apps:
+                raise RuntimeError("KILL_REPLICA: no serve applications")
+            app = self.schedule.pick(idx, apps)
+        rid = ray_tpu.get(
+            self.controller.kill_replica.remote(app, dep or None)
+        )
+        if rid is None:
+            # nothing died — surfacing this matters more than the kill:
+            # a chaos run that silently skips its fault tests nothing
+            raise RuntimeError(
+                f"KILL_REPLICA: no running replica in app {app!r}"
+            )
+        return {"replica_id": rid, "app": app, "deployment": dep}

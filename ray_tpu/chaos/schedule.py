@@ -1,0 +1,218 @@
+"""Deterministic fault schedules: what breaks, where, and when.
+
+Reference analogs: the reference repo's chaos testing utilities
+(python/ray/_private/test_utils.py node/worker killer actors and the
+chaos-test suite built on them) — redesigned as a *seeded, typed*
+schedule instead of ad-hoc `kill_raylet` helpers: the same seed always
+reproduces the same fault sequence against the same call sequence, so a
+failing chaos run is a replayable artifact, not a flake.
+
+Two fault families share one schedule:
+
+ * in-process faults (``DROP_RPC``, ``DELAY_RPC``, ``CORRUPT_FRAME``,
+   ``STALL_HEARTBEAT``, ``KILL_WORKER``, ``KILL_REPLICA``,
+   ``PREEMPT_ENGINE``) fire at hook sites woven into the runtime
+   (cluster/rpc.py, cluster/client.py, cluster/node_daemon.py,
+   core/process_pool.py, serve/replica.py, llm/engine.py). Eligibility
+   is counted per spec; probabilistic specs draw from a per-spec
+   ``random.Random`` derived from the schedule seed — call order in,
+   identical decisions out.
+ * orchestrated faults (``PREEMPT_NODE``, and ``KILL_WORKER`` /
+   ``KILL_REPLICA`` with an ``at_s`` offset) are executed by
+   ``chaos.runner.ChaosRunner`` against a live LocalCluster / serve
+   controller on a deterministic timeline.
+
+Schedules serialize to JSON (``to_wire``/``from_wire``) so a driver can
+propagate them to daemon/worker subprocesses through the
+``RAY_TPU_CHAOS`` environment variable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import threading
+import time
+from fnmatch import fnmatchcase
+from typing import Optional, Sequence
+
+# -- typed fault kinds --------------------------------------------------------
+
+KILL_WORKER = "kill_worker"          # kill the worker process running a task
+KILL_REPLICA = "kill_replica"        # crash a serve replica mid-request
+DROP_RPC = "drop_rpc"                # transport error instead of the send
+DELAY_RPC = "delay_rpc"              # inject latency before the send
+STALL_HEARTBEAT = "stall_heartbeat"  # node stops heartbeating (partition)
+PREEMPT_NODE = "preempt_node"        # SIGKILL a whole node (daemon+workers)
+CORRUPT_FRAME = "corrupt_frame"      # flip bytes in the wire frame
+PREEMPT_ENGINE = "preempt_engine"    # LLM engine dies mid-step
+
+KINDS = frozenset({
+    KILL_WORKER, KILL_REPLICA, DROP_RPC, DELAY_RPC, STALL_HEARTBEAT,
+    PREEMPT_NODE, CORRUPT_FRAME, PREEMPT_ENGINE,
+})
+
+# kinds the in-process hook ignores (a runner executes them instead)
+ORCHESTRATED = frozenset({PREEMPT_NODE})
+# kinds ChaosRunner knows how to execute on an at_s timeline
+RUNNER_KINDS = frozenset({PREEMPT_NODE, KILL_WORKER, KILL_REPLICA})
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One rule in a schedule.
+
+    ``site`` / ``match`` select eligible hook calls (fnmatch patterns;
+    ``match`` patterns apply to the hook's keyword attrs). Of eligible
+    calls, the first ``start_after`` are skipped, then every
+    ``every_n``-th is considered, fires with probability ``p`` (drawn
+    from the spec's seeded RNG), at most ``max_fires`` times."""
+
+    kind: str
+    site: str = "*"
+    match: dict = dataclasses.field(default_factory=dict)
+    p: float = 1.0
+    start_after: int = 0
+    every_n: int = 1
+    max_fires: int = -1          # -1 = unbounded
+    delay_s: float = 0.05        # DELAY_RPC sleep
+    at_s: float = 0.0            # orchestrated: offset from runner start
+    target: Optional[str] = None  # orchestrated: node_id / "app/deployment"
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; one of {sorted(KINDS)}")
+        if not (0.0 <= self.p <= 1.0):
+            raise ValueError(f"p must be in [0, 1], got {self.p}")
+        if self.every_n < 1:
+            raise ValueError("every_n must be >= 1")
+        if self.at_s > 0.0 and self.kind not in RUNNER_KINDS:
+            # at_s routes the spec to ChaosRunner, which only executes
+            # RUNNER_KINDS — anything else would be a silent no-op that
+            # fires nowhere (neither hooks nor runner)
+            raise ValueError(
+                f"at_s is only valid for {sorted(RUNNER_KINDS)}, "
+                f"not {self.kind!r} (in-process kinds use "
+                "start_after/every_n/p instead)"
+            )
+
+
+@dataclasses.dataclass
+class Fault:
+    """A fired fault — the post-mortem record (also mirrored into the
+    ray_tpu.obs flight recorder as a ``chaos.<kind>`` event span)."""
+
+    seq: int
+    kind: str
+    site: str
+    spec_index: int
+    attrs: dict
+    t: float
+
+
+class FaultSchedule:
+    """Seeded, thread-safe fault decider. Same seed + same eligible-call
+    sequence => same fault sequence; zero shared global RNG state."""
+
+    def __init__(self, seed: int, faults: Sequence[FaultSpec]):
+        self.seed = int(seed)
+        self.specs = list(faults)
+        for f in self.specs:
+            if not isinstance(f, FaultSpec):
+                raise TypeError(f"expected FaultSpec, got {type(f)}")
+        # one RNG per spec: a spec added/removed between runs cannot
+        # shift its siblings' decision streams
+        self._rngs = [
+            random.Random((self.seed << 16) ^ i) for i in range(len(self.specs))
+        ]
+        self._eligible = [0] * len(self.specs)
+        self._fired = [0] * len(self.specs)
+        self.log: list[Fault] = []
+        self._seq = 0
+        self._lock = threading.Lock()
+
+    # -- decision ------------------------------------------------------------
+
+    def fire(self, site: str, kinds: Optional[Sequence[str]] = None,
+             **attrs) -> list[FaultSpec]:
+        """Decide which specs fire for this hook call; records them in
+        ``log``. Deterministic in (seed, call order). ``kinds`` is the
+        set of fault kinds THIS hook site implements: a spec whose kind
+        the site would ignore is not eligible here — otherwise a
+        wildcard-site spec could burn its max_fires budget (and log a
+        fault into the post-mortem) at a site where nothing happens."""
+        hits: list[FaultSpec] = []
+        with self._lock:
+            for i, spec in enumerate(self.specs):
+                if spec.kind in ORCHESTRATED or spec.at_s > 0.0:
+                    # timeline-orchestrated specs belong to ChaosRunner;
+                    # matching them at in-process hook sites too would
+                    # fire the same fault twice through different planes
+                    continue
+                if kinds is not None and spec.kind not in kinds:
+                    continue
+                if not fnmatchcase(site, spec.site):
+                    continue
+                if not all(
+                    fnmatchcase(str(attrs.get(k, "")), pat)
+                    for k, pat in spec.match.items()
+                ):
+                    continue
+                n = self._eligible[i]
+                self._eligible[i] += 1
+                if n < spec.start_after:
+                    continue
+                if (n - spec.start_after) % spec.every_n:
+                    continue
+                if spec.max_fires >= 0 and self._fired[i] >= spec.max_fires:
+                    continue
+                if spec.p < 1.0 and self._rngs[i].random() >= spec.p:
+                    continue
+                self._fired[i] += 1
+                self.log.append(Fault(
+                    seq=self._seq, kind=spec.kind, site=site, spec_index=i,
+                    attrs=dict(attrs), t=time.time(),
+                ))
+                self._seq += 1
+                hits.append(spec)
+        return hits
+
+    def pick(self, spec_index: int, choices: Sequence) -> object:
+        """Deterministic choice for orchestrated faults (e.g. which node
+        to preempt) from the spec's own RNG."""
+        if not choices:
+            raise ValueError("no choices to pick from")
+        return self._rngs[spec_index].choice(sorted(choices, key=str))
+
+    def orchestrated(self) -> list[tuple[int, FaultSpec]]:
+        """(index, spec) pairs a ChaosRunner should execute, by at_s."""
+        out = [
+            (i, s) for i, s in enumerate(self.specs)
+            if s.kind in ORCHESTRATED or s.at_s > 0.0
+        ]
+        out.sort(key=lambda t: t[1].at_s)
+        return out
+
+    def fired_kinds(self) -> list[str]:
+        with self._lock:
+            return [f.kind for f in self.log]
+
+    def decisions(self) -> list[tuple[str, str, int]]:
+        """Compact (kind, site, spec_index) sequence — the determinism
+        contract surface: equal for equal seeds and call sequences."""
+        with self._lock:
+            return [(f.kind, f.site, f.spec_index) for f in self.log]
+
+    # -- wire form (env propagation to subprocesses) --------------------------
+
+    def to_wire(self) -> str:
+        return json.dumps({
+            "seed": self.seed,
+            "faults": [dataclasses.asdict(f) for f in self.specs],
+        })
+
+    @classmethod
+    def from_wire(cls, wire: str) -> "FaultSchedule":
+        doc = json.loads(wire)
+        return cls(doc["seed"], [FaultSpec(**f) for f in doc["faults"]])

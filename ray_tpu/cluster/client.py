@@ -32,6 +32,8 @@ from ray_tpu.cluster.rpc import (
     RpcError,
 )
 from ray_tpu.cluster.serialization import _ErrorValue, dumps_value, loads_value
+from ray_tpu.chaos import harness as _chaos
+from ray_tpu.util.backoff import ExponentialBackoff
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("ray_tpu.cluster.client")
@@ -709,6 +711,9 @@ class ClusterClient:
                     arg_refs: Sequence[bytes] = ()) -> None:
         attempt = 0
         exclude: list = []
+        # jittered exponential retry delay: N submitters whose tasks died
+        # with one node must not re-lease in synchronized 0.1s waves
+        backoff = ExponentialBackoff(base=0.1, cap=2.0)
         try:
             while True:
                 try:
@@ -734,7 +739,7 @@ class ClusterClient:
                         "%s attempt %d failed (%s); retrying", payload["desc"],
                         attempt, e,
                     )
-                    time.sleep(0.1)
+                    backoff.sleep()
         finally:
             for oid in arg_refs:  # unpin the task's argument objects
                 self._decref(oid)
@@ -768,6 +773,10 @@ class ClusterClient:
         deadline = time.monotonic() + 120.0
         visited: set = set()
         hops = 0
+        # lease re-poll: jittered exponential (floored by the daemon's
+        # retry_after hint) so saturated-cluster waiters decorrelate
+        # instead of hammering the daemon queue in phase
+        backoff = ExponentialBackoff(base=0.05, cap=1.0)
         while time.monotonic() < deadline:
             daemon = self.pool.get(addr)
             r = daemon.call(
@@ -786,7 +795,7 @@ class ClusterClient:
                 continue
             if "error" in r:
                 raise RemoteError(RuntimeError(r["error"]))
-            time.sleep(r.get("retry_after", 0.05))
+            backoff.sleep(floor=r.get("retry_after", 0.0))
             visited.clear()  # capacity may have freed anywhere
             hops = 0
             if not pinned:
@@ -797,6 +806,7 @@ class ClusterClient:
         """Lease inside a placement group: a fixed bundle (index >= 0) or
         any bundle that grants (index -1), sweeping until the deadline."""
         deadline = time.monotonic() + 120.0
+        backoff = ExponentialBackoff(base=0.05, cap=1.0)
         while time.monotonic() < deadline:
             info = self.gcs.call("get_pg", {"pg_id": spec["pg_id"]})
             if info is None:
@@ -824,7 +834,7 @@ class ClusterClient:
                 if "error" in r and idx >= 0:
                     raise RemoteError(RuntimeError(r["error"]))
                 delay = min(delay, r.get("retry_after", 0.05))
-            time.sleep(delay)
+            backoff.sleep(floor=delay)
         raise RpcError("placement-group lease timed out")
 
     def _lease_cache_key(self, spec: dict):
@@ -960,6 +970,30 @@ class ClusterClient:
         worker_addr = tuple(grant["worker_addr"])
         kill = False
         try:
+            if _chaos.ACTIVE is not None:
+                for _f in _chaos.fire(
+                    "cluster.push",
+                    kinds=(_chaos.KILL_WORKER, _chaos.DROP_RPC,
+                           _chaos.DELAY_RPC),
+                    desc=payload.get("desc", "task"),
+                    node_id=grant.get("node_id", ""),
+                ):
+                    if _f.kind == _chaos.KILL_WORKER:
+                        # kill the granted worker out from under the push:
+                        # the connection error below is exactly what a real
+                        # worker death mid-lease looks like to the driver
+                        self._release_lease_now(
+                            grant,
+                            tuple(grant.get("node_addr")
+                                  or self.local_daemon_addr),
+                            kill=True,
+                        )
+                    elif _f.kind == _chaos.DROP_RPC:
+                        raise RpcError(
+                            f"chaos: dropped push of {payload.get('desc')!r}"
+                        )
+                    elif _f.kind == _chaos.DELAY_RPC:
+                        time.sleep(_f.delay_s)
             w = self.pool.get(worker_addr)
             r = w.call("push_task", payload, timeout=3600)
             if not r.get("ok"):
@@ -1137,6 +1171,7 @@ class ClusterClient:
         if meta is not None:
             return meta["worker_addr"]
         deadline = time.monotonic() + wait_restart
+        backoff = ExponentialBackoff(base=0.05, cap=0.5)
         while time.monotonic() < deadline:
             info = self.gcs.call("get_actor", {"actor_id": actor_id})
             if info is None:
@@ -1145,7 +1180,7 @@ class ClusterClient:
                 return tuple(info["worker_addr"])
             if info["state"] == "DEAD":
                 raise ActorDiedError(f"actor {actor_id.hex()} is dead")
-            time.sleep(0.1)
+            backoff.sleep()
         raise ActorDiedError(f"actor {actor_id.hex()} not available (restarting?)")
 
     def submit_actor_task(
@@ -1173,6 +1208,7 @@ class ClusterClient:
 
     def _drive_actor_task(self, actor_id: bytes, payload: dict,
                           arg_refs: Sequence[bytes] = ()) -> None:
+        backoff = ExponentialBackoff(base=0.2, cap=1.0)
         try:
             for attempt in range(2):
                 try:
@@ -1188,7 +1224,7 @@ class ClusterClient:
                     self._forget_actor_addr(actor_id)
                     if attempt == 1:
                         break
-                    time.sleep(0.2)
+                    backoff.sleep()
                 except ActorDiedError as e:
                     self._store_actor_error(payload, e)
                     return
@@ -1342,14 +1378,19 @@ class ClusterClient:
         nodes = {n["node_id"]: tuple(n["addr"]) for n in self.gcs.call("list_nodes", None)}
         for i, b in enumerate(info["bundles"]):
             addr = nodes[b["node_id"]]
-            for attempt in range(6):
+            # jittered backoff up to the remaining deadline: under load the
+            # daemon's availability can trail the GCS view by several
+            # heartbeats (freed resources still in flight), and the old
+            # fixed 6x0.2s budget gave up inside that window
+            backoff = ExponentialBackoff(base=0.1, cap=1.0)
+            while True:
                 r = self.pool.get(addr).call(
                     "reserve_pg_bundle",
                     {"pg_id": pg_id, "bundle_index": i, "resources": b["resources"]},
                 )
-                if r.get("ok"):
+                if r.get("ok") or time.monotonic() >= deadline:
                     break
-                time.sleep(0.2)
+                backoff.sleep()
             if not r.get("ok"):
                 raise RuntimeError(
                     f"bundle {i} reservation failed on {b['node_id']}: {r}"

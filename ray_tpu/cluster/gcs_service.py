@@ -39,6 +39,7 @@ class NodeEntry:
     available: dict  # name -> available (as last reported)
     labels: dict = field(default_factory=dict)
     alive: bool = True
+    draining: bool = False  # graceful drain: alive but not schedulable
     last_hb: float = field(default_factory=time.monotonic)
     pending: list = field(default_factory=list)  # queued lease specs
 
@@ -206,6 +207,9 @@ class GcsService:
             if "available" in payload:
                 e.available = dict(payload["available"])
             e.pending = list(payload.get("pending", ()))
+            if payload.get("draining") and not e.draining:
+                e.draining = True
+                self._emit("node_draining", {"node_id": e.node_id})
         return {"ok": True}
 
     def rpc_cluster_demand(self, payload, peer):
@@ -247,6 +251,7 @@ class GcsService:
                     "available": dict(e.available),
                     "labels": dict(e.labels),
                     "alive": e.alive,
+                    "draining": e.draining,
                 }
                 for e in self._nodes.values()
             ]
@@ -308,7 +313,7 @@ class GcsService:
             ]
             nodes = [
                 (e.node_id, e.addr, dict(e.available))
-                for e in self._nodes.values() if e.alive
+                for e in self._nodes.values() if e.alive and not e.draining
             ]
         for a in todo:
             res = a.lease_resources
@@ -359,6 +364,59 @@ class GcsService:
                     break
                 except (RpcError, RemoteError):
                     continue
+
+    def pg_reserve_sweep(self, pool) -> None:
+        """Reserve re-placed placement-group bundles on their new nodes
+        (reference: the raylet-side two-phase commit the reference replays
+        on reschedule). The daemon's reserve is idempotent by
+        (pg_id, bundle_index), so surviving bundles are no-ops."""
+        from ray_tpu.cluster.rpc import RemoteError, RpcError
+
+        with self._lock:
+            # snapshot bundles AND the placement generation under the
+            # lock: the reserve RPCs below run lock-free, and a node
+            # death mid-sweep re-places these same bundle dicts
+            todo = [
+                (pg, pg.get("reserve_gen", 0),
+                 [(dict(b["resources"]), b.get("node_id"))
+                  for b in pg["bundles"]])
+                for pg in self._pgs.values()
+                if pg.get("needs_reserve") and pg["state"] == "CREATED"
+            ]
+            nodes = {
+                e.node_id: e.addr for e in self._nodes.values() if e.alive
+            }
+        for pg, gen, bundles in todo:
+            all_ok = True
+            for i, (res, node_id) in enumerate(bundles):
+                addr = nodes.get(node_id)
+                if addr is None:
+                    all_ok = False
+                    continue
+                try:
+                    r = pool.get(tuple(addr)).call(
+                        "reserve_pg_bundle",
+                        {"pg_id": pg["pg_id"], "bundle_index": i,
+                         "resources": res},
+                        timeout=10,
+                    )
+                    if not r.get("ok"):
+                        all_ok = False
+                except (RpcError, RemoteError):
+                    all_ok = False
+            if all_ok:
+                with self._lock:
+                    # clear ONLY if no re-placement raced the RPCs: a
+                    # fresh needs_reserve (bumped generation) must survive
+                    # or its bundles stay unleasable forever
+                    if pg.get("reserve_gen", 0) == gen \
+                            and pg["state"] == "CREATED":
+                        pg["needs_reserve"] = False
+                logger.info(
+                    "pg %s re-reserved after reschedule",
+                    pg["pg_id"].hex()[:12] if isinstance(pg["pg_id"], bytes)
+                    else pg["pg_id"],
+                )
 
     # -- kv -------------------------------------------------------------------
 
@@ -559,7 +617,7 @@ class GcsService:
             return self._pg_info(pg)
 
     def _try_place_pg(self, pg: dict) -> None:
-        alive = [e for e in self._nodes.values() if e.alive]
+        alive = [e for e in self._nodes.values() if e.alive and not e.draining]
         if not alive:
             return
         strategy = pg["strategy"]
@@ -631,6 +689,16 @@ class GcsService:
         if all(a is not None for a in assignment):
             for b, nid in zip(pg["bundles"], assignment):
                 b["node_id"] = nid
+            if pg["state"] == "RESCHEDULING":
+                # node-death re-placement: the CLIENT reserved the original
+                # bundles at create time, but nobody is waiting to reserve
+                # the replacements — the pg_reserve_sweep must do it, or
+                # every lease against the re-placed bundle fails with "no
+                # bundle reserved here" forever (chaos-found bug). The
+                # generation counter lets the sweep detect a re-placement
+                # that raced its (lock-free) reserve RPCs.
+                pg["needs_reserve"] = True
+                pg["reserve_gen"] = pg.get("reserve_gen", 0) + 1
             pg["state"] = "CREATED"
             # deduct from the authoritative view so back-to-back PGs don't
             # double-book before the next heartbeat refreshes availability
@@ -703,6 +771,7 @@ class GcsServer:
                 try:
                     self.service.health_sweep()
                     self.service.restart_sweep(pool)
+                    self.service.pg_reserve_sweep(pool)
                     self.service.persist_if_dirty()
                 except Exception:
                     logger.exception("health sweep failed")

@@ -33,6 +33,7 @@ import time
 import uuid
 from typing import Any, Optional
 
+from ray_tpu.chaos import harness as _chaos
 from ray_tpu.cluster.rpc import (
     ClientPool,
     ReconnectingRpcClient,
@@ -490,6 +491,9 @@ class NodeDaemon:
             ),
         )
         self._stop = threading.Event()
+        # graceful drain (SIGTERM / maintenance event): stop admitting
+        # leases, let in-flight work finish, deregister from the GCS
+        self._draining = False
         self.addr: Optional[tuple] = None
 
     # -- lifecycle ------------------------------------------------------------
@@ -624,6 +628,59 @@ class NodeDaemon:
             self._oom_kills += 1
             w.kill()
 
+    def drain(self, timeout_s: float = 30.0) -> dict:
+        """Graceful drain (SIGTERM / maintenance event): stop admitting
+        leases, wait for in-flight leases to finish (bounded), deregister
+        from the GCS, then stop. In-flight work either completes here or
+        — if the timeout expires — dies with the node and re-homes via
+        the caller's normal retry path (max_retries / actor restart)."""
+        self._draining = True
+        logger.warning("node %s draining (timeout %.1fs)", self.node_id, timeout_s)
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._res_lock:
+                inflight = len(self._leases)
+            if inflight == 0 and self._grant_queue.qsize() == 0 \
+                    and self._num_queued == 0:
+                break
+            time.sleep(0.1)
+        with self._res_lock:
+            leaked = len(self._leases)
+        if leaked:
+            logger.warning(
+                "node %s drain timeout with %d leases in flight; "
+                "their tasks will re-home via retry", self.node_id, leaked,
+            )
+        self.stop()  # stop() deregisters via drain_node before teardown
+        return {"ok": True, "leases_killed": leaked}
+
+    def rpc_drain(self, payload, peer):
+        """Remote maintenance trigger (the autoscaler's scale-down /
+        preemption-notice path); drains on a background thread so the
+        RPC answers immediately."""
+        timeout_s = float((payload or {}).get("timeout_s", 30.0))
+        threading.Thread(
+            target=self.drain, args=(timeout_s,), name="node-drain", daemon=True
+        ).start()
+        return {"ok": True, "draining": True}
+
+    def rpc_chaos_kill_worker(self, payload, peer):
+        """Fault-injection surface (chaos.runner): SIGKILL the newest
+        leased worker — the deterministic stand-in for a worker OOM/crash
+        mid-task."""
+        with self._res_lock:
+            leased = sorted(
+                (ls for ls in self._leases.values()
+                 if ls.get("worker") is not None and ls["worker"].alive()),
+                key=lambda ls: -ls.get("t", 0.0),
+            )
+        if not leased:
+            return {"ok": False, "error": "no leased worker to kill"}
+        w = leased[0]["worker"]
+        logger.warning("chaos: killing worker %s (pid %s)", w.worker_id, w.proc.pid)
+        w.kill()
+        return {"ok": True, "worker_id": w.worker_id}
+
     def stop(self) -> None:
         self._stop.set()
         with self._wlock:
@@ -646,13 +703,25 @@ class NodeDaemon:
                 self._reap_idle_workers()
             except Exception:
                 pass
+            if _chaos.ACTIVE is not None and any(
+                f.kind == _chaos.STALL_HEARTBEAT
+                for f in _chaos.fire("node.heartbeat",
+                                     kinds=(_chaos.STALL_HEARTBEAT,),
+                                     node_id=self.node_id)
+            ):
+                # partition simulation: the node is alive and working but
+                # its heartbeats never reach the GCS — the exact shape of
+                # a network partition / GC pause the _mark_dead sweeper
+                # turns into a (possibly premature) death verdict
+                continue
             try:
                 with self._res_lock:
                     avail = dict(self.available)
                 r = self.gcs.call(
                     "heartbeat",
                     {"node_id": self.node_id, "available": avail,
-                     "pending": self._pending_specs},
+                     "pending": self._pending_specs,
+                     "draining": self._draining},
                     timeout=5,
                 )
                 if not r.get("ok") and r.get("reregister"):
@@ -858,6 +927,13 @@ class NodeDaemon:
         thundering herd that serializes the whole cluster on the GCS."""
         res = payload.get("resources", {})
         pg_key = None
+        if self._draining and (
+            payload.get("pg_id") is not None or payload.get("pinned")
+        ):
+            # placement here is mandatory but the node is leaving: fail
+            # fast so the caller re-resolves instead of queueing into a
+            # node that will never grant again
+            return {"error": f"node {self.node_id} is draining"}
         if payload.get("pg_id") is not None:
             pg_key = (payload["pg_id"], payload.get("bundle_index", 0))
             with self._res_lock:
@@ -866,7 +942,8 @@ class NodeDaemon:
                     return {"error": f"no bundle reserved here for {pg_key}"}
                 acquired = self._try_acquire(res, bundle_pool)
         else:
-            acquired = self._try_acquire(res)
+            # a draining node stops admitting new leases entirely
+            acquired = (not self._draining) and self._try_acquire(res)
         if acquired:
             try:
                 w = self._lease_worker(
@@ -913,7 +990,8 @@ class NodeDaemon:
             nodes = []
         candidates = [
             n for n in nodes
-            if n["alive"] and n["node_id"] not in exclude
+            if n["alive"] and not n.get("draining")
+            and n["node_id"] not in exclude
             and all(n["available"].get(k, 0.0) >= v for k, v in res.items())
         ]
         if candidates:
@@ -933,6 +1011,12 @@ class NodeDaemon:
             return {"spillback": pick["addr"],
                     "spillback_node": pick["node_id"],
                     "node_id": self.node_id}
+        if self._draining:
+            # never queue on a draining node: tell the client to retry
+            # (somewhere else, or here again once replacement capacity
+            # registers) instead of parking until the drain kills us
+            return {"retry_after": 0.25, "node_id": self.node_id,
+                    "draining": True}
         return None  # saturated cluster: queue here
 
     async def rpc_request_worker_lease(self, payload, peer):
@@ -1218,6 +1302,7 @@ def main() -> None:
         if kv:
             k, v = kv.split("=", 1)
             worker_env[k] = v
+    _chaos.install_from_env()  # adopt a driver-propagated fault schedule
     daemon = NodeDaemon(
         (host, int(port)), resources, node_id=args.node_id, worker_env=worker_env,
         object_capacity_bytes=args.object_capacity,
@@ -1227,6 +1312,22 @@ def main() -> None:
     )
     addr = daemon.start()
     print(f"NODE_ADDRESS {addr[0]}:{addr[1]} {daemon.node_id}", flush=True)
+
+    import signal
+
+    def _on_sigterm(signum, frame):
+        # graceful-drain contract: stop admission, finish in-flight work,
+        # deregister from the GCS, exit — run off the signal frame so
+        # blocking waits are legal
+        def _run():
+            daemon.drain(timeout_s=float(
+                os.environ.get("RAY_TPU_DRAIN_TIMEOUT_S", "30")
+            ))
+            os._exit(0)
+
+        threading.Thread(target=_run, name="sigterm-drain", daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _on_sigterm)
     try:
         threading.Event().wait()
     except KeyboardInterrupt:

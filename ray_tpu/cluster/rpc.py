@@ -29,6 +29,8 @@ import threading
 import time
 from typing import Any, Callable, Optional
 
+from ray_tpu.chaos import harness as _chaos
+from ray_tpu.util.backoff import ExponentialBackoff
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("ray_tpu.cluster.rpc")
@@ -155,7 +157,17 @@ class RpcServer:
                 if n > MAX_FRAME:
                     raise RpcError(f"frame too large: {n}")
                 body = await reader.readexactly(n)
-                msg_id, method, payload = pickle.loads(body)
+                try:
+                    msg_id, method, payload = pickle.loads(body)
+                except Exception as e:  # noqa: BLE001 — torn/corrupted frame
+                    # a corrupted frame (bit flip, truncated writer) poisons
+                    # the whole stream (framing offsets are gone): drop the
+                    # CONNECTION, not the server — the peer re-dials
+                    logger.warning(
+                        "dropping connection from %s: undecodable frame (%r)",
+                        peer, e,
+                    )
+                    break
                 # concurrent dispatch: a slow handler must not block the
                 # connection (the reference runs handlers on thread pools)
                 asyncio.ensure_future(
@@ -221,9 +233,20 @@ class RpcClient:
 
     def connect(self, retries: int = 0, delay: float = 0.1) -> "RpcClient":
         last: Optional[BaseException] = None
+        # cap never below the caller's base delay: connect(delay=3.0) is
+        # a legal request for slow dials, not a constructor error
+        backoff = ExponentialBackoff(base=delay, cap=max(2.0, delay))
         for _ in range(retries + 1):
             try:
                 s = socket.create_connection(self.addr, timeout=self._timeout)
+                # back to BLOCKING mode: create_connection's timeout must
+                # not linger on the connected socket — a timeout-mode
+                # sendall can give up MID-FRAME (bytes written:
+                # indeterminate) and corrupt the stream for every pending
+                # call. Sends block (python path; the native writer has
+                # its own bounded poll); the read loop bounds itself with
+                # select() without touching socket-wide state.
+                s.settimeout(None)
                 s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 self._sock = s
                 self._native = None
@@ -241,7 +264,7 @@ class RpcClient:
                 return self
             except OSError as e:
                 last = e
-                time.sleep(delay)
+                backoff.sleep()
         raise RpcError(f"cannot connect to {self.addr}: {last}")
 
     def close(self) -> None:
@@ -268,12 +291,34 @@ class RpcClient:
             raise RpcError("not connected")
         if self._dead:
             raise RpcError(f"connection to {self.addr} is dead")
+        if _chaos.ACTIVE is not None:
+            # fault injection BEFORE the pending-slot registration so a
+            # dropped call leaves no orphaned waiter
+            for _f in _chaos.fire(
+                "rpc.call", kinds=(_chaos.DROP_RPC, _chaos.DELAY_RPC),
+                method=method, peer=f"{self.addr[0]}:{self.addr[1]}",
+            ):
+                if _f.kind == _chaos.DELAY_RPC:
+                    time.sleep(_f.delay_s)
+                elif _f.kind == _chaos.DROP_RPC:
+                    raise RpcError(
+                        f"chaos: dropped rpc {method!r} to {self.addr}"
+                    )
         with self._plock:
             msg_id = self._next_id
             self._next_id += 1
             ev: tuple[threading.Event, list] = (threading.Event(), [])
             self._pending[msg_id] = ev
         body = _dump((msg_id, method, payload))
+        if _chaos.ACTIVE is not None:
+            for _f in _chaos.fire(
+                "rpc.frame", kinds=(_chaos.CORRUPT_FRAME,),
+                method=method, peer=f"{self.addr[0]}:{self.addr[1]}",
+            ):
+                if _f.kind == _chaos.CORRUPT_FRAME:
+                    # the peer reads a full frame, fails to decode it, and
+                    # drops the connection — the realistic torn-wire mode
+                    body = _chaos.corrupt_frame(body)
         try:
             with self._wlock:
                 native = getattr(self, "_native", None)
@@ -307,7 +352,6 @@ class RpcClient:
     def _read_loop(self) -> None:
         sock = self._sock
         assert sock is not None
-        sock.settimeout(None)
         native = None
         try:
             from ray_tpu.native import framing as _framing
@@ -326,6 +370,32 @@ class RpcClient:
         except Exception:  # noqa: BLE001 — build/toolchain missing: Python path
             native = None
         buf = b""
+
+        def _recv_more(mid_frame: bool) -> bytes:
+            """One bounded recv via select() readability polls (NOT
+            settimeout — timeout mode applies socket-wide and would make
+            the writer thread's sendall fail spuriously mid-frame on any
+            >0.25s send). Idle polls re-check _closed; a peer that stalls
+            MID-FRAME past the client timeout reads as connection loss
+            instead of wedging this thread (and every caller's pending
+            slot) forever."""
+            import select
+
+            stall_deadline = time.monotonic() + self._timeout
+            while not self._closed:
+                readable, _, _ = select.select([sock], [], [], 0.25)
+                if not readable:
+                    if mid_frame and time.monotonic() >= stall_deadline:
+                        raise ConnectionError(
+                            f"peer stalled mid-frame > {self._timeout}s"
+                        )
+                    continue
+                chunk = sock.recv(1 << 20)
+                if not chunk:
+                    raise ConnectionError("peer closed")
+                return chunk
+            raise ConnectionError("client closed")
+
         try:
             while not self._closed:
                 if native is not None:
@@ -334,16 +404,10 @@ class RpcClient:
                         raise ConnectionError("peer closed")
                 else:
                     while len(buf) < _LEN.size:
-                        chunk = sock.recv(1 << 20)
-                        if not chunk:
-                            raise ConnectionError("peer closed")
-                        buf += chunk
+                        buf += _recv_more(mid_frame=bool(buf))
                     (n,) = _LEN.unpack(buf[: _LEN.size])
                     while len(buf) < _LEN.size + n:
-                        chunk = sock.recv(1 << 20)
-                        if not chunk:
-                            raise ConnectionError("peer closed")
-                        buf += chunk
+                        buf += _recv_more(mid_frame=True)
                     body = buf[_LEN.size : _LEN.size + n]
                     buf = buf[_LEN.size + n :]
                 msg_id, ok, result = pickle.loads(body)
@@ -371,10 +435,15 @@ class ReconnectingRpcClient:
     reconnect to a Redis-restored GCS, gcs_redis_failure_detector.cc)."""
 
     def __init__(self, host: str, port: int, timeout: float = 30.0,
-                 retries: int = 20):
+                 retries: int = 20, redial_attempts: int = 3):
         self.addr = (host, port)
         self._timeout = timeout
         self._retries = retries
+        # dead-peer calls get up to this many fresh-dial retries (each
+        # dial itself retries `retries` times) with jittered backoff —
+        # a GCS that takes a few seconds to restart no longer fails the
+        # caller on the single old immediate retry
+        self._redial_attempts = max(1, int(redial_attempts))
         self._lock = threading.Lock()
         self._client: Optional[RpcClient] = None
         self._closed = False
@@ -401,16 +470,24 @@ class ReconnectingRpcClient:
 
     def call(self, method: str, payload: Any = None,
              timeout: Optional[float] = None) -> Any:
-        c = self._get()
-        try:
-            return c.call(method, payload, timeout)
-        except RpcError:
-            if c.connected:
-                # plain timeout on a live connection: the request may still
-                # execute — resending would make mutations at-least-once
-                raise
-            # dead peer (e.g. restarted GCS): one retry on a fresh dial
-            return self._get().call(method, payload, timeout)
+        backoff = None
+        for attempt in range(self._redial_attempts + 1):
+            c = self._get()
+            try:
+                return c.call(method, payload, timeout)
+            except RpcError:
+                if c.connected:
+                    # plain timeout on a live connection: the request may
+                    # still execute — resending would make mutations
+                    # at-least-once
+                    raise
+                # dead peer (e.g. restarted GCS): bounded fresh-dial
+                # retries with jittered backoff (capped), not one shot
+                if attempt >= self._redial_attempts:
+                    raise
+                if backoff is None:
+                    backoff = ExponentialBackoff(base=0.05, cap=1.0)
+                backoff.sleep()
 
     def close(self) -> None:
         with self._lock:

@@ -379,6 +379,9 @@ def main() -> None:
     p.add_argument("--gcs", default=None)
     args = p.parse_args()
     _pin_jax_platform()
+    from ray_tpu.chaos import harness as _chaos
+
+    _chaos.install_from_env()  # adopt a driver-propagated fault schedule
     host, port = args.daemon.rsplit(":", 1)
     gcs = None
     if args.gcs:

@@ -19,6 +19,7 @@ from typing import TYPE_CHECKING, Optional
 
 import cloudpickle
 
+from ray_tpu.chaos import harness as _chaos
 from ray_tpu.core import errors
 from ray_tpu.core.task import TaskSpec
 from ray_tpu.utils.logging import get_logger
@@ -308,6 +309,15 @@ class ProcessPool:
         worker = self._lease()
         tid = spec.task_id.binary()
         self._running[tid] = worker
+        if _chaos.ACTIVE is not None:
+            for _f in _chaos.fire("process_pool.task",
+                                  kinds=(_chaos.KILL_WORKER,),
+                                  desc=spec.describe()):
+                if _f.kind == _chaos.KILL_WORKER:
+                    # worker dies out from under the task: the pipe EOF
+                    # below surfaces as WorkerCrashedError and the
+                    # scheduler's max_retries path re-runs the task
+                    worker.kill()
         try:
             try:
                 try:

@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ray_tpu.chaos import harness as _chaos
 from ray_tpu.llm.kv_cache import (
     BlockAllocator,
     NoFreeBlocksError,
@@ -203,14 +204,8 @@ class LLMEngine:
             else llama.init_params(c.model, jax.random.key(seed))
         )
         self.allocator = BlockAllocator(c.num_blocks, c.block_size)
-        self.cache = init_cache(
-            c.model, c.num_blocks * c.block_size, dtype=c.cache_dtype,
-            trash_slots=c.block_size,
-        )
         self.mesh = None
         if c.mesh_spec is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
             from ray_tpu.parallel.mesh import make_mesh
             from ray_tpu.parallel.sharding import default_rules, tree_shardings
 
@@ -225,11 +220,7 @@ class LLMEngine:
                 self.params,
                 tree_shardings(self.mesh, rules, llama.logical_axes(c.model)),
             )
-            # cache [L, kv_heads, slots, hd]: heads across tp
-            kv_sharding = NamedSharding(self.mesh, P(None, "tp", None, None))
-            self.cache = jax.tree.map(
-                lambda x: jax.device_put(x, kv_sharding), self.cache
-            )
+        self.cache = self._init_kv_cache()
         self.waiting: deque[Request] = deque()
         self.running: list[Request] = []
         self.requests: dict[str, Request] = {}  # unfinished only
@@ -289,6 +280,24 @@ class LLMEngine:
 
             self.drafter = c.spec.build_drafter(c.model)
             self.spec_stats = SpecStats()
+
+    def _init_kv_cache(self):
+        """Fresh paged KV cache with the engine's sharding (also the
+        crash-recovery rebuild path: recover(rebuild_kv=True))."""
+        c = self.config
+        cache = init_cache(
+            c.model, c.num_blocks * c.block_size, dtype=c.cache_dtype,
+            trash_slots=c.block_size,
+        )
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            # cache [L, kv_heads, slots, hd]: heads across tp
+            kv_sharding = NamedSharding(self.mesh, P(None, "tp", None, None))
+            cache = jax.tree.map(
+                lambda x: jax.device_put(x, kv_sharding), cache
+            )
+        return cache
 
     def _decode_chunk_fn(self, n_steps: int, sample_mode: str = "full"):
         c = self.config
@@ -519,6 +528,24 @@ class LLMEngine:
         in one batch with a single host sync — per-request syncing cost
         ~150 ms/prefill on the tunneled device (round-5 profile), ~5 s
         of a 32-request benchmark."""
+        if _chaos.ACTIVE is not None:
+            for _f in _chaos.fire(
+                "llm.engine.step",
+                kinds=(_chaos.PREEMPT_ENGINE, _chaos.KILL_WORKER,
+                       _chaos.DELAY_RPC),
+                running=len(self.running), waiting=len(self.waiting),
+            ):
+                if _f.kind in (_chaos.PREEMPT_ENGINE, _chaos.KILL_WORKER):
+                    # engine dies before mutating this round's state — the
+                    # owner (e.g. openai_api._EngineRunner) recovers via
+                    # recover() and re-enqueues in-flight requests
+                    raise _chaos.EnginePreempted(
+                        "chaos: engine preempted mid-step"
+                    )
+                if _f.kind == _chaos.DELAY_RPC:
+                    # deterministic engine slowdown: overload tests build
+                    # real queue depth without racing wall-clock
+                    time.sleep(_f.delay_s)
         if self.waiting and len(self.running) < self.config.max_num_seqs:
             admitted: list = []  # (req, last-token logits [1, V]) pairs
             while self.waiting and len(self.running) < self.config.max_num_seqs:
@@ -549,6 +576,67 @@ class LLMEngine:
         if self.running:
             return self._decode_step()
         return []
+
+    def recover(self, *, rebuild_kv: bool = False) -> list[str]:
+        """Crash/preemption recovery: push every RUNNING request back to
+        the head of the waiting queue with its generated prefix intact.
+
+        Finished-prefix safety falls out of the preemption-recompute
+        contract _preempt_one already honors: re-admission prefills
+        ``prompt + output_token_ids``, so nothing generated is lost and
+        nothing re-emits (callers see only tokens appended past the
+        prefix). ``rebuild_kv=True`` additionally discards the allocator
+        and KV cache (a crash of unknown provenance may have torn them);
+        the prefix cache dies with them, correctness doesn't.
+
+        Returns the re-enqueued request ids (post-mortem / logging)."""
+        now = time.time()
+        victims = sorted(self.running, key=lambda r: r.arrival, reverse=True)
+        self.running.clear()
+        # orphan sweep: a crash INSIDE admission (after waiting.popleft,
+        # before running.append) leaves a live request in neither deque —
+        # without this it would never be stepped again and its caller
+        # would hang forever
+        queued = {r.request_id for r in victims} | {
+            r.request_id for r in self.waiting
+        }
+        for r in self.requests.values():
+            if (r.request_id not in queued
+                    and r.status in (RequestStatus.WAITING,
+                                     RequestStatus.RUNNING)):
+                victims.append(r)
+        if rebuild_kv:
+            c = self.config
+            self.allocator = BlockAllocator(c.num_blocks, c.block_size)
+            self.cache = self._init_kv_cache()
+            for r in victims:
+                r.seq = None  # blocks died with the old allocator
+        moved = []
+        for r in victims:
+            if r.seq is not None:
+                try:
+                    r.seq.release()
+                except Exception:  # noqa: BLE001 — torn allocator state
+                    pass
+            r.seq = None
+            r.status = RequestStatus.WAITING
+            r.num_preemptions += 1
+            self.num_preemptions += 1
+            r.t_queue_start = now
+            r.t_span_cursor = None
+            self.waiting.appendleft(r)  # reversed-arrival: oldest ends up first
+            if self.drafter is not None:
+                self.drafter.release(r.request_id)
+            self._obs_span(r, "engine.recover", now, now,
+                           {"rebuild_kv": rebuild_kv,
+                            "output_tokens": len(r.output_token_ids)})
+            moved.append(r.request_id)
+        if moved:
+            logger.warning(
+                "engine recovered: re-enqueued %d in-flight request(s)%s",
+                len(moved), " with fresh KV cache" if rebuild_kv else "",
+            )
+        return moved
 
     def generate(
         self,

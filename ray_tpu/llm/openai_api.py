@@ -79,10 +79,32 @@ def default_chat_template(messages: list) -> str:
 
 
 class _EngineRunner:
-    def __init__(self, engine: LLMEngine):
+    """Continuous-batching loop + per-request output queues + crash
+    recovery.
+
+    Delivery is gated by a per-request ``delivered`` counter over the
+    request's FULL output prefix (not the engine's per-round
+    new_token_ids): after a crash the engine re-enqueues in-flight
+    requests and recomputes their prefix (LLMEngine.recover), so the
+    completion id stays idempotent — consumers see each output position
+    exactly once, never a lost or duplicated token, whatever the engine
+    died and recovered underneath them."""
+
+    # recovery budget: more than MAX_RECOVERIES engine deaths inside
+    # RECOVERY_WINDOW_S is a crash loop, not a preemption — fail loudly
+    MAX_RECOVERIES = 3
+    RECOVERY_WINDOW_S = 30.0
+
+    def __init__(self, engine: LLMEngine, engine_factory=None):
         self.engine = engine
+        self._engine_factory = engine_factory  # full-rebuild fallback
         self.lock = threading.Lock()
         self._queues: dict[str, queue.Queue] = {}
+        # rid -> {"prompt_ids", "sp", "trace", "delivered"}: enough to
+        # re-create the request on a fresh engine AND to dedupe delivery
+        self._inflight: dict[str, dict] = {}
+        self._recoveries: list[float] = []
+        self.num_recoveries = 0
         self._wake = threading.Event()
         self._stop = False
         self._dead: Optional[BaseException] = None
@@ -110,6 +132,14 @@ class _EngineRunner:
                 prompt_ids, sp, request_id=request_id, trace=trace
             )
             self._queues[rid] = q
+            # "tokens" holds the DELIVERED output prefix (not just a
+            # count): the full-rebuild recovery rung seeds the fresh
+            # engine's request with it, so even unseeded sampling can
+            # never splice two different continuations
+            self._inflight[rid] = {
+                "prompt_ids": list(prompt_ids), "sp": sp, "trace": trace,
+                "tokens": [],
+            }
         self._wake.set()
         return rid, q
 
@@ -117,8 +147,28 @@ class _EngineRunner:
         with self.lock:
             self.engine.abort_request(rid)
             q = self._queues.pop(rid, None)
+            self._inflight.pop(rid, None)
         if q is not None:
             q.put(None)
+
+    def _deliver(self, out: RequestOutput) -> None:
+        """Queue-put with idempotent delivery: only output positions past
+        the per-request delivered watermark ship."""
+        import dataclasses as _dc
+
+        q = self._queues.get(out.request_id)
+        rec = self._inflight.get(out.request_id)
+        if rec is not None:
+            new = list(out.output_token_ids[len(rec["tokens"]):])
+            rec["tokens"].extend(new)
+            out = _dc.replace(out, new_token_ids=new)
+        if q is None:
+            return
+        if out.new_token_ids or out.finished:
+            q.put(out)
+        if out.finished:
+            self._queues.pop(out.request_id, None)
+            self._inflight.pop(out.request_id, None)
 
     def _loop(self) -> None:
         while not self._stop:
@@ -132,12 +182,10 @@ class _EngineRunner:
                 with self.lock:
                     outputs = self.engine.step()
                     for out in outputs:
-                        q = self._queues.get(out.request_id)
-                        if q is not None:
-                            q.put(out)
-                            if out.finished:
-                                del self._queues[out.request_id]
+                        self._deliver(out)
             except BaseException as e:  # a wedged step must not hang callers
+                if not self._stop and self._try_recover(e):
+                    continue
                 logger.exception(
                     "engine loop failed; failing all in-flight requests"
                 )
@@ -145,9 +193,82 @@ class _EngineRunner:
                 with self.lock:
                     queues = list(self._queues.values())
                     self._queues.clear()
+                    self._inflight.clear()
                 for q in queues:
                     q.put(e)
                 return
+
+    def _try_recover(self, exc: BaseException) -> bool:
+        """Engine crash/preemption recovery ladder: (1) requeue in-flight
+        requests on the surviving engine (clean preemption), (2) requeue
+        with a rebuilt KV cache (unknown crash), (3) fresh engine from the
+        factory with every request re-created (engine object torn).
+        Bounded by the recovery budget so a deterministic crash loop still
+        fails fast."""
+        now = time.time()
+        self._recoveries = [
+            t for t in self._recoveries if now - t < self.RECOVERY_WINDOW_S
+        ]
+        if len(self._recoveries) >= self.MAX_RECOVERIES:
+            return False
+        self._recoveries.append(now)
+        self.num_recoveries += 1
+        try:
+            from ray_tpu.chaos.harness import EnginePreempted
+
+            clean = isinstance(exc, EnginePreempted)
+        except Exception:  # noqa: BLE001
+            clean = False
+        t0 = time.time()
+        requeued: Optional[list] = None
+        try:
+            with self.lock:
+                requeued = self.engine.recover(rebuild_kv=not clean)
+        except BaseException:  # noqa: BLE001 — engine object itself is torn
+            logger.exception("engine.recover failed; trying full rebuild")
+            if self._engine_factory is None:
+                return False
+            try:
+                with self.lock:
+                    old = self.engine
+                    self.engine = self._engine_factory()
+                    self.engine.model_tag = old.model_tag
+                    # re-create every in-flight request on the fresh
+                    # engine WITH its delivered prefix restored: admission
+                    # prefills prompt + outputs (the preemption-recompute
+                    # contract), so the continuation extends exactly what
+                    # the consumer already received — not a fresh sample
+                    # spliced at the watermark
+                    for rid, rec in self._inflight.items():
+                        self.engine.add_request(
+                            rec["prompt_ids"], rec["sp"], request_id=rid,
+                            trace=rec["trace"],
+                        )
+                        self.engine.requests[rid].output_token_ids = list(
+                            rec["tokens"]
+                        )
+                    requeued = list(self._inflight)
+            except BaseException:  # noqa: BLE001
+                logger.exception("engine rebuild failed")
+                return False
+        logger.warning(
+            "engine loop recovered from %r (%d request(s) re-enqueued)",
+            exc, len(requeued or ()),
+        )
+        try:
+            from ray_tpu import obs
+
+            obs.get_recorder().record(
+                "engine.runner_recover", t0, time.time(),
+                attrs={"cause": f"{type(exc).__name__}: {exc}"[:200],
+                       "requeued": len(requeued or ()),
+                       "clean_preemption": clean},
+                status="error",
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        self._wake.set()
+        return True
 
     def shutdown(self) -> None:
         self._stop = True
@@ -168,26 +289,71 @@ class LLMConfig:
     tokenizer: Any = None  # encode/decode/eos_token_id; ByteTokenizer default
     params: Any = None     # model weights pytree; random-init if None
     seed: int = 0
+    # admission control / load shedding (llm/admission.py); None = an
+    # unbounded controller that still supports graceful drain
+    admission: Any = None
 
 
 class LLMServer:
     """Serve deployment hosting one engine (reference: VLLMDeployment)."""
 
     def __init__(self, config: LLMConfig):
+        from ray_tpu.llm.admission import AdmissionConfig, AdmissionController
+
         self.config = config
         self.tokenizer = config.tokenizer or ByteTokenizer(
             config.engine.model.vocab_size
         )
         config.engine.eos_token_id = getattr(self.tokenizer, "eos_token_id", 2)
-        self.engine = LLMEngine(config.engine, params=config.params, seed=config.seed)
-        self.engine.model_tag = config.model_id  # SLO histogram label
-        self.runner = _EngineRunner(self.engine)
+        engine = LLMEngine(config.engine, params=config.params, seed=config.seed)
+        engine.model_tag = config.model_id  # SLO histogram label
+
+        def _rebuild_engine():
+            # crash-recovery fallback: fresh engine, same weights/seed
+            return LLMEngine(config.engine, params=config.params,
+                             seed=config.seed)
+
+        self.runner = _EngineRunner(engine, engine_factory=_rebuild_engine)
+        acfg = config.admission
+        if isinstance(acfg, dict):
+            acfg = AdmissionConfig(**acfg)
+        self.admission = AdmissionController(
+            acfg or AdmissionConfig(), model_tag=config.model_id
+        )
+
+    @property
+    def engine(self) -> LLMEngine:
+        # via the runner: crash recovery may have swapped in a rebuilt one
+        return self.runner.engine
 
     def __del__(self):
         try:
             self.runner.shutdown()
         except Exception:
             pass
+
+    def shutdown(self):
+        """Replica graceful-shutdown hook (serve.replica.prepare_shutdown
+        calls this after its own in-flight drain): stop admission, give
+        the engine a short drain, stop the loop."""
+        try:
+            self.drain(timeout_s=5.0)
+        finally:
+            self.runner.shutdown()
+
+    def drain(self, timeout_s: float = 30.0) -> dict:
+        """Maintenance-event drain: new requests get 503 + Retry-After
+        while in-flight requests run to completion (bounded wait)."""
+        self.admission.start_drain()
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            with self.runner.lock:
+                if not self.engine.has_unfinished():
+                    break
+            time.sleep(0.05)
+        with self.runner.lock:
+            left = len(self.engine.waiting) + len(self.engine.running)
+        return {"drained": left == 0, "inflight": left}
 
     # -- request plumbing -----------------------------------------------------
 
@@ -238,7 +404,19 @@ class LLMServer:
     # -- handle-level streaming (token deltas) --------------------------------
 
     async def generate_stream(self, prompt: str, **kwargs):
-        """Async generator of text deltas (serve streaming handles)."""
+        """Async generator of text deltas (serve streaming handles).
+
+        Admission applies here too: a draining/overloaded server must not
+        keep admitting via the streaming side door (that would hold
+        has_unfinished() true and make every drain time out). Streams
+        can't return an error payload, so rejection raises."""
+        rej = self._admission_check()
+        if rej is not None:
+            err = rej["error"]
+            raise RuntimeError(
+                f"admission rejected ({err['code']}): {err['message']}; "
+                f"retry after {err['retry_after']}s"
+            )
         sp = self._sampling_from_body(kwargs)
         ids = self.tokenizer.encode(prompt)
         sent = ""
@@ -288,6 +466,18 @@ class LLMServer:
             return await self.completions(request.json())
         if path.rstrip("/") == "/v1/chat/completions" and method == "POST":
             return await self.chat_completions(request.json())
+        if path.rstrip("/") == "/v1/drain" and method == "POST":
+            # maintenance trigger: stop admission, finish in-flight work.
+            # Off-loop: drain() polls synchronously for up to timeout_s,
+            # and blocking the replica's event loop would freeze the very
+            # in-flight responses the drain is waiting on (plus health
+            # pings — the controller would kill a healthily-draining
+            # replica)
+            body = request.json() or {}
+            timeout_s = float(body.get("timeout_s", 30.0))
+            return await asyncio.get_running_loop().run_in_executor(
+                None, lambda: self.drain(timeout_s=timeout_s)
+            )
         return {"error": {"message": f"no route {method} {path}", "code": 404}}
 
     # -- flight recorder surface ----------------------------------------------
@@ -330,7 +520,19 @@ class LLMServer:
         LLMEngine.stats(), so operators can read draft quality without
         scraping Prometheus."""
         with self.runner.lock:
-            return {"model_id": self.config.model_id, **self.engine.stats()}
+            out = {"model_id": self.config.model_id, **self.engine.stats()}
+        out["admission"] = self.admission.stats()
+        out["engine_recoveries"] = self.runner.num_recoveries
+        return out
+
+    def _admission_check(self) -> Optional[dict]:
+        """Load-shedding decision for one arriving request (None = admit)."""
+        with self.runner.lock:
+            num_waiting = len(self.engine.waiting)
+            num_running = len(self.engine.running)
+        return self.admission.check(
+            num_waiting=num_waiting, num_running=num_running
+        )
 
     def models(self) -> dict:
         return {
@@ -359,6 +561,9 @@ class LLMServer:
         }
 
     async def completions(self, body: dict) -> Any:
+        rej = self._admission_check()
+        if rej is not None:
+            return rej
         try:
             sp = self._sampling_from_body(body)
         except (ValueError, TypeError) as e:
@@ -414,6 +619,9 @@ class LLMServer:
         return payload
 
     async def chat_completions(self, body: dict) -> Any:
+        rej = self._admission_check()
+        if rej is not None:
+            return rej
         try:
             sp = self._sampling_from_body(body)
         except (ValueError, TypeError) as e:

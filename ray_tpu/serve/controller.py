@@ -365,6 +365,49 @@ class ServeController:
 
         threading.Thread(target=_drain, daemon=True).start()
 
+    def kill_replica(self, app_name: str, dep_name: Optional[str] = None,
+                     replica_id: Optional[str] = None) -> Optional[str]:
+        """Fault injection (chaos KILL_REPLICA): crash one replica WITHOUT
+        any bookkeeping — exactly what a preempted replica looks like. The
+        health sweep notices the corpse (ActorDiedError on ping), evicts
+        it, and starts a replacement; routers fail over in the meantime.
+        Returns the killed replica_id, or None if nothing was running."""
+        import ray_tpu
+        from ray_tpu.serve.config import ReplicaState
+
+        with self._lock:
+            app = self._apps.get(app_name)
+            if app is None:
+                return None
+            if dep_name:
+                if dep_name not in app.deployments:
+                    # an unknown deployment name must NOT fall back to
+                    # "kill anything": a chaos test would crash the wrong
+                    # deployment and assert against an unexercised path
+                    return None
+                deps = [app.deployments[dep_name]]
+            else:
+                deps = list(app.deployments.values())
+            victim = None
+            for ds in deps:
+                pool = [r for r in ds.replicas if r.state == ReplicaState.RUNNING] \
+                    or list(ds.replicas)
+                for r in pool:
+                    if replica_id in (None, r.replica_id):
+                        victim = r
+                        break
+                if victim is not None:
+                    break
+        if victim is None:
+            return None
+        logger.warning("chaos: killing replica %s", victim.replica_id)
+        try:
+            ray_tpu.kill(victim.handle)
+        except Exception:
+            logger.exception("chaos replica kill failed")
+            return None
+        return victim.replica_id
+
     def _poll_replicas(self) -> None:
         """Health-check + metrics sweep (outside the lock for the RPCs).
         Fan out all pings first, then collect — one wedged replica must not

@@ -58,26 +58,143 @@ class _RouteSlot:
                 pass
 
 
-class DeploymentResponse:
-    """Future for one unary handle call."""
+def _is_replica_failure(exc: BaseException) -> bool:
+    """Did this call die with the REPLICA (system failure) rather than in
+    user code? Matched by type name so the core-mode errors
+    (ray_tpu.core.errors), the cluster-mode twins (cluster/client.py),
+    and chaos-injected crashes all count, wherever they sit in a
+    TaskError/ClusterTaskError cause chain."""
+    names = {
+        "ActorDiedError", "ActorUnavailableError", "WorkerCrashedError",
+        "ReplicaCrashed",
+    }
+    seen: set = set()
+    stack: list = [exc]
+    while stack:
+        e = stack.pop()
+        if e is None or id(e) in seen:
+            continue
+        seen.add(id(e))
+        if type(e).__name__ in names:
+            return True
+        stack.append(getattr(e, "cause", None))
+        stack.append(e.__cause__)
+    return False
 
-    def __init__(self, router: Router, rid: str, ref, span_info=None):
+
+def _record_failover(app: str, deployment: str, failed_rid: str,
+                     exc: BaseException, attempt: int) -> None:
+    """serve.failover event into the flight recorder: the post-mortem
+    shows which replica died and that the request re-homed."""
+    try:
+        import time
+
+        from ray_tpu.obs import get_recorder
+
+        now = time.time()
+        get_recorder().record(
+            "serve.failover", now, now,
+            attrs={
+                "app": app, "deployment": deployment,
+                "failed_replica": failed_rid, "attempt": attempt,
+                "error": f"{type(exc).__name__}: {exc}"[:200],
+            },
+            status="error",
+        )
+    except Exception:  # noqa: BLE001
+        pass
+
+
+class DeploymentResponse:
+    """Future for one unary handle call.
+
+    When the call carries retry info (unary, retries enabled on the
+    handle), a SYSTEM failure — the replica died or crashed mid-request,
+    not a user exception — re-dispatches onto a healthy replica, with the
+    dead one evicted from the router set. User-code errors and timeouts
+    propagate untouched; in-flight work on a dead replica is assumed
+    idempotent by the caller that left retries on (reference: serve
+    retries actor-death failures at the handle layer)."""
+
+    def __init__(self, router: Router, rid: str, ref, span_info=None,
+                 retry: Optional[tuple] = None):
         import weakref
 
+        self._router = router
+        self._rid = rid
         self._slot = _RouteSlot(router, rid, span_info)
         self._ref = ref
+        self._retry = retry  # (method_name, args, kwargs, max_retries)
+        self._failed: set = set()   # replica ids to avoid on re-dispatch
+        self._attempts = 0          # the budget: ATTEMPTS, not unique rids
         weakref.finalize(self, self._slot.complete, False)
 
     def _complete(self):
         self._slot.complete()
 
+    def _reroute(self) -> None:
+        """Re-dispatch this request excluding every replica it died on.
+        The original call's child TraceContext (span_info[0]) is
+        re-attached around the dispatch so the retried execution's spans
+        land in the same trace — result() may run on a thread with no
+        ambient context at all."""
+        import contextlib
+        import weakref
+
+        from ray_tpu.obs import context as trace_context
+
+        method_name, args, kwargs, _ = self._retry
+        span_info = self._slot._span_info
+        self._slot.complete(record_span=False)
+        ctx = (
+            trace_context.use(span_info[0]) if span_info is not None
+            else contextlib.nullcontext()
+        )
+        with ctx:
+            rid, ref = self._router.dispatch(
+                method_name, args, kwargs, False, exclude=set(self._failed)
+            )
+        self._rid = rid
+        self._ref = ref
+        self._slot = _RouteSlot(self._router, rid, span_info)
+        weakref.finalize(self, self._slot.complete, False)
+
     def result(self, timeout_s: Optional[float] = None) -> Any:
+        import time
+
         import ray_tpu
 
-        try:
-            return ray_tpu.get(self._ref, timeout=timeout_s)
-        finally:
-            self._complete()
+        # ONE overall deadline across failover attempts: the caller's
+        # timeout bounds the call, not each retry individually
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        while True:
+            remaining = (
+                None if deadline is None
+                else max(0.001, deadline - time.monotonic())
+            )
+            try:
+                out = ray_tpu.get(self._ref, timeout=remaining)
+                self._complete()
+                return out
+            except BaseException as e:  # noqa: BLE001 — filtered below
+                # budget counts ATTEMPTS (a set of failed rids would never
+                # grow when the only replica keeps crashing — infinite loop)
+                if (
+                    self._retry is None
+                    or self._attempts >= self._retry[3]
+                    or not _is_replica_failure(e)
+                ):
+                    self._complete()
+                    raise
+                self._attempts += 1
+                failed = self._rid
+                self._failed.add(failed)
+                self._router.report_failure(failed)
+                _record_failover(
+                    self._router._app, self._router._deployment, failed, e,
+                    attempt=self._attempts,
+                )
+                self._reroute()
 
     def __await__(self):
         import asyncio
@@ -179,11 +296,16 @@ class DeploymentHandle:
         app_name: str,
         method_name: Optional[str] = None,
         streaming: bool = False,
+        system_retries: int = 2,
     ):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method_name = method_name
         self._streaming = streaming
+        # failover: how many times a unary call may re-dispatch after a
+        # REPLICA death (user errors never retry). 0 opts a non-idempotent
+        # endpoint out via .options(system_retries=0).
+        self._system_retries = system_retries
 
     # Handles carry no live state — the router is process-local, looked up
     # on each dispatch — so pickling is trivially safe.
@@ -193,10 +315,12 @@ class DeploymentHandle:
             "app_name": self.app_name,
             "_method_name": self._method_name,
             "_streaming": self._streaming,
+            "_system_retries": self._system_retries,
         }
 
     def __setstate__(self, state):
         self.__dict__.update(state)
+        self.__dict__.setdefault("_system_retries", 2)
 
     def _get_router(self) -> Router:
         return _shared_router(self.app_name, self.deployment_name)
@@ -206,6 +330,7 @@ class DeploymentHandle:
         *,
         method_name: Optional[str] = None,
         stream: Optional[bool] = None,
+        system_retries: Optional[int] = None,
         use_new_handle_api: bool = True,  # accepted for reference parity
     ) -> "DeploymentHandle":
         return DeploymentHandle(
@@ -213,6 +338,7 @@ class DeploymentHandle:
             self.app_name,
             method_name if method_name is not None else self._method_name,
             stream if stream is not None else self._streaming,
+            self._system_retries if system_retries is None else system_retries,
         )
 
     def __getattr__(self, name: str):
@@ -249,5 +375,11 @@ class DeploymentHandle:
                 self._method_name, args, kwargs, self._streaming
             )
         if self._streaming:
+            # streaming calls never auto-retry: items may already have
+            # been consumed (not idempotent to replay)
             return DeploymentResponseGenerator(router, rid, ref, span_info)
-        return DeploymentResponse(router, rid, ref, span_info)
+        retry = (
+            (self._method_name, args, kwargs, self._system_retries)
+            if self._system_retries > 0 else None
+        )
+        return DeploymentResponse(router, rid, ref, span_info, retry=retry)

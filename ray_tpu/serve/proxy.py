@@ -127,6 +127,26 @@ class HTTPProxy:
             return web.Response(body=result)
         if isinstance(result, str):
             return web.Response(text=result)
+        if isinstance(result, dict):
+            # OpenAI-style error payloads carry their HTTP status in
+            # error.code; admission rejections additionally carry a
+            # retry_after hint the client reads from the Retry-After
+            # header (429 overload / 503 draining)
+            err = result.get("error")
+            if isinstance(err, dict) and isinstance(err.get("code"), int) \
+                    and 400 <= err["code"] < 600:
+                headers = {}
+                try:
+                    from ray_tpu.llm.admission import retry_after_header
+
+                    ra = retry_after_header(result)
+                    if ra is not None:
+                        headers["Retry-After"] = ra
+                except Exception:  # noqa: BLE001
+                    pass
+                return web.json_response(
+                    result, status=err["code"], headers=headers
+                )
         return web.json_response(result)
 
     def _serve_forever(self) -> None:

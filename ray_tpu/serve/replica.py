@@ -15,6 +15,8 @@ import inspect
 import time
 from typing import Any, Optional
 
+from ray_tpu.chaos import harness as _chaos
+
 
 class Replica:
     """User-code host. Instantiated as an async actor (max_concurrency
@@ -169,12 +171,31 @@ class Replica:
             "method": method_name or "__call__",
         })
 
+    def _chaos_hook(self, method_name: Optional[str]) -> None:
+        """KILL_REPLICA injection: the request dies the way it would if
+        this replica's process/actor crashed mid-call — callers see a
+        system failure (ReplicaCrashed), the router's failover path
+        retries elsewhere, the controller's health sweep replaces us."""
+        if _chaos.ACTIVE is None:
+            return
+        for _f in _chaos.fire(
+            "serve.replica", kinds=(_chaos.KILL_REPLICA,),
+            deployment=self._deployment_name, app=self._app_name,
+            method=method_name or "__call__",
+        ):
+            if _f.kind == _chaos.KILL_REPLICA:
+                raise _chaos.ReplicaCrashed(
+                    f"chaos: replica of {self._app_name}/"
+                    f"{self._deployment_name} crashed mid-request"
+                )
+
     async def handle_request(self, method_name: Optional[str], args, kwargs):
         """Unary request path. _num_ongoing counts queued + executing — the
         autoscaling signal wants in-replica load, not just active slots."""
         self._num_ongoing += 1
         try:
             async with self._request_sem:
+                self._chaos_hook(method_name)
                 with self._request_span(method_name):
                     args, kwargs = await self._resolve_refs(args, kwargs)
                     target = self._resolve_target(method_name)
@@ -203,6 +224,7 @@ class Replica:
         self._num_ongoing += 1
         try:
             async with self._request_sem:  # same cap as the unary path
+                self._chaos_hook(method_name)
                 with self._request_span(method_name):
                     args, kwargs = await self._resolve_refs(args, kwargs)
                     target = self._resolve_target(method_name)

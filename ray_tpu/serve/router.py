@@ -42,6 +42,13 @@ class Router:
         self._version = -1
         self._inflight: dict[str, int] = {}
         self._last_refresh = 0.0
+        # failover suspects: replica_id -> expiry. A reported-dead replica
+        # is avoided for SUSPECT_TTL_S even after a refresh re-adopts the
+        # controller's (not yet updated) set — without routing forever
+        # around a replica that only suffered an injected/transient crash
+        self._suspect: dict[str, float] = {}
+
+    SUSPECT_TTL_S = 2.0
 
     # -- replica-set maintenance ---------------------------------------------
 
@@ -80,17 +87,64 @@ class Router:
             f"{self._app}/{self._deployment} after {timeout}s"
         )
 
+    def report_failure(self, rid: str) -> None:
+        """Failover eviction: a dispatch to this replica hit a system
+        failure (actor died / crashed mid-request). Drop it from the local
+        routing set immediately — the controller's health sweep replaces
+        it, but until that lands no new request should race onto the
+        corpse — and force a controller refresh on the next dispatch."""
+        with self._lock:
+            self._replicas = [r for r in self._replicas if r[0] != rid]
+            # the inflight count is NOT popped: a replica that survives a
+            # transient crash comes back with its real outstanding load
+            # (zeroing it would make p2c prefer the busiest replica);
+            # a genuinely dead replica's counter is pruned by the refresh
+            # once the controller drops it
+            # TTL'd suspicion: the refresh below may re-adopt the
+            # controller's set (its health sweep runs on seconds) with
+            # the corpse still in it — _pick avoids suspects while an
+            # alternative exists, and expiry lets a replica that only
+            # suffered an injected/transient crash come back
+            self._suspect[rid] = time.time() + self.SUSPECT_TTL_S
+            # force the next refresh to re-adopt the controller's set even
+            # at an unchanged version: a crash that didn't kill the actor
+            # (injected fault, transient) leaves the controller's view
+            # intact, and the evicted replica must be able to come back
+            self._last_refresh = 0.0
+            self._version = -1
+
     # -- scheduling -----------------------------------------------------------
 
-    def _pick(self):
+    def _pick(self, exclude: Optional[set] = None):
         """Power-of-two-choices on local in-flight counts; skips replicas at
-        max_ongoing_requests when an alternative exists."""
+        max_ongoing_requests when an alternative exists. ``exclude``
+        (failover retries) removes replicas this request already died on —
+        falling back to them only when nothing else exists."""
+        now = time.time()
         with self._lock:
+            for rid in [r for r, t in self._suspect.items() if t <= now]:
+                del self._suspect[rid]
+            suspects = set(self._suspect)
             replicas = list(self._replicas)
+
+        def _avoiding(pool):
+            # preference ladder: avoid suspects AND this request's failed
+            # replicas; if that empties the pool, drop only the (possibly
+            # stale) suspicion — a replica THIS request died on is a hard
+            # fact and must stay excluded while any alternative exists
+            hard = set(exclude or ())
+            best = [r for r in pool if r[0] not in suspects and r[0] not in hard]
+            if best:
+                return best
+            unfailed = [r for r in pool if r[0] not in hard]
+            return unfailed or pool
+
+        replicas = _avoiding(replicas)
         if not replicas:
             self._wait_for_replicas()
             with self._lock:
                 replicas = list(self._replicas)
+            replicas = _avoiding(replicas)
         if len(replicas) == 1:
             return replicas[0]
         a, b = random.sample(replicas, 2)
@@ -102,7 +156,8 @@ class Router:
         with self._lock:
             return sum(self._inflight.values())
 
-    def dispatch(self, method_name: Optional[str], args, kwargs, streaming: bool):
+    def dispatch(self, method_name: Optional[str], args, kwargs, streaming: bool,
+                 exclude: Optional[set] = None):
         """Route one request; returns (replica_id, ObjectRef-or-generator).
 
         The dispatch wall-clock (refresh + pick + submit — the router's
@@ -120,7 +175,7 @@ class Router:
                 f"deployment {self._app}/{self._deployment}: "
                 f"max_queued_requests={self._max_queued} exceeded"
             )
-        rid, handle, _max_ongoing = self._pick()
+        rid, handle, _max_ongoing = self._pick(exclude)
         with self._lock:
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
         try:

@@ -5,6 +5,7 @@ actors/objects/nodes, timeline), ActorPool, Queue.
 """
 
 from ray_tpu.util.actor_pool import ActorPool
+from ray_tpu.util.backoff import ExponentialBackoff
 from ray_tpu.util.queue import Empty, Full, Queue
 
-__all__ = ["ActorPool", "Empty", "Full", "Queue"]
+__all__ = ["ActorPool", "Empty", "ExponentialBackoff", "Full", "Queue"]

@@ -1,0 +1,64 @@
+"""Tier-1 import of scripts/check_timeouts.py (like check_metrics): every
+blocking socket/RPC receive in cluster/ and native/ must carry an
+explicit timeout, with audited exceptions justified in the allowlist."""
+
+import os
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+
+def _load():
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, "scripts", "check_timeouts.py")
+    spec = importlib.util.spec_from_file_location("check_timeouts", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["check_timeouts"] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_repo_has_no_unbounded_blocking_calls():
+    mod = _load()
+    problems = mod.collect_violations()
+    assert problems == [], "\n".join(problems)
+
+
+def test_lint_catches_violations():
+    mod = _load()
+    bad = (
+        "def f(sock, ev, q):\n"
+        "    sock.settimeout(None)\n"
+        "    data = sock.recv(1024)\n"
+        "    ev.wait()\n"
+        "    return q.get()\n"
+    )
+    out = mod.lint_source(bad, "cluster/synthetic.py")
+    assert len(out) == 4, out
+    assert any("settimeout(None)" in v for v in out)
+    assert any("recv()" in v for v in out)
+    assert any(".wait()" in v for v in out)
+    assert any(".get()" in v for v in out)
+
+
+def test_lint_accepts_bounded_patterns():
+    mod = _load()
+    good = (
+        "def f(sock, ev, q, c):\n"
+        "    sock.settimeout(0.25)\n"
+        "    data = sock.recv(1024)\n"
+        "    ev.wait(timeout=5)\n"
+        "    q.get(timeout=1)\n"
+        "    c.call('m', {}, timeout=10)\n"
+    )
+    assert mod.lint_source(good, "cluster/synthetic.py") == []
+
+
+def test_allowlist_entries_all_have_reasons():
+    mod = _load()
+    for key, reason in mod.ALLOWLIST.items():
+        assert isinstance(reason, str) and len(reason) > 10, key
