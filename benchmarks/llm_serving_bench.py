@@ -21,6 +21,22 @@ distributions, TTFT/TPOT/queue/e2e SLO percentiles, span-coverage
 honesty) to benchmarks/TRACE_serving_r08.json — --profile answers
 "what is one step bound by", --trace answers "where did request X's
 wall-clock go".
+
+--disagg runs the MIXED-LOAD prefill-interference benchmark: a fixed
+decode-heavy workload is timed twice per serving mode — idle, then with
+a feeder hammering long prefills — for (a) one colocated engine and
+(b) a disaggregated prefill/decode pair (ray_tpu.llm.disagg). The
+number that matters is decode TPOT p99 degradation (mixed / idle) per
+mode: disaggregation should hold decode steady where colocated
+time-slices. Also records kv-transfer counts/bytes and the e2e
+span-coverage of the disagg traces (llm.kv_transfer spans must keep the
+>=90% gate). Writes benchmarks/DISAGG_serving_r10.json.
+
+--chaos runs the AVAILABILITY SLO benchmark: the engine serves a fixed
+workload under a seeded PREEMPT_ENGINE schedule (the r09 recovery
+ladder re-enqueues in-flight requests); reports completion rate plus
+client-side TTFT/e2e p99 with and without injection. Writes
+benchmarks/CHAOS_serving_r10.json.
 """
 
 from __future__ import annotations
@@ -38,6 +54,12 @@ _SPEC_OUT = _os.path.join(
 )
 _TRACE_OUT = _os.path.join(
     _os.path.dirname(_os.path.abspath(__file__)), "TRACE_serving_r08.json"
+)
+_DISAGG_OUT = _os.path.join(
+    _os.path.dirname(_os.path.abspath(__file__)), "DISAGG_serving_r10.json"
+)
+_CHAOS_OUT = _os.path.join(
+    _os.path.dirname(_os.path.abspath(__file__)), "CHAOS_serving_r10.json"
 )
 
 
@@ -217,6 +239,321 @@ def run_spec_bench(args) -> dict:
     return result
 
 
+# ---------------------------------------------------------------------------
+# --disagg: mixed-load prefill-interference benchmark
+# ---------------------------------------------------------------------------
+
+
+def _pct(vals: list, p: float) -> float:
+    vals = sorted(float(v) for v in vals)
+    if not vals:
+        return 0.0
+    return vals[min(len(vals) - 1, int(len(vals) * p))]
+
+
+def _drive_decode_workload(submit, prompts, sp, timeout_s: float = 300.0):
+    """Submit `prompts` through `submit(prompt, sp) -> (rid, queue)` and
+    stamp client-side arrival times: per-request ttft / tpot / e2e.
+    Consumption is one thread per request so a slow consumer can never
+    skew another request's timestamps."""
+    import queue as _q
+    import threading
+
+    records = []
+
+    def consume(q, rec):
+        deadline = time.perf_counter() + timeout_s
+        while True:
+            try:
+                out = q.get(timeout=max(0.01, deadline - time.perf_counter()))
+            except _q.Empty:
+                rec["error"] = "timeout"
+                return
+            now = time.perf_counter()
+            if out is None:
+                return
+            if isinstance(out, BaseException):
+                rec["error"] = repr(out)
+                return
+            if out.new_token_ids and "t_first" not in rec:
+                rec["t_first"] = now
+            if out.finished:
+                rec["t_last"] = now
+                rec["n"] = len(out.output_token_ids)
+                return
+
+    threads = []
+    for p in prompts:
+        rec = {"t_submit": time.perf_counter()}
+        rid, q = submit(p, sp)
+        records.append(rec)
+        t = threading.Thread(target=consume, args=(q, rec), daemon=True)
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join(timeout=timeout_s)
+    ttfts, tpots, e2es, errors = [], [], [], 0
+    for rec in records:
+        if "error" in rec or "t_last" not in rec:
+            errors += 1
+            continue
+        ttfts.append(rec["t_first"] - rec["t_submit"])
+        e2es.append(rec["t_last"] - rec["t_submit"])
+        if rec["n"] > 1:
+            tpots.append((rec["t_last"] - rec["t_first"]) / (rec["n"] - 1))
+    return {
+        "completed": len(records) - errors,
+        "submitted": len(records),
+        "ttft_p99_s": round(_pct(ttfts, 0.99), 5),
+        "tpot_p50_s": round(_pct(tpots, 0.50), 5),
+        "tpot_p99_s": round(_pct(tpots, 0.99), 5),
+        "e2e_p99_s": round(_pct(e2es, 0.99), 5),
+    }
+
+
+def run_disagg_bench(args) -> dict:
+    """Decode TPOT under concurrent long prefills: colocated engine vs
+    disaggregated prefill/decode pools, each against its own idle
+    baseline. CPU-safe (the tier-1 smoke runs it under JAX_PLATFORMS=cpu)."""
+    import dataclasses
+    import queue as _q
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.llm.disagg import DisaggConfig, DisaggOrchestrator
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine
+    from ray_tpu.llm.openai_api import _EngineRunner
+    from ray_tpu.llm.sampling import SamplingParams
+    from ray_tpu.models import llama
+    from ray_tpu.obs import get_recorder
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = llama.LLAMA_400M
+        n_short, short_len, max_new = 16, 64, 96
+        long_len, num_blocks, max_prefill = 960, 2048, 1024
+        n_feeders = 4
+    else:
+        cfg = dataclasses.replace(llama.LLAMA_TINY, dtype=jnp.float32)
+        n_short, short_len, max_new = 8, 12, 24
+        long_len, num_blocks, max_prefill = 90, 256, 96
+        n_feeders = 2
+    ec = EngineConfig(
+        model=cfg, num_blocks=num_blocks, block_size=8,
+        max_num_seqs=n_short + n_feeders, max_prefill_len=max_prefill,
+        decode_chunk=4,
+    )
+    params = llama.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    shorts = [
+        [int(x) for x in rng.integers(3, cfg.vocab_size - 1, short_len)]
+        for _ in range(n_short)
+    ]
+    sp = SamplingParams(max_tokens=max_new, temperature=0.0, ignore_eos=True)
+    sp_long = SamplingParams(max_tokens=1, temperature=0.0, ignore_eos=True)
+
+    def fresh_long():
+        # UNIQUE every time: a repeated long prompt would prefix-cache-hit
+        # and the "long prefill" would stop costing anything
+        return [int(x) for x in rng.integers(3, cfg.vocab_size - 1, long_len)]
+
+    def run_mode(submit, label: str) -> dict:
+        # warmup compiles every shape the timed phases will hit: the FULL
+        # short batch (decode bucket = n_short) and the long-prefill
+        # bucket — an under-warmed idle phase would bill compilation to
+        # TPOT and fake a "mixed is faster" inversion
+        _drive_decode_workload(submit, shorts, sp)
+        _drive_decode_workload(submit, [fresh_long()], sp_long)
+        idle = _drive_decode_workload(submit, shorts, sp)
+        # mixed: feeders hammer long prefills for the whole window
+        stop = threading.Event()
+
+        def feeder():
+            while not stop.is_set():
+                _rid, q = submit(fresh_long(), sp_long)
+                deadline = time.perf_counter() + 60
+                while not stop.is_set() and time.perf_counter() < deadline:
+                    try:
+                        out = q.get(timeout=0.25)
+                    except _q.Empty:
+                        continue
+                    if out is None or isinstance(out, BaseException) or out.finished:
+                        break
+
+        feeders = [threading.Thread(target=feeder, daemon=True)
+                   for _ in range(n_feeders)]
+        for f in feeders:
+            f.start()
+        time.sleep(0.2)  # let prefill pressure build before measuring
+        mixed = _drive_decode_workload(submit, shorts, sp)
+        stop.set()
+        for f in feeders:
+            f.join(timeout=10)
+        degradation = (
+            round(mixed["tpot_p99_s"] / idle["tpot_p99_s"], 3)
+            if idle["tpot_p99_s"] > 0 else None
+        )
+        return {"idle": idle, "mixed": mixed,
+                "tpot_p99_degradation": degradation}
+
+    # colocated: one engine, the r09 runner loop
+    engine = LLMEngine(ec, params=params, seed=0)
+    runner = _EngineRunner(engine)
+    colocated = run_mode(lambda p, s: runner.submit(p, s), "colocated")
+    runner.shutdown()
+
+    # disaggregated: 1 prefill + 1 decode pool over the in-proc connector
+    orch = DisaggOrchestrator(
+        DisaggConfig(engine=ec, num_prefill=1, num_decode=1,
+                     connector=args.disagg_connector),
+        params=params, seed=0, model_tag="disagg-bench",
+    )
+    rec = get_recorder()
+    rec.clear()  # coverage describes the disagg phases only
+    disagg = run_mode(lambda p, s: orch.submit(p, s), "disagg")
+    coverages, kv_spans = [], 0
+    for meta in rec.traces(limit=100_000):
+        summary = rec.summary(meta["trace_id"])
+        if summary is None:
+            continue
+        if "e2e_s" in summary.get("attrs", {}):
+            coverages.append(summary["coverage_pct"])
+        kv_spans += sum(
+            1 for s_ in rec.get(meta["trace_id"]) if s_.name == "llm.kv_transfer"
+        )
+    tstats = orch.stats()["transfer"]
+    orch.shutdown()
+
+    result = {
+        "metric": "llm_disagg_tpot_guard" if on_tpu else
+        "llm_disagg_tpot_guard_smoke",
+        # the headline: how much less decode degrades under prefill load
+        "value": (
+            round(colocated["tpot_p99_degradation"]
+                  / disagg["tpot_p99_degradation"], 3)
+            if disagg["tpot_p99_degradation"] else None
+        ),
+        "unit": "colocated_degradation / disagg_degradation (>1 = disagg wins)",
+        "colocated": colocated,
+        "disagg": disagg,
+        "kv_transfers": tstats["kv_transfers"],
+        "kv_bytes": tstats["bytes_sent"],
+        "reprefills": tstats["reprefills"],
+        "kv_transfer_spans": kv_spans,
+        "coverage_pct_mean": (
+            round(sum(coverages) / len(coverages), 2) if coverages else 0.0
+        ),
+        "connector": args.disagg_connector,
+        "n_short": n_short, "max_new": max_new, "long_len": long_len,
+        "n_feeders": n_feeders,
+        "model_params": cfg.num_params(),
+        "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+    }
+    if not on_tpu:
+        result["note"] = (
+            "CPU smoke: absolute TPOT is dispatch-bound; the contract this "
+            "capture carries is the RELATIVE degradation (disagg must not "
+            "degrade more than colocated) and the >=90% span coverage"
+        )
+    with open(args.disagg_out, "w") as f:
+        f.write(json.dumps(result, indent=2) + "\n")
+    result["disagg_out"] = args.disagg_out
+    return result
+
+
+# ---------------------------------------------------------------------------
+# --chaos: availability SLO under seeded engine preemption
+# ---------------------------------------------------------------------------
+
+
+def run_chaos_bench(args) -> dict:
+    """Completion rate + client-side TTFT/e2e p99 under a seeded
+    PREEMPT_ENGINE schedule, against an uninjected baseline of the same
+    workload (the r09 recovery ladder is what's being priced)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.chaos import harness as chaos
+    from ray_tpu.chaos.schedule import FaultSchedule, FaultSpec
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine
+    from ray_tpu.llm.openai_api import _EngineRunner
+    from ray_tpu.llm.sampling import SamplingParams
+    from ray_tpu.models import llama
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    cfg = (llama.LLAMA_400M if on_tpu
+           else dataclasses.replace(llama.LLAMA_TINY, dtype=jnp.float32))
+    n_requests = 24 if on_tpu else 12
+    max_new = 48 if on_tpu else 24
+    ec = EngineConfig(
+        model=cfg, num_blocks=1024 if on_tpu else 128, block_size=8,
+        max_num_seqs=16, max_prefill_len=64, decode_chunk=4,
+    )
+    params = llama.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    prompts = [
+        [int(x) for x in rng.integers(3, cfg.vocab_size - 1, 16)]
+        for _ in range(n_requests)
+    ]
+    sp = SamplingParams(max_tokens=max_new, temperature=0.0, ignore_eos=True)
+
+    def run_pass():
+        engine = LLMEngine(ec, params=params, seed=0)
+
+        def _factory():
+            return LLMEngine(ec, params=params, seed=0)
+
+        runner = _EngineRunner(engine, engine_factory=_factory)
+        out = _drive_decode_workload(
+            lambda p, s: runner.submit(p, s), prompts, sp, timeout_s=180.0
+        )
+        out["engine_recoveries"] = runner.num_recoveries
+        runner.shutdown()
+        return out
+
+    baseline = run_pass()
+
+    sched = FaultSchedule(args.chaos_seed, [
+        FaultSpec(
+            chaos.PREEMPT_ENGINE, site="llm.engine.step",
+            p=args.chaos_rate, start_after=4, every_n=3, max_fires=2,
+        ),
+    ])
+    chaos.install(sched)
+    try:
+        injected = run_pass()
+        fired = sched.fired_kinds()
+    finally:
+        chaos.uninstall()
+
+    result = {
+        "metric": "llm_chaos_completion_rate" if on_tpu else
+        "llm_chaos_completion_rate_smoke",
+        "value": round(injected["completed"] / injected["submitted"], 4),
+        "unit": "completed/submitted under seeded preemption",
+        "chaos_seed": args.chaos_seed,
+        "preempt_rate": args.chaos_rate,
+        "faults_fired": len(fired),
+        "fired_kinds": fired,
+        "baseline": baseline,
+        "injected": injected,
+        "n_requests": n_requests,
+        "max_new": max_new,
+        "model_params": cfg.num_params(),
+        "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+    }
+    with open(args.chaos_out, "w") as f:
+        f.write(json.dumps(result, indent=2) + "\n")
+    result["chaos_out"] = args.chaos_out
+    return result
+
+
 def main():
     import os
 
@@ -237,6 +574,20 @@ def main():
                     help="also write the per-phase request-latency "
                     "breakdown from the ray_tpu.obs flight recorder")
     ap.add_argument("--trace-out", default=_TRACE_OUT)
+    ap.add_argument("--disagg", action="store_true",
+                    help="run the mixed-load disaggregated-vs-colocated "
+                    "TPOT benchmark instead")
+    ap.add_argument("--disagg-out", default=_DISAGG_OUT)
+    ap.add_argument("--disagg-connector", default="inproc",
+                    choices=["inproc", "rpc"])
+    ap.add_argument("--chaos", action="store_true",
+                    help="run the availability-SLO benchmark under seeded "
+                    "engine preemption instead")
+    ap.add_argument("--chaos-out", default=_CHAOS_OUT)
+    ap.add_argument("--chaos-seed", type=int, default=1234)
+    ap.add_argument("--chaos-rate", type=float, default=0.08,
+                    help="per-step preemption probability (bounded by the "
+                    "spec's max_fires so the recovery budget holds)")
     args = ap.parse_args()
 
     want = os.environ.get("JAX_PLATFORMS", "")
@@ -247,6 +598,12 @@ def main():
 
     if args.spec:
         print(json.dumps(run_spec_bench(args)))
+        return
+    if args.disagg:
+        print(json.dumps(run_disagg_bench(args)))
+        return
+    if args.chaos:
+        print(json.dumps(run_chaos_bench(args)))
         return
 
     from ray_tpu.llm.engine import EngineConfig, LLMEngine, SamplingParams

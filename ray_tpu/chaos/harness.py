@@ -28,7 +28,9 @@ from typing import Optional
 
 from ray_tpu.chaos.schedule import (  # noqa: F401 — re-exported for hook sites
     CORRUPT_FRAME,
+    CORRUPT_KV_TRANSFER,
     DELAY_RPC,
+    DROP_KV_TRANSFER,
     DROP_RPC,
     KILL_REPLICA,
     KILL_WORKER,

@@ -47,10 +47,17 @@ STALL_HEARTBEAT = "stall_heartbeat"  # node stops heartbeating (partition)
 PREEMPT_NODE = "preempt_node"        # SIGKILL a whole node (daemon+workers)
 CORRUPT_FRAME = "corrupt_frame"      # flip bytes in the wire frame
 PREEMPT_ENGINE = "preempt_engine"    # LLM engine dies mid-step
+# disaggregated-serving KV-transfer plane (llm/disagg/connector.py): a
+# handoff that vanishes in flight vs one that arrives bit-flipped — the
+# two failure modes a prefill->decode transfer plane must survive
+# (receiver detects corruption by checksum; both end in a re-prefill)
+DROP_KV_TRANSFER = "drop_kv_transfer"        # handoff lost before the send
+CORRUPT_KV_TRANSFER = "corrupt_kv_transfer"  # KV pages bit-flipped in flight
 
 KINDS = frozenset({
     KILL_WORKER, KILL_REPLICA, DROP_RPC, DELAY_RPC, STALL_HEARTBEAT,
     PREEMPT_NODE, CORRUPT_FRAME, PREEMPT_ENGINE,
+    DROP_KV_TRANSFER, CORRUPT_KV_TRANSFER,
 })
 
 # kinds the in-process hook ignores (a runner executes them instead)

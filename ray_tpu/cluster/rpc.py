@@ -310,6 +310,17 @@ class RpcClient:
             ev: tuple[threading.Event, list] = (threading.Event(), [])
             self._pending[msg_id] = ev
         body = _dump((msg_id, method, payload))
+        if len(body) > MAX_FRAME:
+            # mirror the server's read-side limit BEFORE the uint32 length
+            # prefix overflows: disaggregated KV handoffs make multi-MB
+            # frames routine, and an oversized one must fail loudly here,
+            # not poison the stream for every pipelined caller
+            with self._plock:
+                self._pending.pop(msg_id, None)
+            raise RpcError(
+                f"rpc {method!r} frame of {len(body)} bytes exceeds "
+                f"MAX_FRAME={MAX_FRAME}; chunk the payload"
+            )
         if _chaos.ACTIVE is not None:
             for _f in _chaos.fire(
                 "rpc.frame", kinds=(_chaos.CORRUPT_FRAME,),

@@ -8,6 +8,7 @@ batch inference. See SURVEY.md §2.5 (Ray LLM) and §7 L4.
 """
 
 from ray_tpu.llm.batch import ProcessorConfig, build_processor
+from ray_tpu.llm.disagg import DisaggConfig
 from ray_tpu.llm.engine import EngineConfig, LLMEngine, Request, RequestOutput
 from ray_tpu.llm.kv_cache import BlockAllocator, KVCacheConfig
 from ray_tpu.llm.openai_api import ByteTokenizer, LLMConfig, LLMServer, build_openai_app
@@ -17,6 +18,7 @@ from ray_tpu.llm.spec import SpecConfig
 __all__ = [
     "BlockAllocator",
     "ByteTokenizer",
+    "DisaggConfig",
     "EngineConfig",
     "KVCacheConfig",
     "LLMConfig",

@@ -1,0 +1,36 @@
+"""ray_tpu.llm.disagg — disaggregated prefill/decode serving.
+
+Prefill and decode run on separate engine pools; a request migrates
+once, as a ``KVHandoff`` (KV pages + sampler/request state) over a
+pluggable ``KVConnector`` (in-process for tests/CPU, cluster-RPC for
+hosts, ICI/device-direct slots in later). The ``DisaggOrchestrator``
+routes new requests to the prefill pool, picks decode replicas with
+queue-depth + prefix-cache awareness, and re-prefills on any transfer
+loss with delivered-token watermarks keeping completion ids idempotent.
+
+Serving surfaces: ``LLMConfig(disagg=DisaggConfig(...))`` turns the
+OpenAI app's LLMServer into a disaggregated deployment
+(llm/openai_api.py); ``serve/disagg.py`` builds the multi-deployment
+variant with pinned (KV-affinity) routing.
+"""
+
+from ray_tpu.llm.disagg.connector import (
+    InProcessConnector,
+    KVConnector,
+    KVTransferError,
+    RpcKVConnector,
+    make_connector,
+)
+from ray_tpu.llm.disagg.handoff import KVHandoff
+from ray_tpu.llm.disagg.orchestrator import DisaggConfig, DisaggOrchestrator
+
+__all__ = [
+    "DisaggConfig",
+    "DisaggOrchestrator",
+    "InProcessConnector",
+    "KVConnector",
+    "KVHandoff",
+    "KVTransferError",
+    "RpcKVConnector",
+    "make_connector",
+]

@@ -1,0 +1,290 @@
+"""KVConnector: pluggable transfer plane for prefill->decode KV handoffs.
+
+Two backends ship:
+
+ * ``InProcessConnector`` — queue handoff inside one process (tests,
+   CPU smoke, serve replicas which are in-process async actors). The
+   object crosses by reference; integrity still goes through the same
+   checksum gate so chaos corruption is exercised end to end.
+ * ``RpcKVConnector`` — cluster transfer over the ``cluster/rpc.py``
+   length-prefixed frame protocol: each decode target runs one shared
+   RpcServer route (``kv_put``); prefill-side sends go through a
+   ``ClientPool`` with bounded call timeouts, so a stalled decode host
+   fails the transfer (-> re-prefill) instead of wedging the sender.
+
+The interface is deliberately shaped so an ICI/device-to-device backend
+can slot in later: ``send`` takes an opaque target token from
+``register_target`` and a position-ordered ``KVHandoff`` — a TPU
+backend would register a device mesh endpoint, move ``k_pages``/
+``v_pages`` by device DMA, and surface the same checksum/timeout
+failure modes; nothing in the orchestrator changes.
+
+Chaos: every send passes through the ``disagg.kv_transfer`` hook site —
+``DROP_KV_TRANSFER`` raises ``KVTransferError`` before the send,
+``CORRUPT_KV_TRANSFER`` bit-flips the KV pages (the receiver's
+``verify()`` catches it at import), ``DELAY_RPC`` injects latency.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ray_tpu.chaos import harness as _chaos
+from ray_tpu.llm.disagg.handoff import KVHandoff
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.llm.disagg.connector")
+
+
+class KVTransferError(Exception):
+    """A handoff was dropped, timed out, or arrived corrupt. The
+    orchestrator's answer is always the same: re-prefill elsewhere."""
+
+
+def _corrupt_handoff(handoff: KVHandoff) -> KVHandoff:
+    """Deterministic KV bit-flip (CORRUPT_KV_TRANSFER): flip a span of
+    bytes in the middle of the K pages; the checksum is NOT re-sealed,
+    so the receiver's verify() fails exactly like a real torn wire."""
+    k = np.array(handoff.k_pages, copy=True)
+    flat = k.view(np.uint8).reshape(-1)
+    if flat.size:
+        mid = flat.size // 2
+        span = max(1, min(16, flat.size - mid))
+        flat[mid : mid + span] ^= 0xFF
+    return dataclasses.replace(handoff, k_pages=k)
+
+
+class KVConnector:
+    """Transfer-plane interface; see module docstring for the contract."""
+
+    name = "base"
+
+    def __init__(self):
+        self.num_sent = 0
+        self.num_received = 0
+        self.num_dropped = 0
+        self.bytes_sent = 0
+
+    # -- interface ------------------------------------------------------------
+
+    def register_target(self, target_id: str) -> Any:
+        """Create the receive side for ``target_id``; returns the opaque
+        target token ``send`` addresses it by."""
+        raise NotImplementedError
+
+    def send(self, target: Any, handoff: KVHandoff,
+             timeout_s: float = 30.0) -> None:
+        raise NotImplementedError
+
+    def recv(self, target_id: str, timeout_s: float = 0.1) -> Optional[KVHandoff]:
+        """Bounded receive; None when nothing arrived within the
+        timeout (callers poll — a transfer plane must never park a
+        decode loop forever)."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+    def stats(self) -> dict:
+        return {
+            "connector": self.name,
+            "num_sent": self.num_sent,
+            "num_received": self.num_received,
+            "num_dropped": self.num_dropped,
+            "bytes_sent": self.bytes_sent,
+        }
+
+    # -- shared plumbing ------------------------------------------------------
+
+    def _chaos_gate(self, handoff: KVHandoff, target_label: str) -> KVHandoff:
+        """The ``disagg.kv_transfer`` chaos hook, applied on every send."""
+        if _chaos.ACTIVE is None:
+            return handoff
+        for _f in _chaos.fire(
+            "disagg.kv_transfer",
+            kinds=(_chaos.DROP_KV_TRANSFER, _chaos.CORRUPT_KV_TRANSFER,
+                   _chaos.DELAY_RPC),
+            request_id=handoff.request_id, connector=self.name,
+            target=target_label,
+        ):
+            if _f.kind == _chaos.DROP_KV_TRANSFER:
+                self.num_dropped += 1
+                raise KVTransferError(
+                    f"chaos: dropped KV transfer of {handoff.request_id!r} "
+                    f"to {target_label}"
+                )
+            if _f.kind == _chaos.DELAY_RPC:
+                time.sleep(_f.delay_s)
+            if _f.kind == _chaos.CORRUPT_KV_TRANSFER:
+                handoff = _corrupt_handoff(handoff)
+        return handoff
+
+
+# ---------------------------------------------------------------------------
+# in-process backend
+# ---------------------------------------------------------------------------
+
+# process-global queues so serve replicas (in-process async actors) and a
+# same-process orchestrator share one transfer plane; namespaced so two
+# apps/tests never cross-deliver
+_INPROC_LOCK = threading.Lock()
+_INPROC_QUEUES: dict[tuple, "queue.Queue[KVHandoff]"] = {}
+
+
+class InProcessConnector(KVConnector):
+    name = "inproc"
+
+    def __init__(self, namespace: str = "default"):
+        super().__init__()
+        self.namespace = namespace
+        self._targets: set = set()
+
+    def register_target(self, target_id: str) -> str:
+        with _INPROC_LOCK:
+            _INPROC_QUEUES.setdefault((self.namespace, target_id), queue.Queue())
+        self._targets.add(target_id)
+        return target_id
+
+    def _queue(self, target_id: str) -> "queue.Queue[KVHandoff]":
+        with _INPROC_LOCK:
+            q = _INPROC_QUEUES.get((self.namespace, target_id))
+        if q is None:
+            raise KVTransferError(
+                f"unknown KV target {target_id!r} in namespace "
+                f"{self.namespace!r} (register_target first)"
+            )
+        return q
+
+    def send(self, target: str, handoff: KVHandoff,
+             timeout_s: float = 30.0) -> None:
+        handoff = self._chaos_gate(handoff, target)
+        self._queue(target).put(handoff)
+        self.num_sent += 1
+        self.bytes_sent += handoff.nbytes
+
+    def recv(self, target_id: str, timeout_s: float = 0.1) -> Optional[KVHandoff]:
+        try:
+            h = self._queue(target_id).get(timeout=timeout_s)
+        except queue.Empty:
+            return None
+        self.num_received += 1
+        return h
+
+    def close(self) -> None:
+        with _INPROC_LOCK:
+            for tid in self._targets:
+                _INPROC_QUEUES.pop((self.namespace, tid), None)
+        self._targets.clear()
+
+
+# ---------------------------------------------------------------------------
+# cluster-RPC backend
+# ---------------------------------------------------------------------------
+
+
+class RpcKVConnector(KVConnector):
+    """KV transfer over cluster/rpc.py framing.
+
+    One connector instance can play both sides: ``register_target``
+    lazily starts a local RpcServer (one per connector, shared across
+    targets) routing ``kv_put`` frames into per-target queues; ``send``
+    dials the peer's (host, port) through a ClientPool with the
+    transfer timeout bounding the call — large KV frames ride the same
+    pickled length-prefixed protocol the control plane uses.
+    """
+
+    name = "rpc"
+
+    def __init__(self, host: str = "127.0.0.1", timeout_s: float = 30.0):
+        super().__init__()
+        from ray_tpu.cluster.rpc import ClientPool
+
+        self._host = host
+        self._timeout = timeout_s
+        self._pool = ClientPool(timeout=timeout_s)
+        self._server = None
+        self._queues: dict[str, "queue.Queue[KVHandoff]"] = {}
+        self._lock = threading.Lock()
+
+    def _ensure_server(self):
+        from ray_tpu.cluster.rpc import RpcServer
+
+        with self._lock:
+            if self._server is None:
+                srv = RpcServer(host=self._host)
+                srv.route("kv_put", self._on_kv_put)
+                srv.start()
+                self._server = srv
+        return self._server
+
+    def _on_kv_put(self, payload, peer):
+        target_id = payload["target"]
+        with self._lock:
+            q = self._queues.get(target_id)
+        if q is None:
+            raise KVTransferError(f"no such KV target {target_id!r} here")
+        q.put(payload["handoff"])
+        return {"ok": True}
+
+    def register_target(self, target_id: str) -> tuple:
+        srv = self._ensure_server()
+        with self._lock:
+            self._queues.setdefault(target_id, queue.Queue())
+        host, port = srv.address
+        return (host, port, target_id)
+
+    def send(self, target: tuple, handoff: KVHandoff,
+             timeout_s: Optional[float] = None) -> None:
+        from ray_tpu.cluster.rpc import RemoteError, RpcError
+
+        host, port, target_id = target
+        handoff = self._chaos_gate(handoff, f"{host}:{port}/{target_id}")
+        try:
+            self._pool.get((host, port)).call(
+                "kv_put", {"target": target_id, "handoff": handoff},
+                timeout=timeout_s if timeout_s is not None else self._timeout,
+            )
+        except (RpcError, RemoteError) as e:
+            # the frame may or may not have landed; the orchestrator's
+            # re-prefill path is idempotent (delivery watermarks), so
+            # at-most-once here is the right failure mode
+            raise KVTransferError(
+                f"KV transfer of {handoff.request_id!r} to "
+                f"{host}:{port}/{target_id} failed: {e}"
+            ) from e
+        self.num_sent += 1
+        self.bytes_sent += handoff.nbytes
+
+    def recv(self, target_id: str, timeout_s: float = 0.1) -> Optional[KVHandoff]:
+        with self._lock:
+            q = self._queues.get(target_id)
+        if q is None:
+            raise KVTransferError(f"target {target_id!r} not registered here")
+        try:
+            h = q.get(timeout=timeout_s)
+        except queue.Empty:
+            return None
+        self.num_received += 1
+        return h
+
+    def close(self) -> None:
+        self._pool.close_all()
+        with self._lock:
+            srv, self._server = self._server, None
+            self._queues.clear()
+        if srv is not None:
+            srv.stop()
+
+
+def make_connector(kind: str, **kwargs) -> KVConnector:
+    if kind in ("inproc", "in_process", "inprocess"):
+        return InProcessConnector(**kwargs)
+    if kind == "rpc":
+        return RpcKVConnector(**kwargs)
+    raise ValueError(f"unknown KV connector {kind!r}; one of: inproc, rpc")

@@ -1,0 +1,73 @@
+"""KVHandoff: the unit a prefill engine exports and a decode engine imports.
+
+One handoff = one request's complete migration state: the KV pages the
+prefill pass wrote (slot-granular, position-ordered — connector backends
+may repack but importers always receive [L, KVH, n_kv, D] position
+order, the layout `SequenceBlocks.slots_for_range` maps straight back
+onto any block assignment), plus everything the decode side needs to
+continue the request *bit-identically*: sampler key state (raw
+`jax.random.key_data`, so seeded and unseeded streams both survive the
+hop), the sampled-so-far output prefix, logprob accounting, LoRA
+identity, SLO timestamps, and the request's trace context.
+
+Integrity: `seal()` stamps a CRC over the KV page bytes and the token
+ids; `verify()` re-checks it on the receive side. A transfer plane that
+bit-flips in flight (chaos: CORRUPT_KV_TRANSFER, or a real torn wire)
+is detected at import time and handled as a lost transfer (re-prefill),
+never silently decoded from garbage K/V.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import zlib
+from typing import Any, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class KVHandoff:
+    request_id: str
+    prompt_token_ids: list
+    output_token_ids: list          # sampled so far (>=1: the prefill token)
+    sampling_params: Any            # llm.sampling.SamplingParams
+    key_data: np.ndarray            # jax.random.key_data of the request key
+    num_kv_tokens: int              # positions covered by the pages below
+    k_pages: np.ndarray             # [L, KVH, num_kv_tokens, D]
+    v_pages: np.ndarray
+    model_sig: tuple                # (n_layers, n_kv_heads, head_dim)
+    lora_id: Optional[str] = None
+    cumulative_logprob: float = 0.0
+    token_logprobs: list = dataclasses.field(default_factory=list)
+    # SLO timestamps ride the handoff so the decode engine's llm.request
+    # root span / TTFT / e2e keep pricing the REQUEST, not the hop
+    t_arrival: float = 0.0
+    t_first_prefill: Optional[float] = None
+    t_first_token: Optional[float] = None
+    t_export: float = 0.0           # prefill-side export time (span start)
+    trace: Optional[dict] = None    # TraceContext.to_dict wire form
+    checksum: int = 0
+
+    # -- integrity -----------------------------------------------------------
+
+    def _crc(self) -> int:
+        crc = zlib.crc32(np.ascontiguousarray(self.k_pages).tobytes())
+        crc = zlib.crc32(np.ascontiguousarray(self.v_pages).tobytes(), crc)
+        crc = zlib.crc32(
+            np.asarray(self.prompt_token_ids + self.output_token_ids,
+                       np.int64).tobytes(),
+            crc,
+        )
+        return crc & 0xFFFFFFFF
+
+    def seal(self) -> "KVHandoff":
+        self.checksum = self._crc()
+        return self
+
+    def verify(self) -> bool:
+        return self.checksum == self._crc()
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.k_pages.nbytes + self.v_pages.nbytes)

@@ -1,0 +1,660 @@
+"""Disaggregated prefill/decode orchestration over engine pools.
+
+The structural problem this removes: a colocated engine time-slices
+prefill and decode on one device — every admitted long prompt stalls
+every decoding request's next token (the ROADMAP's prefill-roofline and
+chunked-prefill-overlap items are both symptoms). Here prefill and
+decode run on SEPARATE engine pools and a request migrates exactly once:
+
+    submit -> [prefill pool] --KVHandoff over a KVConnector--> [decode pool]
+
+ * prefill engines run admission + prefill + first-token sampling, then
+   export the sequence (``LLMEngine.export_request``) — they never
+   decode, so their queue holds only prefill work;
+ * the orchestrator picks a decode replica per handoff with awareness of
+   queue depth (primary) and prefix-cache state (``peek_prefix_tokens``
+   + hit rate as tiebreaks), then ships the handoff through the
+   connector;
+ * decode engines import (``LLMEngine.import_handoff``, zero recompute:
+   ``num_cached_tokens`` covers every transferred position) and run pure
+   decode rounds.
+
+Failure model (mirrors r09 serving hardening): a handoff that is
+dropped, times out, or arrives corrupt (checksum) is RE-PREFILLED on
+another prefill engine with the request id and delivered-token watermark
+preserved — consumers see each output position exactly once, whatever
+died in the middle. A prefill engine that dies mid-step has its
+in-flight requests re-homed the same way. Every hop lands in the
+``ray_tpu.obs`` flight recorder as an ``llm.kv_transfer`` span tiling
+between the prefill span and the first decode round, so the e2e
+span-coverage gate keeps holding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import queue
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+from ray_tpu.llm.disagg.connector import (
+    InProcessConnector,
+    KVConnector,
+    KVTransferError,
+    make_connector,
+)
+from ray_tpu.llm.disagg.handoff import KVHandoff
+from ray_tpu.llm.engine import EngineConfig, LLMEngine, RequestOutput
+from ray_tpu.llm.sampling import SamplingParams
+from ray_tpu.obs import context as trace_context
+from ray_tpu.obs import recorder as trace_recorder
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.llm.disagg.orchestrator")
+
+
+@dataclasses.dataclass
+class DisaggConfig:
+    """Pool shape + transfer plane for one disaggregated deployment."""
+
+    engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
+    num_prefill: int = 1
+    num_decode: int = 1
+    connector: str = "inproc"           # "inproc" | "rpc"
+    transfer_timeout_s: float = 30.0
+    # re-prefill budget per request across transfer losses / prefill
+    # deaths; exceeding it fails the request loudly (crash loop, not a
+    # transient)
+    max_handoff_retries: int = 2
+    # decode pick: queue depth first, prefix-cache awareness as tiebreak
+    cache_aware_pick: bool = True
+
+    def __post_init__(self):
+        if isinstance(self.engine, dict):
+            self.engine = EngineConfig(**self.engine)
+        if self.num_prefill < 1 or self.num_decode < 1:
+            raise ValueError("num_prefill and num_decode must be >= 1")
+
+
+class _PoolEngine:
+    """One engine + its lock + loop-thread bookkeeping."""
+
+    def __init__(self, engine: LLMEngine, index: int):
+        self.engine = engine
+        self.index = index
+        self.lock = threading.Lock()
+
+    def depth(self) -> int:
+        e = self.engine
+        return len(e.waiting) + len(e.running)
+
+
+class DisaggOrchestrator:
+    """Prefill pool + decode pool + KV transfer plane; one per model."""
+
+    def __init__(
+        self,
+        config: DisaggConfig,
+        params: Any = None,
+        seed: int = 0,
+        model_tag: str = "disagg",
+        connector: Optional[KVConnector] = None,
+    ):
+        self.config = config
+        self.model_tag = model_tag
+        if params is None:
+            import jax
+
+            from ray_tpu.models import llama
+
+            params = llama.init_params(config.engine.model, jax.random.key(seed))
+        self.params = params  # shared, immutable: one copy for every engine
+
+        self._prefill = [
+            _PoolEngine(LLMEngine(config.engine, params=params, seed=seed), i)
+            for i in range(config.num_prefill)
+        ]
+        self._decode = [
+            _PoolEngine(LLMEngine(config.engine, params=params, seed=seed), i)
+            for i in range(config.num_decode)
+        ]
+        for p in self._prefill:
+            p.engine.model_tag = f"{model_tag}-prefill{p.index}"
+        for d in self._decode:
+            d.engine.model_tag = f"{model_tag}-decode{d.index}"
+
+        if connector is not None:
+            self.connector = connector
+        elif config.connector in ("inproc", "in_process", "inprocess"):
+            # unique namespace per orchestrator: two orchestrators with
+            # the same model_tag in one process (num_replicas=2 of an
+            # LLMConfig(disagg=...) deployment) must never steal each
+            # other's handoffs off the process-global queues
+            self.connector = InProcessConnector(
+                namespace=f"{model_tag}-{uuid.uuid4().hex[:8]}"
+            )
+        else:
+            self.connector = make_connector(config.connector)
+        self._targets = [
+            self.connector.register_target(f"{model_tag}-decode{i}")
+            for i in range(config.num_decode)
+        ]
+
+        self._lock = threading.Lock()
+        # orchestrator-minted request ids: every engine counts its own
+        # "req-N", so two prefill engines would both mint "req-0" and the
+        # second submit would orphan the first's output queue
+        self._counter = itertools.count()
+        self._queues: dict[str, queue.Queue] = {}
+        # rid -> {"prompt_ids", "sp", "trace", "tokens" (delivered
+        # watermark), "attempts", "key_data"}: enough to re-prefill
+        # idempotently on any engine
+        self._inflight: dict[str, dict] = {}
+        self.num_transfers = 0
+        self.num_reprefills = 0
+        self.num_transfer_failures = 0
+        self._stop = False
+        self._wake = threading.Event()
+        # handoffs cross to the sender thread: a slow/stalled transfer
+        # (multi-MB KV frame, transfer_timeout_s bound) must not stall
+        # the prefill loop's next step behind it
+        self._transfer_q: "queue.Queue[KVHandoff]" = queue.Queue()
+        self._threads: list[threading.Thread] = []
+        t = threading.Thread(
+            target=self._transfer_loop, name="disagg-transfer", daemon=True
+        )
+        t.start()
+        self._threads.append(t)
+        for p in self._prefill:
+            t = threading.Thread(
+                target=self._prefill_loop, args=(p,),
+                name=f"disagg-prefill-{p.index}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+        for d in self._decode:
+            t = threading.Thread(
+                target=self._decode_loop, args=(d,),
+                name=f"disagg-decode-{d.index}", daemon=True,
+            )
+            t.start()
+            self._threads.append(t)
+
+    # -- public API -----------------------------------------------------------
+
+    def submit(
+        self,
+        prompt_token_ids: list,
+        sampling_params: Optional[SamplingParams] = None,
+        request_id: Optional[str] = None,
+        trace: Optional[trace_context.TraceContext] = None,
+    ) -> tuple[str, queue.Queue]:
+        """Enqueue one request on the least-loaded prefill engine;
+        returns (request_id, output queue). The queue yields
+        RequestOutput objects (watermarked: each output position exactly
+        once), an exception on terminal failure, or None after abort."""
+        sp = sampling_params or SamplingParams()
+        trace = trace or trace_context.current()
+        rid = request_id or f"dreq-{next(self._counter)}"
+        pe = min(self._prefill, key=lambda p: p.depth())
+        q: queue.Queue = queue.Queue()
+        with pe.lock:
+            pe.engine.add_request(
+                list(prompt_token_ids), sp, request_id=rid, trace=trace
+            )
+            req_trace = pe.engine.requests[rid].trace
+        with self._lock:
+            self._queues[rid] = q
+            self._inflight[rid] = {
+                "prompt_ids": list(prompt_token_ids), "sp": sp,
+                "trace": req_trace, "tokens": [], "attempts": 0,
+            }
+        self._wake.set()
+        return rid, q
+
+    def generate(
+        self,
+        prompts: list,
+        sampling_params: "SamplingParams | list[SamplingParams] | None" = None,
+        timeout_s: float = 300.0,
+    ) -> list:
+        """Blocking batch helper (tests/bench); output token lists in order."""
+        if sampling_params is None or isinstance(sampling_params, SamplingParams):
+            sampling_params = [sampling_params or SamplingParams()] * len(prompts)
+        subs = [self.submit(p, sp) for p, sp in zip(prompts, sampling_params)]
+        finals = []
+        deadline = time.time() + timeout_s
+        for rid, q in subs:
+            toks = None
+            while True:
+                remaining = deadline - time.time()
+                if remaining <= 0:
+                    raise TimeoutError(f"request {rid} did not finish in time")
+                try:
+                    out = q.get(timeout=remaining)
+                except queue.Empty:
+                    raise TimeoutError(
+                        f"request {rid} did not finish within {timeout_s}s"
+                    ) from None
+                if isinstance(out, BaseException):
+                    raise out
+                if out is None:
+                    break
+                if out.finished:
+                    toks = out.output_token_ids
+                    break
+            finals.append(toks)
+        return finals
+
+    def abort(self, request_id: str) -> None:
+        """Abort wherever the request currently lives (waiting on a
+        prefill engine, in flight as a handoff, or decoding)."""
+        with self._lock:
+            self._inflight.pop(request_id, None)
+            q = self._queues.pop(request_id, None)
+        for pool in (self._prefill, self._decode):
+            for pe in pool:
+                with pe.lock:
+                    pe.engine.abort_request(request_id)
+        if q is not None:
+            q.put(None)
+
+    def queue_depths(self) -> dict:
+        return {
+            "prefill": [p.depth() for p in self._prefill],
+            "decode": [d.depth() for d in self._decode],
+        }
+
+    def has_unfinished(self) -> bool:
+        with self._lock:
+            return bool(self._inflight)
+
+    def num_inflight(self) -> int:
+        """Requests not yet finished ANYWHERE — queued, decoding, or in
+        transit as a handoff (queue_depths misses that last state)."""
+        with self._lock:
+            return len(self._inflight)
+
+    def stats(self) -> dict:
+        hit = sum(p.engine.prefix_hit_tokens for p in self._prefill + self._decode)
+        lookup = sum(
+            p.engine.prefix_lookup_tokens for p in self._prefill + self._decode
+        )
+        return {
+            "prefill": [p.engine.stats() for p in self._prefill],
+            "decode": [d.engine.stats() for d in self._decode],
+            "transfer": {
+                **self.connector.stats(),
+                "kv_transfers": self.num_transfers,
+                "reprefills": self.num_reprefills,
+                "transfer_failures": self.num_transfer_failures,
+            },
+            "prefix_cache": {
+                "hit_tokens": hit,
+                "lookup_tokens": lookup,
+                "hit_rate": round(hit / lookup, 4) if lookup else 0.0,
+            },
+        }
+
+    def shutdown(self) -> None:
+        self._stop = True
+        self._wake.set()
+        for t in self._threads:
+            t.join(timeout=5)
+        self.connector.close()
+
+    # -- delivery (watermarked, idempotent across re-prefills) ----------------
+
+    def _deliver(self, out: RequestOutput) -> None:
+        with self._lock:
+            rec = self._inflight.get(out.request_id)
+            q = self._queues.get(out.request_id)
+            if rec is None:
+                return
+            new = list(out.output_token_ids[len(rec["tokens"]):])
+            rec["tokens"].extend(new)
+            if out.finished:
+                self._inflight.pop(out.request_id, None)
+                self._queues.pop(out.request_id, None)
+        if q is not None and (new or out.finished):
+            q.put(dataclasses.replace(out, new_token_ids=new))
+
+    def _fail_request(self, rid: str, exc: BaseException) -> None:
+        with self._lock:
+            self._inflight.pop(rid, None)
+            q = self._queues.pop(rid, None)
+        if q is not None:
+            q.put(exc)
+
+    # -- prefill side ---------------------------------------------------------
+
+    def _prefill_loop(self, pe: _PoolEngine) -> None:
+        consec_failures = 0
+        while not self._stop:
+            with pe.lock:
+                busy = pe.engine.has_unfinished()
+            if not busy:
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+                continue
+            handoffs: list[KVHandoff] = []
+            try:
+                with pe.lock:
+                    outputs = pe.engine.step()
+                    # everything still RUNNING after a prefill-pool step
+                    # was just admitted: export it before it ever decodes
+                    for req in list(pe.engine.running):
+                        handoffs.append(pe.engine.export_request(req.request_id))
+            except BaseException as e:  # noqa: BLE001 — re-home in-flight work
+                if self._stop:
+                    return
+                consec_failures += 1
+                # a deterministic crash (recover() not helping) must not
+                # spin forever: after 3 straight failures drain EVERY
+                # request off this engine through the bounded re-prefill
+                # path, so each one either lands elsewhere or fails
+                # loudly at the budget
+                self._recover_prefill(pe, e,
+                                      drain_all=consec_failures >= 3)
+                continue
+            consec_failures = 0
+            for out in outputs:
+                self._deliver(out)  # finished-at-prefill + first tokens (TTFT)
+            for h in handoffs:
+                self._transfer_q.put(h)
+
+    def _transfer_loop(self) -> None:
+        """Dedicated sender thread for the whole transfer plane."""
+        while not self._stop:
+            try:
+                h = self._transfer_q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            try:
+                self._transfer(h)
+            except BaseException:  # noqa: BLE001 — sender must survive
+                logger.exception("transfer of %r failed unexpectedly",
+                                 h.request_id)
+
+    def _recover_prefill(self, pe: _PoolEngine, exc: BaseException,
+                         drain_all: bool = False) -> None:
+        """A prefill engine died mid-step: requeue its in-flight requests
+        through the bounded ``_requeue`` path — on ANOTHER prefill engine
+        when one exists (replica death before handoff must not strand
+        work behind the corpse). Attempts count against
+        ``max_handoff_retries``, so a persistent crash loop terminates
+        with a typed failure instead of spinning. ``drain_all``
+        additionally evacuates still-WAITING requests (engine-wide
+        deterministic failures never admit them, so recover() alone
+        would leave them stuck)."""
+        logger.warning("prefill engine %d failed: %r; re-homing", pe.index, exc)
+        try:
+            with pe.lock:
+                rids = pe.engine.recover()
+                if drain_all:
+                    rids = list(dict.fromkeys(rids + list(pe.engine.requests)))
+                for rid in rids:
+                    req = pe.engine.requests.pop(rid, None)
+                    if req is not None and req in pe.engine.waiting:
+                        pe.engine.waiting.remove(req)
+        except BaseException:  # noqa: BLE001 — engine torn beyond recover
+            logger.exception("prefill engine %d unrecoverable", pe.index)
+            with pe.lock:
+                rids = list(pe.engine.requests)
+                for rid in rids:
+                    try:
+                        pe.engine.abort_request(rid)
+                    except BaseException:  # noqa: BLE001
+                        pe.engine.requests.pop(rid, None)
+        exclude = pe.index if len(self._prefill) > 1 else None
+        for rid in rids:
+            self._requeue(rid, exclude_index=exclude,
+                          reason=f"prefill_death:{type(exc).__name__}")
+
+    # -- transfer + decode pick ----------------------------------------------
+
+    def _pick_decode(self, handoff: KVHandoff) -> int:
+        """Queue depth first; prefix-cache awareness (how many of this
+        prompt's tokens the replica already holds sealed, then its
+        overall hit rate) breaks ties — the replica most likely to serve
+        the NEXT same-prefix prompt from cache keeps accumulating it."""
+        scores = []
+        for d in self._decode:
+            with d.lock:
+                depth = d.depth()
+                peek = 0
+                hit_rate = 0.0
+                if self.config.cache_aware_pick:
+                    try:
+                        peek = d.engine.peek_prefix_tokens(
+                            handoff.prompt_token_ids, handoff.lora_id
+                        )
+                    except ValueError:
+                        peek = 0  # adapter not loaded there
+                    lk = d.engine.prefix_lookup_tokens
+                    hit_rate = d.engine.prefix_hit_tokens / lk if lk else 0.0
+            scores.append((depth, -peek, -hit_rate, d.index))
+        return min(scores)[-1]
+
+    def _transfer(self, handoff: KVHandoff) -> None:
+        idx = self._pick_decode(handoff)
+        try:
+            self.connector.send(
+                self._targets[idx], handoff,
+                timeout_s=self.config.transfer_timeout_s,
+            )
+            self.num_transfers += 1
+        except KVTransferError as e:
+            self._transfer_failed(handoff, e)
+
+    def _transfer_failed(self, handoff: KVHandoff, exc: BaseException) -> None:
+        self.num_transfer_failures += 1
+        self._obs_transfer_event(handoff, error=str(exc))
+        with self._lock:
+            rec = self._inflight.get(handoff.request_id)
+            if rec is not None:
+                # the sampler key rides the retry: the re-prefilled request
+                # continues the exact stream the lost handoff carried
+                rec["key_data"] = handoff.key_data
+        self._requeue(handoff.request_id, reason=f"transfer:{exc}")
+
+    def _requeue(self, rid: str, exclude_index: Optional[int] = None,
+                 reason: str = "") -> None:
+        """Re-prefill a request whose handoff (or prefill engine) was
+        lost. Bounded by max_handoff_retries; the delivered-token prefix
+        is restored so re-admission recomputes prompt+outputs and the
+        continuation extends exactly what consumers already saw."""
+        with self._lock:
+            rec = self._inflight.get(rid)
+            if rec is None:
+                return  # finished/failed concurrently
+            rec["attempts"] += 1
+            attempts = rec["attempts"]
+        if attempts > self.config.max_handoff_retries:
+            self._fail_request(rid, KVTransferError(
+                f"request {rid!r}: handoff failed {attempts} times "
+                f"(last: {reason}); budget exhausted"
+            ))
+            return
+        self.num_reprefills += 1
+        candidates = [p for p in self._prefill if p.index != exclude_index]
+        pe = min(candidates or self._prefill, key=lambda p: p.depth())
+        import jax
+        import jax.numpy as jnp
+
+        with pe.lock:
+            pe.engine.add_request(
+                rec["prompt_ids"], rec["sp"], request_id=rid,
+                trace=rec["trace"],
+            )
+            req = pe.engine.requests[rid]
+            req.output_token_ids = list(rec["tokens"])
+            # a re-prefill re-matches blocks its first attempt just
+            # sealed; count it as a recompute (like a preemption) so the
+            # self-match doesn't inflate the hit rate the decode pick
+            # and /v1/stats trust
+            req.num_preemptions += 1
+            if rec.get("key_data") is not None:
+                # preserve the sampler stream across engines even for
+                # unseeded requests (engines share a seed, but belt and
+                # braces: the key rides the retry)
+                req._key = jax.random.wrap_key_data(
+                    jnp.asarray(rec["key_data"])
+                )
+        logger.warning(
+            "re-prefilling %s on prefill engine %d (attempt %d: %s)",
+            rid, pe.index, attempts, reason,
+        )
+        self._wake.set()
+
+    # -- decode side ----------------------------------------------------------
+
+    def _decode_loop(self, de: _PoolEngine) -> None:
+        target_id = f"{self.model_tag}-decode{de.index}"
+        pending: list[tuple[KVHandoff, float]] = []  # (handoff, deadline)
+        consec_failures = 0
+        while not self._stop:
+            with de.lock:
+                busy = de.engine.has_unfinished()
+            # bounded receive: poll fast while decoding, park briefly idle
+            h = self.connector.recv(
+                target_id, timeout_s=0.001 if (busy or pending) else 0.05
+            )
+            if h is not None:
+                if not h.verify():
+                    self._transfer_failed(
+                        h, KVTransferError(
+                            f"handoff {h.request_id!r} failed checksum on "
+                            f"{target_id} (corrupt in flight)"
+                        ),
+                    )
+                else:
+                    pending.append(
+                        (h, time.time() + self.config.transfer_timeout_s)
+                    )
+            if pending:
+                pending = self._try_imports(de, pending)
+            if busy:
+                try:
+                    with de.lock:
+                        outputs = de.engine.step()
+                except BaseException as e:  # noqa: BLE001
+                    if self._stop:
+                        return
+                    consec_failures += 1
+                    logger.warning(
+                        "decode engine %d failed: %r; recovering (attempt %d)",
+                        de.index, e, consec_failures,
+                    )
+                    # escalation ladder, bounded: recover -> recover with
+                    # a KV/allocator rebuild -> evacuate every request
+                    # through the re-prefill budget. A deterministic
+                    # failure must terminate loudly, not spin hot with
+                    # all its requests hung.
+                    recovered = False
+                    if consec_failures <= 2:
+                        try:
+                            with de.lock:
+                                de.engine.recover(
+                                    rebuild_kv=consec_failures == 2
+                                )
+                            recovered = True
+                        except BaseException:  # noqa: BLE001
+                            logger.exception(
+                                "decode engine %d recover failed", de.index
+                            )
+                    if not recovered:
+                        with de.lock:
+                            rids = list(de.engine.requests)
+                            for rid in rids:
+                                try:
+                                    de.engine.abort_request(rid)
+                                except BaseException:  # noqa: BLE001
+                                    de.engine.requests.pop(rid, None)
+                        for rid in rids:
+                            self._requeue(
+                                rid,
+                                reason=f"decode_death:{type(e).__name__}",
+                            )
+                        consec_failures = 0
+                    continue
+                consec_failures = 0
+                for out in outputs:
+                    self._deliver(out)
+
+    def _try_imports(self, de: _PoolEngine,
+                     pending: list) -> list:
+        """Import received handoffs; a full cache retries until decode
+        frees blocks, bounded by the transfer deadline (then the request
+        re-prefills elsewhere instead of hanging)."""
+        from ray_tpu.llm.kv_cache import NoFreeBlocksError
+
+        still: list = []
+        for h, deadline in pending:
+            with self._lock:
+                live = h.request_id in self._inflight
+            if not live:
+                continue  # aborted/failed meanwhile
+            t_import0 = time.time()
+            try:
+                with de.lock:
+                    de.engine.import_handoff(h)
+            except NoFreeBlocksError:
+                if time.time() >= deadline:
+                    self._transfer_failed(h, KVTransferError(
+                        f"decode engine {de.index} had no KV room for "
+                        f"{h.request_id!r} within the transfer deadline"
+                    ))
+                else:
+                    still.append((h, deadline))
+                continue
+            except BaseException as e:  # noqa: BLE001 — bad handoff state
+                self._transfer_failed(h, e)
+                continue
+            self._obs_transfer_span(h, de.index, t_import0, time.time())
+        return still
+
+    # -- observability --------------------------------------------------------
+
+    def _obs_transfer_span(self, h: KVHandoff, decode_index: int,
+                           t_import0: float, t_done: float) -> None:
+        """llm.kv_transfer span: prefill-span end -> import complete.
+        Tiles between engine.prefill and the first decode round so the
+        request's e2e span coverage survives disaggregation."""
+        try:
+            ctx = trace_context.TraceContext.from_dict(h.trace)
+            trace_recorder.get_recorder().record(
+                "llm.kv_transfer", min(h.t_export, t_done), t_done, ctx=ctx,
+                attrs={
+                    "request_id": h.request_id,
+                    "connector": self.connector.name,
+                    "decode_engine": decode_index,
+                    "kv_tokens": h.num_kv_tokens,
+                    "bytes": h.nbytes,
+                    "import_ms": round((t_done - t_import0) * 1e3, 3),
+                },
+            )
+            from ray_tpu.obs import slo
+
+            slo.record_kv_transfer(
+                self.model_tag, self.connector.name,
+                seconds=max(0.0, t_done - h.t_export), nbytes=h.nbytes,
+            )
+        except Exception:  # noqa: BLE001 — tracing must not break serving
+            pass
+
+    def _obs_transfer_event(self, h: KVHandoff, error: str) -> None:
+        try:
+            ctx = trace_context.TraceContext.from_dict(h.trace)
+            now = time.time()
+            trace_recorder.get_recorder().record(
+                "llm.kv_transfer_failed", now, now, ctx=ctx,
+                attrs={"request_id": h.request_id, "error": error[:200],
+                       "connector": self.connector.name},
+                status="error",
+            )
+        except Exception:  # noqa: BLE001
+            pass

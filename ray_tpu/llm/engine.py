@@ -45,6 +45,37 @@ from ray_tpu.utils.logging import get_logger
 logger = get_logger("ray_tpu.llm.engine")
 
 
+def prefix_cache_hit_counter():
+    """Prompt tokens served from the prefix cache instead of recomputed.
+    Alongside the lookup counter it gives the fleet-level hit rate the
+    disaggregated decode pick consumes (llm/disagg/orchestrator.py)."""
+    from ray_tpu.util.metrics import Counter
+
+    return Counter(
+        "llm_prefix_cache_hit_tokens_total",
+        description="prompt tokens whose KV was reused from the prefix "
+        "cache at prefill admission (no recompute)",
+        tag_keys=("model",),
+    )
+
+
+def prefix_cache_lookup_counter():
+    from ray_tpu.util.metrics import Counter
+
+    return Counter(
+        "llm_prefix_cache_lookup_tokens_total",
+        description="prompt tokens considered for prefix-cache reuse at "
+        "prefill admission (hit_tokens / lookup_tokens = hit rate)",
+        tag_keys=("model",),
+    )
+
+
+def register_metrics() -> None:
+    """scripts/check_metrics.py hook: force lazy metrics to register."""
+    prefix_cache_hit_counter()
+    prefix_cache_lookup_counter()
+
+
 @dataclasses.dataclass
 class EngineConfig:
     model: llama.LlamaConfig = dataclasses.field(default_factory=lambda: llama.LLAMA_TINY)
@@ -142,6 +173,9 @@ class RequestStatus:
     RUNNING = "running"
     FINISHED = "finished"
     ABORTED = "aborted"
+    # exported to another engine via a KV handoff (disaggregated
+    # prefill/decode); this engine no longer owns the request
+    MIGRATED = "migrated"
 
 
 @dataclasses.dataclass
@@ -270,6 +304,14 @@ class LLMEngine:
             donate_argnums=(6,),
         )
         self._decode_chunks: dict[tuple, Any] = {}  # (n_steps, mode) -> jitted
+        # disaggregated serving: jitted KV-page scatter per padded width
+        # (import_handoff), and prefix-cache accounting for stats()/the
+        # decode-replica pick (hit/lookup in TOKENS, not blocks)
+        self._kv_imports: dict[int, Any] = {}
+        self.prefix_hit_tokens = 0
+        self.prefix_lookup_tokens = 0
+        self.num_prefill_batches = 0
+        self.num_kv_imports = 0
 
         # speculative decoding: drafter + verify program cache + stats
         self.drafter = None
@@ -638,6 +680,177 @@ class LLMEngine:
             )
         return moved
 
+    # -- disaggregated prefill/decode (ray_tpu.llm.disagg) --------------------
+    # A prefill-role engine runs _prefill_one + first-token sampling, then
+    # EXPORTS the sequence (KV pages + request state) instead of decoding
+    # it; a decode-role engine IMPORTS it with zero recompute. The wire
+    # unit is llm/disagg/handoff.KVHandoff; transports live in
+    # llm/disagg/connector.py. Invariant both sides rely on: a request
+    # with num_tokens N has KV written for positions 0..N-2 (the newest
+    # sampled token is fed — and its KV written — by the NEXT step).
+
+    def peek_prefix_tokens(self, prompt_token_ids: list,
+                           lora_id: Optional[str] = None) -> int:
+        """Read-only probe: prompt tokens a prefix-cache hit would cover
+        on THIS engine (the disagg decode pick's cache-awareness signal)."""
+        return self.allocator.probe_prefix(
+            list(map(int, prompt_token_ids)), self._lora_slot(lora_id)
+        )
+
+    def export_request(self, request_id: str):
+        """Export a RUNNING request as a KVHandoff and drop local
+        ownership. The request's blocks are released (full prompt blocks
+        stay resurrectable in this engine's prefix cache — a re-prefill
+        after a lost transfer hits them); callers transfer the handoff
+        and import it on a decode engine."""
+        from ray_tpu.llm.disagg.handoff import KVHandoff
+
+        req = self.requests.get(request_id)
+        if req is None or req.status != RequestStatus.RUNNING or req.seq is None:
+            raise ValueError(
+                f"request {request_id!r} is not RUNNING on this engine "
+                "(only admitted, in-flight requests can be exported)"
+            )
+        c = self.config
+        n_kv = req.num_tokens - 1  # positions with KV written
+        slots = req.seq.slots_for_range(0, n_kv)
+        # pad the gather to a power-of-two width (compiled-shape
+        # bucketing on TPU); pad rows read the trash page and are
+        # sliced off host-side after the device->host copy
+        width = max(1, 1 << (n_kv - 1).bit_length()) if n_kv else 1
+        num_slots = c.num_blocks * c.block_size
+        sl = np.full(width, num_slots, np.int32)
+        sl[:n_kv] = slots
+        sl = jnp.asarray(sl)
+        k_pages = np.asarray(self.cache["k"][:, :, sl, :])[:, :, :n_kv, :]
+        v_pages = np.asarray(self.cache["v"][:, :, sl, :])[:, :, :n_kv, :]
+        lora_id = None
+        if req.lora_slot:
+            lora_id = next(
+                (lid for lid, s in self._lora_slots.items() if s == req.lora_slot),
+                None,
+            )
+        handoff = KVHandoff(
+            request_id=req.request_id,
+            prompt_token_ids=list(req.prompt_token_ids),
+            output_token_ids=list(req.output_token_ids),
+            sampling_params=req.sampling_params,
+            key_data=np.asarray(jax.random.key_data(req._key)),
+            num_kv_tokens=n_kv,
+            k_pages=k_pages,
+            v_pages=v_pages,
+            model_sig=(c.model.n_layers, c.model.n_kv_heads, c.model.head_dim),
+            lora_id=lora_id,
+            cumulative_logprob=req.cumulative_logprob,
+            token_logprobs=list(req.token_logprobs),
+            t_arrival=req.arrival,
+            t_first_prefill=req.t_first_prefill,
+            t_first_token=req.t_first_token,
+            # span-tiling: the llm.kv_transfer span starts where the
+            # prefill span ended, so the request's phase spans stay
+            # gap-free across the hop (obs coverage gate)
+            t_export=(req.t_span_cursor if req.t_span_cursor is not None
+                      else time.time()),
+            trace=req.trace.to_dict() if req.trace is not None else None,
+        )
+        handoff.seal()
+        # drop local ownership; sealed full blocks stay in the prefix cache
+        self.running.remove(req)
+        req.seq.release()
+        req.seq = None
+        req.status = RequestStatus.MIGRATED
+        self.requests.pop(request_id, None)
+        if self.drafter is not None:
+            self.drafter.release(request_id)
+        return handoff
+
+    def _kv_import_fn(self, width: int):
+        fn = self._kv_imports.get(width)
+        if fn is None:
+            fn = jax.jit(
+                lambda cache, k, v, slots: {
+                    "k": cache["k"].at[:, :, slots, :].set(k),
+                    "v": cache["v"].at[:, :, slots, :].set(v),
+                },
+                donate_argnums=(0,),
+            )
+            self._kv_imports[width] = fn
+        return fn
+
+    def import_handoff(self, handoff,
+                       trace: Optional[trace_context.TraceContext] = None) -> str:
+        """Adopt an exported request: scatter its KV pages into this
+        engine's paged cache and enqueue it RUNNING — no prefill, no
+        recompute (`num_cached_tokens` covers every transferred
+        position). Raises NoFreeBlocksError when the cache can't hold it
+        right now (callers may retry after decode frees blocks) and
+        ValueError on a model/cache mismatch."""
+        c = self.config
+        sig = (c.model.n_layers, c.model.n_kv_heads, c.model.head_dim)
+        if tuple(handoff.model_sig) != sig:
+            raise ValueError(
+                f"handoff model signature {tuple(handoff.model_sig)} != "
+                f"engine {sig}; prefill and decode pools must serve the "
+                "same model"
+            )
+        rid = handoff.request_id
+        if rid in self.requests:
+            raise ValueError(f"request {rid!r} already live on this engine")
+        n_kv = handoff.num_kv_tokens
+        if handoff.k_pages.shape[2] != n_kv or handoff.v_pages.shape[2] != n_kv:
+            raise ValueError(
+                f"handoff KV pages cover {handoff.k_pages.shape[2]} tokens, "
+                f"header says {n_kv}"
+            )
+        req = Request(rid, list(map(int, handoff.prompt_token_ids)),
+                      handoff.sampling_params)
+        req.output_token_ids = list(map(int, handoff.output_token_ids))
+        req.cumulative_logprob = handoff.cumulative_logprob
+        req.token_logprobs = list(handoff.token_logprobs)
+        req.lora_slot = self._lora_slot(handoff.lora_id)
+        req._key = jax.random.wrap_key_data(jnp.asarray(handoff.key_data))
+        req.trace = (
+            trace
+            or trace_context.TraceContext.from_dict(handoff.trace)
+            or trace_context.new_context()
+        )
+        req.arrival = handoff.t_arrival
+        req.t_queue_start = handoff.t_arrival
+        req.t_first_prefill = handoff.t_first_prefill
+        req.t_first_token = handoff.t_first_token
+
+        seq = SequenceBlocks(self.allocator)
+        seq.chain = req.lora_slot  # salt the hash chain like _prefill_one
+        seq.ensure_capacity(req.num_tokens)  # may raise NoFreeBlocksError
+        width = max(1, 1 << (n_kv - 1).bit_length()) if n_kv else 1
+        num_slots = c.num_blocks * c.block_size
+        sl = np.full(width, num_slots, np.int32)  # pad rows hit the trash page
+        sl[:n_kv] = seq.slots_for_range(0, n_kv)
+        dt = self.cache["k"].dtype
+        k = np.zeros(handoff.k_pages.shape[:2] + (width,) + handoff.k_pages.shape[3:],
+                     handoff.k_pages.dtype)
+        v = np.zeros_like(k)
+        k[:, :, :n_kv] = handoff.k_pages
+        v[:, :, :n_kv] = handoff.v_pages
+        self.cache = self._kv_import_fn(width)(
+            self.cache, jnp.asarray(k, dt), jnp.asarray(v, dt), jnp.asarray(sl)
+        )
+        seq.num_tokens = req.num_tokens
+        # every transferred position counts as cached: zero recompute
+        seq.num_cached_tokens = n_kv
+        if c.enable_prefix_caching:
+            # seal transferred full blocks so future prompts sharing this
+            # prefix hit THIS engine's cache too
+            written = req.prompt_token_ids + req.output_token_ids[:-1]
+            seq.seal_full_blocks(written)
+        req.seq = seq
+        req.status = RequestStatus.RUNNING
+        self.requests[rid] = req
+        self.running.append(req)
+        self.num_kv_imports += 1
+        req.t_span_cursor = time.time()  # decode rounds tile from import
+        return rid
+
     def generate(
         self,
         prompts: list,
@@ -662,7 +875,18 @@ class LLMEngine:
             "num_running": len(self.running),
             "free_blocks": self.allocator.num_free,
             "total_blocks": self.config.num_blocks,
+            "num_prefill_batches": self.num_prefill_batches,
+            "prefix_cache": {
+                "hit_tokens": self.prefix_hit_tokens,
+                "lookup_tokens": self.prefix_lookup_tokens,
+                "hit_rate": (
+                    round(self.prefix_hit_tokens / self.prefix_lookup_tokens, 4)
+                    if self.prefix_lookup_tokens else 0.0
+                ),
+            },
         }
+        if self.num_kv_imports:
+            out["num_kv_imports"] = self.num_kv_imports
         if self.spec_stats is not None:
             out["spec"] = self.spec_stats.to_dict()
         return out
@@ -872,6 +1096,24 @@ class LLMEngine:
                 seq.release()
             return None  # no room: fall through to decode; retry later
         self.waiting.popleft()
+        self.num_prefill_batches += 1
+        # prefix-cache accounting over the ORIGINAL prompt only: a
+        # preemption recompute re-matching its own just-sealed blocks
+        # would otherwise inflate the hit rate the decode pick trusts
+        if req.num_preemptions == 0:
+            self.prefix_lookup_tokens += len(req.prompt_token_ids)
+            self.prefix_hit_tokens += min(matched, len(req.prompt_token_ids))
+            try:
+                tags = {"model": self.model_tag}
+                prefix_cache_lookup_counter().inc(
+                    len(req.prompt_token_ids), tags=tags
+                )
+                if matched > 0:
+                    prefix_cache_hit_counter().inc(
+                        min(matched, len(req.prompt_token_ids)), tags=tags
+                    )
+            except Exception:  # noqa: BLE001 — metrics must not break admission
+                pass
         t_admit = time.time()
         self._obs_span(
             req, "engine.queue_wait", req.t_queue_start, t_admit,

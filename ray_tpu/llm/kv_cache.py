@@ -158,6 +158,23 @@ class BlockAllocator:
         self._refcount[b] = self._refcount.get(b, 0) + 1
         return b
 
+    def probe_prefix(self, tokens: list[int], salt: int = 0) -> int:
+        """Tokens of `tokens` a prefix-cache hit WOULD cover — a
+        read-only `match_prefix` that takes no references and moves no
+        blocks. The disaggregated-serving decode pick uses it to score
+        replicas by how much of a prompt's KV they already hold without
+        perturbing LRU order or refcounts on the losers."""
+        h = salt
+        n_full = len(tokens) // self.block_size
+        matched = 0
+        for i in range(n_full):
+            blk = tuple(tokens[i * self.block_size : (i + 1) * self.block_size])
+            h = self.chain_hash(h, blk)
+            if self._hash_to_block.get(h) is None:
+                break
+            matched += 1
+        return matched * self.block_size
+
     def match_prefix(self, tokens: list[int],
                      salt: int = 0) -> tuple[list[int], int, int]:
         """Longest cached chain of FULL blocks prefixing `tokens`.

@@ -292,6 +292,10 @@ class LLMConfig:
     # admission control / load shedding (llm/admission.py); None = an
     # unbounded controller that still supports graceful drain
     admission: Any = None
+    # disaggregated prefill/decode (llm/disagg): a DisaggConfig (or dict)
+    # replaces the single engine with prefill+decode pools behind the
+    # same OpenAI surface; its .engine defaults to `engine` above
+    disagg: Any = None
 
 
 class LLMServer:
@@ -305,15 +309,32 @@ class LLMServer:
             config.engine.model.vocab_size
         )
         config.engine.eos_token_id = getattr(self.tokenizer, "eos_token_id", 2)
-        engine = LLMEngine(config.engine, params=config.params, seed=config.seed)
-        engine.model_tag = config.model_id  # SLO histogram label
+        self.orchestrator = None
+        self.runner = None
+        if config.disagg is not None:
+            # disaggregated mode: prefill+decode pools replace the single
+            # engine; submit/abort/stats route through the orchestrator
+            from ray_tpu.llm.disagg import DisaggConfig, DisaggOrchestrator
 
-        def _rebuild_engine():
-            # crash-recovery fallback: fresh engine, same weights/seed
-            return LLMEngine(config.engine, params=config.params,
-                             seed=config.seed)
+            dcfg = config.disagg
+            if isinstance(dcfg, dict):
+                dcfg = DisaggConfig(**{"engine": config.engine, **dcfg})
+            self.orchestrator = DisaggOrchestrator(
+                dcfg, params=config.params, seed=config.seed,
+                model_tag=config.model_id,
+            )
+        else:
+            engine = LLMEngine(
+                config.engine, params=config.params, seed=config.seed
+            )
+            engine.model_tag = config.model_id  # SLO histogram label
 
-        self.runner = _EngineRunner(engine, engine_factory=_rebuild_engine)
+            def _rebuild_engine():
+                # crash-recovery fallback: fresh engine, same weights/seed
+                return LLMEngine(config.engine, params=config.params,
+                                 seed=config.seed)
+
+            self.runner = _EngineRunner(engine, engine_factory=_rebuild_engine)
         acfg = config.admission
         if isinstance(acfg, dict):
             acfg = AdmissionConfig(**acfg)
@@ -323,14 +344,23 @@ class LLMServer:
 
     @property
     def engine(self) -> LLMEngine:
+        if self.orchestrator is not None:
+            # config access (eos, max_seq) — pools share one EngineConfig
+            return self.orchestrator._decode[0].engine
         # via the runner: crash recovery may have swapped in a rebuilt one
         return self.runner.engine
 
     def __del__(self):
         try:
-            self.runner.shutdown()
+            self._stop_engines()
         except Exception:
             pass
+
+    def _stop_engines(self):
+        if self.orchestrator is not None:
+            self.orchestrator.shutdown()
+        if self.runner is not None:
+            self.runner.shutdown()
 
     def shutdown(self):
         """Replica graceful-shutdown hook (serve.replica.prepare_shutdown
@@ -339,13 +369,21 @@ class LLMServer:
         try:
             self.drain(timeout_s=5.0)
         finally:
-            self.runner.shutdown()
+            self._stop_engines()
 
     def drain(self, timeout_s: float = 30.0) -> dict:
         """Maintenance-event drain: new requests get 503 + Retry-After
         while in-flight requests run to completion (bounded wait)."""
         self.admission.start_drain()
         deadline = time.time() + timeout_s
+        if self.orchestrator is not None:
+            while time.time() < deadline and self.orchestrator.has_unfinished():
+                time.sleep(0.05)
+            # count the orchestrator's inflight set, not engine queue
+            # depths: a handoff in transit sits on NO engine, and a drain
+            # that misses it reports clean while losing the request
+            left = self.orchestrator.num_inflight()
+            return {"drained": left == 0, "inflight": left}
         while time.time() < deadline:
             with self.runner.lock:
                 if not self.engine.has_unfinished():
@@ -374,9 +412,16 @@ class LLMServer:
         engine explicitly — the engine loop is a separate thread where
         the contextvar is invisible."""
         loop = asyncio.get_running_loop()
-        rid, q = self.runner.submit(
-            prompt_ids, sp, request_id=request_id, trace=obs.current()
-        )
+        if self.orchestrator is not None:
+            rid, q = self.orchestrator.submit(
+                prompt_ids, sp, request_id=request_id, trace=obs.current()
+            )
+            aborter = self.orchestrator.abort
+        else:
+            rid, q = self.runner.submit(
+                prompt_ids, sp, request_id=request_id, trace=obs.current()
+            )
+            aborter = self.runner.abort
         try:
             while True:
                 out: Optional[RequestOutput] = await loop.run_in_executor(None, q.get)
@@ -388,7 +433,7 @@ class LLMServer:
                 if out.finished:
                     return
         finally:
-            self.runner.abort(rid)
+            aborter(rid)
 
     async def _generate_text(self, prompt_ids: list, sp: SamplingParams,
                              request_id: Optional[str] = None):
@@ -517,8 +562,18 @@ class LLMServer:
     def stats(self) -> dict:
         """Engine scheduling/KV state + (when speculative decoding is on)
         acceptance-rate stats — the serving-side view of
-        LLMEngine.stats(), so operators can read draft quality without
+        LLMEngine.stats(), so operators can read draft quality (and in
+        disaggregated mode the per-pool + transfer-plane picture, incl.
+        the prefix-cache hit rate the decode pick consumes) without
         scraping Prometheus."""
+        if self.orchestrator is not None:
+            out = {
+                "model_id": self.config.model_id,
+                "mode": "disagg",
+                **self.orchestrator.stats(),
+            }
+            out["admission"] = self.admission.stats()
+            return out
         with self.runner.lock:
             out = {"model_id": self.config.model_id, **self.engine.stats()}
         out["admission"] = self.admission.stats()
@@ -527,6 +582,12 @@ class LLMServer:
 
     def _admission_check(self) -> Optional[dict]:
         """Load-shedding decision for one arriving request (None = admit)."""
+        if self.orchestrator is not None:
+            depths = self.orchestrator.queue_depths()
+            return self.admission.check(
+                num_waiting=sum(depths["prefill"]),
+                num_running=sum(depths["decode"]),
+            )
         with self.runner.lock:
             num_waiting = len(self.engine.waiting)
             num_running = len(self.engine.running)

@@ -77,6 +77,33 @@ def e2e_histogram() -> Histogram:
     )
 
 
+_KV_TRANSFER_BOUNDARIES = [
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1,
+    2.5, 5,
+]
+
+
+def kv_transfer_histogram() -> Histogram:
+    return Histogram(
+        "llm_kv_transfer_seconds",
+        description="disaggregated serving: prefill-side export -> "
+        "decode-side import complete for one KV handoff, seconds",
+        boundaries=_KV_TRANSFER_BOUNDARIES,
+        tag_keys=("model", "connector"),
+    )
+
+
+def kv_transfer_bytes_counter():
+    from ray_tpu.util.metrics import Counter
+
+    return Counter(
+        "llm_kv_transfer_bytes_total",
+        description="disaggregated serving: KV page bytes moved "
+        "prefill -> decode",
+        tag_keys=("model", "connector"),
+    )
+
+
 def router_dispatch_histogram() -> Histogram:
     return Histogram(
         "serve_router_dispatch_seconds",
@@ -95,6 +122,8 @@ def register_all() -> None:
     queue_wait_histogram()
     e2e_histogram()
     router_dispatch_histogram()
+    kv_transfer_histogram()
+    kv_transfer_bytes_counter()
 
 
 def record_request_slo(
@@ -119,6 +148,17 @@ def record_request_slo(
         e2e_histogram().observe(
             e2e_s, tags={"model": model, "finish_reason": finish_reason or ""}
         )
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def record_kv_transfer(model: str, connector: str, *, seconds: float,
+                       nbytes: int) -> None:
+    """One completed KV handoff (disaggregated serving)."""
+    try:
+        tags = {"model": model, "connector": connector}
+        kv_transfer_histogram().observe(seconds, tags=tags)
+        kv_transfer_bytes_counter().inc(max(0, int(nbytes)), tags=tags)
     except Exception:  # noqa: BLE001
         pass
 

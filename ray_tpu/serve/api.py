@@ -54,6 +54,7 @@ class Deployment:
             "health_check_period_s",
             "health_check_timeout_s",
             "graceful_shutdown_timeout_s",
+            "role",
         }
         dc_updates = {k: v for k, v in kwargs.items() if k in dc_fields}
         rest = {k: v for k, v in kwargs.items() if k not in dc_fields}
@@ -110,6 +111,7 @@ def deployment(
     health_check_period_s: float = 2.0,
     health_check_timeout_s: float = 30.0,
     graceful_shutdown_timeout_s: float = 10.0,
+    role: str = "",
     ray_actor_options: Optional[dict] = None,
 ):
     """@serve.deployment decorator."""
@@ -131,6 +133,7 @@ def deployment(
             health_check_period_s=health_check_period_s,
             health_check_timeout_s=health_check_timeout_s,
             graceful_shutdown_timeout_s=graceful_shutdown_timeout_s,
+            role=role,
         )
         opts = ray_actor_options or {}
         return Deployment(

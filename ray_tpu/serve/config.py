@@ -63,6 +63,11 @@ class DeploymentConfig:
     max_ongoing_requests: int = 100
     max_queued_requests: int = -1  # -1 = unbounded
     user_config: Any = None
+    # deployment role tag ("prefill" / "decode" for disaggregated LLM
+    # serving, "" for ordinary deployments): carried through the
+    # controller's replica listings and serve.status so operators and
+    # pool-aware clients can tell the pools apart
+    role: str = ""
     autoscaling_config: Optional[AutoscalingConfig] = None
     health_check_period_s: float = 2.0
     health_check_timeout_s: float = 30.0

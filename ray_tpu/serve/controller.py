@@ -201,6 +201,10 @@ class ServeController:
                 "replicas": reps,
                 # shipped with every refresh so routers track config updates
                 "max_queued_requests": ds.deployment_config.max_queued_requests,
+                # pool role ("prefill"/"decode" under disaggregated
+                # serving): pool-aware clients tell deployments apart
+                # without a second control-plane call
+                "role": ds.deployment_config.role,
             }
 
     def get_ingress(self, app_name: str):
@@ -237,6 +241,8 @@ class ServeController:
                         },
                         "target_replicas": ds.target_replicas,
                     }
+                    if ds.deployment_config.role:
+                        deps[ds.name]["role"] = ds.deployment_config.role
                 out["applications"][app.name] = {
                     "status": app.status,
                     "route_prefix": app.route_prefix,

@@ -1,0 +1,522 @@
+"""Disaggregated prefill/decode serving as serve deployments.
+
+The multi-deployment variant of ``ray_tpu.llm.disagg``: prefill and
+decode engines live in SEPARATE deployments (role-tagged "prefill" /
+"decode"), scaled and health-checked independently by the serve
+controller, with the ingress reusing the r09 serving machinery:
+
+ * new requests go to the prefill pool through an ordinary
+   power-of-two-choices dispatch (replica death before the handoff is
+   retried by the handle's system_retries failover — the prefill call
+   is idempotent: same completion id, nothing delivered yet);
+ * the exported KV ships over the app's ``KVConnector`` to a decode
+   replica the ingress picks with queue-depth + prefix-cache-hit-rate
+   awareness (decode stats are polled with a short TTL);
+ * the decode-side wait is PINNED (``options(pin_replica=...)``): an
+   imported KV sequence lives on exactly one replica, so a dead pin
+   surfaces as ``ReplicaPinError`` and the ingress re-prefills under a
+   bounded budget instead of silently landing on a replica without the
+   state;
+ * admission control (llm/admission.py) sheds load at the ingress
+   exactly as the colocated OpenAI app does.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.serve.disagg")
+
+
+def _is_transfer_failure(exc: BaseException) -> bool:
+    """Is this (or anything in its cause chain) a transfer-plane loss —
+    a lost/corrupt handoff or a dead pinned replica? Matched by type
+    name because user exceptions cross the actor plane wrapped in
+    TaskError/ClusterTaskError with the original as `.cause`."""
+    names = {"KVTransferError", "ReplicaPinError"}
+    seen: set = set()
+    stack: list = [exc]
+    while stack:
+        e = stack.pop()
+        if e is None or id(e) in seen:
+            continue
+        seen.add(id(e))
+        if type(e).__name__ in names:
+            return True
+        stack.append(getattr(e, "cause", None))
+        stack.append(e.__cause__)
+    return False
+
+
+def _build_engine(llm_config):
+    from ray_tpu.llm.engine import LLMEngine
+
+    return LLMEngine(
+        llm_config.engine, params=llm_config.params, seed=llm_config.seed
+    )
+
+
+def _make_connector(kind: str, namespace: str):
+    from ray_tpu.llm.disagg.connector import make_connector
+
+    if kind in ("inproc", "in_process", "inprocess"):
+        return make_connector("inproc", namespace=namespace)
+    return make_connector(kind)
+
+
+class PrefillServer:
+    """One prefill-role engine per replica: admission + prefill + first
+    token, then export + send — it never decodes."""
+
+    def __init__(self, llm_config, connector_kind: str = "inproc",
+                 namespace: str = "disagg"):
+        from ray_tpu import obs  # noqa: F401 — engine tracing rides requests
+
+        self.engine = _build_engine(llm_config)
+        self.engine.model_tag = f"{llm_config.model_id}-prefill"
+        self.connector = _make_connector(connector_kind, namespace)
+        self._lock = threading.Lock()
+        self._outs: dict[str, Any] = {}
+        self._handoffs: dict[str, Any] = {}
+
+    def prefill(self, prompt_ids: list, sampling: dict, request_id: str,
+                target: Any) -> dict:
+        """Prefill one request and ship its KV to ``target``. Returns the
+        first sampled token(s); raises KVTransferError when the handoff
+        was lost (the ingress re-prefills, same completion id)."""
+        from ray_tpu import obs
+        from ray_tpu.llm.sampling import SamplingParams
+
+        sp = SamplingParams(**sampling)
+        with self._lock:
+            self.engine.add_request(
+                list(prompt_ids), sp, request_id=request_id,
+                trace=obs.current(),
+            )
+        deadline = time.time() + 120.0
+        while True:
+            with self._lock:
+                if request_id in self._outs:
+                    break
+                if self.engine.has_unfinished():
+                    for out in self.engine.step():
+                        self._outs[out.request_id] = out
+                    # everything still running was just admitted: export
+                    # before it ever decodes (a concurrent call picks its
+                    # own export up from the shared dict)
+                    for r in list(self.engine.running):
+                        self._handoffs[r.request_id] = self.engine.export_request(
+                            r.request_id
+                        )
+                elif request_id not in self._outs:
+                    raise RuntimeError(
+                        f"request {request_id!r} vanished from the prefill "
+                        "engine without an output"
+                    )
+            if time.time() > deadline:
+                raise TimeoutError(f"prefill of {request_id!r} timed out")
+        with self._lock:
+            out = self._outs.pop(request_id)
+            handoff = self._handoffs.pop(request_id, None)
+        if handoff is not None:
+            # KVTransferError propagates to the ingress as a user
+            # exception — deliberate: transfer loss is NOT a replica
+            # death, the handle must not blind-retry it (the ingress owns
+            # the budgeted re-prefill)
+            self.connector.send(target, handoff)
+        return {
+            "token_ids": list(out.output_token_ids),
+            "finished": out.finished,
+            "finish_reason": out.finish_reason,
+            "handed_off": handoff is not None,
+        }
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {**self.engine.stats(), "connector": self.connector.stats()}
+
+    def shutdown(self):
+        self.connector.close()
+
+
+class DecodeServer:
+    """One decode-role engine per replica: imports handoffs from its
+    connector target and runs pure decode rounds on a loop thread."""
+
+    POLL_S = 0.02
+
+    def __init__(self, llm_config, connector_kind: str = "inproc",
+                 namespace: str = "disagg"):
+        self.engine = _build_engine(llm_config)
+        self.engine.model_tag = f"{llm_config.model_id}-decode"
+        self.connector = _make_connector(connector_kind, namespace)
+        self._target_id = f"decode-{uuid.uuid4().hex[:12]}"
+        self._target = self.connector.register_target(self._target_id)
+        self._lock = threading.Lock()
+        self._done: dict[str, Any] = {}     # rid -> final RequestOutput
+        self._failed: dict[str, str] = {}   # rid -> reason (corrupt/no room)
+        self._stop = False
+        self._thread = threading.Thread(
+            target=self._loop, name=f"disagg-decode-{self._target_id}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    # -- control plane --------------------------------------------------------
+
+    def kv_target(self) -> Any:
+        """Opaque connector address of THIS replica (the ingress maps
+        replica_id -> target for pinned KV-affinity dispatch)."""
+        return self._target
+
+    def stats(self) -> dict:
+        with self._lock:
+            s = self.engine.stats()
+        s["connector"] = self.connector.stats()
+        return s
+
+    # -- data plane -----------------------------------------------------------
+
+    def _loop(self) -> None:
+        from ray_tpu.llm.kv_cache import NoFreeBlocksError
+
+        while not self._stop:
+            with self._lock:
+                busy = self.engine.has_unfinished()
+            h = self.connector.recv(
+                self._target_id, timeout_s=0.001 if busy else self.POLL_S
+            )
+            if h is not None:
+                if not h.verify():
+                    with self._lock:
+                        self._failed[h.request_id] = "checksum failed (corrupt)"
+                else:
+                    try:
+                        with self._lock:
+                            self.engine.import_handoff(h)
+                    except NoFreeBlocksError:
+                        with self._lock:
+                            self._failed[h.request_id] = "no KV room"
+                    except Exception as e:  # noqa: BLE001
+                        with self._lock:
+                            self._failed[h.request_id] = f"import failed: {e}"
+            if busy:
+                try:
+                    with self._lock:
+                        for out in self.engine.step():
+                            if out.finished:
+                                self._done[out.request_id] = out
+                except Exception:  # noqa: BLE001
+                    logger.exception("decode engine step failed; recovering")
+                    try:
+                        with self._lock:
+                            self.engine.recover()
+                    except Exception:  # noqa: BLE001
+                        logger.exception("decode engine unrecoverable")
+
+    def wait_finish(self, request_id: str, timeout_s: float = 120.0) -> dict:
+        """Block until ``request_id`` (imported via a prior handoff to
+        this replica) finishes; bounded — a handoff that never arrived
+        fails the wait instead of hanging the ingress."""
+        from ray_tpu.llm.disagg.connector import KVTransferError
+
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            with self._lock:
+                out = self._done.pop(request_id, None)
+                fail = self._failed.pop(request_id, None)
+            if fail is not None:
+                raise KVTransferError(
+                    f"handoff of {request_id!r} unusable on this replica: {fail}"
+                )
+            if out is not None:
+                return {
+                    "token_ids": list(out.output_token_ids),
+                    "finish_reason": out.finish_reason,
+                    "num_cached_tokens": out.num_cached_tokens,
+                }
+            time.sleep(0.005)
+        raise KVTransferError(
+            f"request {request_id!r} did not finish on this replica within "
+            f"{timeout_s}s (handoff lost?)"
+        )
+
+    def shutdown(self):
+        self._stop = True
+        self._thread.join(timeout=5)
+        self.connector.close()
+
+
+class DisaggIngress:
+    """OpenAI-style ingress over the two pools (reference shape:
+    llm/openai_api.LLMServer, minus streaming)."""
+
+    STATS_TTL_S = 0.5
+    MAX_RETRIES = 2
+
+    def __init__(self, llm_config, prefill_handle, decode_handle):
+        from ray_tpu.llm.admission import AdmissionConfig, AdmissionController
+        from ray_tpu.llm.openai_api import ByteTokenizer
+
+        self.config = llm_config
+        self.tokenizer = llm_config.tokenizer or ByteTokenizer(
+            llm_config.engine.model.vocab_size
+        )
+        self.prefill_handle = prefill_handle
+        self.decode_handle = decode_handle
+        acfg = llm_config.admission
+        if isinstance(acfg, dict):
+            acfg = AdmissionConfig(**acfg)
+        self.admission = AdmissionController(
+            acfg or AdmissionConfig(), model_tag=llm_config.model_id
+        )
+        self._lock = threading.Lock()
+        self._targets: dict[str, Any] = {}   # decode replica_id -> kv target
+        self._stats: dict[str, dict] = {}    # decode replica_id -> stats
+        self._stats_at = 0.0
+        self.num_reprefills = 0
+
+    # -- decode-pool discovery + pick -----------------------------------------
+
+    def _decode_router(self):
+        return self.decode_handle._get_router()
+
+    def _refresh_decode(self) -> list[str]:
+        """Poll decode replica ids, kv targets, and stats with a TTL."""
+        rids = self._decode_router().replica_ids()
+        now = time.time()
+        with self._lock:
+            fresh = now - self._stats_at < self.STATS_TTL_S
+            known = set(self._targets)
+        if fresh and known >= set(rids):
+            return rids
+        # fire every poll before collecting any: the waits overlap, so a
+        # hung (not yet evicted) replica costs one timeout window, not
+        # one per replica, on the request path that called us
+        target_futs, stat_futs = {}, {}
+        for rid in rids:
+            try:
+                if rid not in known:
+                    target_futs[rid] = self.decode_handle.options(
+                        pin_replica=rid
+                    ).kv_target.remote()
+                stat_futs[rid] = self.decode_handle.options(
+                    pin_replica=rid
+                ).stats.remote()
+            except Exception:  # noqa: BLE001 — replica racing startup/death
+                continue
+        for rid, fut in target_futs.items():
+            try:
+                target = fut.result(timeout_s=10)
+            except Exception:  # noqa: BLE001
+                continue
+            with self._lock:
+                self._targets[rid] = target
+        stats = {}
+        for rid, fut in stat_futs.items():
+            try:
+                stats[rid] = fut.result(timeout_s=10)
+            except Exception:  # noqa: BLE001
+                continue
+        with self._lock:
+            self._stats = stats
+            self._stats_at = now
+            dead = set(self._targets) - set(rids)
+            for rid in dead:
+                self._targets.pop(rid, None)
+        return rids
+
+    def _pick_decode(self) -> tuple[str, Any]:
+        """Queue depth first, prefix-cache hit rate as tiebreak — the
+        serve-mode mirror of DisaggOrchestrator._pick_decode."""
+        from ray_tpu.serve.router import ReplicaPinError
+
+        rids = self._refresh_decode()
+        with self._lock:
+            scored = []
+            for rid in rids:
+                if rid not in self._targets:
+                    continue
+                s = self._stats.get(rid, {})
+                depth = s.get("num_waiting", 0) + s.get("num_running", 0)
+                hit = s.get("prefix_cache", {}).get("hit_rate", 0.0)
+                scored.append((depth, -hit, rid))
+            if not scored:
+                raise ReplicaPinError("no decode replicas available")
+            _, _, rid = min(scored)
+            return rid, self._targets[rid]
+
+    # -- request path ---------------------------------------------------------
+
+    def _sampling_from_body(self, body: dict) -> dict:
+        return {
+            "max_tokens": int(body.get("max_tokens", 64)),
+            "temperature": float(body.get("temperature", 1.0)),
+            "top_k": int(body.get("top_k", 0)),
+            "top_p": float(body.get("top_p", 1.0)),
+            "seed": body.get("seed"),
+            "logprobs": bool(body.get("logprobs", False)),
+        }
+
+    def _generate(self, prompt_ids: list, sampling: dict, rid: str) -> dict:
+        """prefill -> handoff -> pinned decode wait, with the bounded
+        re-prefill ladder on any transfer-plane loss."""
+        last: Optional[BaseException] = None
+        for attempt in range(self.MAX_RETRIES + 1):
+            if attempt > 0:
+                self.num_reprefills += 1
+            try:
+                decode_rid, target = self._pick_decode()
+                pre = self.prefill_handle.prefill.remote(
+                    prompt_ids, sampling, rid, target
+                ).result(timeout_s=180)
+                if pre["finished"]:
+                    return {
+                        "token_ids": pre["token_ids"],
+                        "finish_reason": pre["finish_reason"],
+                    }
+                return self.decode_handle.options(
+                    pin_replica=decode_rid
+                ).wait_finish.remote(rid).result(timeout_s=180)
+            except BaseException as e:  # noqa: BLE001 — filtered below
+                if not _is_transfer_failure(e):
+                    raise
+                # lost handoff / dead pinned replica: re-prefill under the
+                # budget — the completion id is stable and nothing beyond
+                # the prefill token was delivered, so the retry is
+                # idempotent from the client's point of view
+                last = e
+                logger.warning(
+                    "disagg request %s attempt %d failed (%s); re-prefilling",
+                    rid, attempt + 1, e,
+                )
+                with self._lock:
+                    self._stats_at = 0.0  # force re-discovery
+        raise RuntimeError(
+            f"request {rid!r}: transfer plane failed "
+            f"{self.MAX_RETRIES + 1} times"
+        ) from last
+
+    async def completions(self, body: dict) -> dict:
+        import uuid as _uuid
+
+        from ray_tpu import obs
+
+        rej = self._admission_check()
+        if rej is not None:
+            return rej
+        prompts = body.get("prompt", "")
+        if not isinstance(prompts, list):
+            prompts = [prompts]
+        sampling = self._sampling_from_body(body)
+        rid = f"cmpl-{_uuid.uuid4().hex[:24]}"
+        with obs.span("api.completions", attrs={
+            "request_id": rid,
+            "model": body.get("model", self.config.model_id),
+            "endpoint": "/v1/completions", "disagg": True,
+        }) as ctx:
+            import asyncio
+
+            loop = asyncio.get_running_loop()
+            results = []
+            n_prompt = 0
+            for i, p in enumerate(prompts):
+                ids = self.tokenizer.encode(str(p))
+                n_prompt += len(ids)
+                erid = rid if len(prompts) == 1 else f"{rid}-{i}"
+                out = await loop.run_in_executor(
+                    None, self._generate, ids, sampling, erid
+                )
+                toks = out["token_ids"]
+                if toks and toks[-1] == self.config.engine.eos_token_id:
+                    toks = toks[:-1]
+                results.append((self.tokenizer.decode(toks), toks,
+                                out["finish_reason"]))
+        n_out = sum(len(t) for _, t, _ in results)
+        return {
+            "id": rid,
+            "object": "text_completion",
+            "created": int(time.time()),
+            "model": body.get("model", self.config.model_id),
+            "trace_id": ctx.trace_id,
+            "choices": [
+                {"index": i, "text": text, "finish_reason": reason,
+                 "logprobs": None}
+                for i, (text, _t, reason) in enumerate(results)
+            ],
+            "usage": {
+                "prompt_tokens": n_prompt,
+                "completion_tokens": n_out,
+                "total_tokens": n_prompt + n_out,
+            },
+        }
+
+    def _admission_check(self) -> Optional[dict]:
+        with self._lock:
+            stats = dict(self._stats)
+        waiting = sum(s.get("num_waiting", 0) for s in stats.values())
+        running = sum(s.get("num_running", 0) for s in stats.values())
+        return self.admission.check(num_waiting=waiting, num_running=running)
+
+    def stats(self) -> dict:
+        self._refresh_decode()
+        with self._lock:
+            return {
+                "model_id": self.config.model_id,
+                "mode": "disagg",
+                "decode": dict(self._stats),
+                "admission": self.admission.stats(),
+                "reprefills": self.num_reprefills,
+            }
+
+    async def __call__(self, request):
+        path, method = request.path, request.method
+        if path.rstrip("/") == "/v1/stats" and method == "GET":
+            return self.stats()
+        if path.rstrip("/") == "/v1/completions" and method == "POST":
+            return await self.completions(request.json())
+        return {"error": {"message": f"no route {method} {path}", "code": 404}}
+
+
+def build_disagg_openai_app(
+    llm_config,
+    *,
+    num_prefill: int = 1,
+    num_decode: int = 1,
+    connector: str = "inproc",
+    name: str = "llm-disagg",
+    route_prefix: str = "/disagg",
+):
+    """Deploy prefill pool + decode pool + ingress; returns the ingress
+    handle. Pools are role-tagged so serve.status and replica listings
+    show the topology."""
+    from ray_tpu import serve
+
+    prefill_dep = serve.deployment(
+        PrefillServer,
+        name=f"Prefill:{llm_config.model_id}",
+        num_replicas=num_prefill,
+        role="prefill",
+    )
+    decode_dep = serve.deployment(
+        DecodeServer,
+        name=f"Decode:{llm_config.model_id}",
+        num_replicas=num_decode,
+        role="decode",
+    )
+    ingress_dep = serve.deployment(
+        DisaggIngress,
+        name=f"DisaggIngress:{llm_config.model_id}",
+        num_replicas=1,
+    )
+    app = ingress_dep.bind(
+        llm_config,
+        prefill_dep.bind(llm_config, connector, name),
+        decode_dep.bind(llm_config, connector, name),
+    )
+    return serve.run(app, name=name, route_prefix=route_prefix)

@@ -26,6 +26,14 @@ class BackpressureError(Exception):
     serve._private.router queue-length backpressure)."""
 
 
+class ReplicaPinError(Exception):
+    """A pinned dispatch's target replica is gone (dead, evicted, or
+    replaced). Pinning exists for replica-resident state — a KV handoff
+    imported on ONE decode replica — so the router must fail loudly
+    instead of silently re-homing the call onto a replica that doesn't
+    hold the state (disaggregated serving re-prefills on this error)."""
+
+
 class Router:
     def __init__(
         self,
@@ -156,9 +164,38 @@ class Router:
         with self._lock:
             return sum(self._inflight.values())
 
+    def replica_ids(self, refresh: bool = True) -> list[str]:
+        """Current running replica ids (pool enumeration for pool-aware
+        callers, e.g. disaggregated serving discovering decode targets)."""
+        if refresh:
+            self._refresh()
+        with self._lock:
+            return [rid for rid, _, _ in self._replicas]
+
+    def _pick_pinned(self, pin: str):
+        """Hard replica pin: the request must land on `pin` (it holds
+        replica-resident state) or fail with ReplicaPinError — suspects
+        included, p2c skipped. One blocking refresh covers the window
+        where the controller just replaced the set."""
+        for attempt in range(2):
+            with self._lock:
+                for r in self._replicas:
+                    if r[0] == pin:
+                        return r
+            if attempt == 0:
+                self._refresh(block=True)
+        raise ReplicaPinError(
+            f"replica {pin!r} of {self._app}/{self._deployment} is gone; "
+            "its replica-resident state died with it"
+        )
+
     def dispatch(self, method_name: Optional[str], args, kwargs, streaming: bool,
-                 exclude: Optional[set] = None):
+                 exclude: Optional[set] = None, pin: Optional[str] = None):
         """Route one request; returns (replica_id, ObjectRef-or-generator).
+
+        ``pin`` routes to exactly that replica (replica-resident state:
+        a transferred KV sequence lives on ONE decode replica) or raises
+        ReplicaPinError; otherwise power-of-two-choices picks.
 
         The dispatch wall-clock (refresh + pick + submit — the router's
         own contribution to request latency) lands in the
@@ -175,7 +212,10 @@ class Router:
                 f"deployment {self._app}/{self._deployment}: "
                 f"max_queued_requests={self._max_queued} exceeded"
             )
-        rid, handle, _max_ongoing = self._pick(exclude)
+        if pin is not None:
+            rid, handle, _max_ongoing = self._pick_pinned(pin)
+        else:
+            rid, handle, _max_ongoing = self._pick(exclude)
         with self._lock:
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
         try:

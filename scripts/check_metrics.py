@@ -30,6 +30,7 @@ INSTRUMENTED = [
     ("ray_tpu.llm.decode_loop", "chunk_histogram"),
     ("ray_tpu.llm.spec.stats", "_spec_metrics"),
     ("ray_tpu.llm.admission", "register_metrics"),
+    ("ray_tpu.llm.engine", "register_metrics"),
 ]
 
 _NAME_RE = re.compile(r"^(ray_tpu|llm)_[a-z0-9][a-z0-9_]*$")

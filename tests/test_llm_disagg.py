@@ -1,0 +1,585 @@
+"""Disaggregated prefill/decode serving tests (ray_tpu.llm.disagg).
+
+Contracts under test:
+ * export/import is lossless: byte-identical tokens colocated vs
+   disaggregated (both connectors), zero prefill recompute on the decode
+   side (num_cached_tokens covers the full prompt after import);
+ * allocator hygiene: export releases every prefill-side block (sealed
+   prefixes stay resurrectable), decode-side blocks drain on finish;
+ * seeded sampler streams survive the hop (key_data rides the handoff);
+ * the transfer plane fails safe: dropped/corrupt handoffs re-prefill
+   under a bounded budget (chaos DROP_KV_TRANSFER / CORRUPT_KV_TRANSFER)
+   instead of hanging;
+ * serve-layer affinity: pinned dispatch routes to exactly the chosen
+   replica or raises ReplicaPinError;
+ * checked-in bench captures keep the mixed-load TPOT guard, the
+   availability-SLO completion-rate gate, and >=90% span coverage.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.llm.disagg import (
+    DisaggConfig,
+    DisaggOrchestrator,
+    InProcessConnector,
+    KVTransferError,
+    RpcKVConnector,
+)
+from ray_tpu.llm.engine import EngineConfig, LLMEngine
+from ray_tpu.llm.sampling import SamplingParams
+from ray_tpu.models import llama
+
+pytestmark = pytest.mark.disagg
+
+FP32_TINY = dataclasses.replace(llama.LLAMA_TINY, dtype=jnp.float32)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def engine_config(**kw):
+    kw.setdefault("model", FP32_TINY)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_num_seqs", 4)
+    kw.setdefault("max_prefill_len", 64)
+    return EngineConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama.init_params(FP32_TINY, jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(1)
+    return [
+        [int(x) for x in rng.integers(3, 120, rng.integers(8, 24))]
+        for _ in range(4)
+    ]
+
+
+GREEDY = SamplingParams(max_tokens=10, temperature=0.0, ignore_eos=True)
+
+
+@pytest.fixture(scope="module")
+def colocated_out(tiny_params, prompts):
+    eng = LLMEngine(engine_config(), params=tiny_params, seed=0)
+    return eng.generate(prompts, GREEDY)
+
+
+# ---------------------------------------------------------------------------
+# handoff + engine export/import invariants
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def exported(tiny_params, prompts):
+    """One prefill engine + exported handoff, shared by the invariant
+    tests (each engine construction pays its own jit compiles — the
+    tier-1 lane doesn't need four copies of the same prefill)."""
+    pre = LLMEngine(engine_config(), params=tiny_params, seed=0)
+    pre.add_request(prompts[0], GREEDY, request_id="x1")
+    outs = pre.step()
+    assert len(pre.running) == 1
+    return pre, outs, pre.export_request("x1")
+
+
+def test_handoff_checksum_detects_corruption(prompts, exported):
+    from ray_tpu.llm.disagg.connector import _corrupt_handoff
+
+    _pre, _outs, h = exported
+    assert h.verify()
+    assert h.num_kv_tokens == len(prompts[0])
+    bad = _corrupt_handoff(h)
+    assert not bad.verify()
+    assert h.verify()  # the original is untouched (copy-on-corrupt)
+
+
+def test_export_import_refcount_and_hash_hygiene(tiny_params, prompts,
+                                                exported):
+    prompt = prompts[0]
+    pre, outs, h = exported
+    # prefill side dropped ownership entirely; every block is reclaimable
+    # (sealed prefix blocks sit zero-ref in the reuse pool)
+    assert pre.requests == {} and pre.running == []
+    assert pre.allocator.num_free == pre.config.num_blocks
+    # ...and the sealed prefix is still resurrectable: a re-prefill of the
+    # same prompt is a cache hit
+    assert pre.allocator.probe_prefix(prompt) > 0
+
+    dec = LLMEngine(engine_config(), params=tiny_params, seed=0)
+    total = dec.config.num_blocks
+    rid = dec.import_handoff(h)
+    req = dec.requests[rid]
+    # zero recompute: the cached prefix covers the full prompt
+    assert req.seq.num_cached_tokens >= len(prompt)
+    assert dec.num_prefill_batches == 0
+    used = dec.allocator.blocks_needed(req.num_tokens)
+    assert total - len(dec.allocator._free) == used
+    # imported full blocks are sealed into the decode engine's prefix
+    # cache under the same chain hashes
+    assert dec.allocator.probe_prefix(prompt[: (len(prompt) // 8) * 8]) > 0
+    while dec.has_unfinished():
+        dec.step()
+    # blocks drain on finish (hashed ones into the zero-ref pool)
+    assert dec.allocator.num_free == total
+    assert dec.num_prefill_batches == 0
+
+
+def test_import_rejects_model_mismatch(tiny_params, exported):
+    _pre, _outs, h = exported
+    bad = dataclasses.replace(h, model_sig=(1, 1, 4))
+    dec = LLMEngine(engine_config(), params=tiny_params, seed=0)
+    with pytest.raises(ValueError, match="signature"):
+        dec.import_handoff(bad)
+
+
+def test_connector_roundtrip_inproc_and_rpc(exported):
+    _pre, _outs, h = exported
+    inproc = InProcessConnector(namespace="t-roundtrip")
+    tgt = inproc.register_target("d0")
+    inproc.send(tgt, h)
+    got = inproc.recv("d0", timeout_s=1.0)
+    assert got is not None and got.verify()
+    assert got.request_id == h.request_id
+    assert inproc.recv("d0", timeout_s=0.01) is None  # bounded, no hang
+    inproc.close()
+
+    rpc = RpcKVConnector()
+    try:
+        tgt = rpc.register_target("d0")
+        rpc.send(tgt, h)
+        got = rpc.recv("d0", timeout_s=5.0)
+        assert got is not None and got.verify()
+        assert got.num_kv_tokens == h.num_kv_tokens
+        np.testing.assert_array_equal(got.k_pages, h.k_pages)
+        # unknown target fails loudly at the receiver, sender sees a
+        # typed transfer error (not a hang)
+        with pytest.raises(KVTransferError):
+            rpc.send((tgt[0], tgt[1], "nope"), h)
+    finally:
+        rpc.close()
+
+
+# ---------------------------------------------------------------------------
+# orchestrator end-to-end
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("connector", ["inproc", "rpc"])
+def test_greedy_identity_colocated_vs_disagg(tiny_params, prompts,
+                                             colocated_out, connector):
+    orch = DisaggOrchestrator(
+        DisaggConfig(engine=engine_config(), num_prefill=1, num_decode=2,
+                     connector=connector),
+        params=tiny_params, seed=0, model_tag=f"t-{connector}",
+    )
+    try:
+        out = orch.generate(prompts, GREEDY, timeout_s=120)
+        assert out == colocated_out  # byte-identical
+        s = orch.stats()
+        # zero prefill recompute on the decode side
+        assert all(e["num_prefill_batches"] == 0 for e in s["decode"])
+        assert sum(e.get("num_kv_imports", 0) for e in s["decode"]) == len(prompts)
+        assert s["transfer"]["kv_transfers"] == len(prompts)
+        assert s["transfer"]["bytes_sent"] > 0
+    finally:
+        orch.shutdown()
+
+
+def test_seeded_determinism_across_handoff(tiny_params, prompts):
+    """A seeded, sampled (temperature>0) request produces identical
+    tokens colocated vs disaggregated: the sampler key and stream
+    position ride the KV handoff. The request id is pinned on both
+    sides — the key derives from (seed, request_id), which is exactly
+    how the OpenAI layer names engine requests (completion ids)."""
+    sp = SamplingParams(max_tokens=10, temperature=0.9, top_k=8, top_p=0.95,
+                       seed=1234, ignore_eos=True)
+    rid = "seeded-handoff-1"
+    eng = LLMEngine(engine_config(), params=tiny_params, seed=0)
+    eng.add_request(prompts[0], sp, request_id=rid)
+    colocated = None
+    while eng.has_unfinished():
+        for out in eng.step():
+            if out.finished:
+                colocated = out.output_token_ids
+    assert colocated is not None
+    orch = DisaggOrchestrator(
+        DisaggConfig(engine=engine_config(), num_prefill=1, num_decode=1),
+        params=tiny_params, seed=0, model_tag="t-seeded",
+    )
+    try:
+        _rid, q = orch.submit(prompts[0], sp, request_id=rid)
+        disagg = None
+        deadline = time.time() + 120
+        while disagg is None and time.time() < deadline:
+            out = q.get(timeout=120)
+            if isinstance(out, BaseException):
+                raise out
+            if out is not None and out.finished:
+                disagg = out.output_token_ids
+    finally:
+        orch.shutdown()
+    assert disagg == colocated
+
+
+def test_orchestrator_mixed_sampling_two_decode(tiny_params, prompts):
+    """E2e over 2 in-process decode engines with heterogeneous sampling
+    params in flight at once; every request completes and the decode
+    pick spreads by queue depth."""
+    sps = [
+        GREEDY,
+        SamplingParams(max_tokens=8, temperature=0.8, seed=7, ignore_eos=True),
+        GREEDY,
+        SamplingParams(max_tokens=6, temperature=1.1, top_p=0.9, seed=9,
+                       ignore_eos=True),
+    ]
+    orch = DisaggOrchestrator(
+        DisaggConfig(engine=engine_config(), num_prefill=1, num_decode=2),
+        params=tiny_params, seed=0, model_tag="t-mixed",
+    )
+    try:
+        out = orch.generate(prompts, sps, timeout_s=120)
+        assert all(o is not None and len(o) > 0 for o in out)
+        for toks, sp in zip(out, sps):
+            assert len(toks) == sp.max_tokens
+        s = orch.stats()
+        assert s["transfer"]["kv_transfers"] == len(prompts)
+    finally:
+        orch.shutdown()
+
+
+def test_same_tag_orchestrators_do_not_cross_deliver(tiny_params, prompts,
+                                                     colocated_out):
+    """Two orchestrators with the SAME model_tag in one process (e.g.
+    num_replicas=2 of an LLMConfig(disagg=...) deployment) get isolated
+    in-process namespaces: B's idle decode loop polls its own queue, so
+    it can never steal A's handoff (which it would silently drop as
+    not-inflight, hanging A's request forever)."""
+    cfg = DisaggConfig(engine=engine_config(), num_prefill=1, num_decode=1)
+    a = DisaggOrchestrator(cfg, params=tiny_params, seed=0, model_tag="twin")
+    b = DisaggOrchestrator(cfg, params=tiny_params, seed=0, model_tag="twin")
+    try:
+        assert a.connector.namespace != b.connector.namespace
+        # B's decode loop is live and polling while A serves: before the
+        # namespace isolation this raced to a TimeoutError ~half the time
+        out = a.generate(prompts[:2], GREEDY, timeout_s=120)
+        assert out == colocated_out[:2]
+        assert b.generate(prompts[:1], GREEDY, timeout_s=120) == colocated_out[:1]
+    finally:
+        a.shutdown()
+        b.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chaos: the transfer plane fails safe
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("kind", ["drop_kv_transfer", "corrupt_kv_transfer"])
+def test_lost_transfer_reprefills_not_hangs(tiny_params, prompts,
+                                            colocated_out, kind):
+    from ray_tpu.chaos import harness as chaos
+    from ray_tpu.chaos.schedule import FaultSchedule, FaultSpec
+
+    sched = FaultSchedule(7, [
+        FaultSpec(kind, site="disagg.kv_transfer", max_fires=1),
+    ])
+    chaos.install(sched)
+    try:
+        orch = DisaggOrchestrator(
+            DisaggConfig(engine=engine_config(), num_prefill=2, num_decode=1),
+            params=tiny_params, seed=0, model_tag=f"t-{kind}",
+        )
+        try:
+            t0 = time.time()
+            out = orch.generate(prompts, GREEDY, timeout_s=120)
+            assert time.time() - t0 < 60  # bounded, not a hang
+            assert out == colocated_out  # the retry is lossless
+            assert orch.num_reprefills == 1
+            assert orch.num_transfer_failures == 1
+            assert sched.fired_kinds() == [kind]
+        finally:
+            orch.shutdown()
+    finally:
+        chaos.uninstall()
+
+
+@pytest.mark.chaos
+def test_transfer_failover_budget_exhausts_loudly(tiny_params, prompts):
+    """An unbounded drop schedule must fail the request with a typed
+    error once the re-prefill budget runs out — never hang the caller."""
+    from ray_tpu.chaos import harness as chaos
+    from ray_tpu.chaos.schedule import FaultSchedule, FaultSpec
+
+    sched = FaultSchedule(3, [
+        FaultSpec("drop_kv_transfer", site="disagg.kv_transfer"),
+    ])
+    chaos.install(sched)
+    try:
+        orch = DisaggOrchestrator(
+            DisaggConfig(engine=engine_config(), num_prefill=1, num_decode=1,
+                         max_handoff_retries=1),
+            params=tiny_params, seed=0, model_tag="t-budget",
+        )
+        try:
+            with pytest.raises(KVTransferError, match="budget"):
+                orch.generate([prompts[0]], GREEDY, timeout_s=60)
+        finally:
+            orch.shutdown()
+    finally:
+        chaos.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# prefix-cache observability (satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_prefix_cache_hit_rate_in_stats_and_metrics(tiny_params, prompts):
+    from ray_tpu.util.metrics import registry_snapshot
+
+    eng = LLMEngine(engine_config(), params=tiny_params, seed=0)
+    eng.model_tag = "t-prefix"
+    prompt = prompts[0]
+    eng.generate([prompt], GREEDY)
+    s1 = eng.stats()["prefix_cache"]
+    assert s1["lookup_tokens"] == len(prompt) and s1["hit_tokens"] == 0
+    eng.generate([prompt], GREEDY)
+    s2 = eng.stats()["prefix_cache"]
+    assert s2["hit_tokens"] > 0
+    assert 0.0 < s2["hit_rate"] <= 1.0
+    names = {m.name for m in registry_snapshot()}  # registry adds ray_tpu_
+    assert "ray_tpu_llm_prefix_cache_hit_tokens_total" in names
+    assert "ray_tpu_llm_prefix_cache_lookup_tokens_total" in names
+    # the registry stays lint-clean with the new counters registered
+    import importlib.util
+
+    path = os.path.join(REPO, "scripts", "check_metrics.py")
+    spec = importlib.util.spec_from_file_location("check_metrics", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    assert mod.run_check() == []
+
+
+def test_openai_stats_surface_prefix_cache_and_disagg(tiny_params):
+    """GET /v1/stats carries the prefix-cache hit rate (colocated) and
+    the per-pool + transfer picture (disagg mode of LLMServer)."""
+    import asyncio
+
+    from ray_tpu.llm.openai_api import LLMConfig, LLMServer
+
+    class Req:
+        def __init__(self, path, method, body=None):
+            self.path, self.method, self._b = path, method, body
+
+        def json(self):
+            return self._b
+
+    srv = LLMServer(LLMConfig(model_id="t-oai", engine=engine_config(),
+                              params=tiny_params))
+    try:
+        body = {"prompt": "hello prefix", "max_tokens": 6, "temperature": 0.0}
+        asyncio.run(srv.completions(dict(body)))
+        asyncio.run(srv.completions(dict(body)))
+        stats = asyncio.run(srv.__call__(Req("/v1/stats", "GET")))
+        assert stats["prefix_cache"]["hit_tokens"] > 0
+        colocated_text = asyncio.run(srv.completions(dict(body)))
+    finally:
+        srv.shutdown()
+
+    dsrv = LLMServer(LLMConfig(
+        model_id="t-oai-d", engine=engine_config(), params=tiny_params,
+        disagg={"num_prefill": 1, "num_decode": 1},
+    ))
+    try:
+        out = asyncio.run(dsrv.completions(dict(body)))
+        assert out["choices"][0]["text"] == colocated_text["choices"][0]["text"]
+        stats = asyncio.run(dsrv.__call__(Req("/v1/stats", "GET")))
+        assert stats["mode"] == "disagg"
+        assert stats["transfer"]["kv_transfers"] == 1
+        assert len(stats["prefill"]) == 1 and len(stats["decode"]) == 1
+        assert stats["decode"][0]["num_prefill_batches"] == 0
+    finally:
+        dsrv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# serve layer: pinned (KV-affinity) dispatch + the disagg app
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def serve_instance():
+    import ray_tpu
+    from ray_tpu import serve
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=32)
+    yield
+    serve.shutdown()
+
+
+def test_pinned_dispatch_routes_and_fails_loudly(serve_instance):
+    import uuid
+
+    from ray_tpu import serve
+    from ray_tpu.serve.router import ReplicaPinError
+
+    @serve.deployment(num_replicas=2)
+    class Who:
+        def __init__(self):
+            self.me = uuid.uuid4().hex
+
+        def whoami(self):
+            return self.me
+
+    handle = serve.run(Who.bind(), name="pin-test", route_prefix=None)
+    router = handle._get_router()
+    rids = router.replica_ids()
+    assert len(rids) == 2
+    # pinning routes to exactly the chosen replica, repeatably
+    by_rid = {
+        rid: handle.options(pin_replica=rid).whoami.remote().result()
+        for rid in rids
+    }
+    assert len(set(by_rid.values())) == 2
+    for rid, who in by_rid.items():
+        assert handle.options(pin_replica=rid).whoami.remote().result() == who
+    with pytest.raises(ReplicaPinError):
+        handle.options(pin_replica="replica-that-never-was").whoami.remote()
+
+
+def test_serve_disagg_app_end_to_end(serve_instance, tiny_params):
+    from ray_tpu import serve
+    from ray_tpu.llm.openai_api import LLMConfig, LLMServer
+    from ray_tpu.serve.disagg import build_disagg_openai_app
+
+    class Req:
+        def __init__(self, path, method, body=None):
+            self.path, self.method, self._b = path, method, body
+
+        def json(self):
+            return self._b
+
+    body = {"prompt": "serve disagg", "max_tokens": 6, "temperature": 0.0}
+    import asyncio
+
+    ref_srv = LLMServer(LLMConfig(model_id="t-ref", engine=engine_config(),
+                                  params=tiny_params))
+    try:
+        expected = asyncio.run(ref_srv.completions(dict(body)))
+    finally:
+        ref_srv.shutdown()
+
+    lc = LLMConfig(model_id="t-serve", engine=engine_config(),
+                   params=tiny_params)
+    handle = build_disagg_openai_app(lc, num_prefill=1, num_decode=2,
+                                     name="disagg-e2e")
+    resp = handle.remote(Req("/v1/completions", "POST", dict(body))).result(
+        timeout_s=180
+    )
+    assert resp["choices"][0]["text"] == expected["choices"][0]["text"]
+    stats = handle.stats.remote().result(timeout_s=30)
+    assert stats["mode"] == "disagg" and len(stats["decode"]) == 2
+    # pools are role-tagged through the controller
+    st = serve.status()
+    roles = {
+        name: dep.get("role")
+        for app in st["applications"].values()
+        for name, dep in app["deployments"].items()
+    }
+    assert roles.get("Prefill:t-serve") == "prefill"
+    assert roles.get("Decode:t-serve") == "decode"
+
+
+# ---------------------------------------------------------------------------
+# bench smokes + checked-in capture gates
+# ---------------------------------------------------------------------------
+
+
+def _run_bench(args, timeout=560):
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO})
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "llm_serving_bench.py"), *args],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+    assert p.returncode == 0, (p.stdout[-800:], p.stderr[-800:])
+    line = [l for l in p.stdout.splitlines() if l.strip().startswith("{")][-1]
+    return json.loads(line)
+
+
+@pytest.mark.slow
+def test_bench_disagg_smoke_cpu(tmp_path):
+    out = str(tmp_path / "disagg.json")
+    result = _run_bench(["--disagg", "--disagg-out", out])
+    doc = json.loads(open(out).read())
+    assert doc["metric"] == "llm_disagg_tpot_guard_smoke"
+    for mode in ("colocated", "disagg"):
+        for phase in ("idle", "mixed"):
+            assert doc[mode][phase]["completed"] == doc[mode][phase]["submitted"]
+    assert doc["kv_transfers"] > 0
+    assert doc["kv_transfer_spans"] > 0
+    assert doc["coverage_pct_mean"] >= 90.0
+    assert result["disagg_out"] == out
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+def test_bench_chaos_smoke_cpu(tmp_path):
+    out = str(tmp_path / "chaos.json")
+    _run_bench(["--chaos", "--chaos-out", out])
+    doc = json.loads(open(out).read())
+    assert doc["metric"] == "llm_chaos_completion_rate_smoke"
+    assert doc["value"] == 1.0  # every request completes under preemption
+    assert doc["faults_fired"] >= 1
+    assert doc["injected"]["engine_recoveries"] >= 1
+
+
+def test_checked_in_disagg_capture_gates():
+    """The checked-in DISAGG capture keeps the PR's acceptance contract:
+    disagg decode TPOT p99 must not degrade under mixed load by more
+    than colocated does, with llm.kv_transfer spans holding the >=90%
+    e2e coverage gate. Refresh on the TPU when engine phases change."""
+    doc = json.loads(open(
+        os.path.join(REPO, "benchmarks", "DISAGG_serving_r10.json")
+    ).read())
+    col = doc["colocated"]["tpot_p99_degradation"]
+    dis = doc["disagg"]["tpot_p99_degradation"]
+    assert dis is not None and col is not None
+    assert dis <= col, (
+        f"disagg degraded more than colocated ({dis} > {col}); the capture "
+        "no longer demonstrates the disaggregation win"
+    )
+    assert doc["coverage_pct_mean"] >= 90.0
+    assert doc["kv_transfers"] > 0 and doc["kv_transfer_spans"] > 0
+    for mode in ("colocated", "disagg"):
+        for phase in ("idle", "mixed"):
+            assert doc[mode][phase]["completed"] == doc[mode][phase]["submitted"]
+
+
+def test_checked_in_chaos_capture_gates():
+    """Availability SLO gate on the checked-in capture: completion rate
+    1.0 under the seeded preemption schedule, with faults actually
+    fired and the recovery ladder exercised."""
+    doc = json.loads(open(
+        os.path.join(REPO, "benchmarks", "CHAOS_serving_r10.json")
+    ).read())
+    assert doc["value"] == 1.0
+    assert doc["injected"]["completed"] == doc["injected"]["submitted"]
+    assert doc["faults_fired"] >= 1
+    assert doc["injected"]["engine_recoveries"] >= 1
+    assert doc["baseline"]["completed"] == doc["baseline"]["submitted"]
