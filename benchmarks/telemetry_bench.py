@@ -1,0 +1,301 @@
+#!/usr/bin/env python
+"""Telemetry-plane capture: 2-node + 2-pool in-process cluster under
+injected telemetry-push drops -> benchmarks/TELEM_cluster_r11.json.
+
+What it exercises end to end (the r11 acceptance gate):
+
+ * two REAL node daemons piggybacking metrics snapshots on heartbeats to
+   a real GCS server; two tiny LLM engines (prefill-pool / decode-pool
+   model tags) serving real CPU traffic with per-engine reporters
+   pushing over the telemetry_push RPC;
+ * seeded chaos DROP on telemetry_push while a ground-truth counter
+   ticks: the aggregate must stay monotonic through the fault window and
+   converge to EXACTLY the ground truth after it (drops cost freshness,
+   never counts);
+ * merged-histogram correctness: the GCS-served TTFT percentiles per
+   pool must match percentiles over the union of raw per-request TTFT
+   observations (pulled from the flight recorder) within one bucket
+   width;
+ * `ray_tpu status` rendering with per-pool SLO grades sourced from GCS
+   aggregation alone.
+
+Run: JAX_PLATFORMS=cpu python benchmarks/telemetry_bench.py [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+STALENESS_BOUND_S = 5.0
+
+
+def _raw_ttfts_since(known_trace_ids):
+    """Per-request TTFT observations from the flight recorder for traces
+    not yet attributed to a pool (sequential traffic per pool makes
+    attribution by delta exact)."""
+    from ray_tpu import obs
+
+    rec = obs.get_recorder()
+    out, seen = [], set()
+    for meta in rec.traces(limit=10_000):
+        tid = meta["trace_id"]
+        seen.add(tid)
+        if tid in known_trace_ids:
+            continue
+        for s in rec.get(tid):
+            if s.name == "llm.request" and "ttft_s" in s.attrs:
+                out.append(float(s.attrs["ttft_s"]))
+    return out, seen
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "TELEM_cluster_r11.json"
+    ))
+    p.add_argument("--seed", type=int, default=1234)
+    args = p.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from ray_tpu.chaos import harness
+    from ray_tpu.chaos.schedule import DROP_RPC, FaultSchedule, FaultSpec
+    from ray_tpu.cluster.gcs_service import GcsServer
+    from ray_tpu.cluster.node_daemon import NodeDaemon
+    from ray_tpu.cluster.rpc import RpcClient
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine
+    from ray_tpu.llm.sampling import SamplingParams
+    from ray_tpu.models import llama
+    from ray_tpu.obs import telemetry
+    from ray_tpu.serve.controller import replica_gauges
+    from ray_tpu.util import metrics as metrics_mod
+
+    t_start = time.time()
+    server = GcsServer(port=0)
+    gcs_addr = server.start()
+    store = server.service.telemetry
+    daemons = [
+        NodeDaemon(
+            gcs_addr, {"num_cpus": 2}, node_id=f"bench-n{i}",
+            heartbeat_interval_s=0.1, telemetry_interval_s=0.2,
+            memory_monitor_interval_s=0,
+        )
+        for i in range(2)
+    ]
+    for d in daemons:
+        d.start()
+
+    # -- two pools: tiny engines, real CPU traffic -------------------------
+    cfg = dict(model=llama.LLAMA_TINY, num_blocks=64, max_num_seqs=4,
+               max_prefill_len=64)
+    pools = {
+        "bench-prefill-pool": LLMEngine(EngineConfig(**cfg), seed=0),
+        "bench-decode-pool": LLMEngine(EngineConfig(**cfg), seed=1),
+    }
+    rng = np.random.default_rng(args.seed)
+    sp = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+
+    def prompts(n):
+        return [
+            list(map(int, rng.integers(3, 500, size=int(k))))
+            for k in rng.integers(6, 14, size=n)
+        ]
+
+    for tag, eng in pools.items():
+        eng.model_tag = tag
+        # warmup at the measured batch size: the capture's SLO numbers
+        # must price serving, not one-off XLA compiles
+        eng.generate(prompts(6), sp)
+
+    # measured phase starts from a clean registry (warmup compile times
+    # must not pollute the SLO histograms) and BEFORE any telemetry push
+    metrics_mod.clear_registry()
+    from ray_tpu import obs
+
+    obs.get_recorder().clear()
+
+    raw_ttfts: dict = {}
+    seen_traces: set = set()
+    for tag, eng in pools.items():
+        eng.generate(prompts(6), sp)
+        eng.update_telemetry_gauges()
+        raw_ttfts[tag], seen_traces = _raw_ttfts_since(seen_traces)
+
+    g = replica_gauges()
+    for role, dep in (("prefill", "PrefillPool"), ("decode", "DecodePool")):
+        tags = {"app": "llm", "deployment": dep, "role": role}
+        g["running"].set(1, tags=tags)
+        g["target"].set(1, tags=tags)
+
+    ticks = telemetry.cluster_counter(
+        "llm_bench_ticks_total",
+        "telemetry bench ground-truth ticks (drop-injection audit)",
+    )
+
+    def engine_filter(tag):
+        # ONLY series tagged with this engine's model tag: an untagged
+        # series shipped by several reporters would be summed once per
+        # reporter (exactly the double count the ticks audit exists to
+        # catch)
+        return lambda name, t: (
+            name.startswith("ray_tpu_llm_") and t.get("model") == tag
+        )
+
+    reporters = [
+        telemetry.TelemetryReporter(
+            gcs_addr, reporter_id=tag, kind="engine",
+            role="prefill" if "prefill" in tag else "decode",
+            series_filter=engine_filter(tag),
+            collect=[eng.update_telemetry_gauges],
+        )
+        for tag, eng in pools.items()
+    ]
+    driver = telemetry.TelemetryReporter(
+        gcs_addr, reporter_id="bench-driver", kind="driver",
+        series_filter=lambda name, t: name.startswith(
+            ("ray_tpu_serve_", "ray_tpu_llm_bench_")
+        ),
+    )
+    reporters.append(driver)
+
+    # -- fault window: seeded DROP on telemetry_push -----------------------
+    rpc = RpcClient(*gcs_addr).connect()
+    schedule = FaultSchedule(args.seed, [
+        FaultSpec(kind=DROP_RPC, site="rpc.call",
+                  match={"method": "telemetry_push"}, p=0.5),
+    ])
+    harness.install(schedule)
+    ground_truth = 0
+    totals = []
+    dropped = ok = 0
+    try:
+        for _ in range(12):
+            ticks.inc(1)
+            ground_truth += 1
+            for r in reporters:
+                if r.push_once():
+                    ok += 1
+                else:
+                    dropped += 1
+            agg = rpc.call("telemetry_cluster", {})
+            acc = agg["counters"].get("ray_tpu_llm_bench_ticks_total")
+            totals.append(acc["total"] if acc else 0.0)
+    finally:
+        harness.uninstall()
+    monotonic = all(b >= a for a, b in zip(totals, totals[1:]))
+    never_over = all(t <= ground_truth for t in totals)
+    # fault window over: one clean push converges exactly
+    for r in reporters:
+        assert r.push_once(), "clean push failed with chaos uninstalled"
+    agg = rpc.call("telemetry_cluster", {})
+    aggregated = agg["counters"]["ray_tpu_llm_bench_ticks_total"]["total"]
+
+    # -- wait for both node daemons to report via heartbeat piggyback ------
+    deadline = time.monotonic() + 15
+    node_ids = {d.node_id for d in daemons}
+    while time.monotonic() < deadline:
+        reps = rpc.call("telemetry_cluster", {})["reporters"]
+        if node_ids <= set(reps):
+            break
+        time.sleep(0.05)
+    reps = rpc.call("telemetry_cluster", {})["reporters"]
+    nodes_reporting = sum(1 for n in node_ids if n in reps)
+    staleness = rpc.call("telemetry_cluster", {})["staleness"]
+    staleness_max = max(
+        (v for k, v in staleness.items()
+         if k in node_ids or any(k == r.reporter_id for r in reporters)),
+        default=float("inf"),
+    )
+
+    # -- merged-histogram correctness vs union of raw observations ---------
+    agg = rpc.call("telemetry_cluster", {})
+    hist_pools = {}
+    within = True
+    ttft_name = telemetry.SLO_HISTOGRAMS["ttft"]
+    for tag, raw in raw_ttfts.items():
+        merged = agg["histograms"][ttft_name]["series"][f"model={tag}"]
+        union = sorted(raw)
+        checks = {}
+        for q in (50.0, 95.0):
+            rank = max(1, math.ceil(q / 100.0 * len(union)))
+            true_v = union[rank - 1]
+            band = telemetry.bucket_percentile_band(
+                merged["boundaries"], merged["buckets"], q
+            )
+            lo, hi = band
+            in_band = (lo < true_v <= hi) or (hi == float("inf") and true_v > lo)
+            within = within and in_band
+            checks[f"p{q:g}"] = {
+                "merged_estimate": merged[f"p{q:g}"],
+                "union_value": round(true_v, 6),
+                "bucket": [lo, None if hi == float("inf") else hi],
+                "in_band": in_band,
+            }
+        assert merged["count"] == len(union), (merged["count"], len(union))
+        hist_pools[tag] = {"count": merged["count"], **checks}
+
+    # -- SLO grades + pools + status from the one-query status RPC ---------
+    status = rpc.call("telemetry_status", {})
+    status_text = telemetry.format_status(status)
+
+    out = {
+        "capture": "telemetry plane: 2-node + 2-pool in-process cluster, "
+        "CPU engines, seeded telemetry_push drops (p=0.5)",
+        "unix_time": round(t_start, 1),
+        "wall_s": round(time.time() - t_start, 2),
+        "chaos_seed": args.seed,
+        "num_nodes": len(daemons),
+        "nodes_reporting": nodes_reporting,
+        "staleness_max_s": round(staleness_max, 3),
+        "staleness_bound_s": STALENESS_BOUND_S,
+        "pushes_ok": ok,
+        "pushes_dropped": dropped,
+        "counter_ground_truth": float(ground_truth),
+        "counter_aggregated": float(aggregated),
+        "aggregate_monotonic": bool(monotonic and never_over),
+        "observed_totals": totals,
+        "hist_check": {"within_one_bucket": bool(within), "pools": hist_pools},
+        "slo": status["slo"],
+        "pools": status["pools"],
+        "utilization": status["utilization"],
+        "status_text": status_text,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2, default=str)
+        f.write("\n")
+    print(status_text)
+    print(f"\nwrote {args.out}")
+    print(
+        f"nodes {nodes_reporting}/{len(daemons)} reporting, "
+        f"staleness max {staleness_max:.3f}s, "
+        f"drops {dropped}/{ok + dropped} pushes, "
+        f"counter {aggregated}/{ground_truth}, "
+        f"hist within-one-bucket: {within}"
+    )
+    rpc.close()
+    for r in reporters:
+        r.stop(final_push=False)
+    for d in daemons:
+        d.stop()
+    server.stop()
+    failed = (
+        nodes_reporting != len(daemons)
+        or staleness_max > STALENESS_BOUND_S
+        or aggregated != ground_truth
+        or not (monotonic and never_over)
+        or not within
+        or dropped < 1
+    )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1417,6 +1417,28 @@ class ClusterClient:
     def nodes(self) -> list:
         return self.gcs.call("list_nodes", None)
 
+    # -- telemetry plane (ray_tpu.obs.telemetry) ------------------------------
+
+    def cluster_metrics(self) -> dict:
+        """GCS-aggregated cluster metrics: counter sums + windowed rates,
+        gauge rollups, merged histograms, per-reporter staleness."""
+        return self.gcs.call("telemetry_cluster", {})
+
+    def slo_report(self, thresholds: Optional[dict] = None) -> dict:
+        """Per-model-tag green/yellow/red grades from the MERGED SLO
+        histograms (the autoscaler's input)."""
+        return self.gcs.call(
+            "telemetry_slo",
+            {"thresholds": thresholds} if thresholds else {},
+        )
+
+    def telemetry_status(self, thresholds: Optional[dict] = None) -> dict:
+        """Everything `ray_tpu status` prints, in ONE GCS query."""
+        return self.gcs.call(
+            "telemetry_status",
+            {"thresholds": thresholds} if thresholds else {},
+        )
+
     def cluster_resources(self) -> dict:
         total: dict[str, float] = {}
         for n in self.nodes():

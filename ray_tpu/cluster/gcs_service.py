@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from ray_tpu.cluster.rpc import RpcServer
+from ray_tpu.obs.telemetry import SLOThresholds, TelemetryStore
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("ray_tpu.cluster.gcs")
@@ -93,6 +94,12 @@ class GcsService:
         self._persist_path = persist_path
         self._dirty = 0
         self._persisted = 0
+        # cluster-wide metrics plane (ray_tpu.obs.telemetry): bounded
+        # time-series per (reporter, metric, labels), fed by heartbeat
+        # piggybacks and dedicated telemetry_push RPCs. Deliberately NOT
+        # persisted: metrics are a freshness surface; a restarted GCS
+        # repopulates within one reporting interval.
+        self.telemetry = TelemetryStore()
         if persist_path:
             self._load_snapshot()
 
@@ -210,7 +217,45 @@ class GcsService:
             if payload.get("draining") and not e.draining:
                 e.draining = True
                 self._emit("node_draining", {"node_id": e.node_id})
+        snap = payload.get("telemetry")
+        if snap:
+            # piggybacked metrics snapshot (outside the table lock: the
+            # store has its own); a STALL_HEARTBEAT partition shows up as
+            # telemetry staleness for exactly the stalled node
+            self.telemetry.ingest(
+                payload["node_id"], snap, {"kind": "node"}
+            )
         return {"ok": True}
+
+    # -- telemetry plane ------------------------------------------------------
+
+    def rpc_telemetry_push(self, payload, peer):
+        """Dedicated push path for engine hosts / serving processes (node
+        daemons piggyback on heartbeats instead). Drops/delays of this
+        RPC may only cost freshness: snapshots carry monotonic totals."""
+        return self.telemetry.ingest(
+            payload["reporter_id"],
+            payload["snapshot"],
+            {"kind": payload.get("kind", ""), "role": payload.get("role", "")},
+        )
+
+    def rpc_telemetry_cluster(self, payload, peer):
+        return self.telemetry.cluster_metrics()
+
+    def rpc_telemetry_slo(self, payload, peer):
+        th = SLOThresholds.from_dict((payload or {}).get("thresholds"))
+        return self.telemetry.slo_report(th)
+
+    def rpc_telemetry_prometheus(self, payload, peer):
+        return self.telemetry.prometheus_text()
+
+    def rpc_telemetry_status(self, payload, peer):
+        """One-query cluster status (scripts/ray_tpu_status.py): node
+        table + reporters + pool rollups + utilization + SLO grades."""
+        th = SLOThresholds.from_dict((payload or {}).get("thresholds"))
+        out = {"nodes": self.rpc_list_nodes(None, peer)}
+        out.update(self.telemetry.status_payload(th))
+        return out
 
     def rpc_cluster_demand(self, payload, peer):
         """Aggregate autoscaling view: per-node capacity plus every lease

@@ -49,6 +49,49 @@ logger = get_logger("ray_tpu.cluster.node")
 CHUNK = 4 << 20  # object transfer chunk size
 
 
+def _node_gauges() -> dict:
+    """Per-node utilization gauges (tagged by node so in-process test
+    daemons sharing one registry stay distinguishable). Aggregation kinds
+    ride telemetry snapshots to the GCS (obs/telemetry.py)."""
+    from ray_tpu.obs.telemetry import cluster_gauge
+
+    return {
+        "workers": cluster_gauge(
+            "node_workers",
+            description="worker processes attached to this node daemon",
+            tag_keys=("node",),
+        ),
+        "leases": cluster_gauge(
+            "node_leases",
+            description="worker leases currently granted on this node",
+            tag_keys=("node",),
+        ),
+        "queued_leases": cluster_gauge(
+            "node_queued_leases",
+            description="lease requests parked in this node's grant queue "
+            "(the autoscaler's per-node demand signal)",
+            tag_keys=("node",),
+        ),
+        "object_bytes": cluster_gauge(
+            "node_object_store_bytes",
+            description="bytes resident in this node's object-store memory "
+            "tier (dict tier; shm tier reports via stats())",
+            tag_keys=("node",),
+        ),
+        "oom_kills": cluster_gauge(
+            "node_oom_kills",
+            description="workers killed by this node's memory monitor "
+            "since daemon start",
+            tag_keys=("node",),
+        ),
+    }
+
+
+def register_metrics() -> None:
+    """scripts/check_metrics.py hook: force node gauges to register."""
+    _node_gauges()
+
+
 class ObjectService:
     """Node-local object table: byte-capped LRU memory tier + disk-spill
     tier + chunked cross-node pull.
@@ -438,6 +481,7 @@ class NodeDaemon:
         worker_rss_limit_mb: int = 0,       # 0 = no per-worker cap
         memory_usage_threshold: float = 0.95,  # node pressure kill point
         memory_monitor_interval_s: float = 1.0,  # 0 = monitor disabled
+        telemetry_interval_s: float = 2.0,  # 0 = no heartbeat piggyback
     ):
         self.node_id = node_id or f"node-{uuid.uuid4().hex[:8]}"
         self.gcs_addr = gcs_addr
@@ -449,6 +493,8 @@ class NodeDaemon:
         self._rss_limit_mb = int(worker_rss_limit_mb)
         self._mem_threshold = float(memory_usage_threshold)
         self._mem_interval = float(memory_monitor_interval_s)
+        self._telemetry_interval = float(telemetry_interval_s)
+        self._last_telemetry = 0.0
         self._oom_kills = 0
         # RLock: PG-bundle reserve is check-then-act over _bundles AND the
         # node availability — the whole sequence must be atomic across
@@ -717,13 +763,24 @@ class NodeDaemon:
             try:
                 with self._res_lock:
                     avail = dict(self.available)
-                r = self.gcs.call(
-                    "heartbeat",
-                    {"node_id": self.node_id, "available": avail,
-                     "pending": self._pending_specs,
-                     "draining": self._draining},
-                    timeout=5,
-                )
+                hb = {"node_id": self.node_id, "available": avail,
+                      "pending": self._pending_specs,
+                      "draining": self._draining}
+                if (
+                    self._telemetry_interval > 0
+                    and time.monotonic() - self._last_telemetry
+                    >= self._telemetry_interval
+                ):
+                    # piggybacked metrics snapshot (obs/telemetry.py):
+                    # absolute totals, so a beat the chaos STALL drops
+                    # only costs freshness — staleness is the GCS's
+                    # reported metric for exactly that
+                    try:
+                        hb["telemetry"] = self._telemetry_snapshot()
+                        self._last_telemetry = time.monotonic()
+                    except Exception:  # noqa: BLE001 — never break heartbeats
+                        logger.exception("telemetry snapshot failed")
+                r = self.gcs.call("heartbeat", hb, timeout=5)
                 if not r.get("ok") and r.get("reregister"):
                     with self.objects._lock:
                         inventory = list(self.objects._objects.keys()) + list(
@@ -1261,6 +1318,30 @@ class NodeDaemon:
         return [s for s in list(self._spans)
                 if float(s.get("end", 0.0)) >= since]
 
+    def _telemetry_snapshot(self) -> dict:
+        """Refresh this node's utilization gauges, then snapshot ONLY the
+        series this daemon owns (name prefix + node tag): a test daemon
+        colocated with other subsystems in one process must not re-ship
+        their series under its own reporter id (double count)."""
+        from ray_tpu.obs.telemetry import annotated_snapshot
+
+        g = _node_gauges()
+        tags = {"node": self.node_id}
+        with self._wlock:
+            num_workers = len(self._all_workers)
+        with self._res_lock:
+            num_leases = len(self._leases)
+        g["workers"].set(num_workers, tags=tags)
+        g["leases"].set(num_leases, tags=tags)
+        g["queued_leases"].set(self._num_queued, tags=tags)
+        g["object_bytes"].set(self.objects.stats()["bytes"], tags=tags)
+        g["oom_kills"].set(self._oom_kills, tags=tags)
+        node_id = self.node_id
+        return annotated_snapshot(
+            lambda name, t: name.startswith("ray_tpu_node_")
+            and t.get("node") == node_id
+        )
+
     def rpc_stats(self, payload, peer):
         with self._res_lock:
             return {
@@ -1290,6 +1371,9 @@ def main() -> None:
                         "(>=1.0 disables the pressure trigger)")
     p.add_argument("--memory-monitor-interval", type=float, default=1.0,
                    help="memory monitor tick seconds (0 disables entirely)")
+    p.add_argument("--telemetry-interval", type=float, default=2.0,
+                   help="seconds between metrics snapshots piggybacked on "
+                        "heartbeats (0 disables)")
     args = p.parse_args()
     host, port = args.gcs.rsplit(":", 1)
     resources: dict[str, float] = {}
@@ -1309,6 +1393,7 @@ def main() -> None:
         worker_rss_limit_mb=args.worker_rss_limit_mb,
         memory_usage_threshold=args.memory_usage_threshold,
         memory_monitor_interval_s=args.memory_monitor_interval,
+        telemetry_interval_s=args.telemetry_interval,
     )
     addr = daemon.start()
     print(f"NODE_ADDRESS {addr[0]}:{addr[1]} {daemon.node_id}", flush=True)

@@ -109,8 +109,15 @@ class Dashboard:
         async def api_trace(req):
             """Request spans (ray_tpu.obs flight recorder) merged with the
             task/profiler timeline as one Chrome trace; ?trace_id= narrows
-            both halves to one request."""
+            both halves to one request. The response is BOUNDED
+            (?limit=, default 50k events) with an explicit truncated flag
+            — a runaway trace can't produce an export that nothing can
+            ship or open."""
             trace_id = req.query.get("trace_id")
+            try:
+                limit = int(req.query.get("limit", 50_000))
+            except ValueError:
+                limit = 50_000
 
             def build():
                 from ray_tpu.obs import get_recorder
@@ -121,8 +128,19 @@ class Dashboard:
                         e for e in events
                         if e.get("args", {}).get("trace_id") == trace_id
                     ]
-                events += get_recorder().chrome_trace(trace_id=trace_id)
-                return events
+                rec = get_recorder().chrome_trace_bounded(
+                    trace_id=trace_id, max_events=limit
+                )
+                events += rec["events"]
+                total = len(events) + (rec["total_spans"]
+                                       - len(rec["events"]))
+                truncated = rec["truncated"]
+                if len(events) > limit:
+                    events.sort(key=lambda e: e.get("ts", 0.0))
+                    events = events[:limit]
+                    truncated = True
+                return {"events": events, "truncated": truncated,
+                        "total_events": total}
 
             return web.json_response(await offload(build))
 
@@ -244,6 +262,30 @@ class Dashboard:
         async def cluster_timeline(_req):
             return web.json_response(await offload(_cluster_timeline))
 
+        # -- telemetry plane (ray_tpu.obs.telemetry via the GCS store) -----
+
+        async def api_metrics_cluster(_req):
+            """Cluster-level aggregate: counter sums + rates, gauge
+            rollups, merged histograms w/ percentiles, staleness."""
+            return web.json_response(
+                await offload(lambda: _gcs_call("telemetry_cluster"))
+            )
+
+        async def api_slo(_req):
+            """Per-model-tag SLO grades from the MERGED TTFT/TPOT/queue
+            histograms (the autoscaler's input)."""
+            return web.json_response(
+                await offload(lambda: _gcs_call("telemetry_slo"))
+            )
+
+        async def metrics_cluster(_req):
+            """Merged Prometheus exposition: the fleet analog of each
+            process's /metrics."""
+            return web.Response(
+                text=await offload(lambda: _gcs_call("telemetry_prometheus")),
+                content_type="text/plain",
+            )
+
         app = web.Application()
         app.router.add_get("/healthz", healthz)
         if self.gcs_address:
@@ -252,6 +294,9 @@ class Dashboard:
             app.router.add_get("/api/cluster/placement_groups", cluster_pgs)
             app.router.add_get("/api/cluster/demand", cluster_demand)
             app.router.add_get("/api/cluster/timeline", cluster_timeline)
+            app.router.add_get("/api/metrics/cluster", api_metrics_cluster)
+            app.router.add_get("/api/slo", api_slo)
+            app.router.add_get("/metrics/cluster", metrics_cluster)
         app.router.add_get("/api/tasks", tasks)
         app.router.add_get("/api/actors", actors)
         app.router.add_get("/api/objects", objects)

@@ -70,10 +70,49 @@ def prefix_cache_lookup_counter():
     )
 
 
+def utilization_gauges() -> dict:
+    """Per-engine utilization gauges for the cluster telemetry plane
+    (obs/telemetry.py): the fleet view the SLO-driven autoscaler sizes
+    pools from. All aggregate by SUM across engines/replicas."""
+    from ray_tpu.obs.telemetry import cluster_gauge
+
+    return {
+        "kv_pages_used": cluster_gauge(
+            "llm_kv_pages_used",
+            description="paged-KV blocks currently allocated in this "
+            "engine (used = total - free)",
+            tag_keys=("model",),
+        ),
+        "kv_pages_total": cluster_gauge(
+            "llm_kv_pages_total",
+            description="paged-KV blocks this engine was configured with",
+            tag_keys=("model",),
+        ),
+        "kv_hbm_bytes": cluster_gauge(
+            "llm_kv_hbm_bytes",
+            description="bytes of accelerator memory held by this "
+            "engine's paged KV cache (static allocation)",
+            tag_keys=("model",),
+        ),
+        "queue_depth": cluster_gauge(
+            "llm_queue_depth",
+            description="requests waiting for prefill admission in this "
+            "engine",
+            tag_keys=("model",),
+        ),
+        "running": cluster_gauge(
+            "llm_running_requests",
+            description="requests in this engine's decode batch",
+            tag_keys=("model",),
+        ),
+    }
+
+
 def register_metrics() -> None:
     """scripts/check_metrics.py hook: force lazy metrics to register."""
     prefix_cache_hit_counter()
     prefix_cache_lookup_counter()
+    utilization_gauges()
 
 
 @dataclasses.dataclass
@@ -255,6 +294,12 @@ class LLMEngine:
                 tree_shardings(self.mesh, rules, llama.logical_axes(c.model)),
             )
         self.cache = self._init_kv_cache()
+        # static KV allocation size for the llm_kv_hbm_bytes gauge
+        # (nbytes is array metadata; no device sync)
+        self._kv_cache_nbytes = int(sum(
+            getattr(x, "nbytes", 0) for x in jax.tree.leaves(self.cache)
+        ))
+        self._telemetry_next = 0.0  # gauge-refresh throttle
         self.waiting: deque[Request] = deque()
         self.running: list[Request] = []
         self.requests: dict[str, Request] = {}  # unfinished only
@@ -588,6 +633,12 @@ class LLMEngine:
                     # deterministic engine slowdown: overload tests build
                     # real queue depth without racing wall-clock
                     time.sleep(_f.delay_s)
+        now_m = time.monotonic()
+        if now_m >= self._telemetry_next:
+            # throttled gauge refresh: a few dict writes per ~200ms, not
+            # per decode step
+            self._telemetry_next = now_m + 0.2
+            self.update_telemetry_gauges()
         if self.waiting and len(self.running) < self.config.max_num_seqs:
             admitted: list = []  # (req, last-token logits [1, V]) pairs
             while self.waiting and len(self.running) < self.config.max_num_seqs:
@@ -868,6 +919,25 @@ class LLMEngine:
                 if out.finished:
                     finals[out.request_id] = out.output_token_ids
         return [finals[r] for r in rids]
+
+    def update_telemetry_gauges(self) -> None:
+        """Refresh this engine's utilization gauges (KV-page occupancy,
+        HBM bytes, queue depth) in the process registry — the series the
+        telemetry plane ships cluster-wide. Called throttled from step()
+        and by TelemetryReporter collect callbacks; must never throw into
+        the serving path."""
+        try:
+            g = utilization_gauges()
+            tags = {"model": self.model_tag}
+            c = self.config
+            g["kv_pages_used"].set(c.num_blocks - self.allocator.num_free,
+                                   tags=tags)
+            g["kv_pages_total"].set(c.num_blocks, tags=tags)
+            g["kv_hbm_bytes"].set(self._kv_cache_nbytes, tags=tags)
+            g["queue_depth"].set(len(self.waiting), tags=tags)
+            g["running"].set(len(self.running), tags=tags)
+        except Exception:  # noqa: BLE001 — observability must not break serving
+            pass
 
     def stats(self) -> dict:
         out = {

@@ -538,9 +538,16 @@ class LLMServer:
             "dropped_spans": rec.num_dropped_spans,
         }
 
-    def request_trace(self, request_id: str) -> dict:
+    # span cap for one trace response: a runaway generation's trace must
+    # not build a response that blows past RPC framing / HTTP sanity
+    TRACE_MAX_SPANS = 2048
+
+    def request_trace(self, request_id: str, max_spans: Optional[int] = None) -> dict:
         """Full span tree for one request (by engine/completion request
-        id, or directly by trace id), plus e2e + span-coverage honesty."""
+        id, or directly by trace id), plus e2e + span-coverage honesty.
+        Bounded: at most ``max_spans`` spans (earliest first) with an
+        explicit ``truncated`` flag."""
+        cap = self.TRACE_MAX_SPANS if max_spans is None else int(max_spans)
         rec = obs.get_recorder()
         trace_id = rec.find_by_request(request_id) or request_id
         spans = rec.get(trace_id)
@@ -552,11 +559,17 @@ class LLMServer:
                 "code": 404,
             }}
         summary = rec.summary(trace_id) or {}
+        total = len(spans)
+        truncated = total > cap
+        if truncated:
+            spans = sorted(spans, key=lambda s: s.start)[:cap]
         return {
             "request_id": request_id,
             "trace_id": trace_id,
             **{k: v for k, v in summary.items() if k != "trace_id"},
             "spans": [s.to_dict() for s in spans],
+            "truncated": truncated,
+            "total_spans": total,
         }
 
     def stats(self) -> dict:
@@ -566,6 +579,8 @@ class LLMServer:
         disaggregated mode the per-pool + transfer-plane picture, incl.
         the prefix-cache hit rate the decode pick consumes) without
         scraping Prometheus."""
+        from ray_tpu.util.metrics import snapshot_meta
+
         if self.orchestrator is not None:
             out = {
                 "model_id": self.config.model_id,
@@ -573,11 +588,15 @@ class LLMServer:
                 **self.orchestrator.stats(),
             }
             out["admission"] = self.admission.stats()
+            # snapshot timestamp + process-epoch id (the telemetry plane's
+            # restart-detection header; free here via the same API)
+            out["telemetry"] = snapshot_meta()
             return out
         with self.runner.lock:
             out = {"model_id": self.config.model_id, **self.engine.stats()}
         out["admission"] = self.admission.stats()
         out["engine_recoveries"] = self.runner.num_recoveries
+        out["telemetry"] = snapshot_meta()
         return out
 
     def _admission_check(self) -> Optional[dict]:

@@ -58,8 +58,15 @@ def _spec_metrics():
     per-chunk path)."""
     global _metrics
     if _metrics is None:
+        from ray_tpu.obs.telemetry import AGG_MAX, declare_aggregation
         from ray_tpu.util.metrics import Counter, Gauge
 
+        # cluster-telemetry aggregation: the fleet-level acceptance rate
+        # derives from the drafted/accepted counter SUMS; the gauges are
+        # per-engine running rates, where max is the honest rollup
+        # (averaging rates across unevenly-loaded engines lies)
+        declare_aggregation("llm_spec_acceptance_rate", AGG_MAX)
+        declare_aggregation("llm_spec_mean_accepted_len", AGG_MAX)
         _metrics = {
             "drafted": Counter(
                 "llm_spec_drafted_tokens_total",

@@ -9,7 +9,13 @@ Three pieces:
  * recorder — ``SpanRecorder``, a bounded flight recorder of the last N
    requests' spans (``obs.span(...)`` records + propagates in one call);
  * slo — serving SLO histograms (TTFT / TPOT / queue-wait / e2e +
-   router dispatch latency) on the util/metrics Prometheus registry.
+   router dispatch latency) on the util/metrics Prometheus registry;
+ * telemetry — the CLUSTER-WIDE metrics plane (import
+   ``ray_tpu.obs.telemetry`` directly): per-process registries ship
+   monotonic snapshots to the GCS (heartbeat piggyback / telemetry_push),
+   which serves counter sums, bucket-merged histogram percentiles,
+   role/pool rollups, SLO grades, a merged Prometheus exposition, and
+   the ``scripts/ray_tpu_status.py`` one-query status report.
 
 Instrumented surfaces: ``GET /api/trace`` on the dashboard (request
 spans merged with the task/profiler timeline), ``GET /v1/requests`` +

@@ -220,9 +220,31 @@ class SpanRecorder:
             "attrs": dict(root.attrs),
         }
 
-    def chrome_trace(self, trace_id: Optional[str] = None) -> list[dict]:
+    # default export cap: ~200 bytes/event keeps the largest export well
+    # under RPC framing / HTTP response sanity (a full recorder at
+    # 256 traces x 512 spans is 131k spans ≈ tens of MB otherwise)
+    DEFAULT_EXPORT_MAX_EVENTS = 50_000
+
+    def chrome_trace(self, trace_id: Optional[str] = None,
+                     max_events: Optional[int] = None) -> list[dict]:
         """Chrome trace-event JSON ("X" complete events); rows grouped
-        by trace so one request reads as one strip in Perfetto."""
+        by trace so one request reads as one strip in Perfetto.
+        ``max_events`` caps the export (earliest-first after a time sort);
+        use :meth:`chrome_trace_bounded` to also learn whether the cap
+        bit."""
+        return self.chrome_trace_bounded(
+            trace_id=trace_id, max_events=max_events
+        )["events"]
+
+    def chrome_trace_bounded(self, trace_id: Optional[str] = None,
+                             max_events: Optional[int] = None) -> dict:
+        """Bounded export: {"events", "truncated", "total_spans"}. A large
+        trace must not produce an export that blows past the cluster RPC
+        MAX_FRAME guard (or an HTTP response nobody can open) — the cap
+        drops the NEWEST events after an ascending time sort and says so
+        instead of silently shipping everything."""
+        cap = (self.DEFAULT_EXPORT_MAX_EVENTS
+               if max_events is None else int(max_events))
         with self._lock:
             if trace_id is not None:
                 groups = {trace_id: list(self._traces.get(trace_id, ()))}
@@ -246,7 +268,12 @@ class SpanRecorder:
                         **s.attrs,
                     },
                 })
-        return out
+        total = len(out)
+        truncated = cap >= 0 and total > cap
+        if truncated:
+            out.sort(key=lambda e: e["ts"])
+            out = out[:cap]
+        return {"events": out, "truncated": truncated, "total_spans": total}
 
 
 _RECORDER = SpanRecorder()

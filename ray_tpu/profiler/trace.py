@@ -41,6 +41,11 @@ def segment_histogram() -> Histogram:
 
 
 def coverage_gauge() -> Gauge:
+    from ray_tpu.obs.telemetry import AGG_MAX, declare_aggregation
+
+    # cluster rollup: worst-profiled step wins (a fleet "coverage" sum
+    # would be meaningless)
+    declare_aggregation("profiler_step_coverage_pct", AGG_MAX)
     return Gauge(
         "profiler_step_coverage_pct",
         description="profiler: % of measured step time attributed to segments",
@@ -49,6 +54,9 @@ def coverage_gauge() -> Gauge:
 
 
 def step_ms_gauge() -> Gauge:
+    from ray_tpu.obs.telemetry import AGG_MAX, declare_aggregation
+
+    declare_aggregation("profiler_step_ms", AGG_MAX)
     return Gauge(
         "profiler_step_ms",
         description="profiler: measured whole-step wall time (ms)",

@@ -32,6 +32,33 @@ logger = get_logger("ray_tpu.serve.controller")
 CONTROLLER_NAME = "SERVE_CONTROLLER"
 
 
+def replica_gauges() -> dict:
+    """Role-tagged replica gauges: the pool view the telemetry plane
+    rolls up by DeploymentConfig.role (prefill/decode pools under
+    disaggregated serving) for `ray_tpu status` and the autoscaler."""
+    from ray_tpu.obs.telemetry import cluster_gauge
+
+    return {
+        "running": cluster_gauge(
+            "serve_replicas_running",
+            description="serve replicas in RUNNING state per deployment "
+            "(role-tagged for pool rollups)",
+            tag_keys=("app", "deployment", "role"),
+        ),
+        "target": cluster_gauge(
+            "serve_replicas_target",
+            description="serve replica target per deployment "
+            "(role-tagged for pool rollups)",
+            tag_keys=("app", "deployment", "role"),
+        ),
+    }
+
+
+def register_metrics() -> None:
+    """scripts/check_metrics.py hook."""
+    replica_gauges()
+
+
 @dataclass
 class _ReplicaInfo:
     replica_id: str
@@ -123,6 +150,7 @@ class ServeController:
             for stale in set(app.deployments) - new_names:
                 for r in app.deployments[stale].replicas:
                     self._stop_replica(app.deployments[stale], r)
+                self._retract_replica_gauges(app.deployments[stale])
                 del app.deployments[stale]
 
     def _apply_update(
@@ -172,6 +200,7 @@ class ServeController:
                 ds.target_replicas = 0
                 for r in list(ds.replicas):
                     self._stop_replica(ds, r)
+                self._retract_replica_gauges(ds)
             del self._apps[name]
 
     def shutdown(self) -> None:
@@ -267,6 +296,7 @@ class ServeController:
                     for app in list(self._apps.values()):
                         for ds in app.deployments.values():
                             self._reconcile_deployment(ds, now)
+                            self._export_replica_gauges(ds)
                         self._update_app_status(app)
                 if now - last_health > 1.0:
                     last_health = now
@@ -298,6 +328,40 @@ class ServeController:
             ds.status = DeploymentStatus.HEALTHY
         elif starting:
             ds.status = DeploymentStatus.UPDATING
+
+    def _export_replica_gauges(self, ds: _DeploymentState) -> None:
+        """Publish running/target replica counts into the process metrics
+        registry (telemetry-plane pool rollups key off the role tag)."""
+        try:
+            g = replica_gauges()
+            tags = {
+                "app": ds.app_name,
+                "deployment": ds.name,
+                "role": ds.deployment_config.role,
+            }
+            running = sum(
+                1 for r in ds.replicas if r.state == ReplicaState.RUNNING
+            )
+            g["running"].set(running, tags=tags)
+            g["target"].set(ds.target_replicas, tags=tags)
+        except Exception:  # noqa: BLE001 — observability must not break serve
+            pass
+
+    def _retract_replica_gauges(self, ds: _DeploymentState) -> None:
+        """Remove a deleted deployment's gauge series — a gauge that is
+        merely no longer updated keeps its last value in the registry and
+        every telemetry snapshot would keep shipping phantom replicas."""
+        try:
+            g = replica_gauges()
+            tags = {
+                "app": ds.app_name,
+                "deployment": ds.name,
+                "role": ds.deployment_config.role,
+            }
+            g["running"].remove_series(tags=tags)
+            g["target"].remove_series(tags=tags)
+        except Exception:  # noqa: BLE001 — observability must not break serve
+            pass
 
     def _update_app_status(self, app: _AppState) -> None:
         statuses = {ds.status for ds in app.deployments.values()}

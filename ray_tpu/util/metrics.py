@@ -9,12 +9,26 @@ ray_tpu.dashboard); no agent hop.
 
 from __future__ import annotations
 
+import itertools
 import threading
+import time
+import uuid
 from bisect import bisect_right
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence
 
 _REGISTRY_LOCK = threading.Lock()
 _REGISTRY: dict[str, "Metric"] = {}
+
+# Process-epoch id: a restarted process re-registers every counter at 0.
+# Snapshots carry this id so a consumer (the ray_tpu.obs.telemetry plane)
+# can tell "the counter went backwards" (impossible) from "the process
+# restarted" (totals from the dead epoch are banked, the new epoch counts
+# from zero — never a negative or double-counted delta).
+PROCESS_EPOCH = uuid.uuid4().hex[:12]
+
+# Monotonic per-process snapshot sequence: lets a consumer ignore a
+# delayed/re-ordered snapshot without comparing wall clocks.
+_SNAPSHOT_SEQ = itertools.count(1)
 
 DEFAULT_HISTOGRAM_BOUNDARIES = [
     0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1, 5, 10, 50, 100,
@@ -85,6 +99,18 @@ class Metric:
         with self._lock:
             return dict(self._series)
 
+    def remove_series(self, tags: Optional[dict] = None) -> None:
+        """Retract one tag combination entirely. Without this, a gauge
+        for a deleted entity (replica pool, reporter) keeps exporting its
+        last value forever — downstream sum rollups then count phantoms."""
+        k = self._key(tags)
+        with self._lock:
+            self._series.pop(k, None)
+            if isinstance(self, Histogram):
+                self._buckets.pop(k, None)
+                self._sums.pop(k, None)
+                self._counts.pop(k, None)
+
 
 class Counter(Metric):
     TYPE = "counter"
@@ -152,6 +178,65 @@ class Histogram(Metric):
 def registry_snapshot() -> list[Metric]:
     with _REGISTRY_LOCK:
         return list(_REGISTRY.values())
+
+
+def snapshot_meta() -> dict:
+    """Timestamp + epoch header every serialized snapshot carries.
+
+    ``ts_monotonic`` orders snapshots from ONE process; ``ts_wall`` places
+    them on the cluster timeline; ``epoch`` detects process restarts
+    (counter resets); ``seq`` detects re-ordered/duplicated deliveries."""
+    return {
+        "epoch": PROCESS_EPOCH,
+        "seq": next(_SNAPSHOT_SEQ),
+        "ts_monotonic": time.monotonic(),
+        "ts_wall": time.time(),
+    }
+
+
+def snapshot_registry(
+    series_filter: Optional[Callable[[str, dict], bool]] = None,
+) -> dict:
+    """Serializable point-in-time snapshot of the whole registry.
+
+    Counters ship as monotonic totals (not deltas) and histograms as full
+    bucket vectors: a consumer that misses N snapshots loses freshness,
+    never counts — re-sends can only be ignored (by ``seq``) or replace
+    state, so drops/delays are staleness, not corruption.
+
+    ``series_filter(name, tags_dict) -> bool`` narrows the snapshot (a
+    node daemon colocated with other subsystems ships only the series it
+    owns)."""
+    out = snapshot_meta()
+    out["metrics"] = []
+    for m in registry_snapshot():
+        entry: dict = {
+            "name": m.name,
+            "type": m.TYPE,
+            "description": m.description,
+            "tag_keys": list(m.tag_keys),
+        }
+        series: list[dict] = []
+        if isinstance(m, Histogram):
+            entry["boundaries"] = list(m.boundaries)
+            for k, (buckets, total, count) in m.hist_data().items():
+                tags = dict(zip(m.tag_keys, k))
+                if series_filter is not None and not series_filter(m.name, tags):
+                    continue
+                series.append({
+                    "tags": list(k), "buckets": list(buckets),
+                    "sum": total, "count": count,
+                })
+        else:
+            for k, v in m.series().items():
+                tags = dict(zip(m.tag_keys, k))
+                if series_filter is not None and not series_filter(m.name, tags):
+                    continue
+                series.append({"tags": list(k), "value": v})
+        if series:
+            entry["series"] = series
+            out["metrics"].append(entry)
+    return out
 
 
 def clear_registry() -> None:

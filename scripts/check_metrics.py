@@ -12,7 +12,12 @@ register, then walks the util/metrics registry and fails on:
  * duplicate-name/type conflicts, including the sneaky one the registry
    cannot catch at construction time: a counter/gauge named ``x_sum``,
    ``x_count`` or ``x_bucket`` colliding with the exposition series a
-   histogram ``x`` generates.
+   histogram ``x`` generates;
+ * telemetry-plane metrics (names under ``obs.telemetry``'s
+   AGGREGATED_PREFIXES) whose aggregation kind is undeclared: the GCS
+   cannot roll up a gauge without knowing sum-vs-max, and a silently
+   unaggregated metric is invisible fleet-wide (counters default to
+   ``sum`` and histograms to ``merge``; gauges MUST declare).
 
 Run standalone: ``python scripts/check_metrics.py`` (exit 1 on problems).
 """
@@ -26,11 +31,14 @@ import sys
 # singletons to register (None = import alone registers / no hook)
 INSTRUMENTED = [
     ("ray_tpu.obs.slo", "register_all"),
+    ("ray_tpu.obs.telemetry", "register_metrics"),
     ("ray_tpu.profiler.trace", None),
     ("ray_tpu.llm.decode_loop", "chunk_histogram"),
     ("ray_tpu.llm.spec.stats", "_spec_metrics"),
     ("ray_tpu.llm.admission", "register_metrics"),
     ("ray_tpu.llm.engine", "register_metrics"),
+    ("ray_tpu.cluster.node_daemon", "register_metrics"),
+    ("ray_tpu.serve.controller", "register_metrics"),
 ]
 
 _NAME_RE = re.compile(r"^(ray_tpu|llm)_[a-z0-9][a-z0-9_]*$")
@@ -96,8 +104,37 @@ def check_registry() -> list[str]:
     return problems
 
 
+def check_aggregations() -> list[str]:
+    """Telemetry-plane lint: every gauge/counter under the aggregated
+    name prefixes must resolve to a valid aggregation kind. Counters
+    default to sum; gauges must be explicitly declared (sum vs max is a
+    semantic choice the metric's owner makes — see obs/telemetry.py)."""
+    from ray_tpu.obs import telemetry
+    from ray_tpu.util.metrics import registry_snapshot
+
+    problems = []
+    for m in registry_snapshot():
+        if m.TYPE == "histogram":
+            continue  # bucket merge is the only sane histogram rollup
+        if not m.name.startswith(telemetry.AGGREGATED_PREFIXES):
+            continue
+        kind = telemetry.aggregation_kind(m.name, m.TYPE)
+        if kind is None:
+            problems.append(
+                f"{m.name}: telemetry-plane {m.TYPE} with no declared "
+                "aggregation kind (declare sum/max via "
+                "obs.telemetry.declare_aggregation or the cluster_* helpers)"
+            )
+        elif kind not in telemetry.VALID_AGGREGATIONS:
+            problems.append(
+                f"{m.name}: invalid aggregation kind {kind!r}"
+            )
+    return problems
+
+
 def run_check() -> list[str]:
-    return register_instrumented_metrics() + check_registry()
+    return (register_instrumented_metrics() + check_registry()
+            + check_aggregations())
 
 
 def main() -> int:

@@ -101,6 +101,57 @@ def test_flight_recorder_drop_oldest_bounds_memory():
     assert rec.num_dropped_spans == 12
 
 
+def test_chrome_trace_export_is_bounded_with_truncated_flag():
+    """Satellite r11: a large trace's Chrome-trace export must be capped
+    (span-count limit + explicit truncated flag) so it can never blow
+    past the cluster RPC MAX_FRAME guard or an openable HTTP response."""
+    rec = SpanRecorder(max_traces=8, max_spans_per_trace=100)
+    tid = "a" * 32
+    for j in range(50):
+        rec.add(_mk_span(tid, name=f"s{j}", start=float(j), end=float(j) + 1))
+    bounded = rec.chrome_trace_bounded(max_events=10)
+    assert bounded["truncated"] is True
+    assert bounded["total_spans"] == 50
+    assert len(bounded["events"]) == 10
+    # deterministic: the EARLIEST events survive (ascending time sort)
+    assert [e["ts"] for e in bounded["events"]] == sorted(
+        e["ts"] for e in bounded["events"]
+    )
+    assert bounded["events"][0]["ts"] == 0.0
+    # under the cap: untouched, flag off
+    free = rec.chrome_trace_bounded(max_events=1000)
+    assert free["truncated"] is False
+    assert len(free["events"]) == 50
+    # list-returning compat surface honors the cap too
+    assert len(rec.chrome_trace(max_events=10)) == 10
+    # per-trace filter composes with the cap
+    only = rec.chrome_trace_bounded(trace_id=tid, max_events=5)
+    assert only["truncated"] and len(only["events"]) == 5
+
+
+def test_openai_request_trace_is_bounded():
+    """GET /v1/requests/{rid}/trace caps its span list and says so."""
+    rec = obs.get_recorder()
+    tid = "b" * 32
+    for j in range(30):
+        rec.add(_mk_span(tid, name=f"s{j}", start=float(j), end=float(j) + 1))
+
+    from ray_tpu.llm.openai_api import LLMServer
+
+    class _FakeApp:
+        TRACE_MAX_SPANS = 8
+        request_trace = LLMServer.request_trace
+
+    resp = _FakeApp().request_trace(tid)
+    assert resp["truncated"] is True
+    assert resp["total_spans"] == 30
+    assert len(resp["spans"]) == 8
+    # earliest-first, so the root/arrival side of the trace survives
+    assert [s["start"] for s in resp["spans"]] == sorted(
+        s["start"] for s in resp["spans"]
+    )
+
+
 def test_recorder_request_index_and_summary():
     rec = SpanRecorder(max_traces=4)
     ctx = trace_context.new_context()
