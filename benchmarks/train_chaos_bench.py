@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Elastic-training chaos capture: seeded KILL_RANK + PARTIAL_PARTITION
+mid-training -> benchmarks/TRAIN_chaos_r12.json.
+
+The r12 acceptance gate, end to end:
+
+ * an uninterrupted baseline run (world 2, deterministic counter-based
+   seed stream) records the ground-truth per-step loss curve;
+ * the chaos run trains the SAME problem under a seeded schedule that
+   kills rank 1 mid-allreduce AND partitions rank 1 from its peers
+   (GCS-visible, peer-unreachable) later in the run — the
+   TrainerSupervisor must detect each within the step timeout, abort
+   the in-flight step, re-form the gang at the next gang epoch with a
+   replacement rank, restore from the last crash-atomic checkpoint, and
+   resume;
+ * gates: completion rate 1.0 (every step of the horizon trained),
+   >= 1 recovery actually exercised, and — because resume happens at
+   the SAME world size — the chaos run's loss curve is BITWISE
+   identical to the baseline's (max_abs_loss_diff == 0.0);
+ * recovery cost honesty: per-recovery detect_s (fault -> all survivors
+   unblocked) and recover_s (fault -> training resumed) land in the
+   capture, plus the fired-fault log for the post-mortem.
+
+Run: JAX_PLATFORMS=cpu python benchmarks/train_chaos_bench.py [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+# -- the training problem (pure numpy, deterministic, CPU-fast) --------------
+
+W_TRUE = np.asarray([1.0, -2.0, 3.0, 0.5])
+
+
+def init_fn(seed):
+    return {"w": np.zeros(4, np.float64)}
+
+
+def grad_fn(state, batch):
+    x, y = batch
+    err = x @ state["w"] - y
+    return float(np.mean(err ** 2)), {"w": 2 * x.T @ err / len(y)}
+
+
+def apply_fn(state, grads):
+    return {"w": state["w"] - 0.1 * grads["w"]}
+
+
+def batch_fn(seed, step, world, rank):
+    from ray_tpu.train.elastic import rng_for
+
+    rng = rng_for(seed, step, rank)
+    x = rng.normal(size=(8, 4))
+    return x, x @ W_TRUE
+
+
+def _run(root, steps, world, timeout_s, schedule=None):
+    from ray_tpu.chaos import install, uninstall
+    from ray_tpu.train.elastic import ElasticConfig, TrainerSupervisor
+
+    if schedule is not None:
+        install(schedule)
+    try:
+        sup = TrainerSupervisor(
+            init_fn=init_fn, grad_fn=grad_fn, apply_fn=apply_fn,
+            batch_fn=batch_fn, total_steps=steps, checkpoint_root=root,
+            config=ElasticConfig(
+                world_size=world, step_timeout_s=timeout_s,
+                checkpoint_every=4, sharded_checkpoints=False,
+            ),
+        )
+        t0 = time.monotonic()
+        res = sup.fit()
+        return res, time.monotonic() - t0
+    finally:
+        if schedule is not None:
+            uninstall()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=24)
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=12)
+    ap.add_argument("--timeout-s", type=float, default=3.0)
+    ap.add_argument(
+        "--out",
+        default=os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "TRAIN_chaos_r12.json"),
+    )
+    args = ap.parse_args()
+
+    from ray_tpu.chaos import (
+        KILL_RANK,
+        PARTIAL_PARTITION,
+        FaultSchedule,
+        FaultSpec,
+    )
+
+    with tempfile.TemporaryDirectory() as base_root:
+        base, base_s = _run(base_root, args.steps, args.world, args.timeout_s)
+    if not base.completed:
+        print("baseline failed to complete", file=sys.stderr)
+        return 1
+
+    # two seeded faults against rank 1, spaced across the horizon:
+    # a mid-allreduce kill early, a GCS-visible peer partition later
+    # (start_after counts the rank's eligible hook calls — one per
+    # collective op, i.e. one per step here)
+    schedule = FaultSchedule(args.seed, [
+        FaultSpec(kind=KILL_RANK, site="collective.rendezvous", p=1.0,
+                  max_fires=1, start_after=args.steps // 4,
+                  match={"rank": "1"}),
+        FaultSpec(kind=PARTIAL_PARTITION, site="collective.rendezvous",
+                  p=1.0, max_fires=1, start_after=(2 * args.steps) // 3,
+                  match={"rank": "1"}),
+    ])
+    with tempfile.TemporaryDirectory() as chaos_root:
+        res, chaos_s = _run(chaos_root, args.steps, args.world,
+                            args.timeout_s, schedule=schedule)
+        fired = [
+            {"kind": f.kind, "site": f.site, "start_after": f.start_after}
+            for f in schedule.specs
+        ]
+        log = [
+            {"kind": f.kind, "site": f.site, "seq": f.seq}
+            for f in schedule.log
+        ]
+
+    completion = (len(res.losses) / args.steps) if args.steps else 0.0
+    diffs = [abs(a - b) for a, b in zip(base.losses, res.losses)]
+    max_diff = max(diffs) if diffs else float("inf")
+    identical = (
+        len(res.losses) == len(base.losses)
+        and all(a == b for a, b in zip(base.losses, res.losses))
+    )
+
+    out = {
+        "bench": "train_chaos",
+        "rev": "r12",
+        "platform": "cpu",
+        "config": {
+            "steps": args.steps,
+            "world_size": args.world,
+            "seed": args.seed,
+            "step_timeout_s": args.timeout_s,
+            "checkpoint_every": 4,
+        },
+        "baseline": {
+            "completed": base.completed,
+            "wall_s": round(base_s, 3),
+            "final_loss": base.losses[-1],
+        },
+        "chaos": {
+            "completed": res.completed,
+            "completion_rate": completion,
+            "wall_s": round(chaos_s, 3),
+            "final_loss": res.losses[-1] if res.losses else None,
+            "recoveries": len(res.recoveries),
+            "ranks_lost": sum(r.ranks_lost for r in res.recoveries),
+            "final_gen": res.final_gen,
+            "final_world_size": res.final_world_size,
+            "loss_identical": identical,
+            "max_abs_loss_diff": max_diff,
+            "detect_s_max": max((r.detect_s for r in res.recoveries),
+                                default=0.0),
+            "recover_s_max": max((r.recover_s for r in res.recoveries),
+                                 default=0.0),
+            "recovery_log": [dataclasses.asdict(r) for r in res.recoveries],
+        },
+        "faults_scheduled": fired,
+        "faults_fired": log,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2, default=str)
+        f.write("\n")
+    print(json.dumps(out["chaos"], indent=2, default=str))
+    print(f"\nwrote {args.out}")
+
+    failed = (
+        completion != 1.0
+        or len(res.recoveries) < 1
+        or not identical
+        or {"kill_rank", "partial_partition"} - {e["kind"] for e in log}
+    )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,7 @@ from ray_tpu.chaos.harness import (
     ENV_VAR,
     EnginePreempted,
     FaultInjected,
+    RankKilled,
     ReplicaCrashed,
     corrupt_frame,
     fault_log,
@@ -43,12 +44,16 @@ from ray_tpu.chaos.harness import (
 from ray_tpu.chaos.schedule import (
     CORRUPT_FRAME,
     DELAY_RPC,
+    DROP_COLLECTIVE,
     DROP_RPC,
+    KILL_RANK,
     KILL_REPLICA,
     KILL_WORKER,
     KINDS,
+    PARTIAL_PARTITION,
     PREEMPT_ENGINE,
     PREEMPT_NODE,
+    STALL_COLLECTIVE,
     STALL_HEARTBEAT,
     Fault,
     FaultSchedule,
@@ -73,9 +78,11 @@ def __getattr__(name):
 
 
 __all__ = [
-    "CORRUPT_FRAME", "DELAY_RPC", "DROP_RPC", "KILL_REPLICA", "KILL_WORKER",
-    "KINDS", "PREEMPT_ENGINE", "PREEMPT_NODE", "STALL_HEARTBEAT",
-    "Fault", "FaultSchedule", "FaultSpec", "FaultInjected", "ReplicaCrashed",
+    "CORRUPT_FRAME", "DELAY_RPC", "DROP_COLLECTIVE", "DROP_RPC", "KILL_RANK",
+    "KILL_REPLICA", "KILL_WORKER", "KINDS", "PARTIAL_PARTITION",
+    "PREEMPT_ENGINE", "PREEMPT_NODE", "STALL_COLLECTIVE", "STALL_HEARTBEAT",
+    "Fault", "FaultSchedule", "FaultSpec", "FaultInjected", "RankKilled",
+    "ReplicaCrashed",
     "EnginePreempted", "ChaosRunner", "ENV_VAR", "active", "corrupt_frame",
     "fault_log", "fire", "harness", "install", "install_from_env", "uninstall",
 ]

@@ -30,12 +30,16 @@ from ray_tpu.chaos.schedule import (  # noqa: F401 — re-exported for hook site
     CORRUPT_FRAME,
     CORRUPT_KV_TRANSFER,
     DELAY_RPC,
+    DROP_COLLECTIVE,
     DROP_KV_TRANSFER,
     DROP_RPC,
+    KILL_RANK,
     KILL_REPLICA,
     KILL_WORKER,
+    PARTIAL_PARTITION,
     PREEMPT_ENGINE,
     PREEMPT_NODE,
+    STALL_COLLECTIVE,
     STALL_HEARTBEAT,
     Fault,
     FaultSchedule,
@@ -60,6 +64,12 @@ class ReplicaCrashed(FaultInjected):
 
 class EnginePreempted(FaultInjected):
     """The LLM engine was preempted mid-step (PREEMPT_ENGINE)."""
+
+
+class RankKilled(FaultInjected):
+    """A collective-gang rank died mid-op (KILL_RANK): the victim raises
+    this; its peers see a typed CollectiveTimeoutError within their
+    bounded wait — never a forever-hung allreduce."""
 
 
 def install(schedule: FaultSchedule, *, propagate_env: bool = False) -> FaultSchedule:

@@ -53,11 +53,21 @@ PREEMPT_ENGINE = "preempt_engine"    # LLM engine dies mid-step
 # (receiver detects corruption by checksum; both end in a re-prefill)
 DROP_KV_TRANSFER = "drop_kv_transfer"        # handoff lost before the send
 CORRUPT_KV_TRANSFER = "corrupt_kv_transfer"  # KV pages bit-flipped in flight
+# collective/DAG plane (collective/collective.py, collective/
+# cluster_group.py, plus rpc.call for process-wide partitions): the gang
+# failure modes a data-parallel trainer on a preemptible pod must
+# survive. All four end the same way for the survivors — a bounded wait
+# raising a typed CollectiveError instead of a forever-hung allreduce.
+KILL_RANK = "kill_rank"                  # a gang rank dies mid-collective
+STALL_COLLECTIVE = "stall_collective"    # a rank arrives late (delay_s)
+DROP_COLLECTIVE = "drop_collective"      # a contribution lost in flight
+PARTIAL_PARTITION = "partial_partition"  # heartbeats reach GCS, peers don't
 
 KINDS = frozenset({
     KILL_WORKER, KILL_REPLICA, DROP_RPC, DELAY_RPC, STALL_HEARTBEAT,
     PREEMPT_NODE, CORRUPT_FRAME, PREEMPT_ENGINE,
     DROP_KV_TRANSFER, CORRUPT_KV_TRANSFER,
+    KILL_RANK, STALL_COLLECTIVE, DROP_COLLECTIVE, PARTIAL_PARTITION,
 })
 
 # kinds the in-process hook ignores (a runner executes them instead)

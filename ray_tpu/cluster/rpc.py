@@ -295,7 +295,9 @@ class RpcClient:
             # fault injection BEFORE the pending-slot registration so a
             # dropped call leaves no orphaned waiter
             for _f in _chaos.fire(
-                "rpc.call", kinds=(_chaos.DROP_RPC, _chaos.DELAY_RPC),
+                "rpc.call",
+                kinds=(_chaos.DROP_RPC, _chaos.DELAY_RPC,
+                       _chaos.PARTIAL_PARTITION),
                 method=method, peer=f"{self.addr[0]}:{self.addr[1]}",
             ):
                 if _f.kind == _chaos.DELAY_RPC:
@@ -303,6 +305,18 @@ class RpcClient:
                 elif _f.kind == _chaos.DROP_RPC:
                     raise RpcError(
                         f"chaos: dropped rpc {method!r} to {self.addr}"
+                    )
+                elif _f.kind == _chaos.PARTIAL_PARTITION:
+                    # rpc/daemon-layer partition: the matched methods
+                    # (typically the collective KV plane — match on
+                    # method="kv_*") become unreachable while everything
+                    # unmatched, e.g. the daemon's heartbeats, still
+                    # flows. ClusterGroup maps this RpcError to the
+                    # typed CollectivePartitionError.
+                    raise RpcError(
+                        f"chaos: partial partition — {method!r} to "
+                        f"{self.addr} unreachable (unmatched control "
+                        "traffic unaffected)"
                     )
         with self._plock:
             msg_id = self._next_id

@@ -47,8 +47,8 @@ def _framework_actor_method(actor, name: str):
     if name == "__ray_tpu_collective_init__":
         from ray_tpu.collective.collective import init_collective_group
 
-        return lambda world, rank, backend, group: init_collective_group(
-            world, rank, backend=backend, group_name=group
+        return lambda world, rank, backend, group, gen=0: init_collective_group(
+            world, rank, backend=backend, group_name=group, gen=gen
         )
     if name == "__ray_tpu_dag_exec_loop__":
         from ray_tpu.dag.compiled import _actor_exec_loop

@@ -15,12 +15,42 @@ not threads. TPU-native split:
 
 Same collective contract as the in-process `_HostGroup`: every rank
 issues the same collectives in the same order.
+
+Robustness (r12): every wait is bounded by a per-op deadline shared
+across the op's KV round-trips — a peer that dies or partitions
+mid-rendezvous produces ``CollectiveTimeoutError`` within the timeout,
+a GCS transport failure surfaces as ``CollectivePartitionError`` (the
+rank's daemon may still heartbeat — only this plane is cut), and all
+round/p2p keys are scoped under the gang epoch (``gen``): a zombie rank
+from a superseded generation fails its generation check with
+``StaleGenerationError`` and its late deposits land under old-gen keys
+nobody reads.
 """
 
 from __future__ import annotations
 
 import pickle
+import time
 from typing import Any, Optional
+
+from ray_tpu.collective.errors import (
+    DEFAULT_TIMEOUT,
+    CollectiveAbortedError,
+    CollectiveError,
+    CollectivePartitionError,
+    CollectiveTimeoutError,
+    StaleGenerationError,
+)
+
+
+def _transport_errors() -> tuple:
+    """Error types that mean 'could not reach the rendezvous plane'."""
+    try:
+        from ray_tpu.cluster.rpc import RpcError
+
+        return (RpcError, ConnectionError, OSError)
+    except ImportError:  # pragma: no cover — cluster extra not loaded
+        return (ConnectionError, OSError)
 
 
 class ClusterGroup:
@@ -31,8 +61,10 @@ class ClusterGroup:
     """
 
     NS = "__collective__"
+    JOIN_TIMEOUT = 60.0
 
-    def __init__(self, name: str, world_size: int, rank: int, client=None):
+    def __init__(self, name: str, world_size: int, rank: int, client=None,
+                 gen: int = 0):
         if client is None:
             from ray_tpu.cluster.client import _ambient_client
 
@@ -48,76 +80,295 @@ class ClusterGroup:
         self.name = name
         self.world_size = world_size
         self.rank = rank
+        self.gen = int(gen)
         self._client = client
         self._round = 0
         self._send_seq: dict[int, int] = {}
         self._recv_seq: dict[int, int] = {}
-        if rank == 0:
-            client.kv_put(
-                self._key("meta"), pickle.dumps({"world_size": world_size}), self.NS
-            )
-        else:
-            meta = pickle.loads(client.kv_wait(self._key("meta"), self.NS, 60.0))
-            if meta["world_size"] != world_size:
-                raise ValueError(
-                    f"group {name!r} exists with world_size "
-                    f"{meta['world_size']} != {world_size}"
+        try:
+            cur = self._published_gen()
+            if cur is not None and cur > self.gen:
+                raise StaleGenerationError(
+                    f"group {name!r} re-formed at gen {cur}; cannot join at "
+                    f"gen {self.gen}",
+                    group=name, gen=self.gen, rank=rank,
                 )
+            if rank == 0:
+                if cur is None or cur < self.gen:
+                    client.kv_put(
+                        self._base_key("gen"),
+                        str(self.gen).encode(),
+                        self.NS,
+                    )
+                    if cur is not None:
+                        # GC the superseded generation's residue: aborted
+                        # rounds hold full gradient payloads under
+                        # name/g{cur}/ that nobody will ever read (the
+                        # re-formed gang is keyed g{gen}, zombies only
+                        # write) — without this every recovery strands
+                        # world_size gradient copies in GCS memory until
+                        # group destroy
+                        try:
+                            for key in client.gcs.call("kv_keys", {
+                                "ns": self.NS,
+                                "prefix": f"{name}/g{cur}/".encode(),
+                            }):
+                                client.kv_del(key, self.NS)
+                        except Exception:  # noqa: BLE001 — best-effort GC
+                            pass
+                client.kv_put(
+                    self._key("meta"),
+                    pickle.dumps({"world_size": world_size}),
+                    self.NS,
+                )
+            else:
+                # sliced wait, not one JOIN_TIMEOUT-long park: a
+                # supervisor abort (rank 0 died before publishing meta)
+                # unparks the join within one poll slice instead of
+                # costing the full 60s of recovery latency
+                meta = pickle.loads(self._wait(
+                    self._key("meta"),
+                    time.monotonic() + self.JOIN_TIMEOUT,
+                    f"joining group {name!r} (gen {self.gen})",
+                    rank,
+                ))
+                if meta["world_size"] != world_size:
+                    raise ValueError(
+                        f"group {name!r} (gen {self.gen}) exists with "
+                        f"world_size {meta['world_size']} != {world_size}"
+                    )
+        except TimeoutError as e:
+            raise CollectiveTimeoutError(
+                f"joining group {name!r} (gen {self.gen}) as rank {rank}: "
+                f"rank 0 never published meta within {self.JOIN_TIMEOUT}s",
+                group=name, gen=self.gen, rank=rank,
+            ) from e
+        except _transport_errors() as e:
+            raise CollectivePartitionError(
+                f"joining group {name!r} (gen {self.gen}) as rank {rank}: "
+                f"cannot reach the rendezvous plane: {e}",
+                group=name, gen=self.gen, rank=rank,
+            ) from e
+
+    def _base_key(self, *parts) -> bytes:
+        """Gen-independent key (group-lifetime state: the current gen)."""
+        return "/".join((self.name,) + tuple(str(p) for p in parts)).encode()
 
     def _key(self, *parts) -> bytes:
-        return "/".join((self.name,) + tuple(str(p) for p in parts)).encode()
+        """Gen-scoped key: round contributions/results and p2p payloads
+        of different gang epochs can never collide — a zombie's late
+        deposit is invisible to the re-formed gang by construction."""
+        return "/".join(
+            (self.name, f"g{self.gen}") + tuple(str(p) for p in parts)
+        ).encode()
+
+    def _published_gen(self) -> Optional[int]:
+        raw = self._client.kv_get(self._base_key("gen"), self.NS)
+        return int(raw) if raw is not None else None
+
+    def abort(self, reason: str) -> None:
+        """Publish the abort marker for this gang epoch: every rank of
+        gen <= this one parked in a sliced wait wakes with
+        ``CollectiveAbortedError`` within one poll slice, instead of
+        burning its full op timeout on a peer known dead."""
+        publish_abort(self.name, reason, gen=self.gen, client=self._client)
+
+    def _guard(self, op: str) -> bool:
+        """Chaos hook at every op entry. Returns the drop-in-flight flag
+        (see collective_chaos). Deliberately NO GCS round-trip here: the
+        steady-state fast path stays at the op's own KV traffic —
+        abort/stale-generation checks run inside the sliced waits, the
+        only place a zombie or abandoned rank can actually linger (a
+        zombie's deposits land under old-gen keys nobody reads, so an
+        op that would complete without waiting is already harmless)."""
+        from ray_tpu.collective.collective import collective_chaos
+
+        return collective_chaos(self.name, self.gen, self.rank, op)
+
+    def _check_live(self, rank: int) -> None:
+        """Raise if this gang epoch was aborted or superseded (one
+        kv_get each — only called between wait slices, never on the
+        fast path)."""
+        raw = self._client.kv_get(self._base_key("abort"), self.NS)
+        if raw is not None:
+            marker = pickle.loads(raw)
+            if int(marker.get("gen", 0)) >= self.gen:
+                raise CollectiveAbortedError(
+                    f"collective group {self.name!r} (gen {self.gen}) "
+                    f"aborted: {marker.get('reason', '')}",
+                    group=self.name, gen=self.gen, rank=rank,
+                )
+        cur = self._published_gen()
+        if cur is not None and cur > self.gen:
+            raise StaleGenerationError(
+                f"group {self.name!r} re-formed at gen {cur}; rank "
+                f"{rank} joined gen {self.gen} and must exit",
+                group=self.name, gen=self.gen, rank=rank,
+            )
+
+    POLL_SLICE_S = 1.0
+
+    def _wait(self, key: bytes, deadline: float, what: str,
+              rank: int) -> bytes:
+        """``kv_wait`` in bounded slices, checking the abort marker and
+        the published generation between slices — the cluster-tier
+        analog of ``_HostGroup``'s condition-variable wake: an abort or
+        a superseding re-form unparks this rank within one slice."""
+        while True:
+            left = deadline - time.monotonic()
+            if left <= 0:
+                raise CollectiveTimeoutError(
+                    f"{what}: peers missing at deadline",
+                    group=self.name, gen=self.gen, rank=rank,
+                )
+            try:
+                return self._client.kv_wait(
+                    key, self.NS, min(left, self.POLL_SLICE_S)
+                )
+            except TimeoutError:
+                self._check_live(rank)
 
     # -- collective rendezvous ------------------------------------------------
 
-    def rendezvous(self, rank: int, value: Any, compute, timeout: float = 120.0):
+    def rendezvous(self, rank: int, value: Any, compute,
+                   timeout: Optional[float] = None):
         """Deposit value under this round; rank 0 reduces once all ranks
-        landed and publishes; everyone returns the published result."""
+        landed and publishes; everyone returns the published result.
+
+        One deadline bounds the WHOLE op (rank 0's reads across all
+        peers share it — world_size stragglers cannot stack timeouts)."""
+        timeout = DEFAULT_TIMEOUT if timeout is None else timeout
+        deadline = time.monotonic() + timeout
         rnd, self._round = self._round, self._round + 1
         kv = self._client
-        kv.kv_put(self._key(rnd, "c", rank), pickle.dumps(value), self.NS)
-        if rank == 0:
-            vals = []
-            for r in range(self.world_size):
-                raw = kv.kv_wait(self._key(rnd, "c", r), self.NS, timeout)
-                vals.append(pickle.loads(raw))
-            result = compute(vals)
-            kv.kv_put(self._key(rnd, "r"), pickle.dumps(result), self.NS)
-            # garbage: contributions of this round; result of the previous
-            # round (published results can only be awaited by ranks that
-            # already contributed to THIS round, i.e. consumed round-1)
-            for r in range(self.world_size):
-                kv.kv_del(self._key(rnd, "c", r), self.NS)
-            if rnd > 0:
-                kv.kv_del(self._key(rnd - 1, "r"), self.NS)
-            return result
-        raw = kv.kv_wait(self._key(rnd, "r"), self.NS, timeout)
-        return pickle.loads(raw)
+        ctx = dict(group=self.name, gen=self.gen, rank=rank)
+        try:
+            drop = self._guard("rendezvous")
+            if not drop:
+                kv.kv_put(self._key(rnd, "c", rank), pickle.dumps(value), self.NS)
+            if rank == 0:
+                vals = []
+                for r in range(self.world_size):
+                    raw = self._wait(
+                        self._key(rnd, "c", r), deadline,
+                        f"round {rnd} gather", rank,
+                    )
+                    vals.append(pickle.loads(raw))
+                result = compute(vals)
+                kv.kv_put(self._key(rnd, "r"), pickle.dumps(result), self.NS)
+                # garbage: contributions of this round; result of the previous
+                # round (published results can only be awaited by ranks that
+                # already contributed to THIS round, i.e. consumed round-1)
+                for r in range(self.world_size):
+                    kv.kv_del(self._key(rnd, "c", r), self.NS)
+                if rnd > 0:
+                    kv.kv_del(self._key(rnd - 1, "r"), self.NS)
+                return result
+            raw = self._wait(
+                self._key(rnd, "r"), deadline, f"round {rnd} result", rank,
+            )
+            return pickle.loads(raw)
+        except CollectiveError:
+            raise
+        except TimeoutError as e:
+            raise CollectiveTimeoutError(
+                f"collective group {self.name!r} (gen {self.gen}) round "
+                f"{rnd}: peers missing after {timeout}s: {e}",
+                **ctx,
+            ) from e
+        except _transport_errors() as e:
+            raise CollectivePartitionError(
+                f"collective group {self.name!r} (gen {self.gen}) round "
+                f"{rnd}: lost the rendezvous plane: {e}",
+                **ctx,
+            ) from e
 
     # -- p2p ------------------------------------------------------------------
 
-    def send(self, src: int, dst: int, value: Any, timeout: float = 120.0) -> None:
-        seq = self._send_seq.get(dst, 0)
-        self._send_seq[dst] = seq + 1
-        self._client.kv_put(
-            self._key("p2p", src, dst, seq), pickle.dumps(value), self.NS
-        )
+    def send(self, src: int, dst: int, value: Any,
+             timeout: Optional[float] = None) -> None:
+        ctx = dict(group=self.name, gen=self.gen, rank=src)
+        try:
+            drop = self._guard("send")
+            seq = self._send_seq.get(dst, 0)
+            self._send_seq[dst] = seq + 1
+            if drop:  # lost in flight: sender believes it sent
+                return
+            self._client.kv_put(
+                self._key("p2p", src, dst, seq), pickle.dumps(value), self.NS
+            )
+        except CollectiveError:
+            raise
+        except _transport_errors() as e:
+            raise CollectivePartitionError(
+                f"send {src}->{dst} in group {self.name!r}: lost the "
+                f"rendezvous plane: {e}",
+                **ctx,
+            ) from e
 
-    def recv(self, src: int, dst: int, timeout: float = 120.0) -> Any:
-        seq = self._recv_seq.get(src, 0)
-        self._recv_seq[src] = seq + 1
-        key = self._key("p2p", src, dst, seq)
-        raw = self._client.kv_wait(key, self.NS, timeout)
-        self._client.kv_del(key, self.NS)
-        return pickle.loads(raw)
+    def recv(self, src: int, dst: int,
+             timeout: Optional[float] = None) -> Any:
+        timeout = DEFAULT_TIMEOUT if timeout is None else timeout
+        deadline = time.monotonic() + timeout
+        ctx = dict(group=self.name, gen=self.gen, rank=dst)
+        try:
+            self._guard("recv")
+            seq = self._recv_seq.get(src, 0)
+            self._recv_seq[src] = seq + 1
+            key = self._key("p2p", src, dst, seq)
+            raw = self._wait(key, deadline, f"recv from rank {src}", dst)
+            self._client.kv_del(key, self.NS)
+            return pickle.loads(raw)
+        except CollectiveError:
+            raise
+        except TimeoutError as e:
+            raise CollectiveTimeoutError(
+                f"recv from rank {src} in group {self.name!r} timed out "
+                f"after {timeout}s",
+                **ctx,
+            ) from e
+        except _transport_errors() as e:
+            raise CollectivePartitionError(
+                f"recv {src}->{dst} in group {self.name!r}: lost the "
+                f"rendezvous plane: {e}",
+                **ctx,
+            ) from e
 
     def destroy(self) -> None:
         clear_group_kv(self._client, self.name)
 
 
+def publish_abort(name: str, reason: str, gen: Optional[int] = None,
+                  client=None) -> None:
+    """Publish a group's abort marker to the GCS — the driver-side abort
+    primitive for cluster gangs whose ranks live in OTHER processes (a
+    supervisor is not necessarily a rank). Ranks of gang epoch <= the
+    marker's gen wake from their sliced waits with
+    ``CollectiveAbortedError``; a re-formed gang at a higher epoch is
+    untouched by it."""
+    if client is None:
+        from ray_tpu.cluster.client import _ambient_client
+
+        client = _ambient_client()
+        if client is None:
+            return
+    if gen is None:
+        raw = client.kv_get(
+            "/".join((name, "gen")).encode(), ClusterGroup.NS
+        )
+        gen = int(raw) if raw is not None else 0
+    client.kv_put(
+        "/".join((name, "abort")).encode(),
+        pickle.dumps({"gen": int(gen), "reason": reason}),
+        ClusterGroup.NS,
+    )
+
+
 def clear_group_kv(client, name: str) -> None:
-    """Best-effort removal of a group's GCS residue (meta, unread round
-    results, unclaimed p2p payloads) — shared by rank-side destroy and
-    the driver-side destroy_collective_group path."""
+    """Best-effort removal of a group's GCS residue (meta, current-gen
+    marker, unread round results, unclaimed p2p payloads) — shared by
+    rank-side destroy and the driver-side destroy_collective_group
+    path."""
     try:
         for key in client.gcs.call(
             "kv_keys", {"ns": ClusterGroup.NS, "prefix": name.encode() + b"/"}

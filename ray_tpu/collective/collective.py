@@ -16,15 +16,36 @@ two-tier:
     in-process rendezvous over the gang's ranks — the Gloo-equivalent
     for thread workers on one host, and the seam where the DCN
     transport plugs in for multi-host.
+
+Robustness contract (r12): every collective op is bounded — a peer that
+dies, stalls, or partitions mid-allreduce produces a typed
+``CollectiveError`` (collective/errors.py) within the op's timeout, and
+groups carry a **gang epoch** (``gen``): when a supervisor re-forms the
+gang at a higher generation, ops issued by zombie ranks of the old
+generation raise ``StaleGenerationError`` instead of injecting into the
+new gang. Chaos hook site ``collective.rendezvous`` fires the seeded
+``KILL_RANK`` / ``STALL_COLLECTIVE`` / ``DROP_COLLECTIVE`` /
+``PARTIAL_PARTITION`` fault kinds here.
 """
 
 from __future__ import annotations
 
 import enum
 import threading
+import time
 from typing import Any, Optional
 
 import numpy as np
+
+from ray_tpu.chaos import harness as _chaos
+from ray_tpu.collective.errors import (
+    DEFAULT_TIMEOUT,
+    CollectiveAbortedError,
+    CollectiveError,
+    CollectivePartitionError,
+    CollectiveTimeoutError,
+    StaleGenerationError,
+)
 
 
 class ReduceOp(enum.Enum):
@@ -51,43 +72,129 @@ def _tree_reduce(op, vals):
     return out
 
 
+def collective_chaos(name: str, gen: int, rank: int, op: str) -> bool:
+    """The collective-plane chaos hook (shared by the host-tier
+    ``_HostGroup`` and the cluster-tier ``ClusterGroup``). Returns True
+    when this rank's contribution must be DROPPED in flight — the rank
+    believes it sent and keeps waiting, peers never see it (everyone's
+    bounded wait then raises). ``KILL_RANK`` raises in the victim,
+    ``STALL_COLLECTIVE`` sleeps ``delay_s`` before the op proceeds, and
+    ``PARTIAL_PARTITION`` raises the typed partition error (the rank
+    still heartbeats to GCS through its daemon — only the peer-facing
+    collective plane is cut).
+
+    ``DROP_COLLECTIVE`` is only eligible at ops that contribute data
+    (rendezvous deposits, sends): a recv has nothing in flight to lose,
+    and fire()'s site-kind contract says a spec must not burn its
+    max_fires budget at a site that ignores its kind."""
+    if _chaos.ACTIVE is None:
+        return False
+    kinds = (_chaos.KILL_RANK, _chaos.STALL_COLLECTIVE,
+             _chaos.PARTIAL_PARTITION)
+    if op != "recv":
+        kinds += (_chaos.DROP_COLLECTIVE,)
+    drop = False
+    for f in _chaos.fire(
+        "collective.rendezvous",
+        kinds=kinds,
+        group=name, gen=gen, rank=rank, op=op,
+    ):
+        if f.kind == _chaos.STALL_COLLECTIVE:
+            time.sleep(f.delay_s)
+        elif f.kind == _chaos.DROP_COLLECTIVE:
+            drop = True
+        elif f.kind == _chaos.KILL_RANK:
+            raise _chaos.RankKilled(
+                f"chaos: rank {rank} of group {name!r} (gen {gen}) "
+                f"killed mid-{op}"
+            )
+        elif f.kind == _chaos.PARTIAL_PARTITION:
+            raise CollectivePartitionError(
+                f"chaos: rank {rank} of group {name!r} (gen {gen}) "
+                "partitioned from peers (GCS heartbeats still flowing)",
+                group=name, gen=gen, rank=rank,
+            )
+    return drop
+
+
 class _HostGroup:
     """Rank-rendezvous collective group for ranks running as threads of one
     host process. Every rank must issue collectives in the same order
-    (standard collective contract)."""
+    (standard collective contract). Carries its gang epoch (``gen``);
+    a supervisor re-forming the gang replaces this incarnation and
+    ``abort()``s it so stragglers wake with a typed error instead of
+    burning their full timeout."""
 
-    def __init__(self, name: str, world_size: int):
+    def __init__(self, name: str, world_size: int, gen: int = 0):
         self.name = name
         self.world_size = world_size
+        self.gen = int(gen)
         self._cv = threading.Condition()
         self._rounds: dict[int, dict] = {}  # round -> {values, result, reads}
         self._rank_round: dict[int, int] = {}
         self._p2p: dict[tuple, Any] = {}  # (src, dst, seq) -> value
         self._p2p_seq: dict[tuple, int] = {}
+        self._aborted: Optional[str] = None
+
+    def abort(self, reason: str) -> None:
+        """Wake every blocked waiter with ``CollectiveAbortedError`` —
+        the supervisor's abort-the-in-flight-step primitive: once one
+        rank is known dead, survivors must not wait out their timeout."""
+        with self._cv:
+            self._aborted = reason
+            self._cv.notify_all()
+
+    def _check_live(self, rank: int, rnd: Optional[int] = None) -> None:
+        if self._aborted is not None:
+            raise CollectiveAbortedError(
+                f"collective group {self.name!r} (gen {self.gen})"
+                + (f" round {rnd}" if rnd is not None else "")
+                + f" aborted: {self._aborted}",
+                group=self.name, gen=self.gen, rank=rank,
+            )
+        current = _generations.get(self.name, self.gen)
+        if current > self.gen:
+            raise StaleGenerationError(
+                f"collective group {self.name!r} re-formed at gen {current}; "
+                f"this rank joined gen {self.gen} and must exit",
+                group=self.name, gen=self.gen, rank=rank,
+            )
 
     def _next_round(self, rank: int) -> int:
         r = self._rank_round.get(rank, 0)
         self._rank_round[rank] = r + 1
         return r
 
-    def rendezvous(self, rank: int, value: Any, compute, timeout: float = 120.0):
+    def rendezvous(self, rank: int, value: Any, compute,
+                   timeout: Optional[float] = None):
         """Deposit value; when all ranks arrive, compute(list_by_rank) once;
-        everyone returns its output."""
+        everyone returns its output. Bounded: a missing peer raises
+        ``CollectiveTimeoutError`` after ``timeout`` seconds."""
+        timeout = DEFAULT_TIMEOUT if timeout is None else timeout
+        drop = collective_chaos(self.name, self.gen, rank, "rendezvous")
         with self._cv:
+            self._check_live(rank)
             rnd = self._next_round(rank)
             slot = self._rounds.setdefault(rnd, {"values": {}, "result": None, "done": False, "reads": 0})
-            slot["values"][rank] = value
+            if not drop:
+                slot["values"][rank] = value
             if len(slot["values"]) == self.world_size:
                 ordered = [slot["values"][r] for r in range(self.world_size)]
                 slot["result"] = compute(ordered)
                 slot["done"] = True
                 self._cv.notify_all()
             else:
-                ok = self._cv.wait_for(lambda: slot["done"], timeout)
-                if not ok:
-                    raise TimeoutError(
-                        f"collective group {self.name!r} round {rnd}: only "
-                        f"{len(slot['values'])}/{self.world_size} ranks arrived"
+                ok = self._cv.wait_for(
+                    lambda: slot["done"] or self._aborted is not None, timeout
+                )
+                if not slot["done"]:
+                    self._check_live(rank, rnd)  # aborted / superseded
+                    assert not ok
+                    raise CollectiveTimeoutError(
+                        f"collective group {self.name!r} (gen {self.gen}) "
+                        f"round {rnd}: only {len(slot['values'])}/"
+                        f"{self.world_size} ranks arrived within {timeout}s",
+                        group=self.name, gen=self.gen, rank=rank,
                     )
             result = slot["result"]
             slot["reads"] += 1
@@ -97,25 +204,42 @@ class _HostGroup:
 
     # p2p ---------------------------------------------------------------
 
-    def send(self, src: int, dst: int, value: Any, timeout: float = 120.0) -> None:
+    def send(self, src: int, dst: int, value: Any,
+             timeout: Optional[float] = None) -> None:
+        drop = collective_chaos(self.name, self.gen, src, "send")
         with self._cv:
+            self._check_live(src)
             seq = self._p2p_seq.get((src, dst, "s"), 0)
             self._p2p_seq[(src, dst, "s")] = seq + 1
-            self._p2p[(src, dst, seq)] = value
-            self._cv.notify_all()
+            if not drop:  # dropped in flight: sender believes it sent
+                self._p2p[(src, dst, seq)] = value
+                self._cv.notify_all()
 
-    def recv(self, src: int, dst: int, timeout: float = 120.0) -> Any:
+    def recv(self, src: int, dst: int, timeout: Optional[float] = None) -> Any:
+        timeout = DEFAULT_TIMEOUT if timeout is None else timeout
+        collective_chaos(self.name, self.gen, dst, "recv")
         with self._cv:
+            self._check_live(dst)
             seq = self._p2p_seq.get((src, dst, "r"), 0)
             self._p2p_seq[(src, dst, "r")] = seq + 1
-            ok = self._cv.wait_for(lambda: (src, dst, seq) in self._p2p, timeout)
-            if not ok:
-                raise TimeoutError(f"recv from rank {src} timed out")
+            ok = self._cv.wait_for(
+                lambda: (src, dst, seq) in self._p2p
+                or self._aborted is not None,
+                timeout,
+            )
+            if (src, dst, seq) not in self._p2p:
+                self._check_live(dst)
+                assert not ok
+                raise CollectiveTimeoutError(
+                    f"recv from rank {src} timed out after {timeout}s",
+                    group=self.name, gen=self.gen, rank=dst,
+                )
             return self._p2p.pop((src, dst, seq))
 
 
 _groups: dict[str, _HostGroup] = {}
 _declared: dict[str, dict] = {}
+_generations: dict[str, int] = {}  # group name -> current gang epoch
 _lock = threading.Lock()
 _local = threading.local()
 
@@ -125,49 +249,83 @@ def init_collective_group(
     rank: int,
     backend: str = "host",
     group_name: str = "default",
+    gen: int = 0,
 ) -> None:
     """Join (creating if first) a collective group. Called by every rank.
 
     Backends: "host" (thread ranks of one process), "cluster" (process
     ranks rendezvousing through the attached cluster's GCS — the
     cross-process/DCN tier), "ici" (device tier: use mesh_for_group).
+
+    ``gen`` is the gang epoch: a supervisor recovering from a lost rank
+    re-forms the SAME group name at ``gen + 1`` — the old incarnation is
+    aborted and superseded, and any zombie rank still holding it gets
+    ``StaleGenerationError`` instead of injecting into the new gang.
     """
     if backend not in ("host", "ici", "cluster"):
         raise ValueError(f"unknown backend {backend!r}; 'host', 'cluster' or 'ici'")
     if not 0 <= rank < world_size:
         raise ValueError(f"rank {rank} out of range for world_size {world_size}")
+    gen = int(gen)
     if backend == "cluster":
         from ray_tpu.collective.cluster_group import ClusterGroup
 
         with _lock:
+            if gen < _generations.get(group_name, 0):
+                raise StaleGenerationError(
+                    f"group {group_name!r} is at gen "
+                    f"{_generations[group_name]} in this process; cannot "
+                    f"join at gen {gen}",
+                    group=group_name, gen=gen, rank=rank,
+                )
             existing = _groups.get(group_name)
-            if isinstance(existing, ClusterGroup) and existing.rank != rank:
+            if (
+                isinstance(existing, ClusterGroup)
+                and existing.gen >= gen
+                and existing.rank != rank
+            ):
                 # the rank->group fallback in _group_and_rank is per-process;
                 # two ranks of one cluster group inside one process would
                 # silently collapse onto the last writer. Cluster ranks are
                 # process actors — use backend="host" for thread gangs.
+                # (A HIGHER gen re-join may renumber this process's rank:
+                # elastic re-form after eviction.)
                 raise ValueError(
                     f"group {group_name!r} already has cluster rank "
                     f"{existing.rank} in this process; one cluster-backend "
                     "rank per process"
                 )
-        group = ClusterGroup(group_name, world_size, rank)
+        group = ClusterGroup(group_name, world_size, rank, gen=gen)
         with _lock:
             _groups[group_name] = group
+            _generations[group_name] = max(_generations.get(group_name, 0), gen)
         if not hasattr(_local, "ranks"):
             _local.ranks = {}
         _local.ranks[group_name] = (group, rank)
         return
+    superseded = None
     with _lock:
+        if gen < _generations.get(group_name, 0):
+            raise StaleGenerationError(
+                f"group {group_name!r} is at gen {_generations[group_name]}; "
+                f"cannot join at gen {gen}",
+                group=group_name, gen=gen, rank=rank,
+            )
         group = _groups.get(group_name)
-        if group is None:
-            group = _HostGroup(group_name, world_size)
+        if group is None or getattr(group, "gen", 0) < gen:
+            superseded = group
+            group = _HostGroup(group_name, world_size, gen=gen)
             _groups[group_name] = group
+            _generations[group_name] = gen
         elif group.world_size != world_size:
             raise ValueError(
                 f"group {group_name!r} already exists with world_size "
                 f"{group.world_size} != {world_size}"
             )
+    if superseded is not None and hasattr(superseded, "abort"):
+        # wake the old incarnation's stragglers NOW — they are zombies of
+        # a dead gang epoch, not participants who might still arrive
+        superseded.abort(f"superseded by gen {gen}")
     if not hasattr(_local, "ranks"):
         _local.ranks = {}
     # bind the rank to THIS group incarnation: after destroy/recreate, stale
@@ -181,6 +339,7 @@ def create_collective_group(
     ranks: list[int],
     backend: str = "host",
     group_name: str = "default",
+    gen: int = 0,
 ) -> None:
     """Declarative creation (reference collective.py:160): registers the
     group, then runs the rank join ON each actor's executor thread (so the
@@ -203,13 +362,16 @@ def create_collective_group(
     with _lock:
         _declared[group_name] = {"world_size": world_size, "backend": backend}
         if backend != "cluster" and group_name not in _groups:
-            _groups[group_name] = _HostGroup(group_name, world_size)
+            _groups[group_name] = _HostGroup(group_name, world_size, gen=gen)
+            _generations[group_name] = max(
+                _generations.get(group_name, 0), int(gen)
+            )
     if cluster_actors:
         from ray_tpu.cluster.client import _ActorMethod
 
         refs = [
             _ActorMethod(actor, "__ray_tpu_collective_init__").remote(
-                world_size, rank, backend, group_name
+                world_size, rank, backend, group_name, gen
             )
             for actor, rank in zip(actors, ranks)
         ]
@@ -217,7 +379,7 @@ def create_collective_group(
         refs = [
             actor._invoke(
                 "__ray_tpu_collective_init__",
-                (world_size, rank, backend, group_name),
+                (world_size, rank, backend, group_name, gen),
                 {},
             )
             for actor, rank in zip(actors, ranks)
@@ -225,12 +387,40 @@ def create_collective_group(
     _api.get(refs, timeout=60)
 
 
+def declare_collective_group(
+    world_size: int,
+    backend: str = "host",
+    group_name: str = "default",
+) -> None:
+    """Driver-side declaration WITHOUT joining: records the group's
+    backend so ``abort_collective_group`` / ``destroy_collective_group``
+    issued from a non-rank supervisor process reach the cluster tier
+    (publish the GCS abort marker / clear the group's KV residue) even
+    though no local group object exists. A supervisor whose ranks join
+    via their own ``init_collective_group`` calls (the elastic trainer's
+    shape) must declare, or its aborts silently no-op and a leaked GCS
+    ``gen`` key poisons the next run reusing the group name."""
+    if backend not in ("host", "ici", "cluster"):
+        raise ValueError(f"unknown backend {backend!r}; 'host', 'cluster' or 'ici'")
+    with _lock:
+        _declared[group_name] = {"world_size": world_size, "backend": backend}
+
+
 def destroy_collective_group(group_name: str = "default") -> None:
     with _lock:
         group = _groups.pop(group_name, None)
         declared = _declared.pop(group_name, None)
+        _generations.pop(group_name, None)
+    if group is not None and hasattr(group, "abort"):
+        group.abort("group destroyed")
     if group is not None and hasattr(group, "destroy"):
-        group.destroy()  # cluster-tier: clear its GCS KV residue
+        # cluster-tier: clear its GCS KV residue. This also deletes the
+        # abort marker just published, so a REMOTE rank parked mid-op
+        # may miss the one-poll-slice wake and fall back to its bounded
+        # op timeout (typed CollectiveTimeoutError) — destroy is a
+        # terminal cleanup, not the supervisor's abort primitive; use
+        # abort_collective_group for latency-critical unparking.
+        group.destroy()
     elif declared is not None and declared.get("backend") == "cluster":
         # driver declared the gang but never joined it, so no local
         # ClusterGroup exists; clear the GCS residue directly (stale
@@ -246,17 +436,55 @@ def destroy_collective_group(group_name: str = "default") -> None:
         _local.ranks.pop(group_name, None)
 
 
+def abort_collective_group(group_name: str = "default",
+                           reason: str = "aborted") -> None:
+    """Abort the group's in-flight rounds WITHOUT destroying it: every
+    blocked rank wakes with ``CollectiveAbortedError``. The supervisor's
+    first move on detecting a dead rank — survivors must stop waiting on
+    a peer that will never arrive.
+
+    Host tier: wakes waiters via the group's condition variable. Cluster
+    tier: publishes the group's GCS abort marker, which parked ranks in
+    OTHER processes observe within one poll slice of their sliced waits
+    — works from a driver that is not itself a rank."""
+    with _lock:
+        group = _groups.get(group_name)
+        declared = _declared.get(group_name)
+    if group is not None and hasattr(group, "abort"):
+        group.abort(reason)
+        return
+    if declared is not None and declared.get("backend") == "cluster":
+        from ray_tpu.collective.cluster_group import publish_abort
+
+        try:
+            publish_abort(group_name, reason)
+        except Exception:  # noqa: BLE001 — abort is best-effort; the
+            pass           # bounded op timeout remains the backstop
+
+
 def _group_and_rank(group_name: str, rank: Optional[int]) -> tuple[_HostGroup, int]:
     with _lock:
         group = _groups.get(group_name)
+        current_gen = _generations.get(group_name, 0)
+    bound = getattr(_local, "ranks", {}).get(group_name)
+    if bound is not None and bound[0] is not group:
+        # this thread joined an incarnation that is no longer current
+        if getattr(bound[0], "gen", 0) < current_gen:
+            raise StaleGenerationError(
+                f"group {group_name!r} re-formed at gen {current_gen}; this "
+                f"thread joined gen {getattr(bound[0], 'gen', 0)} and must "
+                "exit (zombie rank)",
+                group=group_name, gen=getattr(bound[0], "gen", 0),
+                rank=bound[1],
+            )
+        bound = None  # destroyed/recreated at same gen: stale binding
     if group is None:
         raise RuntimeError(
             f"collective group {group_name!r} not initialized; call "
             f"init_collective_group first"
         )
     if rank is None:
-        bound = getattr(_local, "ranks", {}).get(group_name)
-        if bound is not None and bound[0] is group:
+        if bound is not None:
             rank = bound[1]
         elif hasattr(group, "rank"):
             # cluster-tier groups are per-process with a fixed rank, so
@@ -280,44 +508,62 @@ def get_collective_group_size(group_name: str = "default") -> int:
     return group.world_size
 
 
+def get_gang_epoch(group_name: str = "default") -> int:
+    """The group's current gang epoch (generation) in this process."""
+    with _lock:
+        return _generations.get(group_name, 0)
+
+
 # -- collectives -------------------------------------------------------------
 
 
-def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM, rank: Optional[int] = None):
+def allreduce(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM,
+              rank: Optional[int] = None, timeout: Optional[float] = None):
     group, rank = _group_and_rank(group_name, rank)
-    return group.rendezvous(rank, np.asarray(tensor), _REDUCERS[op])
+    return group.rendezvous(rank, np.asarray(tensor), _REDUCERS[op],
+                            timeout=timeout)
 
 
-def allgather(tensor, group_name: str = "default", rank: Optional[int] = None) -> list:
+def allgather(tensor, group_name: str = "default", rank: Optional[int] = None,
+              timeout: Optional[float] = None) -> list:
     group, rank = _group_and_rank(group_name, rank)
-    return group.rendezvous(rank, np.asarray(tensor), lambda vals: list(vals))
+    return group.rendezvous(rank, np.asarray(tensor), lambda vals: list(vals),
+                            timeout=timeout)
 
 
-def reducescatter(tensor, group_name: str = "default", op: ReduceOp = ReduceOp.SUM, rank: Optional[int] = None):
+def reducescatter(tensor, group_name: str = "default",
+                  op: ReduceOp = ReduceOp.SUM, rank: Optional[int] = None,
+                  timeout: Optional[float] = None):
     group, rank = _group_and_rank(group_name, rank)
-    reduced = group.rendezvous(rank, np.asarray(tensor), _REDUCERS[op])
+    reduced = group.rendezvous(rank, np.asarray(tensor), _REDUCERS[op],
+                               timeout=timeout)
     shards = np.array_split(reduced, group.world_size, axis=0)
     return shards[rank]
 
 
-def broadcast(tensor, src_rank: int = 0, group_name: str = "default", rank: Optional[int] = None):
+def broadcast(tensor, src_rank: int = 0, group_name: str = "default",
+              rank: Optional[int] = None, timeout: Optional[float] = None):
     group, rank = _group_and_rank(group_name, rank)
-    return group.rendezvous(rank, np.asarray(tensor), lambda vals: vals[src_rank])
+    return group.rendezvous(rank, np.asarray(tensor),
+                            lambda vals: vals[src_rank], timeout=timeout)
 
 
-def barrier(group_name: str = "default", rank: Optional[int] = None) -> None:
+def barrier(group_name: str = "default", rank: Optional[int] = None,
+            timeout: Optional[float] = None) -> None:
     group, rank = _group_and_rank(group_name, rank)
-    group.rendezvous(rank, None, lambda vals: None)
+    group.rendezvous(rank, None, lambda vals: None, timeout=timeout)
 
 
-def send(tensor, dst_rank: int, group_name: str = "default", rank: Optional[int] = None) -> None:
+def send(tensor, dst_rank: int, group_name: str = "default",
+         rank: Optional[int] = None, timeout: Optional[float] = None) -> None:
     group, rank = _group_and_rank(group_name, rank)
-    group.send(rank, dst_rank, np.asarray(tensor))
+    group.send(rank, dst_rank, np.asarray(tensor), timeout=timeout)
 
 
-def recv(src_rank: int, group_name: str = "default", rank: Optional[int] = None):
+def recv(src_rank: int, group_name: str = "default",
+         rank: Optional[int] = None, timeout: Optional[float] = None):
     group, rank = _group_and_rank(group_name, rank)
-    return group.recv(src_rank, rank)
+    return group.recv(src_rank, rank, timeout=timeout)
 
 
 # -- device tier -------------------------------------------------------------

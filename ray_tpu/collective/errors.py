@@ -1,0 +1,65 @@
+"""Typed collective-plane errors.
+
+The availability contract of the training path (ISSUE r12, mirroring
+what r09 did for serving): NO collective call may hang forever on a
+peer that died, stalled, or partitioned. Every failure mode surfaces as
+a subclass of ``CollectiveError`` within the op's bounded timeout, so a
+supervisor (``ray_tpu.train.elastic.TrainerSupervisor``) can tell *how*
+the gang broke and pick the right recovery:
+
+ * ``CollectiveTimeoutError`` — a peer never arrived at the rendezvous
+   (the survivor-side view of a killed/stalled/partitioned rank);
+ * ``CollectiveAbortedError`` — the supervisor tore the round down
+   deliberately (abort-on-first-fault, so survivors don't burn the full
+   timeout waiting on a rank already known dead);
+ * ``CollectivePartitionError`` — this rank can reach the GCS but not
+   its peers (the ``PARTIAL_PARTITION`` chaos kind; also raised when
+   peer-facing transport errors hit a collective op);
+ * ``StaleGenerationError`` — the gang re-formed at a higher gang epoch
+   while this rank was stalled/partitioned; the zombie's op is refused
+   so it can never inject gradients into the new gang.
+
+``CollectiveTimeoutError`` subclasses ``TimeoutError`` too, so callers
+that predate the typed hierarchy (``except TimeoutError``) keep working.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.core.errors import RayTpuError
+
+# Default bound on every collective op (rendezvous, p2p recv, join). Ops
+# accept timeout= per call; None means this. Chosen large enough for
+# slow control-plane reduces, small enough that a hung gang surfaces as
+# a typed error instead of a wedged pod.
+DEFAULT_TIMEOUT = 120.0
+
+
+class CollectiveError(RayTpuError):
+    """Base of all collective-plane failures. Carries the group name,
+    gang epoch (generation) and rank when the raiser knows them."""
+
+    def __init__(self, msg: str, *, group: str = "", gen: int = -1,
+                 rank: int = -1):
+        self.group = group
+        self.gen = gen
+        self.rank = rank
+        super().__init__(msg)
+
+
+class CollectiveTimeoutError(CollectiveError, TimeoutError):
+    """A collective op's bounded wait expired: some peer never arrived."""
+
+
+class CollectiveAbortedError(CollectiveError):
+    """The round was aborted out from under the waiter (supervisor
+    fault-recovery, or the group was superseded by a newer gang epoch)."""
+
+
+class CollectivePartitionError(CollectiveError):
+    """This rank cannot reach its peers (it may still reach the GCS —
+    the partial-partition failure mode)."""
+
+
+class StaleGenerationError(CollectiveError):
+    """Op issued against a gang generation that has been superseded: the
+    caller is a zombie rank from a previous gang epoch and must exit."""

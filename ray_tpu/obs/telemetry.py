@@ -65,6 +65,7 @@ AGGREGATED_PREFIXES = (
     "ray_tpu_telemetry_",
     "ray_tpu_llm_",
     "ray_tpu_profiler_",
+    "ray_tpu_train_",
 )
 
 _AGGREGATIONS: dict[str, str] = {}
@@ -935,10 +936,32 @@ class TelemetryStore:
             )
         return "\n".join(lines) + "\n"
 
+    def trainer_health(self, agg: Optional[dict] = None) -> dict:
+        """Elastic-trainer rollup for `ray_tpu status` (r12): the
+        fleet's current gang epoch (max over reporters — every recovery
+        bumps it), completed recoveries and ranks lost (sums). All None
+        when no trainer is reporting."""
+        if agg is None:
+            agg = self.cluster_metrics()
+
+        def gauge(name):
+            acc = agg["gauges"].get(_fq(name))
+            return acc["value"] if acc else None
+
+        def counter(name):
+            acc = agg["counters"].get(_fq(name))
+            return acc["total"] if acc else None
+
+        return {
+            "gang_epoch": gauge("train_gang_epoch"),
+            "recoveries_total": counter("train_recoveries_total"),
+            "ranks_lost_total": counter("train_ranks_lost_total"),
+        }
+
     def status_payload(self, thresholds: Optional[SLOThresholds] = None) -> dict:
         """Everything `ray_tpu status` needs beyond the node table — the
         GCS assembles this so the CLI is ONE RPC. The full aggregation
-        pass (every series, under the lock) runs ONCE and feeds all four
+        pass (every series, under the lock) runs ONCE and feeds all five
         views."""
         agg = self.cluster_metrics()
         return {
@@ -947,6 +970,7 @@ class TelemetryStore:
             "pools": self.pool_rollups(agg),
             "utilization": self.utilization(agg),
             "slo": self.slo_report(thresholds, agg),
+            "trainer": self.trainer_health(agg),
         }
 
 
@@ -1009,6 +1033,17 @@ def format_status(report: dict) -> str:
             )
     else:
         lines.append("  (no serve pools reporting)")
+    trainer = report.get("trainer") or {}
+    if any(v is not None for v in trainer.values()):
+        ge = trainer.get("gang_epoch")
+        rec = trainer.get("recoveries_total")
+        lost = trainer.get("ranks_lost_total")
+        lines.append("== trainer ==")
+        lines.append(
+            f"  gang epoch {int(ge) if ge is not None else '-'}"
+            f"  recoveries {int(rec) if rec is not None else 0}"
+            f"  ranks lost {int(lost) if lost is not None else 0}"
+        )
     u = report.get("utilization", {})
     occ = u.get("kv_page_occupancy")
     lines.append("== utilization ==")

@@ -1,6 +1,20 @@
 from ray_tpu.train import session
-from ray_tpu.train.checkpoint import Checkpoint, CheckpointManager, restore_sharded, save_sharded
+from ray_tpu.train.checkpoint import (
+    Checkpoint,
+    CheckpointManager,
+    latest_complete,
+    prune_partial,
+    restore_sharded,
+    save_sharded,
+)
 from ray_tpu.train.config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from ray_tpu.train.elastic import (
+    ElasticConfig,
+    ElasticResult,
+    Recovery,
+    TrainerSupervisor,
+    rng_for,
+)
 from ray_tpu.train.result import Result
 from ray_tpu.train.step import TrainState, init_sharded_params, make_train_step
 from ray_tpu.train.trainer import JaxTrainer
@@ -9,15 +23,22 @@ __all__ = [
     "Checkpoint",
     "CheckpointConfig",
     "CheckpointManager",
+    "ElasticConfig",
+    "ElasticResult",
     "FailureConfig",
     "JaxTrainer",
+    "Recovery",
     "Result",
     "RunConfig",
     "ScalingConfig",
     "TrainState",
+    "TrainerSupervisor",
     "init_sharded_params",
+    "latest_complete",
     "make_train_step",
+    "prune_partial",
     "restore_sharded",
+    "rng_for",
     "save_sharded",
     "session",
 ]

@@ -39,6 +39,7 @@ INSTRUMENTED = [
     ("ray_tpu.llm.engine", "register_metrics"),
     ("ray_tpu.cluster.node_daemon", "register_metrics"),
     ("ray_tpu.serve.controller", "register_metrics"),
+    ("ray_tpu.train.elastic", "register_metrics"),
 ]
 
 _NAME_RE = re.compile(r"^(ray_tpu|llm)_[a-z0-9][a-z0-9_]*$")

@@ -4,8 +4,9 @@
 The control plane's availability story (heartbeat death verdicts, lease
 retries, chaos-driven failover) only works if no thread can block
 FOREVER on a peer that silently died: every blocking socket/RPC receive
-in ``ray_tpu/cluster/`` and ``ray_tpu/native/`` must carry an explicit
-timeout. This walks those files' ASTs and fails on:
+in ``ray_tpu/cluster/``, ``ray_tpu/native/`` and ``ray_tpu/collective/``
+(r12: the trainer's gang plane — a hung allreduce is a hung pod) must
+carry an explicit timeout. This walks those files' ASTs and fails on:
 
  * ``settimeout(None)`` — an explicit opt-in to unbounded blocking;
  * bare receive-family calls (``recv`` / ``recv_into`` / ``recvfrom`` /
@@ -31,6 +32,11 @@ RECV_CALLS = {
     "recv", "recv_into", "recvfrom", "recv_bytes", "readexactly", "accept",
 }
 PARK_CALLS = {"wait", "get", "result"}
+# park-calls whose timeout is a REQUIRED trailing positional (or kwarg):
+# Condition.wait_for(pred[, timeout]) and the GCS kv_wait(key, ns,
+# timeout) — the collective plane's rendezvous primitives. Calling them
+# without the timeout operand is an unbounded park.
+BOUNDED_PARK_MIN_ARGS = {"wait_for": 2, "kv_wait": 3}
 
 # (path suffix, enclosing function name, call attr) -> reason
 ALLOWLIST: dict[tuple[str, str, str], str] = {
@@ -58,7 +64,7 @@ ALLOWLIST: dict[tuple[str, str, str], str] = {
     ),
 }
 
-SCAN_DIRS = ("ray_tpu/cluster", "ray_tpu/native")
+SCAN_DIRS = ("ray_tpu/cluster", "ray_tpu/native", "ray_tpu/collective")
 
 
 def _has_timeout_arg(call: ast.Call) -> bool:
@@ -147,6 +153,18 @@ class _Linter(ast.NodeVisitor):
                 self.violations.append(
                     f"{self.rel}:{node.lineno}: zero-argument .{name}() — "
                     "unbounded park; pass a timeout and loop on a stop flag"
+                )
+        elif (
+            name in BOUNDED_PARK_MIN_ARGS
+            and isinstance(node.func, ast.Attribute)
+            and len(node.args) < BOUNDED_PARK_MIN_ARGS[name]
+            and not _has_timeout_arg(node)
+        ):
+            if not self._allowed(name):
+                self.violations.append(
+                    f"{self.rel}:{node.lineno}: .{name}() without its "
+                    "timeout operand — unbounded park on a peer that may "
+                    "never arrive"
                 )
         self.generic_visit(node)
 

@@ -58,6 +58,31 @@ def test_lint_accepts_bounded_patterns():
     assert mod.lint_source(good, "cluster/synthetic.py") == []
 
 
+def test_lint_covers_collective_park_primitives():
+    """r12: the collective plane's parks are Condition.wait_for and the
+    GCS kv_wait — calling them without their timeout operand is an
+    unbounded park the lint must catch, and ray_tpu/collective/ is in
+    the scanned set."""
+    mod = _load()
+    assert "ray_tpu/collective" in mod.SCAN_DIRS
+    bad = (
+        "def f(cv, kv, key):\n"
+        "    cv.wait_for(lambda: done)\n"
+        "    return kv.kv_wait(key, 'ns')\n"
+    )
+    out = mod.lint_source(bad, "collective/synthetic.py")
+    assert len(out) == 2, out
+    assert any("wait_for" in v for v in out)
+    assert any("kv_wait" in v for v in out)
+    good = (
+        "def f(cv, kv, key):\n"
+        "    cv.wait_for(lambda: done, 5.0)\n"
+        "    kv.kv_wait(key, 'ns', 5.0)\n"
+        "    return kv.kv_wait(key, 'ns', timeout=5.0)\n"
+    )
+    assert mod.lint_source(good, "collective/synthetic.py") == []
+
+
 def test_allowlist_entries_all_have_reasons():
     mod = _load()
     for key, reason in mod.ALLOWLIST.items():

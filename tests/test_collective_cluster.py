@@ -93,6 +93,193 @@ def test_cluster_collective_gang(attached_cluster):
     np.testing.assert_allclose(got, np.arange(4.0))
 
 
+@api.remote
+class GangRank:
+    """A gang member for the partition/eviction tests: joins at an
+    explicit gang epoch and steps with a bounded timeout."""
+
+    def ping(self):
+        return True
+
+    def install_chaos(self, wire):
+        from ray_tpu.chaos import FaultSchedule, install
+
+        install(FaultSchedule.from_wire(wire))
+        return True
+
+    def join(self, world, rank, gen, group):
+        from ray_tpu.collective import init_collective_group
+
+        init_collective_group(world, rank, backend="cluster",
+                              group_name=group, gen=gen)
+        return True
+
+    def step(self, x, group, timeout):
+        from ray_tpu import collective
+
+        return collective.allreduce(np.asarray(x, np.float64),
+                                    group_name=group, timeout=timeout)
+
+
+def _cause(err: BaseException) -> BaseException:
+    """Unwrap the task-error envelope(s) down to the raiser's exception."""
+    seen = set()
+    while id(err) not in seen:
+        seen.add(id(err))
+        nxt = getattr(err, "cause", None)
+        if nxt is None:
+            break
+        err = nxt
+    return err
+
+
+@pytest.mark.chaos
+def test_partial_partition_exactly_once(attached_cluster):
+    """The r12 partition contract: a rank that still sees the GCS but
+    cannot reach its peers (PARTIAL_PARTITION) is evicted from the gang,
+    the step is retried exactly once at the next gang epoch, and the
+    zombie's late ops are discarded by the generation guard — never
+    injected into the re-formed gang."""
+    from ray_tpu.chaos import PARTIAL_PARTITION, FaultSchedule, FaultSpec
+    from ray_tpu.collective import (
+        CollectivePartitionError,
+        CollectiveTimeoutError,
+        StaleGenerationError,
+    )
+
+    # earlier tests' actors still hold their leases on the module
+    # cluster — bring capacity for this test's three ranks
+    attached_cluster.add_node({"num_cpus": 4}, node_id="n_pp")
+    attached_cluster.wait_for_nodes(3)
+
+    a = GangRank.options(num_cpus=1).remote()
+    b = GangRank.options(num_cpus=1).remote()
+    api.get([a.join.remote(2, 0, 0, "pp"), b.join.remote(2, 1, 0, "pp")],
+            timeout=30)
+
+    # cut rank 1 off from its peers (its daemon keeps heartbeating to the
+    # GCS: only the collective plane is partitioned)
+    wire = FaultSchedule(11, [
+        FaultSpec(kind=PARTIAL_PARTITION, site="collective.rendezvous",
+                  p=1.0, max_fires=1),
+    ]).to_wire()
+    api.get(b.install_chaos.remote(wire), timeout=30)
+
+    # step attempt 1: both ranks surface TYPED errors within the bound —
+    # the partitioned rank sees the partition, the survivor's wait
+    # expires; nobody hangs
+    errs = {}
+    refs = [a.step.remote([1.0, 2.0], "pp", 3.0),
+            b.step.remote([10.0, 20.0], "pp", 3.0)]
+    for rank, ref in enumerate(refs):
+        try:
+            api.get(ref, timeout=30)
+        except Exception as e:  # noqa: BLE001 — unwrap below
+            errs[rank] = _cause(e)
+    assert len(errs) == 2  # NO rank got a result: attempt 1 fully failed
+    assert isinstance(errs.get(1), CollectivePartitionError)
+    assert isinstance(errs.get(0), CollectiveTimeoutError)
+
+    # the partitioned rank still reaches the control plane (it would
+    # keep heartbeating in a real pod — that's what makes this failure
+    # mode nasty: GCS liveness alone won't evict it)
+    assert api.get(b.ping.remote(), timeout=10) is True
+
+    # evict rank 1 and re-form the SAME group at gen 1 with a
+    # replacement; retry the step EXACTLY once
+    c = GangRank.options(num_cpus=1).remote()
+    api.get([a.join.remote(2, 0, 1, "pp"), c.join.remote(2, 1, 1, "pp")],
+            timeout=30)
+    r0, r1 = api.get([a.step.remote([1.0, 2.0], "pp", 15.0),
+                      c.step.remote([100.0, 200.0], "pp", 15.0)], timeout=60)
+    # exactly-once is in the VALUES: the sum holds precisely the retry's
+    # two contributions — the evicted rank's [10, 20] from the failed
+    # attempt never leaked in, and no hidden extra retry doubled anything
+    np.testing.assert_allclose(r0, [101.0, 202.0])
+    np.testing.assert_allclose(r1, [101.0, 202.0])
+
+    # the evicted rank comes back from its partition and retries its
+    # step: the generation guard refuses it (StaleGenerationError), so
+    # its late contribution can never reach the new gang
+    with pytest.raises(Exception) as ei:
+        api.get(b.step.remote([666.0, 666.0], "pp", 4.0), timeout=30)
+    assert isinstance(_cause(ei.value), StaleGenerationError)
+
+    # and the re-formed gang's next round is untouched by the zombie
+    r0, r1 = api.get([a.step.remote([1.0, 1.0], "pp", 15.0),
+                      c.step.remote([2.0, 2.0], "pp", 15.0)], timeout=60)
+    np.testing.assert_allclose(r0, [3.0, 3.0])
+    np.testing.assert_allclose(r1, [3.0, 3.0])
+
+
+@pytest.mark.chaos
+def test_driver_abort_unparks_remote_rank(attached_cluster):
+    """The supervisor's abort primitive works across processes: a driver
+    that is NOT a rank publishes the GCS abort marker and a remote rank
+    parked mid-rendezvous wakes with CollectiveAbortedError well before
+    its op timeout (within one poll slice, not 20s)."""
+    import time as _time
+
+    from ray_tpu.collective import abort_collective_group
+
+    from ray_tpu import collective
+
+    d = GangRank.options(num_cpus=1).remote()
+    e = GangRank.options(num_cpus=1).remote()
+    # declarative creation, as a supervisor would: the driver holds the
+    # declaration (not a rank slot), which is what routes its abort to
+    # the GCS marker
+    collective.create_collective_group([d, e], 2, [0, 1], group_name="ab",
+                                       backend="cluster")
+    # only rank 0 steps: it parks waiting for rank 1's contribution
+    ref = d.step.remote([1.0, 1.0], "ab", 20.0)
+    _time.sleep(0.5)
+    t0 = _time.monotonic()
+    abort_collective_group("ab", "supervisor detected a dead rank")
+    with pytest.raises(Exception) as ei:
+        api.get(ref, timeout=30)
+    waited = _time.monotonic() - t0
+    from ray_tpu.collective import CollectiveAbortedError
+
+    assert isinstance(_cause(ei.value), CollectiveAbortedError)
+    assert waited < 10.0  # woke on the marker, not the 20s op timeout
+
+
+@pytest.mark.chaos
+def test_rpc_layer_partition_surfaces_typed_error(attached_cluster):
+    """PARTIAL_PARTITION injected at the rpc/daemon layer: the matched
+    KV-plane methods become unreachable (the collective rendezvous
+    rides them) while unmatched control traffic still flows — and the
+    collective op surfaces the typed CollectivePartitionError, not a
+    hang or a raw transport error."""
+    from ray_tpu import collective
+    from ray_tpu.chaos import (
+        PARTIAL_PARTITION,
+        FaultSchedule,
+        FaultSpec,
+        install,
+        uninstall,
+    )
+    from ray_tpu.collective import CollectivePartitionError
+
+    collective.init_collective_group(1, 0, backend="cluster",
+                                     group_name="rpp")
+    install(FaultSchedule(5, [
+        FaultSpec(kind=PARTIAL_PARTITION, site="rpc.call", p=1.0,
+                  max_fires=2, match={"method": "kv_*"}),
+    ]))
+    try:
+        with pytest.raises(CollectivePartitionError):
+            collective.allreduce(np.ones(2), group_name="rpp", rank=0,
+                                 timeout=5.0)
+        # unmatched control-plane traffic was never cut: the client can
+        # still reach the GCS (list nodes)
+        assert len(attached_cluster.client().nodes()) >= 2
+    finally:
+        uninstall()
+        collective.destroy_collective_group("rpp")
+
+
 def test_driver_participates_in_gang(attached_cluster):
     """The driver itself can be a rank (reference: the trainer driver
     joining the gloo group)."""
