@@ -1,0 +1,430 @@
+#!/usr/bin/env python
+"""Control-plane blackout availability capture: seeded KILL_GCS +
+scheduled restart mid-run -> benchmarks/GCS_outage_r13.json.
+
+The r13 acceptance gate, end to end, against a REAL LocalCluster (GCS
+process + node daemon + worker processes):
+
+ * serve-shaped traffic (named replica actors driven by a driver-side
+   request loop) runs ACROSS the blackout window — per-request paths
+   ride cached worker addresses and the node-local object store, so the
+   outage may cost latency on directory lookups but NEVER a completion:
+   gate completion_rate == 1.0;
+ * a cluster-backend training gang (allreduce over the GCS KV — the
+   plane the blackout cuts) is supervised with a control-plane probe:
+   the dark window is classified as a BLACKOUT (wait -> re-form ->
+   restore -> resume), never as rank death: gate trainer recoveries ==
+   0 with >= 1 blackout ridden out, and the loss curve bitwise equal to
+   the uninterrupted baseline;
+ * after the restart, the GCS reconciles against node re-reports: gate
+   zero duplicate or lost actors (every created actor ALIVE exactly
+   once, replica-side request counts equal to client-side completions)
+   and write-ahead-acked registrations present;
+ * telemetry rides monotonic totals: after the staleness spike the
+   GCS-aggregated bench counter converges EXACTLY to the local total.
+
+Run: JAX_PLATFORMS=cpu python benchmarks/gcs_outage_bench.py [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+def req_counter_name(run_tag: str) -> str:
+    # per-run metric name: the registry is process-global, so a shared
+    # name would carry the baseline run's total into the chaos run and
+    # break the exact-convergence comparison
+    return f"ray_tpu_bench_outage_requests_{run_tag}_total"
+
+
+# -- the serve plane (replica actors + driver request loop) -------------------
+
+
+class BenchReplica:
+    def __init__(self, idx):
+        self.idx = idx
+        self.count = 0
+
+    def serve_one(self, x):
+        self.count += 1
+        return (self.idx, self.count)
+
+    def stats(self):
+        return {"idx": self.idx, "count": self.count}
+
+
+# -- the training problem (same shape as train_chaos_bench) ------------------
+
+W_TRUE = np.asarray([1.0, -2.0, 3.0, 0.5])
+
+
+def init_fn(seed):
+    return {"w": np.zeros(4, np.float64)}
+
+
+def grad_fn(state, batch):
+    x, y = batch
+    err = x @ state["w"] - y
+    return float(np.mean(err ** 2)), {"w": 2 * x.T @ err / len(y)}
+
+
+def apply_fn(state, grads):
+    return {"w": state["w"] - 0.1 * grads["w"]}
+
+
+def batch_fn(seed, step, world, rank):
+    import time as _t
+
+    from ray_tpu.train.elastic import rng_for
+
+    _t.sleep(0.03)  # pace the gang so the horizon spans the blackout
+    rng = rng_for(seed, step, rank)
+    x = rng.normal(size=(8, 4))
+    return x, x @ W_TRUE
+
+
+def make_probe(gcs_addr):
+    def probe() -> bool:
+        from ray_tpu.cluster.rpc import RpcClient
+
+        try:
+            c = RpcClient(gcs_addr[0], gcs_addr[1], timeout=2.0).connect()
+            try:
+                c.call("list_nodes", None, timeout=2.0)
+            finally:
+                c.close()
+            return True
+        except Exception:  # noqa: BLE001 — dark is dark
+            return False
+
+    return probe
+
+
+def make_epoch(gcs_addr):
+    """Restart detector for the supervisor: the GCS's own persisted
+    restart counter. A changed value across a round = the round spanned
+    a blackout, even if the plane is back by classification time."""
+    def epoch():
+        from ray_tpu.cluster.rpc import RpcClient
+
+        c = RpcClient(gcs_addr[0], gcs_addr[1], timeout=2.0).connect()
+        try:
+            return c.call("gcs_ft", {}, timeout=2.0)["gcs_restarts_total"]
+        finally:
+            c.close()
+
+    return epoch
+
+
+def _run_once(steps: int, world: int, schedule=None, run_tag: str = "run",
+              traffic_s: float = 12.0) -> dict:
+    from ray_tpu import chaos
+    from ray_tpu.chaos.runner import ChaosRunner
+    from ray_tpu.cluster import LocalCluster
+    from ray_tpu.core import api
+    from ray_tpu.obs.telemetry import TelemetryReporter, cluster_counter
+    from ray_tpu.train.elastic import ElasticConfig, TrainerSupervisor
+
+    out: dict = {}
+    with tempfile.TemporaryDirectory() as tmp, \
+            tempfile.TemporaryDirectory() as ckpt_root:
+        persist = os.path.join(tmp, "gcs.snap")
+        with LocalCluster(node_death_timeout_s=2.0,
+                          gcs_persist_path=persist) as c:
+            c.start()
+            c.add_node({"num_cpus": 8}, node_id="head")
+            c.wait_for_nodes(1)
+            client = c.client()
+            api.init(address=c.address, ignore_reinit_error=True)
+            try:
+                replicas = [
+                    client.create_actor(
+                        BenchReplica, (i,), name=f"replica-{i}",
+                        max_restarts=1,
+                    )
+                    for i in range(2)
+                ]
+                counter_name = req_counter_name(run_tag)
+                req_counter = cluster_counter(
+                    counter_name,
+                    description="outage bench: completed serve requests",
+                )
+                reporter = TelemetryReporter(
+                    gcs_addr=c.gcs_addr, reporter_id="bench-driver",
+                    kind="bench", interval_s=0.25, timeout_s=2.0,
+                    series_filter=lambda name, tags: name.startswith(
+                        "ray_tpu_bench_"
+                    ),
+                ).start()
+
+                sent = [0]
+                completed = [0]
+                failures: list = []
+                stop_traffic = threading.Event()
+
+                def traffic():
+                    i = 0
+                    # hard cap well past any plausible run; the stop
+                    # event (set when the trainer finishes) is the real
+                    # terminator, so traffic is GUARANTEED to span the
+                    # whole blackout window
+                    deadline = time.monotonic() + traffic_s + 240
+                    while time.monotonic() < deadline \
+                            and not stop_traffic.is_set():
+                        h = replicas[i % len(replicas)]
+                        i += 1
+                        sent[0] += 1
+                        try:
+                            client.get(h.serve_one.remote(i), timeout=60)
+                            completed[0] += 1
+                            req_counter.inc()
+                        except Exception as e:  # noqa: BLE001
+                            failures.append(repr(e))
+                        time.sleep(0.01)
+
+                sup = TrainerSupervisor(
+                    init_fn=init_fn, grad_fn=grad_fn, apply_fn=apply_fn,
+                    batch_fn=batch_fn, total_steps=steps,
+                    checkpoint_root=ckpt_root,
+                    config=ElasticConfig(
+                        world_size=world, backend="cluster",
+                        group_name="outage_gang", seed=7,
+                        step_timeout_s=2.0, checkpoint_every=4,
+                        sharded_checkpoints=False,
+                        control_plane_probe=make_probe(c.gcs_addr),
+                        control_plane_epoch=make_epoch(c.gcs_addr),
+                        blackout_wait_s=30.0,
+                    ),
+                )
+                train_res: list = [None]
+
+                def train():
+                    train_res[0] = sup.fit()
+
+                t0 = time.monotonic()
+                tt = threading.Thread(target=traffic, daemon=True)
+                tr = threading.Thread(target=train, daemon=True)
+                tt.start()
+                tr.start()
+
+                # arm the blackout only once the gang is formed and
+                # traffic is warm — worker spawns take seconds, and a
+                # kill that lands before the gang joins tests nothing
+                runner = None
+                if schedule is not None:
+                    deadline = time.monotonic() + 60
+                    while time.monotonic() < deadline:
+                        try:
+                            infos = client.gcs.call(
+                                "list_actors", None, timeout=5
+                            )
+                            alive = [
+                                a for a in infos if a["state"] == "ALIVE"
+                            ]
+                            if len(alive) >= 2 + world \
+                                    and completed[0] >= 20:
+                                break
+                        except Exception:  # noqa: BLE001
+                            pass
+                        time.sleep(0.1)
+                    chaos.install(schedule)
+                    runner = ChaosRunner(schedule, cluster=c).start()
+
+                tr.join(timeout=300)
+                stop_traffic.set()
+                tt.join(timeout=120)
+                wall_s = time.monotonic() - t0
+                if runner is not None:
+                    runner.join(timeout=60)
+
+                # -- post-blackout reconcile + convergence ---------------
+                ft = {}
+                deadline = time.monotonic() + 20
+                while time.monotonic() < deadline:
+                    try:
+                        ft = client.gcs.call("gcs_ft", {}, timeout=5)
+                        if schedule is None or (
+                            ft.get("reconcile_nodes_reregistered", 0) >= 1
+                            and ft.get("actors_pending_confirm", 0) == 0
+                        ):
+                            break
+                    except Exception:  # noqa: BLE001
+                        pass
+                    time.sleep(0.25)
+
+                local_total = float(completed[0])
+                converged = False
+                remote_total = None
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    try:
+                        agg = client.cluster_metrics()
+                        acc = agg.get("counters", {}).get(counter_name)
+                        remote_total = (
+                            float(acc["total"]) if acc is not None else None
+                        )
+                        if remote_total == local_total:
+                            converged = True
+                            break
+                    except Exception:  # noqa: BLE001
+                        pass
+                    time.sleep(0.25)
+
+                infos = client.gcs.call("list_actors", None, timeout=10)
+                alive = [a for a in infos if a["state"] == "ALIVE"]
+                ids = [a["actor_id"] for a in infos]
+                replica_counts = [
+                    client.get(h.stats.remote(), timeout=30)["count"]
+                    for h in replicas
+                ]
+                res = train_res[0]
+                reporter.stop(final_push=True)
+
+                out = {
+                    "wall_s": round(wall_s, 3),
+                    "serve": {
+                        "sent": sent[0],
+                        "completed": completed[0],
+                        "completion_rate": (
+                            completed[0] / sent[0] if sent[0] else 0.0
+                        ),
+                        "failures": failures[:10],
+                        "replica_counts": replica_counts,
+                        "replica_total": sum(replica_counts),
+                    },
+                    "actors": {
+                        "created": 2 + (res.final_world_size if res else 0),
+                        "alive": len(alive),
+                        "duplicate_ids": len(ids) - len(set(ids)),
+                        "replicas_alive": sum(
+                            1 for a in alive
+                            if (a.get("name") or "").startswith("replica-")
+                        ),
+                    },
+                    "trainer": None if res is None else {
+                        "completed": res.completed,
+                        "steps": len(res.losses),
+                        "losses": res.losses,
+                        "recoveries": len(res.recoveries),
+                        "blackouts": len(res.blackouts),
+                        "blackout_log": [
+                            dataclasses.asdict(r) for r in res.blackouts
+                        ],
+                        "final_gen": res.final_gen,
+                    },
+                    "telemetry": {
+                        "local_total": local_total,
+                        "remote_total": remote_total,
+                        "convergent": converged,
+                    },
+                    "gcs_ft": ft,
+                }
+            finally:
+                api.shutdown()
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=120)
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=13)
+    # measured from runner arming (which waits for the gang to form and
+    # traffic to warm), so a small offset reliably lands mid-training
+    ap.add_argument("--outage-at-s", type=float, default=1.5)
+    ap.add_argument("--restart-after-s", type=float, default=3.0)
+    ap.add_argument("--traffic-s", type=float, default=12.0)
+    ap.add_argument(
+        "--out",
+        default=os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "GCS_outage_r13.json"),
+    )
+    args = ap.parse_args()
+
+    from ray_tpu.chaos import KILL_GCS, FaultSchedule, FaultSpec
+
+    base = _run_once(args.steps, args.world, schedule=None,
+                     run_tag="baseline", traffic_s=args.traffic_s)
+    if not base["trainer"]["completed"] or \
+            base["serve"]["completion_rate"] != 1.0:
+        print("baseline failed", file=sys.stderr)
+        print(json.dumps(base, indent=2, default=str), file=sys.stderr)
+        return 1
+
+    schedule = FaultSchedule(args.seed, [
+        FaultSpec(kind=KILL_GCS, at_s=args.outage_at_s,
+                  restart_after_s=args.restart_after_s),
+    ])
+    chaos_run = _run_once(args.steps, args.world, schedule=schedule,
+                          run_tag="chaos", traffic_s=args.traffic_s)
+    fired = [{"kind": f.kind, "site": f.site, "seq": f.seq}
+             for f in schedule.log]
+
+    base_losses = base["trainer"]["losses"]
+    chaos_losses = chaos_run["trainer"]["losses"]
+    identical = (
+        len(base_losses) == len(chaos_losses)
+        and all(a == b for a, b in zip(base_losses, chaos_losses))
+    )
+    for run in (base, chaos_run):
+        run["trainer"].pop("losses", None)
+
+    out = {
+        "bench": "gcs_outage",
+        "rev": "r13",
+        "platform": "cpu",
+        "config": {
+            "steps": args.steps,
+            "world_size": args.world,
+            "seed": args.seed,
+            "outage_at_s": args.outage_at_s,
+            "restart_after_s": args.restart_after_s,
+            "traffic_s": args.traffic_s,
+        },
+        "baseline": base,
+        "chaos": chaos_run,
+        "loss_identical": identical,
+        "faults_fired": fired,
+    }
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2, default=str)
+        f.write("\n")
+    print(json.dumps({
+        "serve_completion": chaos_run["serve"]["completion_rate"],
+        "trainer_recoveries": chaos_run["trainer"]["recoveries"],
+        "trainer_blackouts": chaos_run["trainer"]["blackouts"],
+        "loss_identical": identical,
+        "telemetry_convergent": chaos_run["telemetry"]["convergent"],
+        "gcs_ft": chaos_run["gcs_ft"],
+    }, indent=2, default=str))
+    print(f"\nwrote {args.out}")
+
+    failed = (
+        chaos_run["serve"]["completion_rate"] != 1.0
+        or not chaos_run["trainer"]["completed"]
+        or chaos_run["trainer"]["recoveries"] != 0
+        or chaos_run["trainer"]["blackouts"] < 1
+        or not identical
+        or chaos_run["actors"]["duplicate_ids"] != 0
+        or chaos_run["actors"]["replicas_alive"] != 2
+        or chaos_run["serve"]["replica_total"]
+        != chaos_run["serve"]["completed"]
+        or not chaos_run["telemetry"]["convergent"]
+        or "kill_gcs" not in {e["kind"] for e in fired}
+    )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

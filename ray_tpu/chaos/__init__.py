@@ -44,8 +44,10 @@ from ray_tpu.chaos.harness import (
 from ray_tpu.chaos.schedule import (
     CORRUPT_FRAME,
     DELAY_RPC,
+    DROP_CHANNEL,
     DROP_COLLECTIVE,
     DROP_RPC,
+    KILL_GCS,
     KILL_RANK,
     KILL_REPLICA,
     KILL_WORKER,
@@ -53,7 +55,9 @@ from ray_tpu.chaos.schedule import (
     PARTIAL_PARTITION,
     PREEMPT_ENGINE,
     PREEMPT_NODE,
+    STALL_CHANNEL,
     STALL_COLLECTIVE,
+    STALL_GCS,
     STALL_HEARTBEAT,
     Fault,
     FaultSchedule,
@@ -78,9 +82,11 @@ def __getattr__(name):
 
 
 __all__ = [
-    "CORRUPT_FRAME", "DELAY_RPC", "DROP_COLLECTIVE", "DROP_RPC", "KILL_RANK",
+    "CORRUPT_FRAME", "DELAY_RPC", "DROP_CHANNEL", "DROP_COLLECTIVE",
+    "DROP_RPC", "KILL_GCS", "KILL_RANK",
     "KILL_REPLICA", "KILL_WORKER", "KINDS", "PARTIAL_PARTITION",
-    "PREEMPT_ENGINE", "PREEMPT_NODE", "STALL_COLLECTIVE", "STALL_HEARTBEAT",
+    "PREEMPT_ENGINE", "PREEMPT_NODE", "STALL_CHANNEL", "STALL_COLLECTIVE",
+    "STALL_GCS", "STALL_HEARTBEAT",
     "Fault", "FaultSchedule", "FaultSpec", "FaultInjected", "RankKilled",
     "ReplicaCrashed",
     "EnginePreempted", "ChaosRunner", "ENV_VAR", "active", "corrupt_frame",

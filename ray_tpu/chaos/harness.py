@@ -30,16 +30,20 @@ from ray_tpu.chaos.schedule import (  # noqa: F401 — re-exported for hook site
     CORRUPT_FRAME,
     CORRUPT_KV_TRANSFER,
     DELAY_RPC,
+    DROP_CHANNEL,
     DROP_COLLECTIVE,
     DROP_KV_TRANSFER,
     DROP_RPC,
+    KILL_GCS,
     KILL_RANK,
     KILL_REPLICA,
     KILL_WORKER,
     PARTIAL_PARTITION,
     PREEMPT_ENGINE,
     PREEMPT_NODE,
+    STALL_CHANNEL,
     STALL_COLLECTIVE,
+    STALL_GCS,
     STALL_HEARTBEAT,
     Fault,
     FaultSchedule,

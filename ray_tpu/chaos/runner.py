@@ -25,6 +25,7 @@ import time
 from typing import Optional
 
 from ray_tpu.chaos.schedule import (
+    KILL_GCS,
     KILL_REPLICA,
     KILL_WORKER,
     PREEMPT_NODE,
@@ -50,6 +51,7 @@ class ChaosRunner:
         self.executed: list[Fault] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._restart_threads: list[threading.Thread] = []
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -64,10 +66,14 @@ class ChaosRunner:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        for t in self._restart_threads:
+            t.join(timeout=5)
 
     def join(self, timeout: Optional[float] = None) -> None:
         if self._thread is not None:
             self._thread.join(timeout)
+        for t in self._restart_threads:
+            t.join(timeout)
 
     # -- execution ------------------------------------------------------------
 
@@ -92,6 +98,8 @@ class ChaosRunner:
             attrs = self._kill_worker(idx, spec)
         elif spec.kind == KILL_REPLICA:
             attrs = self._kill_replica(idx, spec)
+        elif spec.kind == KILL_GCS:
+            attrs = self._kill_gcs(idx, spec)
         else:
             return
         with self.schedule._lock:
@@ -102,6 +110,11 @@ class ChaosRunner:
             self.schedule._seq += 1
             self.schedule.log.append(fault)
         self.executed.append(fault)
+        # mirror into the obs flight recorder like in-process hook fires,
+        # so orchestrated kills land in Chrome-trace exports too
+        from ray_tpu.chaos import harness as _harness
+
+        _harness._record_obs_event("runner", spec.kind, attrs)
         logger.warning("chaos: executed %s %s", spec.kind, attrs)
 
     def _preempt_node(self, idx, spec) -> dict:
@@ -125,6 +138,46 @@ class ChaosRunner:
             "chaos_kill_worker", {}, timeout=10
         )
         return {"node_id": node_id, **(r or {})}
+
+    def _kill_gcs(self, idx, spec) -> dict:
+        """SIGKILL the control plane; optionally schedule its restart
+        ``restart_after_s`` later — the blackout window the data plane
+        must serve through. The restart runs on its own thread so a long
+        window never delays other orchestrated faults; the ``gcs.outage``
+        obs span covers kill -> restart so Chrome-trace exports show the
+        blackout instead of an unexplained metrics gap."""
+        if self.cluster is None:
+            raise RuntimeError("KILL_GCS needs a cluster")
+        t_kill = time.time()
+        self.cluster.kill_gcs()
+        attrs = {"restart_after_s": spec.restart_after_s}
+        if spec.restart_after_s > 0:
+            def _restart():
+                if self._stop.wait(spec.restart_after_s):
+                    return
+                try:
+                    self.cluster.restart_gcs()
+                except Exception:  # noqa: BLE001 — surface, don't die
+                    logger.exception("chaos: scheduled GCS restart failed")
+                    return
+                try:
+                    from ray_tpu.obs import recorder as _recorder
+
+                    _recorder.get_recorder().record(
+                        "gcs.outage", t_kill, time.time(),
+                        attrs={"restart_after_s": str(spec.restart_after_s)},
+                        status="error",
+                    )
+                except Exception:  # noqa: BLE001
+                    pass
+                logger.warning("chaos: restarted GCS after blackout")
+
+            t = threading.Thread(
+                target=_restart, name="chaos-gcs-restart", daemon=True
+            )
+            t.start()
+            self._restart_threads.append(t)
+        return attrs
 
     def _kill_replica(self, idx, spec) -> dict:
         if self.controller is None:

@@ -62,18 +62,32 @@ KILL_RANK = "kill_rank"                  # a gang rank dies mid-collective
 STALL_COLLECTIVE = "stall_collective"    # a rank arrives late (delay_s)
 DROP_COLLECTIVE = "drop_collective"      # a contribution lost in flight
 PARTIAL_PARTITION = "partial_partition"  # heartbeats reach GCS, peers don't
+# control plane (r13): the one process chaos had never touched. KILL_GCS
+# SIGKILLs the GCS on the runner timeline (with a scheduled restart via
+# restart_after_s — the blackout window); STALL_GCS is an outage WITHOUT
+# a process death: every GCS-bound rpc.call in the seeded window fails
+# with DROP_RPC-style transport loss while the process stays up.
+KILL_GCS = "kill_gcs"                    # SIGKILL the control plane
+STALL_GCS = "stall_gcs"                  # GCS-bound RPCs get transport loss
+# compiled-DAG channel plane (dag/channels.py send/recv + the
+# dag/compiled.py exec loops): a value lost in flight (receiver's
+# bounded read raises ChannelTimeoutError) vs a late writer (delay_s) —
+# the collective fault kinds' semantics on the channel substrate.
+DROP_CHANNEL = "drop_channel"            # written value lost in flight
+STALL_CHANNEL = "stall_channel"          # channel op delayed by delay_s
 
 KINDS = frozenset({
     KILL_WORKER, KILL_REPLICA, DROP_RPC, DELAY_RPC, STALL_HEARTBEAT,
     PREEMPT_NODE, CORRUPT_FRAME, PREEMPT_ENGINE,
     DROP_KV_TRANSFER, CORRUPT_KV_TRANSFER,
     KILL_RANK, STALL_COLLECTIVE, DROP_COLLECTIVE, PARTIAL_PARTITION,
+    KILL_GCS, STALL_GCS, DROP_CHANNEL, STALL_CHANNEL,
 })
 
 # kinds the in-process hook ignores (a runner executes them instead)
-ORCHESTRATED = frozenset({PREEMPT_NODE})
+ORCHESTRATED = frozenset({PREEMPT_NODE, KILL_GCS})
 # kinds ChaosRunner knows how to execute on an at_s timeline
-RUNNER_KINDS = frozenset({PREEMPT_NODE, KILL_WORKER, KILL_REPLICA})
+RUNNER_KINDS = frozenset({PREEMPT_NODE, KILL_WORKER, KILL_REPLICA, KILL_GCS})
 
 
 @dataclasses.dataclass
@@ -96,6 +110,10 @@ class FaultSpec:
     delay_s: float = 0.05        # DELAY_RPC sleep
     at_s: float = 0.0            # orchestrated: offset from runner start
     target: Optional[str] = None  # orchestrated: node_id / "app/deployment"
+    # KILL_GCS only: restart the control plane this many seconds after
+    # the kill (0 = no scheduled restart; the test restarts it itself).
+    # The window [at_s, at_s + restart_after_s] IS the blackout.
+    restart_after_s: float = 0.0
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -104,6 +122,13 @@ class FaultSpec:
             raise ValueError(f"p must be in [0, 1], got {self.p}")
         if self.every_n < 1:
             raise ValueError("every_n must be >= 1")
+        if self.restart_after_s < 0.0:
+            raise ValueError("restart_after_s must be >= 0")
+        if self.restart_after_s > 0.0 and self.kind != KILL_GCS:
+            raise ValueError(
+                f"restart_after_s is only valid for {KILL_GCS!r}, "
+                f"not {self.kind!r}"
+            )
         if self.at_s > 0.0 and self.kind not in RUNNER_KINDS:
             # at_s routes the spec to ChaosRunner, which only executes
             # RUNNER_KINDS — anything else would be a silent no-op that

@@ -1119,7 +1119,13 @@ class ClusterClient:
         w = self.pool.get(worker_addr)
         r = w.call(
             "create_actor",
-            {"actor_id": actor_id, "creation_spec": creation_spec},
+            {"actor_id": actor_id, "creation_spec": creation_spec,
+             # registration metadata rides to the worker too: the node's
+             # reconcile report can then resurrect this actor (name and
+             # all) on a GCS whose snapshot predates it
+             "meta": {"name": name, "namespace": namespace,
+                      "max_restarts": max_restarts,
+                      "lease_resources": dict(spec["resources"])}},
             timeout=300,
         )
         if not r.get("ok"):

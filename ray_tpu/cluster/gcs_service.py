@@ -43,6 +43,11 @@ class NodeEntry:
     draining: bool = False  # graceful drain: alive but not schedulable
     last_hb: float = field(default_factory=time.monotonic)
     pending: list = field(default_factory=list)  # queued lease specs
+    # set on snapshot restore: the entry is a (possibly stale) claim, not
+    # ground truth — the node's next heartbeat is answered with
+    # `reregister` so it re-reports its live workers/actors/leases/PG
+    # bundles and the reconcile path converges the table to reality
+    pending_reconcile: bool = False
 
 
 @dataclass
@@ -94,6 +99,34 @@ class GcsService:
         self._persist_path = persist_path
         self._dirty = 0
         self._persisted = 0
+        self._persist_io = threading.Lock()  # serializes snapshot installs
+        # control-plane FT observability (r13): restart + reconcile-delta
+        # counters for `ray_tpu status` — a blackout must show up as a
+        # counted restart and explicit convergence deltas, not as
+        # phantom-zero metrics. restarts_total rides the snapshot so it
+        # is cumulative across the process's own restarts.
+        self.ft = {
+            "gcs_restarts_total": 0,
+            "reconcile_nodes_reregistered": 0,
+            "reconcile_actors_confirmed": 0,
+            "reconcile_actors_resurrected": 0,
+            "reconcile_actors_lost": 0,
+            "reconcile_bundles_adopted": 0,
+            "reconcile_bundles_orphaned": 0,
+            "reconcile_leases_reported": 0,
+            "reconcile_actors_stale_copies": 0,
+        }
+        # snapshot-ALIVE actors awaiting confirmation by their node's
+        # re-registration report; grace-expired leftovers are buried by
+        # reconcile_sweep instead of lingering as phantoms
+        self._needs_confirm: set[bytes] = set()
+        self._orphan_bundles: list[tuple] = []  # (daemon_addr, pg_id, idx)
+        # stale actor copies a reconciling node reported after the actor
+        # was restarted elsewhere: (daemon_addr, actor_id, lease_id) to
+        # destroy in reconcile_sweep (killing the lease kills the
+        # dedicated worker and the copy with it)
+        self._stale_copies: list[tuple] = []
+        self._restore_t: Optional[float] = None
         # cluster-wide metrics plane (ray_tpu.obs.telemetry): bounded
         # time-series per (reporter, metric, labels), fed by heartbeat
         # piggybacks and dedicated telemetry_push RPCs. Deliberately NOT
@@ -112,6 +145,7 @@ class GcsService:
     def _load_snapshot(self) -> None:
         import pickle
 
+        t0 = time.time()
         try:
             with open(self._persist_path, "rb") as f:
                 snap = pickle.load(f)
@@ -121,35 +155,125 @@ class GcsService:
         self._named = snap.get("named", {})
         self._pgs = snap.get("pgs", {})
         self._kv = snap.get("kv", {})
+        self.ft["gcs_restarts_total"] = int(snap.get("restarts_total", 0)) + 1
+        # restored nodes are CLAIMS until they re-register: keep them
+        # visible (their daemons are usually still alive and serving) but
+        # answer their first heartbeat with `reregister` so the node
+        # re-reports ground truth; the health sweep buries ones that
+        # never come back within the death timeout
+        for node_id, rec in snap.get("nodes", {}).items():
+            self._nodes[node_id] = NodeEntry(
+                node_id=node_id,
+                addr=tuple(rec["addr"]),
+                resources=dict(rec["resources"]),
+                available=dict(rec["resources"]),
+                labels=dict(rec.get("labels", {})),
+                pending_reconcile=True,
+            )
+        self._needs_confirm = {
+            a.actor_id for a in self._actors.values() if a.state == "ALIVE"
+        }
+        self._restore_t = time.monotonic()
         logger.info(
-            "GCS restored from snapshot: %d actors, %d pgs, %d kv namespaces",
-            len(self._actors), len(self._pgs), len(self._kv),
+            "GCS restored from snapshot (restart #%d): %d actors, %d pgs, "
+            "%d kv namespaces, %d nodes pending reconcile",
+            self.ft["gcs_restarts_total"], len(self._actors), len(self._pgs),
+            len(self._kv), len(self._nodes),
         )
+        try:
+            from ray_tpu.obs.recorder import get_recorder
+
+            get_recorder().record(
+                "gcs.restore", t0, time.time(),
+                attrs={
+                    "restart": str(self.ft["gcs_restarts_total"]),
+                    "actors": str(len(self._actors)),
+                    "pgs": str(len(self._pgs)),
+                    "nodes": str(len(self._nodes)),
+                },
+            )
+        except Exception:  # noqa: BLE001 — tracing must never break restore
+            pass
+
+    def _snapshot_state_locked(self) -> tuple[int, dict]:
+        """(generation, shallow-copied durable tables). Caller holds the
+        table lock — only the O(entries) dict copies happen under it;
+        the pickle of the (potentially large) values runs outside, so a
+        critical persist can't stretch the lock past what heartbeat
+        handlers tolerate. Entries mutated after the copy may pickle
+        torn across fields; the reconcile path converges those."""
+        return self._dirty, {
+            "actors": dict(self._actors),
+            "named": dict(self._named),
+            "pgs": {k: dict(v) for k, v in self._pgs.items()},
+            # the collective rendezvous namespace is EPHEMERAL by design:
+            # round contributions are multi-MB gradient payloads (every
+            # write-ahead critical persist would ship them), and they are
+            # gen-scoped in-flight state — after a restart the round is
+            # gone, ranks surface typed CollectiveErrors within their
+            # bounded waits, and the supervisor rides it out as a
+            # blackout (re-form at gen+1, restore, resume)
+            "kv": {ns: dict(kv) for ns, kv in self._kv.items()
+                   if ns != "__collective__"},
+            "nodes": {
+                e.node_id: {
+                    "addr": tuple(e.addr),
+                    "resources": dict(e.resources),
+                    "labels": dict(e.labels),
+                }
+                for e in self._nodes.values() if e.alive
+            },
+            "restarts_total": self.ft["gcs_restarts_total"],
+        }
+
+    def _write_snapshot(self, gen: int, doc: dict) -> None:
+        """Crash-atomic snapshot install (.tmp + os.replace — the r12
+        checkpoint discipline): a crash mid-write leaves the previous
+        complete snapshot in place, never a torn file. Serialized by the
+        persist I/O lock: handlers run on a thread pool, and two
+        concurrent critical persists sharing one .tmp path could
+        interleave writes or install an OLDER generation over a newer
+        acked one — exactly the dirty window write-ahead exists to
+        close. A generation at/behind what's already on disk is skipped
+        (same-gen builds see identical tables)."""
+        import pickle
+
+        snap = pickle.dumps(doc)
+        with self._persist_io:
+            if gen <= self._persisted:
+                return
+            tmp = self._persist_path + ".tmp"
+            try:
+                with open(tmp, "wb") as f:
+                    f.write(snap)
+                os.replace(tmp, self._persist_path)
+                self._persisted = gen
+            except OSError:
+                logger.exception("GCS snapshot write failed")
 
     def persist_if_dirty(self) -> None:
-        """Debounced snapshot write (driven by the server's sweeper)."""
+        """Debounced snapshot write (driven by the server's sweeper) —
+        the non-critical tables' path. Critical mutations (actor/node
+        registration, PG creation) go through persist_critical instead
+        and never wait for this sweep."""
         if not self._persist_path:
             return
         with self._lock:
             if self._dirty == self._persisted:
                 return
-            gen = self._dirty
-            import pickle
+            gen, doc = self._snapshot_state_locked()
+        self._write_snapshot(gen, doc)
 
-            snap = pickle.dumps({
-                "actors": dict(self._actors),
-                "named": dict(self._named),
-                "pgs": {k: dict(v) for k, v in self._pgs.items()},
-                "kv": {ns: dict(kv) for ns, kv in self._kv.items()},
-            })
-        tmp = self._persist_path + ".tmp"
-        try:
-            with open(tmp, "wb") as f:
-                f.write(snap)
-            os.replace(tmp, self._persist_path)
-            self._persisted = gen
-        except OSError:
-            logger.exception("GCS snapshot write failed")
+    def persist_critical(self) -> None:
+        """Write-ahead ack: persist NOW, before the caller's RPC is
+        acknowledged. Closes the dirty window where an acked
+        registration existed only in memory until the next debounced
+        sweep — a crash in that window silently lost the actor."""
+        if not self._persist_path:
+            return
+        with self._lock:
+            gen, doc = self._snapshot_state_locked()
+        self._write_snapshot(gen, doc)
 
     # -- events ---------------------------------------------------------------
 
@@ -199,9 +323,138 @@ class GcsService:
             # relearns locations via raylet resubscription)
             for oid in payload.get("objects", ()):
                 self._objects.setdefault(oid, set()).add(e.node_id)
+            if "actors" in payload or "bundles" in payload:
+                # report-carrying registration = a RE-registration (fresh
+                # nodes never send reports) — counted even when the
+                # snapshot didn't know the node (lost/stale snapshot)
+                self.ft["reconcile_nodes_reregistered"] += 1
+            # reconcile-on-restart: converge the (possibly stale) snapshot
+            # to the node's reported ground truth — live actors are
+            # confirmed or resurrected, never killed; reported bundle
+            # reservations are adopted, never double-reserved; a
+            # snapshot-ALIVE actor this node did NOT report is gone and
+            # takes the normal node-death path (restart budget or bury)
+            if "actors" in payload or "bundles" in payload:
+                self._reconcile_node_report_locked(e, payload)
+            self._mark_dirty()
             self._emit("node_added", {"node_id": e.node_id, "addr": e.addr})
             logger.info("node %s registered at %s", e.node_id, e.addr)
+        # node registration is a critical mutation: persist BEFORE the ack
+        # (write-ahead) so a crash right after cannot forget the node
+        self.persist_critical()
         return {"ok": True}
+
+    def _reconcile_node_report_locked(self, e: NodeEntry, payload) -> None:
+        """Apply a re-registering node's {actors, leases, bundles} report
+        (caller holds the lock) — the r09 pg_reserve_sweep generalized
+        into full reconciliation."""
+        reported: set[bytes] = set()
+        for rec in payload.get("actors", ()):
+            aid = rec["actor_id"]
+            reported.add(aid)
+            a = self._actors.get(aid)
+            if a is not None and a.state == "DEAD":
+                # tombstone wins: the kill was acked; a worker whose
+                # destroy raced the outage must not resurrect it
+                continue
+            if a is None:
+                # created after the last snapshot (or the snapshot was
+                # lost): the data plane is ground truth — resurrect
+                a = ActorEntry(
+                    actor_id=aid,
+                    name=rec.get("name"),
+                    namespace=rec.get("namespace", "default"),
+                    node_id=e.node_id,
+                    worker_addr=tuple(rec["worker_addr"])
+                    if rec.get("worker_addr") else None,
+                    state="ALIVE",
+                    max_restarts=int(rec.get("max_restarts", 0)),
+                    creation_spec=rec.get("creation_spec"),
+                    lease_resources=dict(
+                        rec.get("lease_resources") or {"num_cpus": 1}
+                    ),
+                    lease_id=rec.get("lease_id"),
+                    node_addr=e.addr,
+                )
+                self._actors[aid] = a
+                if a.name and (a.namespace, a.name) not in self._named:
+                    self._named[(a.namespace, a.name)] = aid
+                self.ft["reconcile_actors_resurrected"] += 1
+                self._emit("actor_update", {"actor_id": aid, "state": "ALIVE"})
+            else:
+                cur = self._nodes.get(a.node_id) if a.node_id else None
+                if (
+                    a.state == "ALIVE"
+                    and a.node_id is not None
+                    and a.node_id != e.node_id
+                    and cur is not None and cur.alive
+                    and not cur.pending_reconcile
+                ):
+                    # the table's binding is NEWER ground truth: this
+                    # actor was already restarted on another live node
+                    # (e.g. while the reporter was partitioned and
+                    # declared dead). Repointing here would leave two
+                    # live copies — instead the reported stale copy is
+                    # destroyed by the reconcile sweep
+                    self._stale_copies.append(
+                        (e.addr, aid, rec.get("lease_id"))
+                    )
+                    self.ft["reconcile_actors_stale_copies"] += 1
+                    continue
+                a.state = "ALIVE"
+                a.node_id = e.node_id
+                if rec.get("worker_addr"):
+                    a.worker_addr = tuple(rec["worker_addr"])
+                if rec.get("lease_id"):
+                    a.lease_id = rec["lease_id"]
+                a.node_addr = e.addr
+                self.ft["reconcile_actors_confirmed"] += 1
+            self._needs_confirm.discard(aid)
+        # snapshot-ALIVE actors homed on THIS node that it did not report
+        # are gone with the outage: normal node-death treatment, now
+        for a in self._actors.values():
+            if (
+                a.actor_id in self._needs_confirm
+                and a.node_id == e.node_id
+                and a.actor_id not in reported
+            ):
+                self._needs_confirm.discard(a.actor_id)
+                self.ft["reconcile_actors_lost"] += 1
+                self._bury_or_restart_locked(a)
+        for rec in payload.get("bundles", ()):
+            pg = self._pgs.get(rec["pg_id"])
+            idx = int(rec["bundle_index"])
+            if (
+                pg is None or pg["state"] == "REMOVED"
+                or idx >= len(pg["bundles"])
+            ):
+                # reservation for a PG the table no longer knows: the
+                # daemon still holds the resources — release them via the
+                # reconcile sweep (needs the RPC pool, not held here)
+                self._orphan_bundles.append((e.addr, rec["pg_id"], idx))
+                self.ft["reconcile_bundles_orphaned"] += 1
+                continue
+            b = pg["bundles"][idx]
+            b["node_id"] = e.node_id  # daemon-held reservation wins
+            self.ft["reconcile_bundles_adopted"] += 1
+        self.ft["reconcile_leases_reported"] += len(payload.get("leases", ()))
+
+    def _bury_or_restart_locked(self, a: ActorEntry) -> None:
+        """Node-death treatment for one actor (caller holds the lock)."""
+        if a.state not in ("ALIVE", "PENDING"):
+            return
+        if a.num_restarts < a.max_restarts:
+            a.state = "RESTARTING"
+            a.num_restarts += 1
+            a.node_id = None
+            a.worker_addr = None
+        else:
+            a.state = "DEAD"
+        self._emit(
+            "actor_update",
+            {"actor_id": a.actor_id, "state": a.state,
+             "num_restarts": a.num_restarts},
+        )
 
     def rpc_heartbeat(self, payload, peer):
         with self._lock:
@@ -209,6 +462,12 @@ class GcsService:
             if e is None or not e.alive:
                 # unknown/dead node: tell it to re-register (GCS restart or
                 # it was declared dead while partitioned)
+                return {"ok": False, "reregister": True}
+            if e.pending_reconcile:
+                # restored-from-snapshot claim: keep the lease fresh (the
+                # node IS alive — it just proved it) but demand a full
+                # re-registration so its ground-truth report arrives
+                e.last_hb = time.monotonic()
                 return {"ok": False, "reregister": True}
             e.last_hb = time.monotonic()
             if "available" in payload:
@@ -251,10 +510,21 @@ class GcsService:
 
     def rpc_telemetry_status(self, payload, peer):
         """One-query cluster status (scripts/ray_tpu_status.py): node
-        table + reporters + pool rollups + utilization + SLO grades."""
+        table + reporters + pool rollups + utilization + SLO grades +
+        control-plane FT counters (restart/reconcile deltas — a blackout
+        shows as a counted restart, not phantom-zero metrics)."""
         th = SLOThresholds.from_dict((payload or {}).get("thresholds"))
         out = {"nodes": self.rpc_list_nodes(None, peer)}
         out.update(self.telemetry.status_payload(th))
+        out["gcs_ft"] = self.rpc_gcs_ft(None, peer)
+        return out
+
+    def rpc_gcs_ft(self, payload, peer):
+        """Control-plane FT counters: restarts + reconcile deltas (the
+        bench's duplicate/lost-actor gate reads these)."""
+        with self._lock:
+            out = dict(self.ft)
+            out["actors_pending_confirm"] = len(self._needs_confirm)
         return out
 
     def rpc_cluster_demand(self, payload, peer):
@@ -317,18 +587,9 @@ class GcsService:
         # GcsActorManager::OnNodeDead)
         for a in self._actors.values():
             if a.node_id == node_id and a.state in ("ALIVE", "PENDING"):
-                if a.num_restarts < a.max_restarts:
-                    a.state = "RESTARTING"
-                    a.num_restarts += 1
-                    a.node_id = None
-                    a.worker_addr = None
-                else:
-                    a.state = "DEAD"
-                self._emit(
-                    "actor_update",
-                    {"actor_id": a.actor_id, "state": a.state,
-                     "num_restarts": a.num_restarts},
-                )
+                self._needs_confirm.discard(a.actor_id)
+                self._bury_or_restart_locked(a)
+        self._mark_dirty()
         # placement groups with bundles there reschedule
         for pg in self._pgs.values():
             if any(b.get("node_id") == node_id for b in pg["bundles"]):
@@ -376,7 +637,10 @@ class GcsService:
                     w = pool.get(tuple(g["worker_addr"]))
                     cr = w.call(
                         "create_actor",
-                        {"actor_id": a.actor_id, "creation_spec": a.creation_spec},
+                        {"actor_id": a.actor_id, "creation_spec": a.creation_spec,
+                         "meta": {"name": a.name, "namespace": a.namespace,
+                                  "max_restarts": a.max_restarts,
+                                  "lease_resources": dict(a.lease_resources)}},
                         timeout=300,
                     )
                     if not cr.get("ok"):
@@ -391,17 +655,41 @@ class GcsService:
                         )
                         continue
                     with self._lock:
-                        a.node_id = g["node_id"]
-                        a.worker_addr = tuple(g["worker_addr"])
-                        a.lease_id = g["lease_id"]
-                        a.node_addr = tuple(g.get("node_addr") or addr)
-                        a.state = "ALIVE"
-                        self._mark_dirty()
-                        self._emit(
-                            "actor_update",
-                            {"actor_id": a.actor_id, "state": "ALIVE",
-                             "worker_addr": a.worker_addr},
+                        if (
+                            a.state == "ALIVE"
+                            and a.worker_addr is not None
+                            and tuple(a.worker_addr) != tuple(g["worker_addr"])
+                        ):
+                            # a reconcile report confirmed the ORIGINAL
+                            # copy alive while this sweep was re-creating
+                            # it (restore race): keep ground truth, kill
+                            # the just-created duplicate with its lease
+                            duplicate = True
+                        else:
+                            duplicate = False
+                            a.node_id = g["node_id"]
+                            a.worker_addr = tuple(g["worker_addr"])
+                            a.lease_id = g["lease_id"]
+                            a.node_addr = tuple(g.get("node_addr") or addr)
+                            a.state = "ALIVE"
+                            self._mark_dirty()
+                            self._emit(
+                                "actor_update",
+                                {"actor_id": a.actor_id, "state": "ALIVE",
+                                 "worker_addr": a.worker_addr},
+                            )
+                    if duplicate:
+                        daemon.call(
+                            "release_lease",
+                            {"lease_id": g["lease_id"], "kill": True},
+                            timeout=10,
                         )
+                        logger.warning(
+                            "actor %s: reconcile confirmed the original "
+                            "copy; discarded duplicate restart",
+                            a.actor_id.hex()[:12],
+                        )
+                        break
                     logger.info(
                         "actor %s restarted on %s",
                         a.actor_id.hex()[:12], g["node_id"],
@@ -409,6 +697,65 @@ class GcsService:
                     break
                 except (RpcError, RemoteError):
                     continue
+
+    def reconcile_sweep(self, pool) -> None:
+        """Post-restore convergence work that needs the RPC pool:
+
+         * release orphaned bundle reservations a re-registering node
+           reported for PGs the table no longer knows (their resources
+           are otherwise leaked on the daemon forever);
+         * after a grace period, bury snapshot-ALIVE actors whose node
+           never re-registered to confirm them (the node itself is
+           handled by the health sweep; this covers actors whose
+           snapshot node entry was missing or stale)."""
+        from ray_tpu.cluster.rpc import RemoteError, RpcError
+
+        with self._lock:
+            orphans, self._orphan_bundles = self._orphan_bundles, []
+            stale, self._stale_copies = self._stale_copies, []
+        for addr, pg_id, idx in orphans:
+            try:
+                pool.get(tuple(addr)).call(
+                    "release_pg_bundle",
+                    {"pg_id": pg_id, "bundle_index": idx},
+                    timeout=10,
+                )
+            except (RpcError, RemoteError):
+                pass  # daemon died; the reservation died with it
+        for addr, aid, lease_id in stale:
+            # kill the stale copy's lease on its own daemon: the worker
+            # (and the duplicate actor in it) dies with the lease
+            if not lease_id:
+                continue
+            try:
+                pool.get(tuple(addr)).call(
+                    "release_lease", {"lease_id": lease_id, "kill": True},
+                    timeout=10,
+                )
+                logger.warning(
+                    "reconcile: destroyed stale copy of actor %s",
+                    aid.hex()[:12] if isinstance(aid, bytes) else aid,
+                )
+            except (RpcError, RemoteError):
+                pass
+        if self._restore_t is None or not self._needs_confirm:
+            return
+        grace = max(2 * self._death_timeout, 3.0)
+        if time.monotonic() - self._restore_t < grace:
+            return
+        with self._lock:
+            stale, self._needs_confirm = self._needs_confirm, set()
+            for aid in stale:
+                a = self._actors.get(aid)
+                if a is None or a.state not in ("ALIVE", "PENDING"):
+                    continue
+                node = self._nodes.get(a.node_id)
+                if node is not None and node.alive and not node.pending_reconcile:
+                    continue  # node re-registered and confirmed it already
+                self.ft["reconcile_actors_lost"] += 1
+                self._bury_or_restart_locked(a)
+            if stale:
+                self._mark_dirty()
 
     def pg_reserve_sweep(self, pool) -> None:
         """Reserve re-placed placement-group bundles on their new nodes
@@ -457,6 +804,7 @@ class GcsService:
                     if pg.get("reserve_gen", 0) == gen \
                             and pg["state"] == "CREATED":
                         pg["needs_reserve"] = False
+                        self._mark_dirty()  # re-reservation is durable state
                 logger.info(
                     "pg %s re-reserved after reschedule",
                     pg["pg_id"].hex()[:12] if isinstance(pg["pg_id"], bytes)
@@ -587,6 +935,10 @@ class GcsService:
             if name:
                 self._named[(ns, name)] = a.actor_id
             self._mark_dirty()
+        # write-ahead ack: the registration must be durable BEFORE the
+        # client sees ok — killing the GCS between this ack and the next
+        # debounced sweep used to silently lose the actor
+        self.persist_critical()
         return {"ok": True}
 
     def rpc_update_actor(self, payload, peer):
@@ -605,6 +957,11 @@ class GcsService:
                 "actor_update", {"actor_id": a.actor_id, "state": a.state}
             )
             self._mark_dirty()
+            died = a.state == "DEAD"
+        if died:
+            # a kill is a critical mutation too: an unpersisted tombstone
+            # lets the reconcile path resurrect an actor the user killed
+            self.persist_critical()
         return {"ok": True}
 
     def _actor_info(self, a: ActorEntry) -> dict:
@@ -659,7 +1016,12 @@ class GcsService:
             self._pgs[pg["pg_id"]] = pg
             self._try_place_pg(pg)
             self._mark_dirty()
-            return self._pg_info(pg)
+            info = self._pg_info(pg)
+        # write-ahead ack (same contract as register_actor): the
+        # reservation the client is about to make against this placement
+        # must survive a control-plane crash after the ack
+        self.persist_critical()
+        return info
 
     def _try_place_pg(self, pg: dict) -> None:
         alive = [e for e in self._nodes.values() if e.alive and not e.draining]
@@ -815,6 +1177,7 @@ class GcsServer:
             while not self._stop.wait(0.25):
                 try:
                     self.service.health_sweep()
+                    self.service.reconcile_sweep(pool)
                     self.service.restart_sweep(pool)
                     self.service.pg_reserve_sweep(pool)
                     self.service.persist_if_dirty()

@@ -142,6 +142,11 @@ class ObjectService:
         self._node_id = node_id
         self._gcs = gcs
         self._pool = pool
+        # object-directory announcements that failed because the GCS was
+        # dark: the object is stored and served locally regardless (a
+        # control-plane outage must not fail the data plane's put path);
+        # the heartbeat loop re-announces these once the GCS answers
+        self._unannounced: set[bytes] = set()
 
     def _spill_path(self, object_id: bytes) -> str:
         return os.path.join(self._spill_dir, object_id.hex())
@@ -189,10 +194,7 @@ class ObjectService:
         with self._lock:
             self._shm_held.add(object_id)
             self._arrived.notify_all()
-        self._gcs.call(
-            "add_object_location",
-            {"object_id": object_id, "node_id": self._node_id},
-        )
+        self._announce(object_id)
         return True
 
     def put(self, object_id: bytes, data: bytes) -> None:
@@ -209,10 +211,38 @@ class ObjectService:
                 self._bytes += len(data)
                 self._evict_over_capacity_locked()
             self._arrived.notify_all()  # unblock fetch() waiters instantly
-        self._gcs.call(
-            "add_object_location",
-            {"object_id": object_id, "node_id": self._node_id},
-        )
+        self._announce(object_id)
+
+    def _announce(self, object_id: bytes) -> None:
+        """Publish the location; a dark GCS only costs directory
+        freshness — the bytes are stored and locally readable either way
+        (degraded-mode contract: per-request paths never fail on the
+        control plane). Deferred announcements flush from the heartbeat
+        loop / the re-registration inventory."""
+        try:
+            self._gcs.call(
+                "add_object_location",
+                {"object_id": object_id, "node_id": self._node_id},
+            )
+        except (RpcError, RemoteError):
+            with self._lock:
+                self._unannounced.add(object_id)
+
+    def flush_unannounced(self) -> None:
+        """Re-announce puts that landed while the GCS was dark (called
+        after a successful heartbeat)."""
+        with self._lock:
+            todo = list(self._unannounced)
+        for oid in todo:
+            try:
+                self._gcs.call(
+                    "add_object_location",
+                    {"object_id": oid, "node_id": self._node_id},
+                )
+            except (RpcError, RemoteError):
+                return  # still dark; retry on a later beat
+            with self._lock:
+                self._unannounced.discard(oid)
 
     def get_local(self, object_id: bytes) -> Optional[bytes]:
         if self._shm is not None and object_id in self._shm_held:
@@ -294,6 +324,7 @@ class ObjectService:
                     os.unlink(self._spill_path(object_id))
                 except OSError:
                     pass
+            self._unannounced.discard(object_id)
         try:
             self._gcs.call(
                 "remove_object_location",
@@ -387,6 +418,18 @@ class ObjectService:
             parts.append(chunk)
             off += len(chunk)
         return b"".join(parts)
+
+    def inventory(self) -> list:
+        """Every object id resident on this node (memory + spilled +
+        shm tiers) — the re-registration report that rebuilds a restarted
+        GCS's object directory."""
+        with self._lock:
+            return list(
+                dict.fromkeys(
+                    list(self._objects) + list(self._spilled)
+                    + list(self._shm_held)
+                )
+            )
 
     def close(self) -> None:
         """Release pins and close (owner: unlink) the shm store."""
@@ -782,10 +825,11 @@ class NodeDaemon:
                         logger.exception("telemetry snapshot failed")
                 r = self.gcs.call("heartbeat", hb, timeout=5)
                 if not r.get("ok") and r.get("reregister"):
-                    with self.objects._lock:
-                        inventory = list(self.objects._objects.keys()) + list(
-                            self.objects._spilled
-                        )
+                    # a restarted/partition-recovered GCS asked for ground
+                    # truth: re-register with the FULL reconcile report —
+                    # object inventory, held leases, reserved PG bundles,
+                    # and the live actors our workers host — so the GCS
+                    # converges its (possibly stale) snapshot to reality
                     self.gcs.call(
                         "register_node",
                         {
@@ -793,13 +837,57 @@ class NodeDaemon:
                             "addr": self.addr,
                             "resources": self.total,
                             "labels": self.labels,
-                            # a restarted GCS lost its object directory:
-                            # rebuild it from our inventory
-                            "objects": inventory,
+                            **self._reconcile_report(),
                         },
                     )
+                else:
+                    self.objects.flush_unannounced()
             except (RpcError, RemoteError):
                 pass  # GCS down: keep trying (it may restart)
+
+    def _reconcile_report(self) -> dict:
+        """Ground truth for a reconciling GCS: everything live on this
+        node right now. Worker actor inventories are collected over
+        bounded RPCs; a worker that died mid-collect simply contributes
+        nothing (its actors are genuinely gone)."""
+        with self._res_lock:
+            leases = [
+                {
+                    "lease_id": lid,
+                    "resources": dict(ls["resources"]),
+                    "worker_id": getattr(ls.get("worker"), "worker_id", None),
+                }
+                for lid, ls in self._leases.items()
+            ]
+            bundles = [
+                {"pg_id": pg_id, "bundle_index": idx,
+                 "resources": dict(res)}
+                for (pg_id, idx), res in self._bundles.items()
+            ]
+            worker_by_lease = {
+                lid: ls.get("worker") for lid, ls in self._leases.items()
+            }
+        actors: list[dict] = []
+        for lid, w in worker_by_lease.items():
+            if w is None or not w.alive() or w.addr is None:
+                continue
+            try:
+                inv = self.pool.get(tuple(w.addr)).call(
+                    "actor_inventory", {}, timeout=5
+                )
+            except (RpcError, RemoteError):
+                continue
+            for rec in inv or ():
+                rec = dict(rec)
+                rec.setdefault("lease_id", lid)
+                rec.setdefault("worker_addr", tuple(w.addr))
+                actors.append(rec)
+        return {
+            "objects": self.objects.inventory(),
+            "leases": leases,
+            "bundles": bundles,
+            "actors": actors,
+        }
 
     # -- resources ------------------------------------------------------------
 

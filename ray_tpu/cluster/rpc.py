@@ -495,6 +495,22 @@ class ReconnectingRpcClient:
 
     def call(self, method: str, payload: Any = None,
              timeout: Optional[float] = None) -> Any:
+        if _chaos.ACTIVE is not None:
+            # STALL_GCS: a control-plane outage WITHOUT a process death —
+            # this client class is only ever pointed at the GCS, so the
+            # hook covers exactly the GCS-bound plane. The seeded window
+            # (start_after/every_n/max_fires over this process's call
+            # order) fails each covered call with transport loss; callers
+            # must degrade exactly as they would for a dead GCS.
+            for _f in _chaos.fire(
+                "gcs.call", kinds=(_chaos.STALL_GCS,),
+                method=method, peer=f"{self.addr[0]}:{self.addr[1]}",
+            ):
+                if _f.kind == _chaos.STALL_GCS:
+                    raise RpcError(
+                        f"chaos: GCS stalled — {method!r} to {self.addr} "
+                        "lost in the outage window"
+                    )
         backoff = None
         for attempt in range(self._redial_attempts + 1):
             c = self._get()

@@ -70,6 +70,11 @@ class WorkerRuntime:
         self.shm = None  # attached after registration (daemon owns the file)
         self.actors: dict[bytes, Any] = {}
         self._actor_locks: dict[bytes, asyncio.Lock] = {}
+        # registration metadata per hosted actor (name/namespace/
+        # max_restarts/creation_spec...) — the data-plane ground truth a
+        # reconciling GCS rebuilds its actor table from after a restart
+        # with a stale or lost snapshot (rpc_actor_inventory)
+        self._actor_meta: dict[bytes, dict] = {}
         self.rpc = RpcServer(self)
         # execution-side tracing: spans buffered here, flushed to the node
         # daemon in batches off the hot path (reference: per-worker
@@ -232,6 +237,9 @@ class WorkerRuntime:
                     payload["creation_spec"], self.resolve_ref
                 )
                 self.actors[payload["actor_id"]] = cls(*args, **kwargs)
+                meta = dict(payload.get("meta") or {})
+                meta["creation_spec"] = payload["creation_spec"]
+                self._actor_meta[payload["actor_id"]] = meta
                 return {"ok": True}
             except BaseException as e:  # noqa: BLE001
                 return {"ok": False, "error": repr(e), "tb": traceback.format_exc()}
@@ -309,7 +317,18 @@ class WorkerRuntime:
     async def rpc_destroy_actor(self, payload, peer):
         self.actors.pop(payload["actor_id"], None)
         self._actor_locks.pop(payload["actor_id"], None)
+        self._actor_meta.pop(payload["actor_id"], None)
         return {"ok": True}
+
+    def rpc_actor_inventory(self, payload, peer):
+        """Live actors hosted here, with their registration metadata —
+        the node daemon forwards this in its reconcile report when a
+        restarted GCS asks it to re-register."""
+        out = []
+        for aid in list(self.actors):
+            meta = self._actor_meta.get(aid, {})
+            out.append({"actor_id": aid, **meta})
+        return out
 
     def rpc_ping(self, payload, peer):
         return {"worker_id": self.worker_id, "actors": len(self.actors)}

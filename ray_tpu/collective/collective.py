@@ -468,8 +468,18 @@ def _group_and_rank(group_name: str, rank: Optional[int]) -> tuple[_HostGroup, i
         current_gen = _generations.get(group_name, 0)
     bound = getattr(_local, "ranks", {}).get(group_name)
     if bound is not None and bound[0] is not group:
-        # this thread joined an incarnation that is no longer current
-        if getattr(bound[0], "gen", 0) < current_gen:
+        # this thread joined an incarnation that is no longer current.
+        # HOST tier (no .rank attr — rank identity IS the thread): that
+        # thread is a zombie of a superseded gang and must exit. CLUSTER
+        # tier (per-process group with a fixed .rank): actor calls hop
+        # executor-pool threads, so a stale thread binding after a
+        # legitimate same-process re-join at gen+1 is just superseded —
+        # a genuinely zombie PROCESS keeps its old group object and is
+        # refused by the ClusterGroup's own published-gen check instead
+        if (
+            getattr(bound[0], "gen", 0) < current_gen
+            and not hasattr(bound[0], "rank")
+        ):
             raise StaleGenerationError(
                 f"group {group_name!r} re-formed at gen {current_gen}; this "
                 f"thread joined gen {getattr(bound[0], 'gen', 0)} and must "
@@ -477,7 +487,7 @@ def _group_and_rank(group_name: str, rank: Optional[int]) -> tuple[_HostGroup, i
                 group=group_name, gen=getattr(bound[0], "gen", 0),
                 rank=bound[1],
             )
-        bound = None  # destroyed/recreated at same gen: stale binding
+        bound = None  # superseded/recreated: stale binding
     if group is None:
         raise RuntimeError(
             f"collective group {group_name!r} not initialized; call "

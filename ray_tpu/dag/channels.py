@@ -8,17 +8,63 @@ substrate there is MutableObjectManager spin-wait buffers
 host process a bounded queue per reader gives the same semantics
 (backpressure at capacity, ordered delivery, N-reader fan-out) without
 shared-memory ceremony.
+
+Robustness (r13): reads are BOUNDED by default — ``read(timeout=None)``
+parks at most ``default_timeout`` seconds and raises the typed
+``ChannelTimeoutError`` instead of hanging an exec loop forever on a
+peer that died outside the channel protocol. The channel plane is also
+a chaos surface: ``DROP_CHANNEL`` (a written value lost in flight — the
+reader's bounded wait surfaces it) and ``STALL_CHANNEL`` (a late
+writer/reader, ``delay_s``) fire at the ``dag.channel`` hook sites,
+mirroring the collective fault kinds' eligibility rules (drops are only
+eligible at the send side — there is nothing in flight to lose at a
+recv).
 """
 
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Optional
+
+from ray_tpu.chaos import harness as _chaos
 
 
 class ChannelClosedError(Exception):
     pass
+
+
+class ChannelTimeoutError(TimeoutError):
+    """A bounded channel read expired with no value: the writer is dead,
+    stalled past the bound, or its value was lost in flight
+    (DROP_CHANNEL). Typed so exec loops poison the pipeline instead of
+    hanging, and callers can tell a dead peer from a closed channel."""
+
+
+# default bound on read(timeout=None): long enough for any legitimate
+# upstream compute, finite so a dead writer can never park a loop forever
+DEFAULT_READ_TIMEOUT = 120.0
+
+
+def chaos_channel_op(role: str, **attrs) -> bool:
+    """Shared chaos hook for every channel flavor (in-process queue, shm
+    ring, socket stream): returns True when the op's value should be
+    DROPPED (send side only); STALL_CHANNEL sleeps ``delay_s`` inline.
+    Fast path: one attribute load when chaos is disabled."""
+    if _chaos.ACTIVE is None:
+        return False
+    kinds = (
+        (_chaos.DROP_CHANNEL, _chaos.STALL_CHANNEL)
+        if role == "send" else (_chaos.STALL_CHANNEL,)
+    )
+    drop = False
+    for f in _chaos.fire(f"dag.channel.{role}", kinds=kinds, **attrs):
+        if f.kind == _chaos.STALL_CHANNEL:
+            time.sleep(f.delay_s)
+        elif f.kind == _chaos.DROP_CHANNEL:
+            drop = True
+    return drop
 
 
 _CLOSED = object()
@@ -28,24 +74,40 @@ class Channel:
     """Single-writer, N-reader channel. Each reader gets every value
     (fan-out duplicates the reference's reader-registration model)."""
 
-    def __init__(self, num_readers: int = 1, maxsize: int = 2):
+    def __init__(self, num_readers: int = 1, maxsize: int = 2,
+                 default_timeout: float = DEFAULT_READ_TIMEOUT):
         if num_readers < 1:
             raise ValueError("channel needs at least one reader")
         self._queues = [queue.Queue(maxsize=maxsize) for _ in range(num_readers)]
         self._closed = threading.Event()
+        self._default_timeout = float(default_timeout)
 
     def write(self, value: Any, timeout: Optional[float] = None) -> None:
         if self._closed.is_set():
             raise ChannelClosedError("channel closed")
+        if chaos_channel_op("send"):
+            return  # lost in flight: readers' bounded waits surface it
         for q in self._queues:
             q.put(value, timeout=timeout)
 
     def read(self, reader_idx: int = 0, timeout: Optional[float] = None) -> Any:
+        """Read the next value. ``timeout=None`` means the channel's
+        default BOUND (not forever): expiry raises the typed
+        ``ChannelTimeoutError``. An explicit timeout keeps the legacy
+        ``queue.Empty`` contract for pollers."""
+        chaos_channel_op("recv")
+        bounded_default = timeout is None
+        eff = self._default_timeout if bounded_default else timeout
         try:
-            v = self._queues[reader_idx].get(timeout=timeout)
+            v = self._queues[reader_idx].get(timeout=eff)
         except queue.Empty:
             if self._closed.is_set():
                 raise ChannelClosedError("channel closed") from None
+            if bounded_default:
+                raise ChannelTimeoutError(
+                    f"channel read parked > {eff}s with no value (writer "
+                    "dead, stalled, or value dropped in flight)"
+                ) from None
             raise
         if v is _CLOSED:
             raise ChannelClosedError("channel closed")

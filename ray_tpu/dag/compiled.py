@@ -16,10 +16,15 @@ exactly like the reference's per-actor exec loop tasks.
 
 from __future__ import annotations
 
+import os
 import threading
 from typing import Any, Optional
 
-from ray_tpu.dag.channels import Channel, ChannelClosedError
+from ray_tpu.dag.channels import (
+    Channel,
+    ChannelClosedError,
+    ChannelTimeoutError,
+)
 from ray_tpu.dag.nodes import (
     ClassMethodNode,
     CollectiveOutputNode,
@@ -47,7 +52,36 @@ class _Op:
         self.out_channel = out_channel
 
 
-def _resolve_source(src, input_value, local: dict):
+# bound on mid-iteration channel reads (op args fed by peer loops): the
+# upstream loop is already executing this iteration, so a read parked
+# past this is a dead peer or a value dropped in flight — surface the
+# typed error instead of a hung exec loop
+EXEC_READ_TIMEOUT_S = float(os.environ.get("RAY_TPU_DAG_READ_TIMEOUT_S", "120"))
+
+
+def _bounded_chan_read(ch, reader_idx: int):
+    """Exec-loop channel read with the DAG-wide bound, normalized to the
+    typed ChannelTimeoutError across channel flavors (the queue-backed
+    in-process channel and the shm/socket channels all raise queue.Empty
+    on an explicit-timeout expiry)."""
+    import queue as _q
+
+    try:
+        return ch.read(reader_idx, timeout=EXEC_READ_TIMEOUT_S)
+    except _q.Empty:
+        raise ChannelTimeoutError(
+            f"exec-loop channel read parked > {EXEC_READ_TIMEOUT_S}s "
+            "(peer loop dead, stalled, or value dropped in flight)"
+        ) from None
+
+
+def _resolve_source(src, input_value, local: dict, started=None):
+    """``started`` is a one-element cell shared across an iteration's
+    reads: while False, a channel read is the loop WAITING for its next
+    iteration to begin — an idle DAG is legal for any length of time, so
+    timeouts there retry (close still exits via ChannelClosedError).
+    Once any value has been consumed the iteration is in flight and a
+    parked read past the bound is a dead/stalled peer — fatal, typed."""
     kind = src[0]
     if kind == "const":
         return src[1]
@@ -58,18 +92,33 @@ def _resolve_source(src, input_value, local: dict):
     if kind == "local":
         return local[src[1]]
     if kind == "chan":
-        return src[1].read(src[2])
+        while True:
+            try:
+                v = _bounded_chan_read(src[1], src[2])
+                break
+            except ChannelTimeoutError:
+                if started is None or started[0]:
+                    raise
+        if started is not None:
+            started[0] = True
+        return v
     raise AssertionError(src)
 
 
-def _run_loop_iteration(instance, plan, input_value, local: dict):
+def _run_loop_iteration(instance, plan, input_value, local: dict,
+                        have_input: bool = True):
+    started = [have_input]
     for op in plan:
-        args = [_resolve_source(s, input_value, local) for s in op.arg_sources]
+        args = [
+            _resolve_source(s, input_value, local, started)
+            for s in op.arg_sources
+        ]
         kwargs = {
-            k: _resolve_source(s, input_value, local)
+            k: _resolve_source(s, input_value, local, started)
             for k, s in op.kwarg_sources.items()
         }
         out = getattr(instance, op.method_name)(*args, **kwargs)
+        started[0] = True
         local[op.node_id] = out
         if op.out_channel is not None:
             op.out_channel.write(out)
@@ -109,7 +158,12 @@ def _actor_exec_loop(instance, plan, input_source):
         def _read_ahead():
             while not dead[0]:
                 try:
-                    v = input_source[1].read(input_source[2])
+                    # bounded slices, not one unbounded park: an idle DAG
+                    # (driver not calling execute()) is legal forever, but
+                    # each park re-checks the dead flag and channel close
+                    v = input_source[1].read(input_source[2], timeout=1.0)
+                except _q.Empty:
+                    continue  # idle: no execute() in flight
                 except ChannelClosedError:
                     _put(_POISON)
                     return
@@ -127,7 +181,15 @@ def _actor_exec_loop(instance, plan, input_source):
         while True:
             try:
                 if prefetch is not None:
-                    item = prefetch.get()
+                    while True:
+                        try:
+                            # bounded park (check_timeouts contract): the
+                            # prefetch thread owns the unbounded wait in
+                            # 1s close-aware slices; this side just polls
+                            item = prefetch.get(timeout=0.5)
+                            break
+                        except _q.Empty:
+                            continue
                     if item is _POISON:
                         raise ChannelClosedError("input channel closed")
                     tag, input_value = item
@@ -135,7 +197,10 @@ def _actor_exec_loop(instance, plan, input_source):
                         raise input_value
                 else:
                     input_value = None
-                _run_loop_iteration(instance, plan, input_value, {})
+                _run_loop_iteration(
+                    instance, plan, input_value, {},
+                    have_input=prefetch is not None,
+                )
             except ChannelClosedError:
                 # propagate the poison downstream: close OUR out channels
                 # too, else a mid-pipeline failure only unblocks immediate
@@ -415,16 +480,23 @@ class CompiledDAG:
     def _fetch(self, seq: int, timeout: Optional[float]):
         import queue as _queue
 
+        from ray_tpu.dag.channels import DEFAULT_READ_TIMEOUT
+
+        # timeout=None means the BOUNDED default for every channel
+        # flavor: the shm/socket channels' read(timeout=None) parks
+        # forever, and a value dropped on the final output edge (the
+        # exec loops all stay healthy) would hang the driver's get()
+        eff = DEFAULT_READ_TIMEOUT if timeout is None else timeout
         with self._lock:
             while self._fetched < seq:
                 try:
                     vals = [
-                        src[1].read(src[2], timeout=timeout)
+                        src[1].read(src[2], timeout=eff)
                         for src in self._output_sources
                     ]
-                except _queue.Empty:
+                except (_queue.Empty, ChannelTimeoutError):
                     raise TimeoutError(
-                        f"compiled DAG output {seq} not ready after {timeout}s"
+                        f"compiled DAG output {seq} not ready after {eff}s"
                     ) from None
                 self._fetched += 1
                 self._results[self._fetched] = (
@@ -475,7 +547,8 @@ def _submit_exec_loop(handle, plan, input_source):
 def _collective_loop(op, srcs, out_ch):
     while True:
         try:
-            vals = [_resolve_source(s, None, {}) for s in srcs]
+            started = [False]  # idle-tolerant until the round's first value
+            vals = [_resolve_source(s, None, {}, started) for s in srcs]
             acc = vals[0]
             for v in vals[1:]:
                 acc = op(acc, v)

@@ -109,6 +109,10 @@ class ShmChannel:
     # -- API (mirrors dag.channels.Channel) -----------------------------------
 
     def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        from ray_tpu.dag.channels import chaos_channel_op
+
+        if chaos_channel_op("send", transport="shm"):
+            return  # DROP_CHANNEL: lost in flight; readers' bounds surface it
         self._write_payload(pickle.dumps(value, protocol=5), timeout)
 
     def _write_payload(self, payload: bytes, timeout: Optional[float]) -> None:
@@ -126,6 +130,9 @@ class ShmChannel:
         self._write_seq = seq + 1
 
     def read(self, reader_idx: int = 0, timeout: Optional[float] = None) -> Any:
+        from ray_tpu.dag.channels import chaos_channel_op
+
+        chaos_channel_op("recv", transport="shm")
         store = self._s()
         seq = self._read_seq[reader_idx]
         oid = _oid(self.name, "d", seq)

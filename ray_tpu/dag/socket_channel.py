@@ -90,9 +90,17 @@ class _WriterServer:
         self._threads.append(t)
 
     def _accept_loop(self):
+        # bounded accept: each park re-checks the closed flag so a closed
+        # channel reaps this thread instead of leaving it parked forever
+        self.sock.settimeout(1.0)
         while True:
             try:
                 conn, _ = self.sock.accept()
+            except socket.timeout:
+                with self.lock:
+                    if self.closed:
+                        return
+                continue
             except OSError:
                 return  # listener closed
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
@@ -250,6 +258,10 @@ class SocketChannel:
         return self._server
 
     def write(self, value: Any, timeout: Optional[float] = None) -> None:
+        from ray_tpu.dag.channels import chaos_channel_op
+
+        if chaos_channel_op("send", transport="socket"):
+            return  # DROP_CHANNEL: lost in flight (never framed)
         self._ensure_server().write(
             pickle.dumps(value, protocol=5), timeout
         )
@@ -301,6 +313,9 @@ class SocketChannel:
             buf.extend(chunk)
 
     def read(self, reader_idx: int = 0, timeout: Optional[float] = None) -> Any:
+        from ray_tpu.dag.channels import chaos_channel_op
+
+        chaos_channel_op("recv", transport="socket")
         deadline = None if timeout is None else time.monotonic() + timeout
         self._connect(reader_idx, timeout)
         buf = self._rbufs[reader_idx]

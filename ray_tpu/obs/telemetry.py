@@ -1033,6 +1033,22 @@ def format_status(report: dict) -> str:
             )
     else:
         lines.append("  (no serve pools reporting)")
+    ft = report.get("gcs_ft") or {}
+    if ft.get("gcs_restarts_total"):
+        # the blackout must SHOW here: a restarted control plane renders
+        # as a counted restart + reconcile deltas, not phantom-zero rows
+        lines.append("== control plane ==")
+        lines.append(
+            f"  gcs restarts {ft['gcs_restarts_total']}"
+            f"  reconcile: {ft.get('reconcile_nodes_reregistered', 0)} nodes"
+            f", actors +{ft.get('reconcile_actors_confirmed', 0)} confirmed"
+            f" +{ft.get('reconcile_actors_resurrected', 0)} resurrected"
+            f" -{ft.get('reconcile_actors_lost', 0)} lost"
+            f", bundles {ft.get('reconcile_bundles_adopted', 0)} adopted"
+            f"/{ft.get('reconcile_bundles_orphaned', 0)} released"
+            + (f"  [{ft['actors_pending_confirm']} awaiting confirm]"
+               if ft.get("actors_pending_confirm") else "")
+        )
     trainer = report.get("trainer") or {}
     if any(v is not None for v in trainer.values()):
         ge = trainer.get("gang_epoch")

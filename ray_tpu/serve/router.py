@@ -69,9 +69,21 @@ class Router:
         now = time.time()
         if not block and self._replicas and now - self._last_refresh < 0.25:
             return
-        info = ray_tpu.get(
-            self._controller.get_running_replicas.remote(self._app, self._deployment)
-        )
+        try:
+            info = ray_tpu.get(
+                self._controller.get_running_replicas.remote(self._app, self._deployment)
+            )
+        except Exception:
+            # degraded-mode contract (control-plane blackout): the
+            # controller/GCS being unreachable may only cost routing
+            # FRESHNESS — keep serving the cached replica set and retry
+            # the refresh on a later dispatch. Only an empty cache (no
+            # replicas ever seen) propagates the failure.
+            with self._lock:
+                if self._replicas:
+                    self._last_refresh = now
+                    return
+            raise
         with self._lock:
             self._last_refresh = now
             self._max_queued = info.get("max_queued_requests", -1)

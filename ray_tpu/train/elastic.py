@@ -60,6 +60,8 @@ from ray_tpu.collective import (
     declare_collective_group,
     destroy_collective_group,
 )
+from ray_tpu.cluster.client import ActorDiedError as ClusterActorDiedError
+from ray_tpu.cluster.client import ClusterTaskError
 from ray_tpu.core import api
 from ray_tpu.core.errors import (
     ActorDiedError,
@@ -109,6 +111,12 @@ def register_metrics() -> dict:
         "ray_tpu_train_ranks_lost_total",
         description="elastic trainer: ranks lost to kill/stall/partition "
         "across all recoveries",
+    )
+    _METRICS["blackouts"] = cluster_counter(
+        "ray_tpu_train_blackouts_total",
+        description="elastic trainer: control-plane blackouts ridden out "
+        "(GCS dark -> wait -> resume; no ranks blamed, no recovery "
+        "budget burned)",
     )
     return _METRICS
 
@@ -242,6 +250,22 @@ class ElasticConfig:
     max_recoveries: int = 8
     allow_replacement: bool = True  # spawn a fresh rank vs shrink
     sharded_checkpoints: bool = True  # orbax path vs pickle
+    # control-plane blackout contract (r13): when the probe says the GCS
+    # itself is dark, a failed round is NOBODY's fault — the supervisor
+    # parks (bounded) until the plane answers again, re-forms the SAME
+    # gang at gen+1, restores, and resumes. No rank is killed, nothing
+    # lands in `recoveries`, and max_recoveries is untouched: a blackout
+    # may only cost scheduling freshness, never gang health.
+    control_plane_probe: Optional[Callable[[], bool]] = None
+    # optional restart detector: sampled before each round and again at
+    # fault time — a CHANGED value means the control plane restarted
+    # during the round (the typed errors often only surface once the
+    # plane answers again, when a probe would already say "fine"), which
+    # is a blackout even if the plane is back up by classification time
+    control_plane_epoch: Optional[Callable[[], Any]] = None
+    blackout_wait_s: float = 60.0   # bound on waiting for the GCS to return
+    blackout_poll_s: float = 0.25   # probe cadence while waiting
+    max_blackouts: int = 8          # flap bound; beyond it, normal recovery
 
     def __post_init__(self):
         if not 1 <= self.min_world_size <= self.world_size:
@@ -277,22 +301,36 @@ class ElasticResult:
     final_world_size: int
     checkpoint: Optional[Checkpoint] = None
     error: Optional[BaseException] = None
+    # control-plane blackouts ridden out (Recovery records with
+    # cause="control_plane_blackout", ranks_lost=0) — deliberately NOT
+    # in `recoveries`: a dark GCS is never attributed to the gang
+    blackouts: list = dataclasses.field(default_factory=list)
 
 
 def _classify(err: BaseException) -> Optional[str]:
     """Fault taxonomy for a failed rank ref. Returns None for errors that
     mean 'collateral of someone else's fault' (aborted round, stale
     generation, a survivor's own expired wait) — those ranks SURVIVED."""
-    # the actor runtime wraps task-side exceptions in TaskError with the
-    # original in .cause — classify the original
-    while isinstance(err, TaskError) and err.cause is not None:
-        err = err.cause
+    # both actor runtimes wrap task-side exceptions with the original in
+    # .cause (in-process TaskError, cluster ClusterTaskError) — unwrap
+    # the whole chain and classify the raiser's exception, else every
+    # cluster-backend fault misreads as rank death (innocent teardown)
+    seen: set[int] = set()
+    while id(err) not in seen:
+        seen.add(id(err))
+        cause = getattr(err, "cause", None)
+        if isinstance(err, (TaskError, ClusterTaskError)) and isinstance(
+            cause, BaseException
+        ):
+            err = cause
+        else:
+            break
     if isinstance(err, RankKilled):
         return "rank_killed"
     if isinstance(err, CollectivePartitionError):
         return "partition"
     if isinstance(err, (ActorDiedError, ActorUnavailableError,
-                        WorkerCrashedError)):
+                        WorkerCrashedError, ClusterActorDiedError)):
         return "rank_died"
     if isinstance(err, (CollectiveAbortedError, StaleGenerationError)):
         return None
@@ -343,6 +381,7 @@ class TrainerSupervisor:
         self._world = self._cfg.world_size
         self._last_faults: dict[int, BaseException] = {}
         self.recoveries: list[Recovery] = []
+        self.blackouts: list[Recovery] = []
 
     # -- gang lifecycle -------------------------------------------------------
 
@@ -416,6 +455,62 @@ class TrainerSupervisor:
             doc = ckpt.load_state()
         return doc["state"], int(np.asarray(doc["step"]))
 
+    # -- control-plane blackout -----------------------------------------------
+
+    def _control_plane_ok(self) -> bool:
+        """True when the GCS answers (or no probe is configured — then
+        blackout handling is off and every fault takes the normal path)."""
+        probe = self._cfg.control_plane_probe
+        if probe is None:
+            return True
+        try:
+            return bool(probe())
+        except Exception:  # noqa: BLE001 — a probe failure IS "dark"
+            return False
+
+    def _await_control_plane(self) -> bool:
+        """Park until the probe answers again (bounded). True = the plane
+        returned within blackout_wait_s."""
+        deadline = time.monotonic() + self._cfg.blackout_wait_s
+        while time.monotonic() < deadline:
+            if self._control_plane_ok():
+                return True
+            time.sleep(self._cfg.blackout_poll_s)
+        return False
+
+    # distinct from None ("no detector configured"): the detector exists
+    # but the plane would not answer — i.e. it was DARK at sample time
+    _EPOCH_UNREADABLE = object()
+
+    def _plane_epoch(self) -> Any:
+        fn = self._cfg.control_plane_epoch
+        if fn is None:
+            return None
+        try:
+            return fn()
+        except Exception:  # noqa: BLE001 — unreadable IS a signal
+            return self._EPOCH_UNREADABLE
+
+    def _blackout_detected(self, epoch_before: Any) -> bool:
+        """A fault round is a control-plane blackout when the plane is
+        dark RIGHT NOW, when it was already dark at round START (epoch
+        unreadable — the blackout began before the round did), or when
+        it restarted during the round (epoch changed — the typed errors
+        often surface only once the redial succeeds, i.e. after the
+        plane already returned)."""
+        if self._cfg.control_plane_probe is None:
+            return False
+        if not self._control_plane_ok():
+            return True
+        if epoch_before is None:
+            return False  # no restart detector configured
+        if epoch_before is self._EPOCH_UNREADABLE:
+            return True  # the round was dispatched into a dark plane
+        epoch_after = self._plane_epoch()
+        if epoch_after is None or epoch_after is self._EPOCH_UNREADABLE:
+            return False  # probe says fine but detector flaky: no claim
+        return epoch_after != epoch_before
+
     # -- supervision ----------------------------------------------------------
 
     def _drive_round(self, step: int, n: int) -> tuple[Optional[list], list, float]:
@@ -443,13 +538,22 @@ class TrainerSupervisor:
                     if t_fault is None:
                         t_fault = time.monotonic()
                         # unblock every survivor still parked in the
-                        # broken round NOW — the abort primitive
-                        abort_collective_group(
-                            self._cfg.group_name,
-                            f"rank {rank} fault at step {step}: {e!r}",
-                        )
+                        # broken round NOW — the abort primitive. Best
+                        # effort: with the control plane dark the marker
+                        # can't publish, and the bounded op timeouts are
+                        # the backstop
+                        try:
+                            abort_collective_group(
+                                self._cfg.group_name,
+                                f"rank {rank} fault at step {step}: {e!r}",
+                            )
+                        except Exception:  # noqa: BLE001
+                            pass
             if pending and time.monotonic() > deadline:
-                abort_collective_group(self._cfg.group_name, "round deadline")
+                try:
+                    abort_collective_group(self._cfg.group_name, "round deadline")
+                except Exception:  # noqa: BLE001
+                    pass
                 for ref in pending:
                     rank = by_ref[id(ref)]
                     wedged.add(rank)
@@ -488,6 +592,7 @@ class TrainerSupervisor:
         try:
             while step < self._total_steps:
                 n = min(cfg.steps_per_round, self._total_steps - step)
+                epoch_before = self._plane_epoch()
                 round_losses, lost_workers, detect_s = self._drive_round(step, n)
                 if round_losses is not None:
                     for i, lv in enumerate(round_losses):
@@ -517,6 +622,59 @@ class TrainerSupervisor:
                                  "collective_error") if c in causes),
                     "stall",
                 )
+                # -- control-plane blackout: wait-and-resume, blame nobody
+                if (
+                    len(self.blackouts) < cfg.max_blackouts
+                    and self._blackout_detected(epoch_before)
+                ):
+                    from ray_tpu.obs.recorder import span as _span
+
+                    t0 = time.monotonic()
+                    with _span("train.blackout", attrs={
+                        "group": cfg.group_name, "step": str(step),
+                        "gen": str(self._gen),
+                    }):
+                        logger.warning(
+                            "train.blackout: control plane dark at step %d; "
+                            "parking (no ranks blamed, budget untouched)",
+                            step,
+                        )
+                        if self._await_control_plane():
+                            # every rank survived — re-form the SAME gang
+                            # at gen+1 (the aborted round poisoned this
+                            # epoch), restore, resume deterministically
+                            fault_step = step
+                            state, step = self._restore()
+                            try:
+                                self._spawn_gang(
+                                    self._world, self._gen + 1, state,
+                                    survivors=list(self._workers),
+                                )
+                            except BaseException:  # noqa: BLE001
+                                self._teardown()
+                                self._spawn_gang(
+                                    self._world, self._gen + 2, state
+                                )
+                            self._metrics["blackouts"].inc()
+                            rec = Recovery(
+                                step=fault_step, resumed_from=step,
+                                gen=self._gen, world_size=self._world,
+                                ranks_lost=0,
+                                cause="control_plane_blackout",
+                                detect_s=round(detect_s, 4),
+                                recover_s=round(time.monotonic() - t0, 4),
+                            )
+                            self.blackouts.append(rec)
+                            logger.warning(
+                                "train.blackout: plane returned after "
+                                "%.2fs; resumed from step %d at gen %d",
+                                rec.recover_s, step, self._gen,
+                            )
+                            continue
+                    # the plane never came back within blackout_wait_s:
+                    # this is a real outage, not a blip — surface it
+                    error = next(iter(faults.values()))
+                    break
                 if len(self.recoveries) >= cfg.max_recoveries:
                     error = next(iter(faults.values()))
                     break
@@ -595,6 +753,7 @@ class TrainerSupervisor:
                 final_world_size=self._world,
                 checkpoint=self._manager.latest(),
                 error=error,
+                blackouts=list(self.blackouts),
             )
         finally:
             self._teardown()

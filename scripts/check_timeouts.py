@@ -64,7 +64,13 @@ ALLOWLIST: dict[tuple[str, str, str], str] = {
     ),
 }
 
-SCAN_DIRS = ("ray_tpu/cluster", "ray_tpu/native", "ray_tpu/collective")
+SCAN_DIRS = (
+    "ray_tpu/cluster", "ray_tpu/native", "ray_tpu/collective",
+    # r13: the compiled-DAG channel plane — exec loops ride the same
+    # peer-may-die substrate as the collectives, so its reads/parks must
+    # be bounded too (ChannelTimeoutError instead of a hung loop)
+    "ray_tpu/dag",
+)
 
 
 def _has_timeout_arg(call: ast.Call) -> bool:

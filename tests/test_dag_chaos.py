@@ -1,0 +1,157 @@
+"""Compiled-DAG channel-plane chaos (r13 satellite): DROP_CHANNEL /
+STALL_CHANNEL at the dag/channels.py send/recv hooks, bounded exec-loop
+reads raising the typed ChannelTimeoutError instead of hanging, and
+clean teardown of a poisoned pipeline."""
+
+import queue
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu import chaos
+from ray_tpu.dag import InputNode
+from ray_tpu.dag.channels import (
+    Channel,
+    ChannelClosedError,
+    ChannelTimeoutError,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def rt():
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=32)
+    yield
+    chaos.uninstall()
+
+
+def test_read_bounded_by_default_typed_error():
+    """read(timeout=None) is a BOUNDED park now: expiry raises the typed
+    error; an explicit timeout keeps the legacy queue.Empty contract."""
+    ch = Channel(num_readers=1, default_timeout=0.1)
+    t0 = time.monotonic()
+    with pytest.raises(ChannelTimeoutError):
+        ch.read(0)
+    assert time.monotonic() - t0 < 5.0
+    with pytest.raises(queue.Empty):
+        ch.read(0, timeout=0.05)
+    ch.close()
+    with pytest.raises(ChannelClosedError):
+        ch.read(0)
+
+
+def test_drop_channel_lost_in_flight():
+    """A dropped write is invisible to the reader (bounded read times
+    out); the next write flows — the channel protocol itself survives."""
+    chaos.install(chaos.FaultSchedule(5, [
+        chaos.FaultSpec(chaos.DROP_CHANNEL, site="dag.channel.send",
+                        max_fires=1),
+    ]))
+    ch = Channel(num_readers=1, default_timeout=0.2)
+    ch.write("lost")
+    with pytest.raises(ChannelTimeoutError):
+        ch.read(0)
+    ch.write("kept")
+    assert ch.read(0) == "kept"
+    assert chaos.active().fired_kinds() == ["drop_channel"]
+
+
+def test_stall_channel_delays_not_drops():
+    chaos.install(chaos.FaultSchedule(5, [
+        chaos.FaultSpec(chaos.STALL_CHANNEL, site="dag.channel.send",
+                        delay_s=0.15, max_fires=1),
+    ]))
+    ch = Channel(num_readers=1)
+    t0 = time.monotonic()
+    ch.write("v")
+    assert time.monotonic() - t0 >= 0.15
+    assert ch.read(0, timeout=1.0) == "v"
+
+
+def test_drop_not_eligible_at_recv():
+    """The collective kinds' eligibility rule on the channel plane: a
+    DROP spec can never burn its budget at a recv site (nothing is in
+    flight to lose there)."""
+    sched = chaos.FaultSchedule(5, [
+        chaos.FaultSpec(chaos.DROP_CHANNEL, site="dag.channel.*",
+                        max_fires=1),
+    ])
+    chaos.install(sched)
+    ch = Channel(num_readers=1)
+    ch.write("a")          # send site: the drop fires here...
+    ch.write("b")          # ...budget spent; this delivers
+    assert ch.read(0, timeout=1.0) == "b"
+    assert [f.site for f in sched.log] == ["dag.channel.send"]
+
+
+def test_same_seed_reproduces_channel_fault_trace():
+    def drive(sched):
+        chaos.install(sched)
+        try:
+            ch = Channel(num_readers=1, default_timeout=0.05)
+            for i in range(6):
+                ch.write(i)
+                try:
+                    ch.read(0, timeout=0.2)
+                except queue.Empty:
+                    pass
+            return sched.decisions()
+        finally:
+            chaos.uninstall()
+
+    specs = lambda: [  # noqa: E731
+        chaos.FaultSpec(chaos.DROP_CHANNEL, site="dag.channel.send", p=0.5),
+        chaos.FaultSpec(chaos.STALL_CHANNEL, site="dag.channel.*", p=0.3,
+                        delay_s=0.0),
+    ]
+    t1 = drive(chaos.FaultSchedule(77, specs()))
+    t2 = drive(chaos.FaultSchedule(77, specs()))
+    assert t1 == t2 and len(t1) > 0
+
+
+@ray_tpu.remote
+class Stage:
+    def __init__(self, scale=1):
+        self.scale = scale
+
+    def mul(self, x):
+        return x * self.scale
+
+    def add(self, x, y):
+        return x + y
+
+
+def test_exec_loop_poisons_and_tears_down_on_dropped_edge(monkeypatch):
+    """The r12 ROADMAP carry-over, closed: a value dropped on a
+    cross-actor edge MID-iteration (the consumer already started on this
+    round's input) surfaces as a BOUNDED typed read timeout in its exec
+    loop, which poisons the pipeline (closes its out channels) — and
+    teardown() completes instead of hanging on a parked loop."""
+    from ray_tpu.dag import compiled as compiled_mod
+
+    monkeypatch.setattr(compiled_mod, "EXEC_READ_TIMEOUT_S", 0.5)
+    a = Stage.remote(2)
+    b = Stage.remote(10)
+    with InputNode() as inp:
+        # b consumes the input AND a's output: once b's iteration starts
+        # (input arrived), the a->b edge read is bounded-fatal
+        dag = b.add.bind(inp, a.mul.bind(inp))
+    c = dag.experimental_compile()
+    # first execute clean (pre-install: not counted by the schedule)
+    assert c.execute(3).get(timeout=30) == 9
+    # post-install sends: n0 = driver input write, n1 = the a->b edge —
+    # drop exactly that edge's value mid-iteration
+    chaos.install(chaos.FaultSchedule(9, [
+        chaos.FaultSpec(chaos.DROP_CHANNEL, site="dag.channel.send",
+                        start_after=1, max_fires=1),
+    ]))
+    ref = c.execute(5)
+    with pytest.raises(Exception):  # noqa: B017 — timeout or closed-poison
+        ref.get(timeout=5)
+    t0 = time.monotonic()
+    c.teardown()
+    assert time.monotonic() - t0 < 30, "teardown hung on a poisoned loop"
+    assert chaos.active().fired_kinds() == ["drop_channel"]

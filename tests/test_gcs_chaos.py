@@ -1,0 +1,424 @@
+"""Control-plane blackout tolerance (r13): seeded GCS outage chaos,
+write-ahead-acked registrations, reconcile-on-restart, and the
+degraded-mode data plane.
+
+Reference analog: the reference treats GCS restart as a first-class
+recovery path (Redis-backed FT, gcs_init_data.cc replay + raylet
+re-registration); here the contract is chaos-gated — a control-plane
+blackout may cost the data plane nothing but scheduling freshness.
+"""
+
+import json
+import os
+import sys
+import time
+
+import cloudpickle
+import pytest
+
+from ray_tpu import chaos
+from ray_tpu.cluster import LocalCluster
+from ray_tpu.cluster.gcs_service import GcsService
+
+pytestmark = [pytest.mark.chaos, pytest.mark.gcs_chaos]
+
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    yield
+    chaos.uninstall()
+
+
+class Counter:
+    def __init__(self, start):
+        self.v = start
+
+    def incr(self):
+        self.v += 1
+        return self.v
+
+
+# -- write-ahead ack ----------------------------------------------------------
+
+
+def test_write_ahead_ack_survives_crash_window(tmp_path):
+    """Kill -9 the GCS IMMEDIATELY after an actor-registration ack —
+    inside the old debounced-sweeper dirty window. The registration must
+    be durable (persisted before the ack), so the restarted GCS still
+    resolves the named actor; previously it was silently lost."""
+    persist = str(tmp_path / "gcs.snap")
+    with LocalCluster(node_death_timeout_s=2.0, gcs_persist_path=persist) as c:
+        c.start()
+        c.add_node({"num_cpus": 2}, node_id="wa0")
+        c.wait_for_nodes(1)
+        client = c.client()
+
+        h = client.create_actor(Counter, (100,), name="acked")
+        assert client.get(h.incr.remote(), timeout=60) == 101
+        # NO sleep: the kill lands before any debounced sweep could run
+        c.kill_gcs()
+        c.restart_gcs()
+
+        deadline = time.monotonic() + 20
+        h2 = None
+        while time.monotonic() < deadline:
+            try:
+                h2 = client.get_named_actor("acked")
+                break
+            except Exception:
+                time.sleep(0.2)
+        assert h2 is not None, "write-ahead-acked actor lost across restart"
+        # worker never died: state is intact, not re-initialized
+        assert client.get(h2.incr.remote(), timeout=60) == 102
+        ft = client.gcs.call("gcs_ft", {}, timeout=10)
+        assert ft["gcs_restarts_total"] >= 1
+        h2.kill()
+
+
+def test_stale_snapshot_reconcile_resurrects_actor(tmp_path):
+    """The snapshot is DELETED between crash and restart (worst-case
+    stale state: the GCS boots empty) — the actor still exists on its
+    worker, and the node daemon's re-registration report must resurrect
+    it, name and all, instead of the table forgetting a live actor."""
+    persist = str(tmp_path / "gcs.snap")
+    with LocalCluster(node_death_timeout_s=2.0, gcs_persist_path=persist) as c:
+        c.start()
+        c.add_node({"num_cpus": 2}, node_id="rs0")
+        c.wait_for_nodes(1)
+        client = c.client()
+
+        h = client.create_actor(Counter, (5,), name="phoenix")
+        assert client.get(h.incr.remote(), timeout=60) == 6
+        c.kill_gcs()
+        os.unlink(persist)  # the snapshot never happened
+        c.restart_gcs()
+
+        deadline = time.monotonic() + 25
+        h2 = None
+        while time.monotonic() < deadline:
+            try:
+                h2 = client.get_named_actor("phoenix")
+                break
+            except Exception:
+                time.sleep(0.2)
+        assert h2 is not None, "daemon re-report did not resurrect the actor"
+        # state intact: resurrected from ground truth, not re-created
+        assert client.get(h2.incr.remote(), timeout=60) == 7
+        ft = client.gcs.call("gcs_ft", {}, timeout=10)
+        assert ft["reconcile_actors_resurrected"] >= 1
+        assert ft["reconcile_nodes_reregistered"] >= 1
+        h2.kill()
+
+
+# -- reconcile semantics (process-free GcsService unit tests) ----------------
+
+
+def _mk_service(tmp_path, name="svc.snap"):
+    return GcsService(node_death_timeout_s=5.0,
+                      persist_path=str(tmp_path / name))
+
+
+def test_reconcile_unit_confirm_lost_and_tombstone(tmp_path):
+    """Restart a GcsService on its own snapshot and replay a node's
+    re-registration report: reported actors are confirmed, unreported
+    ones on that node take the node-death path, and a DEAD tombstone is
+    never resurrected by a stale worker report."""
+    svc = _mk_service(tmp_path)
+    svc.rpc_register_node(
+        {"node_id": "n1", "addr": ("127.0.0.1", 1), "resources": {"num_cpus": 4}},
+        None,
+    )
+    for i, name in enumerate(("kept", "gone", "dead")):
+        svc.rpc_register_actor({
+            "actor_id": bytes([i]) * 16, "name": name, "namespace": "default",
+            "node_id": "n1", "worker_addr": ("127.0.0.1", 100 + i),
+            "state": "ALIVE", "max_restarts": 0,
+        }, None)
+    svc.rpc_update_actor({"actor_id": b"\x02" * 16, "state": "DEAD"}, None)
+
+    svc2 = _mk_service(tmp_path)  # restart: loads the snapshot
+    assert svc2.ft["gcs_restarts_total"] == 1
+    # restored node claim: heartbeat demands a re-register
+    r = svc2.rpc_heartbeat({"node_id": "n1"}, None)
+    assert r.get("reregister")
+    svc2.rpc_register_node({
+        "node_id": "n1", "addr": ("127.0.0.1", 1),
+        "resources": {"num_cpus": 4},
+        "actors": [
+            {"actor_id": b"\x00" * 16, "name": "kept",
+             "namespace": "default", "worker_addr": ("127.0.0.1", 100)},
+            # stale report for the tombstoned actor: must NOT resurrect
+            {"actor_id": b"\x02" * 16, "name": "dead",
+             "namespace": "default", "worker_addr": ("127.0.0.1", 102)},
+        ],
+        "bundles": [], "leases": [],
+    }, None)
+    assert svc2._actors[b"\x00" * 16].state == "ALIVE"
+    assert svc2._actors[b"\x01" * 16].state == "DEAD"  # unreported, 0 restarts
+    assert svc2._actors[b"\x02" * 16].state == "DEAD"  # tombstone wins
+    assert svc2.ft["reconcile_actors_confirmed"] == 1
+    assert svc2.ft["reconcile_actors_lost"] == 1
+    assert svc2.ft["reconcile_nodes_reregistered"] == 1
+
+
+def test_reconcile_unit_resurrects_unknown_actor(tmp_path):
+    """An actor created after the last snapshot (restored table does not
+    know it) comes back from the node's report with its name intact."""
+    svc = _mk_service(tmp_path)
+    svc.rpc_register_node(
+        {"node_id": "n1", "addr": ("127.0.0.1", 1), "resources": {"num_cpus": 4}},
+        None,
+    )
+    svc2 = _mk_service(tmp_path)
+    svc2.rpc_register_node({
+        "node_id": "n1", "addr": ("127.0.0.1", 1),
+        "resources": {"num_cpus": 4},
+        "actors": [
+            {"actor_id": b"\x09" * 16, "name": "late", "namespace": "default",
+             "worker_addr": ("127.0.0.1", 109), "max_restarts": 2,
+             "lease_id": "L1"},
+        ],
+        "bundles": [], "leases": [],
+    }, None)
+    info = svc2.rpc_get_named_actor({"name": "late"}, None)
+    assert info is not None and info["state"] == "ALIVE"
+    assert info["max_restarts"] == 2
+    assert svc2.ft["reconcile_actors_resurrected"] == 1
+
+
+def test_reconcile_unit_adopts_bundles_and_orphans(tmp_path):
+    """Reported bundle reservations are adopted onto the pg table
+    (ground truth wins); reservations for a PG the table no longer knows
+    queue for release instead of leaking daemon resources forever."""
+    svc = _mk_service(tmp_path)
+    svc.rpc_register_node(
+        {"node_id": "n1", "addr": ("127.0.0.1", 1), "resources": {"num_cpus": 8}},
+        None,
+    )
+    pg = svc.rpc_create_pg(
+        {"pg_id": b"pg1", "bundles": [{"num_cpus": 2}]}, None
+    )
+    assert pg["state"] == "CREATED"
+
+    svc2 = _mk_service(tmp_path)
+    svc2.rpc_register_node({
+        "node_id": "n1", "addr": ("127.0.0.1", 1),
+        "resources": {"num_cpus": 8},
+        "actors": [],
+        "bundles": [
+            {"pg_id": b"pg1", "bundle_index": 0, "resources": {"num_cpus": 2}},
+            {"pg_id": b"zombie", "bundle_index": 0,
+             "resources": {"num_cpus": 1}},
+        ],
+        "leases": [],
+    }, None)
+    assert svc2.ft["reconcile_bundles_adopted"] == 1
+    assert svc2.ft["reconcile_bundles_orphaned"] == 1
+    assert svc2._pgs[b"pg1"]["bundles"][0]["node_id"] == "n1"
+    assert len(svc2._orphan_bundles) == 1
+
+
+def test_status_renders_control_plane_block(tmp_path):
+    from ray_tpu.obs.telemetry import format_status
+
+    svc = _mk_service(tmp_path)
+    svc.rpc_register_node(
+        {"node_id": "n1", "addr": ("127.0.0.1", 1), "resources": {"num_cpus": 1}},
+        None,
+    )
+    svc2 = _mk_service(tmp_path)
+    report = svc2.rpc_telemetry_status({}, None)
+    text = format_status(report)
+    assert "== control plane ==" in text
+    assert "gcs restarts 1" in text
+
+
+# -- STALL_GCS (outage without a process death) ------------------------------
+
+
+def test_stall_gcs_fires_at_gcs_call_only(tmp_path):
+    """STALL_GCS makes every GCS-bound rpc fail with transport loss in
+    its seeded window — and same seed + same call order reproduces the
+    identical fault trace."""
+    from ray_tpu.cluster.gcs_service import GcsServer
+    from ray_tpu.cluster.rpc import ReconnectingRpcClient, RpcError
+
+    server = GcsServer(port=0)
+    host, port = server.start()
+    try:
+        client = ReconnectingRpcClient(host, port, timeout=5).connect()
+        assert client.call("list_nodes", None, timeout=5) == []
+        sched = chaos.install(chaos.FaultSchedule(11, [
+            chaos.FaultSpec(chaos.STALL_GCS, site="gcs.call",
+                            start_after=1, max_fires=2),
+        ]))
+        # call 0 passes (start_after=1), calls 1-2 are the outage window,
+        # call 3 passes again — the plane "came back"
+        assert client.call("list_nodes", None, timeout=5) == []
+        for _ in range(2):
+            with pytest.raises(RpcError):
+                client.call("list_nodes", None, timeout=5)
+        assert client.call("list_nodes", None, timeout=5) == []
+        trace = sched.decisions()
+        assert trace == [("stall_gcs", "gcs.call", 0)] * 2
+        chaos.uninstall()
+
+        # determinism: replay the same call order under the same seed
+        sched2 = chaos.FaultSchedule(11, [
+            chaos.FaultSpec(chaos.STALL_GCS, site="gcs.call",
+                            start_after=1, max_fires=2),
+        ])
+        for _ in range(4):
+            sched2.fire("gcs.call", kinds=(chaos.STALL_GCS,),
+                        method="list_nodes", peer="x")
+        assert sched2.decisions() == trace
+        client.close()
+    finally:
+        server.stop()
+
+
+def test_kill_gcs_spec_validation():
+    """restart_after_s only rides KILL_GCS; KILL_GCS routes to the
+    runner (orchestrated), never the in-process hook."""
+    with pytest.raises(ValueError):
+        chaos.FaultSpec(chaos.DROP_RPC, restart_after_s=1.0)
+    spec = chaos.FaultSpec(chaos.KILL_GCS, at_s=1.0, restart_after_s=2.0)
+    sched = chaos.FaultSchedule(1, [spec])
+    assert sched.orchestrated() == [(0, spec)]
+    # the in-process hook must ignore it even at a matching site
+    assert sched.fire("gcs.call", kinds=(chaos.KILL_GCS,)) == []
+
+
+# -- trainer blackout classification -----------------------------------------
+
+
+def test_supervisor_blackout_wait_and_resume(tmp_path):
+    """A fault round with a dark control plane is a BLACKOUT: no rank is
+    blamed or killed, nothing lands in recoveries (max_recoveries
+    untouched), the supervisor waits for the probe and resumes — and the
+    resumed run is loss-identical to an uninterrupted one."""
+    import numpy as np
+
+    import ray_tpu
+
+    if not ray_tpu.is_initialized():
+        # in-process host gang: make sure the runtime has headroom no
+        # matter which test initialized it (order-robustness)
+        ray_tpu.init(num_cpus=32)
+
+    from ray_tpu.train.elastic import ElasticConfig, TrainerSupervisor
+
+    W = np.asarray([1.0, -2.0, 3.0, 0.5])
+
+    def init_fn(seed):
+        return {"w": np.zeros(4, np.float64)}
+
+    def grad_fn(state, batch):
+        x, y = batch
+        err = x @ state["w"] - y
+        return float(np.mean(err ** 2)), {"w": 2 * x.T @ err / len(y)}
+
+    def apply_fn(state, grads):
+        return {"w": state["w"] - 0.1 * grads["w"]}
+
+    def batch_fn(seed, step, world, rank):
+        from ray_tpu.train.elastic import rng_for
+
+        rng = rng_for(seed, step, rank)
+        x = rng.normal(size=(8, 4))
+        return x, x @ W
+
+    def run(root, schedule=None, probe=None):
+        if schedule is not None:
+            chaos.install(schedule)
+        try:
+            sup = TrainerSupervisor(
+                init_fn=init_fn, grad_fn=grad_fn, apply_fn=apply_fn,
+                batch_fn=batch_fn, total_steps=12, checkpoint_root=root,
+                config=ElasticConfig(
+                    world_size=2, step_timeout_s=3.0, checkpoint_every=4,
+                    sharded_checkpoints=False, control_plane_probe=probe,
+                    blackout_poll_s=0.05, blackout_wait_s=10.0,
+                ),
+            )
+            return sup.fit()
+        finally:
+            chaos.uninstall()
+
+    base = run(str(tmp_path / "base"))
+    assert base.completed
+
+    # scripted outage: dark at classification time and for two more
+    # probe polls, then the plane "returns"
+    calls = [0]
+
+    def probe():
+        calls[0] += 1
+        return calls[0] > 3
+
+    sched = chaos.FaultSchedule(3, [
+        chaos.FaultSpec(chaos.KILL_RANK, site="collective.rendezvous",
+                        max_fires=1, start_after=5, match={"rank": "1"}),
+    ])
+    res = run(str(tmp_path / "blk"), schedule=sched, probe=probe)
+    assert res.completed
+    assert len(res.recoveries) == 0, "blackout burned the recovery budget"
+    assert len(res.blackouts) == 1
+    assert res.blackouts[0].cause == "control_plane_blackout"
+    assert res.blackouts[0].ranks_lost == 0
+    assert res.losses == base.losses, "resume is not loss-identical"
+    assert calls[0] > 3  # the wait actually polled the probe
+
+
+# -- capture gate -------------------------------------------------------------
+
+
+def test_gcs_outage_capture_gates():
+    """The checked-in GCS_outage_r13.json must prove the blackout
+    contract: completion 1.0 through the outage, zero trainer recoveries
+    attributed to it (>=1 blackout ridden out, loss curve bitwise equal
+    to baseline), zero duplicate/lost actors after reconcile, exact
+    telemetry counter convergence, and the kill actually fired."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "benchmarks", "GCS_outage_r13.json",
+    )
+    with open(path) as f:
+        cap = json.load(f)
+    assert cap["bench"] == "gcs_outage" and cap["rev"] == "r13"
+    ch = cap["chaos"]
+    assert ch["serve"]["completion_rate"] == 1.0
+    assert ch["serve"]["replica_total"] == ch["serve"]["completed"]
+    assert ch["trainer"]["completed"] is True
+    assert ch["trainer"]["recoveries"] == 0
+    assert ch["trainer"]["blackouts"] >= 1
+    assert cap["loss_identical"] is True
+    assert ch["actors"]["duplicate_ids"] == 0
+    assert ch["actors"]["replicas_alive"] == 2
+    assert ch["telemetry"]["convergent"] is True
+    assert ch["gcs_ft"]["gcs_restarts_total"] >= 1
+    assert ch["gcs_ft"]["actors_pending_confirm"] == 0
+    assert "kill_gcs" in {e["kind"] for e in cap["faults_fired"]}
+
+
+@pytest.mark.slow
+def test_gcs_outage_bench_smoke(tmp_path):
+    """End-to-end bench run (slow lane): exercises KILL_GCS + restart
+    against a real cluster and enforces its own gates via exit code."""
+    import subprocess
+
+    out = str(tmp_path / "cap.json")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(os.path.dirname(os.path.dirname(
+             os.path.abspath(__file__))), "benchmarks",
+             "gcs_outage_bench.py"),
+         "--out", out, "--steps", "80", "--traffic-s", "10",
+         "--outage-at-s", "1.5"],
+        env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert os.path.exists(out)
