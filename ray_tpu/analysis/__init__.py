@@ -1,0 +1,56 @@
+"""ray_tpu.analysis — concurrency-discipline static analysis.
+
+The Python planes' answer to the C++ layers' TSAN + absl thread
+annotations (SURVEY: GCS/raylet/core_worker lean on both): a shared AST
+framework plus whole-package passes that make threading discipline a
+tier-1 gate instead of a chaos-suite lottery.
+
+Shared framework
+----------------
+ * ``walker``      — repo/package file iteration, function-stack visitor,
+                     per-class attribute/lock models (the scaffolding
+                     ``check_timeouts``/``check_metrics`` used to duplicate)
+ * ``allowlist``   — audited-exception infrastructure: every entry carries
+                     a mandatory written justification, and entries that no
+                     longer match code fail the lint (stale-entry detection)
+ * ``lockmodel``   — per-class lock inventory (Lock/RLock/Condition/
+                     Semaphore, with Condition(self._lock) aliasing) and
+                     per-method lock-held event streams
+
+Passes (each has a ``scripts/check_*.py`` CLI and a tier-1 test)
+----------------------------------------------------------------
+ * ``lock_guards``  — infer which lock guards which attribute from
+                      ``with self._lock:`` bodies; flag unguarded accesses
+ * ``lock_order``   — global lock-acquisition graph; fail on cycles and
+                      non-reentrant self-deadlocks
+ * ``blocking``     — blocking calls (RPC sends, socket recvs, sleeps,
+                      joins, kv_wait, chaos-hook fires) under a held lock
+ * ``thread_hygiene``  — every Thread is daemon or joined on shutdown
+ * ``chaos_coverage``  — every declared FaultKind has a firing site + test
+ * ``timeouts``     — unbounded blocking receives/parks (moved from
+                      scripts/check_timeouts.py onto this framework)
+ * ``metrics_registry`` — live metrics-registry lint (moved from
+                      scripts/check_metrics.py)
+
+Run everything: ``python scripts/lint_all.py`` (``--json`` for machines).
+"""
+
+from ray_tpu.analysis.allowlist import Allowlist
+from ray_tpu.analysis.walker import (
+    DEFAULT_PACKAGES,
+    FuncStackVisitor,
+    SourceFile,
+    call_name,
+    iter_files,
+    repo_root,
+)
+
+__all__ = [
+    "Allowlist",
+    "DEFAULT_PACKAGES",
+    "FuncStackVisitor",
+    "SourceFile",
+    "call_name",
+    "iter_files",
+    "repo_root",
+]

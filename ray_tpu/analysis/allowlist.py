@@ -1,0 +1,76 @@
+"""Audited-exception infrastructure shared by every analysis pass.
+
+An allowlist is how a lint stays honest at scale: a true positive the
+code is *deliberately* keeping (a forever-park in a daemon main(), an
+RPC send under a lock whose hold-invariant is documented) gets an entry
+— but every entry must carry a written justification, and an entry whose
+code disappeared FAILS the lint. A stale audited exception is a lie
+waiting to mask the next violation introduced under the same key.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+MIN_JUSTIFICATION = 10  # characters; a reason must actually say something
+
+
+class Allowlist(dict):
+    """``{key_tuple: justification}`` with used-entry tracking.
+
+    Subclasses dict so existing callers (and the check_timeouts tier-1
+    test) keep ``.items()`` / ``in`` / indexing. Passes call
+    ``permits(key)`` at each would-be violation; after the scan,
+    ``problems()`` reports unjustified and stale entries as violations
+    in their own right.
+    """
+
+    def __init__(self, entries: Optional[dict] = None, *, label: str = "allowlist"):
+        super().__init__(entries or {})
+        self.label = label
+        self.used: set = set()
+
+    def permits(self, key) -> bool:
+        """True when ``key`` is audited; marks the entry as used."""
+        if key in self:
+            self.used.add(key)
+            return True
+        return False
+
+    def unjustified(self) -> list:
+        """Keys whose justification is missing or too short to mean
+        anything."""
+        return [
+            k for k, reason in self.items()
+            if not isinstance(reason, str) or len(reason.strip()) < MIN_JUSTIFICATION
+        ]
+
+    def stale(self) -> list:
+        """Entries never consumed by the scan that just ran."""
+        return sorted(set(self) - self.used, key=str)
+
+    def problems(self) -> list[str]:
+        """Post-scan self-audit: unjustified entries + stale entries,
+        formatted like pass violations so they fail the same gate."""
+        out = []
+        for key in self.unjustified():
+            out.append(
+                f"{_key_head(key)}: {self.label} entry {_key_tail(key)} has "
+                "no written justification — say WHY the invariant holds"
+            )
+        for key in self.stale():
+            out.append(
+                f"{_key_head(key)}: stale {self.label} entry {_key_tail(key)}"
+                " — the call it audited no longer exists; remove it"
+            )
+        return out
+
+
+def _key_head(key) -> str:
+    return str(key[0]) if isinstance(key, tuple) and key else str(key)
+
+
+def _key_tail(key) -> str:
+    if isinstance(key, tuple) and len(key) > 1:
+        return "/".join(str(p) for p in key[1:])
+    return str(key)

@@ -1,0 +1,134 @@
+"""Blocking-call-under-lock: a stalled peer must never stall every other
+caller of the lock.
+
+"Exploring the limits of Concurrency in ML Training on Google TPUs"
+(PAPERS.md) makes the availability case: one thread parked under a lock
+the hot path needs stalls a whole pod. The pass flags calls that can
+block on something OUTSIDE the process-local lock discipline — an RPC
+send, a socket receive, a sleep, a thread join, a GCS ``kv_wait``, a
+chaos-hook ``fire`` (an injected DELAY would serialize behind the lock)
+— executed while any known lock is held.
+
+The one systematic exemption: ``cv.wait()`` / ``cv.wait_for()`` on a
+Condition whose lock is the ONLY lock held — waiting releases that lock;
+that is the entire point of conditions. Holding a *second* lock while
+waiting is still flagged (the wait releases only its own lock).
+
+Everything else goes through ``ALLOWLIST`` keyed by
+``(file, function, call name)`` with a written hold-invariant.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.analysis import lockmodel
+from ray_tpu.analysis.allowlist import Allowlist
+from ray_tpu.analysis.walker import DEFAULT_PACKAGES, iter_files
+
+# call names that can park the calling thread on an external event.
+# ``call`` is the cluster RPC send (cluster/client.py, rpc.py); ``fire``
+# is the chaos hook (an injected DELAY_RPC sleeps at the hook site).
+BLOCKING_CALLS = frozenset({
+    "sleep",
+    "recv", "recv_into", "recvfrom", "recv_bytes", "readexactly", "accept",
+    "connect", "sendall", "send_frame",
+    "call", "kv_wait",
+    "wait", "wait_for",
+    "join",
+    "fire",
+})
+
+ALLOWLIST = Allowlist({
+    ("cluster/rpc.py", "call", "sendall"): (
+        "_wlock IS the frame-serialization lock: writes to one socket "
+        "must be serialized, so snapshot-then-send-outside cannot exist "
+        "here. The native path bounds the write with a poll timeout "
+        "derived from the client timeout; the pure-python sendall "
+        "fallback rides the audited no-socket-timeout invariant "
+        "(check_timeouts: a timeout-mode sendall can abandon a frame "
+        "mid-write, bytes-sent indeterminate, and corrupt the stream)"
+    ),
+}, label="blocking-under-lock allowlist")
+
+
+def _condition_roots(model: lockmodel.FileModel, owner: str) -> dict[str, str]:
+    """{condition attr/global name: canonical root ident} for conditions
+    owned by ``owner`` (waiting on one releases its root)."""
+    out = {}
+    for info in model.locks.values():
+        if info.owner == owner and info.kind == "condition":
+            root = model.lock_root(info.owner, info.name)
+            if root is not None:
+                out[info.name] = root
+    return out
+
+
+def check_model(model: lockmodel.FileModel,
+                allowlist: Allowlist | None = None) -> list[str]:
+    al = ALLOWLIST if allowlist is None else allowlist
+    out = []
+    for call in model.calls:
+        if call.name not in BLOCKING_CALLS or not call.held:
+            continue
+        if _is_self_method(model, call):
+            continue  # self.wait()/self.join() on own class: the
+            # one-hop lock_order pass judges what the callee does
+        if _is_exempt_condition_wait(model, call):
+            continue
+        if call.name == "join" and not _looks_like_thread_join(call.node):
+            continue  # "-".join(parts) / os.path.join(...) are not parks
+        key = (model.rel, call.func.split(".", 1)[0], call.name)
+        if al.permits(key):
+            continue
+        held = ", ".join(sorted(call.held))
+        recv = f"{call.receiver}.{call.name}" if call.receiver else call.name
+        out.append(
+            f"{model.rel}:{call.line}: blocking {recv}() while holding "
+            f"{held} (in {call.func}) — a stalled peer stalls every "
+            "caller of the lock; snapshot under the lock, block outside it"
+        )
+    return out
+
+
+def _looks_like_thread_join(node: ast.Call) -> bool:
+    """Thread/process joins are ``t.join()`` or ``t.join(timeout)`` /
+    ``t.join(timeout=...)``; ``sep.join(iterable)`` and
+    ``os.path.join(a, b, ...)`` take string/iterable positionals."""
+    if len(node.args) > 1:
+        return False
+    if (isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Constant)):
+        return False  # "sep".join(...)
+    if node.args:
+        arg = node.args[0]
+        return (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, (int, float)))
+    return True
+
+
+def _is_self_method(model: lockmodel.FileModel, call) -> bool:
+    return (call.receiver == "self"
+            and call.name in model.class_methods.get(call.owner, ()))
+
+
+def _is_exempt_condition_wait(model: lockmodel.FileModel, call) -> bool:
+    if call.name not in ("wait", "wait_for") or call.receiver is None:
+        return False
+    cv_name = call.receiver.removeprefix("self.")
+    root = _condition_roots(model, call.owner).get(cv_name)
+    if root is None and call.owner != lockmodel.MODULE:
+        root = _condition_roots(model, lockmodel.MODULE).get(call.receiver)
+    return root is not None and call.held == frozenset({root})
+
+
+def collect_violations(packages=DEFAULT_PACKAGES, root=None,
+                       allowlist: Allowlist | None = None) -> list[str]:
+    al = ALLOWLIST if allowlist is None else allowlist
+    al.used.clear()
+    out: list[str] = []
+    for sf in iter_files(packages, root):
+        model = lockmodel.build_file_model(sf.tree, sf.rel)
+        out.extend(check_model(model, al))
+    out.extend(al.problems())
+    return out

@@ -1,0 +1,132 @@
+"""Chaos-coverage lint: a declared fault kind nobody fires or tests is
+untested robustness.
+
+``ray_tpu/chaos/schedule.py`` is the fault vocabulary; this pass holds
+it to account:
+
+ * every kind in ``KINDS`` must have >= 1 FIRING SITE — an in-process
+   ``fire(..., kinds=(..., KIND, ...))`` hook naming it, or (for the
+   runner-orchestrated kinds) an executor branch in ``chaos/runner.py``
+   referencing it;
+ * every kind must be REFERENCED BY >= 1 TEST (constant name or wire
+   string in ``tests/``) — a kind that fires but is never asserted on is
+   coverage theater.
+
+Everything is resolved from the AST (no imports), so a half-broken
+schedule module still lints. Dead kinds being *removed* is fine — the
+point is that declaration, firing, and testing move together.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ray_tpu.analysis.walker import call_name, iter_files, repo_root
+
+SCHEDULE_REL = "ray_tpu/chaos/schedule.py"
+RUNNER_REL = "ray_tpu/chaos/runner.py"
+
+
+def declared_kinds(root: str | None = None) -> dict[str, str]:
+    """{CONSTANT_NAME: wire string} for every kind in schedule.KINDS,
+    resolved statically from the module's AST."""
+    base = root or repo_root()
+    with open(os.path.join(base, SCHEDULE_REL), encoding="utf-8") as fh:
+        tree = ast.parse(fh.read())
+    consts: dict[str, str] = {}
+    kinds_names: set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if not isinstance(tgt, ast.Name):
+                continue
+            if (isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                consts[tgt.id] = node.value.value
+            elif tgt.id == "KINDS":
+                for sub in ast.walk(node.value):
+                    if isinstance(sub, ast.Name):
+                        kinds_names.add(sub.id)
+    return {name: consts[name] for name in sorted(kinds_names)
+            if name in consts}
+
+
+def firing_sites(root: str | None = None) -> dict[str, list[str]]:
+    """{CONSTANT_NAME: ["file:line", ...]} — in-process ``fire`` hook
+    sites whose ``kinds`` argument names the constant, plus runner
+    executor references in chaos/runner.py."""
+    base = root or repo_root()
+    sites: dict[str, list[str]] = {}
+
+    def add(name: str, where: str) -> None:
+        sites.setdefault(name, []).append(where)
+
+    for sf in iter_files(("ray_tpu",), base):
+        is_runner = sf.rel == RUNNER_REL.removeprefix("ray_tpu/")
+        if sf.rel.startswith("chaos/") and not is_runner:
+            continue  # the schedule/harness defining a kind isn't firing it
+        if is_runner:
+            # the runner EXECUTES orchestrated kinds: any load of the
+            # constant in an executor branch counts as its firing site
+            for node in ast.walk(sf.tree):
+                if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                    add(node.id, f"{sf.rel}:{node.lineno}")
+            continue
+        fire_lines = [
+            node.lineno for node in ast.walk(sf.tree)
+            if isinstance(node, ast.Call) and call_name(node) == "fire"
+        ]
+        if not fire_lines:
+            continue
+        # a hook file passes kinds both inline (kinds=(_chaos.X,)) and
+        # via a variable built from the constants earlier in the file —
+        # any constant reference in a file that fires counts as its site
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Attribute):
+                add(node.attr, f"{sf.rel}:{node.lineno}")
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                add(node.id, f"{sf.rel}:{node.lineno}")
+    return sites
+
+
+def test_references(root: str | None = None) -> set[str]:
+    """Raw token soup of tests/: constant names and wire strings are
+    matched textually (tests reference kinds both ways)."""
+    base = root or repo_root()
+    blob: list[str] = []
+    tests_dir = os.path.join(base, "tests")
+    for dirpath, _dirs, files in os.walk(tests_dir):
+        for f in sorted(files):
+            if f.endswith(".py"):
+                with open(os.path.join(dirpath, f), encoding="utf-8") as fh:
+                    blob.append(fh.read())
+    return _token_set("\n".join(blob))
+
+
+def _token_set(text: str) -> set[str]:
+    import re
+
+    return set(re.findall(r"[A-Za-z_][A-Za-z0-9_]*", text))
+
+
+def collect_violations(root: str | None = None) -> list[str]:
+    kinds = declared_kinds(root)
+    sites = firing_sites(root)
+    tokens = test_references(root)
+    out = []
+    for name, wire in kinds.items():
+        if not sites.get(name):
+            out.append(
+                f"{SCHEDULE_REL}: fault kind {name} ({wire!r}) has no "
+                "firing site — no fire(..., kinds=...) hook names it and "
+                "the runner does not execute it; a kind nothing can "
+                "inject is dead vocabulary"
+            )
+        if name not in tokens and wire not in tokens:
+            out.append(
+                f"{SCHEDULE_REL}: fault kind {name} ({wire!r}) is not "
+                "referenced by any test under tests/ — untested "
+                "robustness is a claim, not a property"
+            )
+    return out

@@ -1,0 +1,144 @@
+"""Lock-order deadlock detection over the global acquisition graph.
+
+Two rules, one graph:
+
+ * **cycles** — an edge L -> M exists when M is acquired while L is held:
+   nested ``with`` in one method, or one hop through a self-method call
+   (method holds L, calls ``self.m()``, m acquires M). A cycle means two
+   threads can each hold one lock and want the other — the classic
+   deadlock no test reliably reproduces and chaos only finds by luck.
+ * **non-reentrant self-acquisition** — an edge L -> L where L is a plain
+   ``Lock`` (or ``Condition`` wrapping one) is not a *potential* deadlock
+   but a CERTAIN one on any path that executes it: ``with self._lock:``
+   then a call into a method that re-takes ``_lock``. RLock/bare-
+   Condition self-edges are reentrant and ignored.
+
+Lock identity is per (file, owner, attribute): cross-file edges would
+need points-to analysis the model deliberately doesn't claim. A
+justified exception (e.g. a self-edge on a branch that provably cannot
+execute under the outer hold) goes in ``ALLOWLIST`` keyed by
+``(file, "L->M")``.
+"""
+
+from __future__ import annotations
+
+from ray_tpu.analysis import lockmodel
+from ray_tpu.analysis.allowlist import Allowlist
+from ray_tpu.analysis.walker import DEFAULT_PACKAGES, iter_files
+
+ALLOWLIST = Allowlist(label="lock-order allowlist")
+
+
+def build_edges(model: lockmodel.FileModel) -> dict[tuple, list[str]]:
+    """{(L, M): [evidence site, ...]} in canonical lock idents, scoped to
+    this file. Includes self-edges (L == M)."""
+    edges: dict[tuple, list[str]] = {}
+
+    def add(src: str, dst: str, where: str) -> None:
+        edges.setdefault((src, dst), []).append(where)
+
+    # direct nesting: with self._a: ... with self._b:
+    for acq in model.acquires:
+        for held in acq.held_before:
+            add(held, acq.lock,
+                f"{model.rel}:{acq.line} ({acq.func})")
+    # one hop through self-method calls: holder -> every lock the callee
+    # acquires anywhere in its body
+    # nested defs inside the callee run later on another stack — only
+    # the method's own body counts as "the callee acquires"
+    callee_locks: dict[tuple, set] = {}
+    for acq in model.acquires:
+        if "." in acq.func:
+            continue
+        callee_locks.setdefault((acq.owner, acq.func), set()).add(
+            (acq.lock, acq.line)
+        )
+    for call in model.self_calls:
+        if not call.held:
+            continue
+        for lock, line in sorted(callee_locks.get((call.cls, call.callee), ())):
+            for held in call.held:
+                add(held, lock,
+                    f"{model.rel}:{call.line} ({call.func} -> "
+                    f"self.{call.callee}, acquires at line {line})")
+    return edges
+
+
+def _reentrant(model: lockmodel.FileModel, ident: str) -> bool:
+    info = model.lock_info(ident)
+    if info is None:
+        return False
+    # a Condition wrapping a lock resolves to the wrapped lock before it
+    # ever reaches an edge, so `kind` here is the root's own kind
+    return info.kind in lockmodel.REENTRANT_KINDS
+
+
+def _find_cycles(edges: dict[tuple, list[str]]) -> list[list[str]]:
+    """Simple cycles of length >= 2 via DFS (the graphs here are tiny:
+    a handful of locks per file)."""
+    graph: dict[str, set] = {}
+    for (src, dst), _ev in edges.items():
+        if src != dst:
+            graph.setdefault(src, set()).add(dst)
+    cycles: list[list[str]] = []
+    seen_keys: set = set()
+
+    def dfs(start: str, node: str, path: list[str], visited: set) -> None:
+        for nxt in sorted(graph.get(node, ())):
+            if nxt == start and len(path) >= 2:
+                key = frozenset(path)
+                if key not in seen_keys:
+                    seen_keys.add(key)
+                    cycles.append(path + [start])
+            elif nxt not in visited and nxt > start:
+                # only walk nodes ordered after start: each cycle is
+                # found exactly once, rooted at its smallest node
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.remove(nxt)
+
+    for start in sorted(graph):
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+def check_model(model: lockmodel.FileModel,
+                allowlist: Allowlist | None = None) -> list[str]:
+    al = ALLOWLIST if allowlist is None else allowlist
+    edges = build_edges(model)
+    out = []
+    for (src, dst), evidence in sorted(edges.items()):
+        if src != dst:
+            continue
+        if _reentrant(model, src):
+            continue
+        if al.permits((model.rel, f"{src}->{dst}")):
+            continue
+        out.append(
+            f"{model.rel}: non-reentrant self-acquisition of {src} — "
+            f"guaranteed deadlock on this path: {'; '.join(evidence)}"
+        )
+    for cycle in _find_cycles(edges):
+        arrow = " -> ".join(cycle)
+        if al.permits((model.rel, arrow)):
+            continue
+        ev = []
+        for a, b in zip(cycle, cycle[1:]):
+            ev.append(f"{a}->{b} at {edges[(a, b)][0]}")
+        out.append(
+            f"{model.rel}: lock-order cycle {arrow} — two threads taking "
+            f"these in opposite order deadlock: {'; '.join(ev)}"
+        )
+    return out
+
+
+def collect_violations(packages=DEFAULT_PACKAGES, root=None,
+                       allowlist: Allowlist | None = None) -> list[str]:
+    al = ALLOWLIST if allowlist is None else allowlist
+    al.used.clear()
+    out: list[str] = []
+    for sf in iter_files(packages, root):
+        model = lockmodel.build_file_model(sf.tree, sf.rel)
+        out.extend(check_model(model, al))
+    out.extend(al.problems())
+    return out

@@ -1,0 +1,606 @@
+"""Per-file lock inventory + lock-held event streams.
+
+This is the shared semantic model under the three concurrency passes
+(lock_guards / lock_order / blocking): for every function and method in
+a file, WHICH locks are held at every attribute access, lock
+acquisition, and call site.
+
+Model scope (deliberate under-approximation — a lint must not lie):
+
+ * locks are ``threading.Lock/RLock/Condition/Semaphore/BoundedSemaphore``
+   bound to ``self._x`` attributes or module-level names, acquired via
+   ``with``;
+ * ``threading.Condition(self._lock)`` ALIASES the wrapped lock — holding
+   the condition is holding ``_lock`` (both resolve to one canonical
+   root), which is what makes ``with self._lock: self._cv.wait(t)``
+   analyzable;
+ * unknown context managers (obs spans, ``open``, locks reached through
+   dicts/tuples) are treated as not-a-lock: they add nothing to the held
+   set, so they can cause false NEGATIVES but never false positives;
+ * a nested ``def`` (thread target, callback) runs LATER — its body is
+   walked with an empty held set, not the definition site's.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from ray_tpu.analysis.walker import call_name
+
+# factory name -> lock kind; reentrancy matters for self-deadlock edges
+LOCK_FACTORIES = {
+    "Lock": "lock",
+    "RLock": "rlock",
+    "Condition": "condition",
+    "Semaphore": "semaphore",
+    "BoundedSemaphore": "semaphore",
+}
+REENTRANT_KINDS = frozenset({"rlock", "condition"})
+# A bare Condition() wraps an RLock, so re-entering is safe; a
+# Condition(self._lock) resolves to the wrapped lock's kind instead.
+
+# receiver methods that mutate the receiver object — a call
+# ``self._x.append(v)`` is a WRITE to the state _x guards
+MUTATOR_METHODS = frozenset({
+    "append", "appendleft", "add", "update", "extend", "insert", "remove",
+    "discard", "pop", "popleft", "popitem", "clear", "setdefault", "sort",
+    "reverse",
+})
+
+MODULE = "<module>"
+
+
+@dataclasses.dataclass
+class LockInfo:
+    owner: str                  # class name or MODULE
+    name: str                   # attribute / global name
+    kind: str                   # lock | rlock | condition | semaphore
+    line: int
+    wraps: Optional[str] = None  # Condition(self._x) -> "_x" (same owner)
+
+    @property
+    def ident(self) -> str:
+        return f"{self.owner}.{self.name}"
+
+
+@dataclasses.dataclass
+class Access:
+    """One read/write of a guard-candidate attribute or module global."""
+
+    owner: str                  # class name or MODULE
+    attr: str
+    line: int
+    write: bool
+    held: frozenset             # canonical lock idents held
+    func: str                   # "method" / "method.<locals>.inner" / func name
+
+
+@dataclasses.dataclass
+class Acquire:
+    lock: str                   # canonical lock ident
+    line: int
+    held_before: frozenset
+    func: str
+    owner: str                  # class the acquiring code lives in (or MODULE)
+
+
+@dataclasses.dataclass
+class CallEvent:
+    name: str                   # called attr/function name
+    receiver: Optional[str]     # "x" / "self.x" / None
+    line: int
+    held: frozenset
+    func: str
+    owner: str
+    node: ast.Call
+
+
+@dataclasses.dataclass
+class SelfCall:
+    cls: str
+    callee: str                 # method name on self
+    line: int
+    held: frozenset
+    func: str
+
+
+@dataclasses.dataclass
+class ThreadCreate:
+    line: int
+    func: str
+    owner: str
+    node: ast.Call
+    target_name: Optional[str] = None   # "self.x" / "x" the Thread is bound to
+    stored_into: Optional[str] = None   # container it was .append()ed into
+
+
+class FileModel:
+    """Everything the passes need to know about one source file."""
+
+    def __init__(self, rel: str):
+        self.rel = rel
+        self.locks: dict[str, LockInfo] = {}        # ident -> info
+        self.class_methods: dict[str, set[str]] = {}
+        self.module_globals: set[str] = set()
+        self.accesses: list[Access] = []
+        self.acquires: list[Acquire] = []
+        self.calls: list[CallEvent] = []
+        self.self_calls: list[SelfCall] = []
+        self.threads: list[ThreadCreate] = []
+        self.joined_names: set[str] = set()          # names .join() is called on
+        self.join_covered_containers: set[str] = set()
+        self.appends: list[tuple[str, str]] = []     # (container, appended name)
+        self.method_refs: set[tuple[str, str]] = set()  # self.m passed as value
+
+    # -- lock identity --------------------------------------------------------
+
+    def lock_root(self, owner: str, name: str) -> Optional[str]:
+        """Canonical ident for a lock reference: Condition(wrapped) chains
+        resolve to the wrapped lock (holding one IS holding the other)."""
+        seen = set()
+        cur = f"{owner}.{name}"
+        while cur in self.locks and cur not in seen:
+            seen.add(cur)
+            wraps = self.locks[cur].wraps
+            if wraps is None:
+                return cur
+            cur = f"{self.locks[cur].owner}.{wraps}"
+        return cur if cur in self.locks else None
+
+    def lock_info(self, ident: str) -> Optional[LockInfo]:
+        return self.locks.get(ident)
+
+
+def _factory_kind(call: ast.Call) -> Optional[str]:
+    """'lock'/'rlock'/... when ``call`` is a threading-primitive
+    constructor (``threading.Lock()`` or bare ``Lock()``)."""
+    name = call_name(call)
+    if name not in LOCK_FACTORIES:
+        return None
+    if isinstance(call.func, ast.Attribute):
+        base = call.func.value
+        if not (isinstance(base, ast.Name) and base.id == "threading"):
+            return None
+    return LOCK_FACTORIES[name]
+
+
+def _self_attr_of(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def build_file_model(tree: ast.Module, rel: str) -> FileModel:
+    model = FileModel(rel)
+    _collect_module_level(model, tree)
+    _collect_classes(model, tree)
+    # walk module functions and class methods with lock-context tracking
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            _ContextWalker(model, MODULE, node.name).walk(node)
+    for cls in _iter_classes(tree):
+        for item in cls.body:
+            if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                _ContextWalker(model, cls.name, item.name).walk(item)
+    _propagate_private_held(model)
+    return model
+
+
+def _propagate_private_held(model: FileModel) -> None:
+    """Call-graph-lite held-context propagation: a PRIVATE method whose
+    every visible self-call site holds lock L is analyzed as entered
+    with L held (the ``_evict_over_capacity_locked`` convention, made
+    checkable). Excluded: dunders (the runtime calls them with nothing
+    held) and methods ever passed as values (thread targets/callbacks
+    run with no context we can see). Transitive via a small fixpoint."""
+    for _ in range(6):
+        calls_by_callee: dict[tuple, list[SelfCall]] = {}
+        for sc in model.self_calls:
+            calls_by_callee.setdefault((sc.cls, sc.callee), []).append(sc)
+        entry: dict[tuple, frozenset] = {}
+        for (cls, m), sites in calls_by_callee.items():
+            if not m.startswith("_") or m.startswith("__"):
+                continue
+            if (cls, m) in model.method_refs:
+                continue
+            inter = frozenset.intersection(*[s.held for s in sites])
+            if inter:
+                entry[(cls, m)] = inter
+        changed = False
+        for ev in model.accesses + model.calls + model.self_calls:
+            owner = ev.cls if isinstance(ev, SelfCall) else ev.owner
+            if "." in ev.func:
+                continue  # nested defs run later, on another stack
+            extra = entry.get((owner, ev.func))
+            if extra and not extra <= ev.held:
+                ev.held = ev.held | extra
+                changed = True
+        for acq in model.acquires:
+            if "." in acq.func:
+                continue
+            extra = entry.get((acq.owner, acq.func))
+            if extra and not extra <= acq.held_before:
+                acq.held_before = acq.held_before | extra
+                changed = True
+        if not changed:
+            break
+
+
+def _iter_classes(tree: ast.Module):
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef):
+            yield node
+
+
+def _collect_module_level(model: FileModel, tree: ast.Module) -> None:
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name):
+                if isinstance(node.value, ast.Call):
+                    kind = _factory_kind(node.value)
+                    if kind is not None:
+                        model.locks[f"{MODULE}.{tgt.id}"] = LockInfo(
+                            MODULE, tgt.id, kind, node.lineno,
+                            wraps=_wrapped_name(node.value, module_level=True),
+                        )
+                        continue
+                model.module_globals.add(tgt.id)
+        elif isinstance(node, ast.AnnAssign) and isinstance(node.target, ast.Name):
+            model.module_globals.add(node.target.id)
+
+
+def _wrapped_name(call: ast.Call, *, module_level: bool) -> Optional[str]:
+    """``Condition(self._lock)`` / ``Condition(_lock)`` -> wrapped name."""
+    if call_name(call) != "Condition" or not call.args:
+        return None
+    arg = call.args[0]
+    if module_level and isinstance(arg, ast.Name):
+        return arg.id
+    return _self_attr_of(arg)
+
+
+def _collect_classes(model: FileModel, tree: ast.Module) -> None:
+    for cls in _iter_classes(tree):
+        methods = {
+            n.name for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        model.class_methods[cls.name] = methods
+        for node in ast.walk(cls):
+            if not isinstance(node, ast.Assign):
+                continue
+            for tgt in node.targets:
+                attr = _self_attr_of(tgt)
+                if attr is None or not isinstance(node.value, ast.Call):
+                    continue
+                kind = _factory_kind(node.value)
+                if kind is None:
+                    continue
+                model.locks[f"{cls.name}.{attr}"] = LockInfo(
+                    cls.name, attr, kind, node.lineno,
+                    wraps=_wrapped_name(node.value, module_level=False),
+                )
+
+
+class _ContextWalker:
+    """Walks ONE function/method body tracking the held-lock stack.
+
+    Nested defs/lambdas are walked as their own contexts (empty held set
+    — their bodies run later, on some other stack)."""
+
+    def __init__(self, model: FileModel, owner: str, func: str):
+        self.model = model
+        self.owner = owner          # class name or MODULE
+        self.func = func            # possibly dotted for nested defs
+        self.held: list[str] = []   # canonical lock idents (stack)
+        self.locals: set[str] = set()
+
+    # -- entry ---------------------------------------------------------------
+
+    def walk(self, fn) -> None:
+        self.locals = _local_names(fn)
+        for stmt in fn.body:
+            self._visit(stmt)
+
+    # -- held-set helpers ----------------------------------------------------
+
+    def _held(self) -> frozenset:
+        return frozenset(self.held)
+
+    def _resolve_lock(self, expr: ast.AST) -> Optional[str]:
+        attr = _self_attr_of(expr)
+        if attr is not None and self.owner != MODULE:
+            return self.model.lock_root(self.owner, attr)
+        if isinstance(expr, ast.Name) and expr.id not in self.locals:
+            return self.model.lock_root(MODULE, expr.id)
+        return None
+
+    # -- dispatch ------------------------------------------------------------
+
+    def _visit(self, node: ast.AST) -> None:
+        method = getattr(self, f"_visit_{type(node).__name__}", None)
+        if method is not None:
+            method(node)
+        else:
+            for child in ast.iter_child_nodes(node):
+                self._visit(child)
+
+    def _visit_children(self, node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            self._visit(child)
+
+    # -- nested scopes run later ---------------------------------------------
+
+    def _nested(self, node, name: str) -> None:
+        sub = _ContextWalker(self.model, self.owner,
+                             f"{self.func}.<locals>.{name}")
+        sub.walk(node)
+
+    def _visit_FunctionDef(self, node):
+        self._nested(node, node.name)
+
+    _visit_AsyncFunctionDef = _visit_FunctionDef
+
+    def _visit_Lambda(self, node):
+        sub = _ContextWalker(self.model, self.owner,
+                             f"{self.func}.<locals>.<lambda>")
+        sub.locals = _local_names(node)
+        sub._visit(node.body)
+
+    # -- with: the acquisition form ------------------------------------------
+
+    def _visit_With(self, node):
+        pushed = 0
+        for item in node.items:
+            self._visit(item.context_expr)
+            lock = self._resolve_lock(item.context_expr)
+            if lock is not None:
+                self.model.acquires.append(Acquire(
+                    lock=lock, line=item.context_expr.lineno,
+                    held_before=self._held(), func=self.func,
+                    owner=self.owner,
+                ))
+                self.held.append(lock)
+                pushed += 1
+            if item.optional_vars is not None:
+                self._visit(item.optional_vars)
+        for stmt in node.body:
+            self._visit(stmt)
+        for _ in range(pushed):
+            self.held.pop()
+
+    _visit_AsyncWith = _visit_With
+
+    # -- accesses ------------------------------------------------------------
+
+    def _record_access(self, owner: str, attr: str, line: int, write: bool):
+        if f"{owner}.{attr}" in self.model.locks:
+            return
+        if owner != MODULE and attr in self.model.class_methods.get(owner, ()):
+            # `self._m` referenced as a VALUE (thread target, callback):
+            # the method can then run with no lock context we can see, so
+            # held-context propagation must not assume its call sites
+            self.model.method_refs.add((owner, attr))
+            return
+        self.model.accesses.append(Access(
+            owner=owner, attr=attr, line=line, write=write,
+            held=self._held(), func=self.func,
+        ))
+
+    def _visit_Attribute(self, node):
+        attr = _self_attr_of(node)
+        if attr is not None and self.owner != MODULE:
+            write = isinstance(node.ctx, (ast.Store, ast.Del))
+            self._record_access(self.owner, attr, node.lineno, write)
+            return
+        # self._obj.field = v / self._map[k] = v: mutation of the object
+        # _obj/_map holds — a write to the guarded state
+        inner = _self_attr_of(node.value)
+        if (inner is not None and self.owner != MODULE
+                and isinstance(node.ctx, (ast.Store, ast.Del))):
+            self._record_access(self.owner, inner, node.lineno, write=True)
+            return
+        self._visit_children(node)
+
+    def _visit_Subscript(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            attr = _self_attr_of(node.value)
+            if attr is not None and self.owner != MODULE:
+                self._record_access(self.owner, attr, node.lineno, write=True)
+                self._visit(node.slice)
+                return
+            if (isinstance(node.value, ast.Name)
+                    and node.value.id in self.model.module_globals
+                    and node.value.id not in self.locals):
+                self._record_access(MODULE, node.value.id, node.lineno,
+                                    write=True)
+                self._visit(node.slice)
+                return
+        self._visit_children(node)
+
+    def _visit_Name(self, node):
+        if (node.id in self.model.module_globals
+                and node.id not in self.locals):
+            self._record_access(
+                MODULE, node.id, node.lineno,
+                write=isinstance(node.ctx, (ast.Store, ast.Del)),
+            )
+
+    # -- calls ---------------------------------------------------------------
+
+    def _visit_Call(self, node):
+        name = call_name(node)
+        receiver = None
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            recv_attr = _self_attr_of(fn.value)
+            if recv_attr is not None:
+                receiver = f"self.{recv_attr}"
+            elif isinstance(fn.value, ast.Name):
+                receiver = fn.value.id
+            callee_self = _self_attr_of(fn)
+            if callee_self is not None and self.owner != MODULE:
+                if callee_self in self.model.class_methods.get(self.owner, ()):
+                    self.model.self_calls.append(SelfCall(
+                        cls=self.owner, callee=callee_self, line=node.lineno,
+                        held=self._held(), func=self.func,
+                    ))
+                else:
+                    # self._cb(...) — a read of the attr holding the callable
+                    self._record_access(self.owner, callee_self,
+                                        node.lineno, write=False)
+            elif recv_attr is not None:
+                # self._x.append(v): mutator calls write the guarded state
+                self._record_access(self.owner, recv_attr, node.lineno,
+                                    write=name in MUTATOR_METHODS)
+            elif (isinstance(fn.value, ast.Name)
+                  and fn.value.id in self.model.module_globals
+                  and fn.value.id not in self.locals):
+                # _REG.pop(k): mutator calls write the guarded global
+                self._record_access(MODULE, fn.value.id, node.lineno,
+                                    write=name in MUTATOR_METHODS)
+            else:
+                self._visit(fn.value)
+        elif isinstance(fn, ast.Name):
+            self._visit_Name(fn)
+        else:
+            self._visit(fn)
+
+        if name is not None:
+            self.model.calls.append(CallEvent(
+                name=name, receiver=receiver, line=node.lineno,
+                held=self._held(), func=self.func, owner=self.owner,
+                node=node,
+            ))
+        self._record_thread_ops(name, receiver, node)
+        for arg in node.args:
+            self._visit(arg)
+        for kw in node.keywords:
+            self._visit(kw.value)
+        if (name == "append" and receiver is not None and len(node.args) == 1
+                and isinstance(node.args[0], ast.Call) and self.model.threads
+                and self.model.threads[-1].node is node.args[0]):
+            self.model.threads[-1].stored_into = receiver
+
+    # -- thread hygiene raw facts --------------------------------------------
+
+    def _record_thread_ops(self, name, receiver, node: ast.Call) -> None:
+        if name == "Thread":
+            ok_receiver = receiver in (None, "threading")
+            if ok_receiver:
+                self.model.threads.append(ThreadCreate(
+                    line=node.lineno, func=self.func, owner=self.owner,
+                    node=node,
+                ))
+        elif name == "join" and receiver is not None:
+            self.model.joined_names.add(receiver)
+        elif name == "append" and receiver is not None and len(node.args) == 1:
+            arg = node.args[0]
+            if isinstance(arg, ast.Name):
+                self.model.appends.append((receiver, arg.id))
+
+    def _visit_For(self, node):
+        # join-coverage: ``for t in self._threads: t.join()`` marks the
+        # container as joined, covering every thread appended into it
+        if isinstance(node.target, ast.Name):
+            container = self._container_of(node.iter)
+            if container is not None:
+                tv = node.target.id
+                for sub in ast.walk(node):
+                    if (isinstance(sub, ast.Call) and call_name(sub) == "join"
+                            and isinstance(sub.func, ast.Attribute)
+                            and isinstance(sub.func.value, ast.Name)
+                            and sub.func.value.id == tv):
+                        self.model.join_covered_containers.add(container)
+                        break
+        self._visit_children(node)
+
+    def _container_of(self, it: ast.AST) -> Optional[str]:
+        attr = _self_attr_of(it)
+        if attr is not None:
+            return f"self.{attr}"
+        if isinstance(it, ast.Name):
+            return it.id
+        if isinstance(it, ast.Call):  # list(ts) / sorted(self._threads)
+            for a in it.args:
+                aa = _self_attr_of(a)
+                if aa is not None:
+                    return f"self.{aa}"
+                if isinstance(a, ast.Name):
+                    return a.id
+        return None
+
+    # -- assignment forms feed both accesses and thread targets --------------
+
+    def _visit_Assign(self, node):
+        self._visit(node.value)
+        for tgt in node.targets:
+            self._visit(tgt)
+        self._maybe_bind_thread(node.value, node.targets)
+
+    def _visit_AugAssign(self, node):
+        # x += v reads AND writes x
+        self._visit(node.value)
+        tgt = node.target
+        attr = _self_attr_of(tgt)
+        if attr is not None and self.owner != MODULE:
+            self._record_access(self.owner, attr, tgt.lineno, write=True)
+            self._record_access(self.owner, attr, tgt.lineno, write=False)
+        else:
+            self._visit(tgt)
+
+    def _maybe_bind_thread(self, value: ast.AST, targets: list) -> None:
+        """``t = threading.Thread(...)`` / ``self._t = Thread(...)`` —
+        remember what name the thread landed in (join-coverage)."""
+        if not (isinstance(value, ast.Call) and self.model.threads):
+            return
+        last = self.model.threads[-1]
+        if last.node is not value or len(targets) != 1:
+            return
+        tgt = targets[0]
+        attr = _self_attr_of(tgt)
+        if attr is not None:
+            last.target_name = f"self.{attr}"
+        elif isinstance(tgt, ast.Name):
+            last.target_name = tgt.id
+
+
+def _local_names(fn) -> set[str]:
+    """Names bound locally in ``fn`` (params + assignments), so global
+    reads aren't confused with locals shadowing them. Names under a
+    ``global`` declaration stay global."""
+    names: set[str] = set()
+    args = fn.args
+    for a in (list(args.posonlyargs) + list(args.args)
+              + list(args.kwonlyargs)):
+        names.add(a.arg)
+    if args.vararg:
+        names.add(args.vararg.arg)
+    if args.kwarg:
+        names.add(args.kwarg.arg)
+    declared_global: set[str] = set()
+
+    def scan(node: ast.AST) -> None:
+        # manual recursion so nested def/lambda subtrees are PRUNED —
+        # ast.walk would keep descending and a name assigned only inside
+        # a nested scope would wrongly shadow the module global in the
+        # outer body (suppressing lock_guards events for it)
+        if isinstance(node, ast.Global):
+            declared_global.update(node.names)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested scopes collect their own locals
+        if isinstance(node, ast.Name) and isinstance(node.ctx, ast.Store):
+            names.add(node.id)
+        for child in ast.iter_child_nodes(node):
+            scan(child)
+
+    body = fn.body if isinstance(fn.body, list) else [fn.body]
+    for stmt in body:
+        scan(stmt)
+    return names - declared_global

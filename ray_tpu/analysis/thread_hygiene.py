@@ -1,0 +1,87 @@
+"""Thread-hygiene lint: every ``threading.Thread`` must be daemonized or
+joined on a reachable shutdown path.
+
+A non-daemon thread nobody joins keeps the process alive after main()
+returns — the bench-helper hang — and a *daemon* thread nobody joins is
+fine for the interpreter but still a leak if its loop pins resources.
+The enforced rule is the cheap, checkable core: ``daemon=True`` at
+construction, OR the thread object lands somewhere (``self._t = ...``,
+``t = ...``, ``pool.append(t)``) that a ``.join()`` in the same file
+reaches (direct ``name.join()``, or ``for t in pool: t.join()`` covering
+the container it was appended into).
+
+Exceptions (e.g. a thread whose join lives in another module) go in
+``ALLOWLIST`` keyed by ``(file, function)`` with a written reason.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ray_tpu.analysis import lockmodel
+from ray_tpu.analysis.allowlist import Allowlist
+from ray_tpu.analysis.walker import DEFAULT_PACKAGES, has_kwarg, iter_files
+
+ALLOWLIST = Allowlist(label="thread-hygiene allowlist")
+
+# this pass also scans the bench helpers: driver threads leaked there
+# hang the bench process exactly like a leaked runtime thread would.
+# Single source of truth for the CLI, the umbrella runner, and the
+# tier-1 gate.
+SCAN_PACKAGES = tuple(DEFAULT_PACKAGES) + ("benchmarks",)
+
+
+def _daemon_true(node) -> bool:
+    for kw in node.keywords:
+        if kw.arg == "daemon":
+            return isinstance(kw.value, ast.Constant) and kw.value.value is True
+    return False
+
+
+def check_model(model: lockmodel.FileModel,
+                allowlist: Allowlist | None = None) -> list[str]:
+    al = ALLOWLIST if allowlist is None else allowlist
+    # containers whose elements get joined, plus names appended into them
+    covered_names: set[str] = set(model.joined_names)
+    for container, member in model.appends:
+        if container in model.join_covered_containers:
+            covered_names.add(member)
+    out = []
+    for th in model.threads:
+        if _daemon_true(th.node):
+            continue
+        if has_kwarg(th.node, "daemon"):
+            # daemon=<expr>: defer to the expression's author
+            continue
+        target = th.target_name
+        joined = (
+            (target is not None and target in covered_names)
+            or (th.stored_into is not None
+                and th.stored_into in model.join_covered_containers)
+        )
+        if joined:
+            continue
+        key = (model.rel, th.func.split(".", 1)[0])
+        if al.permits(key):
+            continue
+        out.append(
+            f"{model.rel}:{th.line}: Thread created in {th.func} is neither "
+            "daemon=True nor joined on any path in this file — a leaked "
+            "non-daemon thread outlives main(); pass daemon=True or join "
+            "it on the shutdown path"
+        )
+    return out
+
+
+def collect_violations(packages=None, root=None,
+                       allowlist: Allowlist | None = None) -> list[str]:
+    if packages is None:
+        packages = SCAN_PACKAGES
+    al = ALLOWLIST if allowlist is None else allowlist
+    al.used.clear()
+    out: list[str] = []
+    for sf in iter_files(packages, root):
+        model = lockmodel.build_file_model(sf.tree, sf.rel)
+        out.extend(check_model(model, al))
+    out.extend(al.problems())
+    return out

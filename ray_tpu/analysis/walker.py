@@ -1,0 +1,143 @@
+"""Shared AST walking scaffolding for the analysis passes.
+
+One place owns "find the repo, iterate a package's Python files, parse
+them, track the enclosing-function stack" so each pass is only its rule.
+``check_timeouts`` and ``check_metrics`` predate this module and carried
+private copies; they now ride it (scripts/ keeps thin CLI shims).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Iterable, Iterator, Optional
+
+# The heavily-threaded planes every concurrency pass scans by default.
+# util/ is deliberately out of scope here: its primitives (metrics
+# registry, queues) are the *implementations* the passes model, and the
+# registry lint covers them through the live registry instead.
+DEFAULT_PACKAGES = (
+    "ray_tpu/cluster",
+    "ray_tpu/serve",
+    "ray_tpu/llm",
+    "ray_tpu/collective",
+    "ray_tpu/dag",
+    "ray_tpu/core",
+    "ray_tpu/obs",
+    "ray_tpu/train",
+    "ray_tpu/chaos",
+    # the native socket/shm plane rides the same peer-may-die substrate
+    # the timeouts pass already scans — the lock passes cover it too
+    "ray_tpu/native",
+)
+
+
+def repo_root() -> str:
+    """The repository root (two levels above this file's package)."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@dataclasses.dataclass
+class SourceFile:
+    """One parsed module. ``rel`` is the repo-relative path with "/"
+    separators and the leading ``ray_tpu/`` stripped — the key form the
+    allowlists and violation strings use (stable across checkouts)."""
+
+    rel: str
+    path: str
+    source: str
+    tree: ast.Module
+
+
+def rel_key(path: str, root: str) -> str:
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    return rel.removeprefix("ray_tpu/")
+
+
+def iter_files(packages: Iterable[str] = DEFAULT_PACKAGES,
+               root: Optional[str] = None) -> Iterator[SourceFile]:
+    """Yield every ``.py`` file under the given repo-relative package
+    dirs, parsed, in deterministic (sorted) order."""
+    base_root = root or repo_root()
+    for pkg in packages:
+        pkg_dir = os.path.join(base_root, pkg.replace("/", os.sep))
+        for dirpath, dirs, files in os.walk(pkg_dir):
+            dirs.sort()
+            for f in sorted(files):
+                if not f.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, f)
+                with open(path, encoding="utf-8") as fh:
+                    source = fh.read()
+                yield SourceFile(
+                    rel=rel_key(path, base_root),
+                    path=path,
+                    source=source,
+                    tree=ast.parse(source),
+                )
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """The called name: ``attr`` for ``x.attr(...)``, ``id`` for
+    ``name(...)``, None for anything fancier."""
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return None
+
+
+def call_receiver(node: ast.Call) -> Optional[str]:
+    """For ``x.attr(...)``: ``x`` if the receiver is a bare name,
+    ``self.y`` if it is a self attribute; else None."""
+    if not isinstance(node.func, ast.Attribute):
+        return None
+    val = node.func.value
+    if isinstance(val, ast.Name):
+        return val.id
+    if (isinstance(val, ast.Attribute) and isinstance(val.value, ast.Name)
+            and val.value.id == "self"):
+        return f"self.{val.attr}"
+    return None
+
+
+def has_kwarg(node: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in node.keywords)
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """``x`` when ``node`` is exactly ``self.x``; else None."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class FuncStackVisitor(ast.NodeVisitor):
+    """NodeVisitor that maintains the enclosing-function-name stack —
+    the scope scaffolding every pass needs. Subclasses read
+    ``self.func_stack`` / ``self.scope()`` and may override
+    ``enter_function``/``leave_function`` for per-scope state."""
+
+    def __init__(self) -> None:
+        self.func_stack: list[str] = []
+
+    def scope(self) -> str:
+        return self.func_stack[-1] if self.func_stack else "<module>"
+
+    def enter_function(self, node) -> None:  # pragma: no cover - hook
+        pass
+
+    def leave_function(self, node) -> None:  # pragma: no cover - hook
+        pass
+
+    def _visit_func(self, node) -> None:
+        self.func_stack.append(node.name)
+        self.enter_function(node)
+        self.generic_visit(node)
+        self.leave_function(node)
+        self.func_stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func

@@ -738,12 +738,14 @@ class GcsService:
                 )
             except (RpcError, RemoteError):
                 pass
-        if self._restore_t is None or not self._needs_confirm:
-            return
         grace = max(2 * self._death_timeout, 3.0)
-        if time.monotonic() - self._restore_t < grace:
-            return
         with self._lock:
+            # invariant: _needs_confirm is only read/cleared under _lock —
+            # the restore path populates it concurrently with this sweep
+            if self._restore_t is None or not self._needs_confirm:
+                return
+            if time.monotonic() - self._restore_t < grace:
+                return
             stale, self._needs_confirm = self._needs_confirm, set()
             for aid in stale:
                 a = self._actors.get(aid)

@@ -1431,6 +1431,10 @@ class NodeDaemon:
         )
 
     def rpc_stats(self, payload, peer):
+        # invariant: _all_workers is _wlock state — snapshot it under its
+        # own lock BEFORE _res_lock (never nested: lock-order discipline)
+        with self._wlock:
+            num_workers = len(self._all_workers)
         with self._res_lock:
             return {
                 "node_id": self.node_id,
@@ -1438,7 +1442,7 @@ class NodeDaemon:
                 "available": dict(self.available),
                 "num_leases": len(self._leases),
                 "num_oom_kills": self._oom_kills,
-                "num_workers": len(self._all_workers),
+                "num_workers": num_workers,
                 "objects": self.objects.stats(),
             }
 

@@ -480,9 +480,21 @@ class ReconnectingRpcClient:
             c = self._client
             if c is not None and c.connected:
                 return c
-            c = RpcClient(*self.addr, timeout=self._timeout).connect(
-                retries=self._retries
-            )
+        # dial OUTSIDE the lock (same discipline as ClientPool.get):
+        # holding _lock through a connect timeout x retries would wedge
+        # every concurrent caller behind one dead peer
+        c = RpcClient(*self.addr, timeout=self._timeout).connect(
+            retries=self._retries
+        )
+        with self._lock:
+            if self._closed:
+                c.close()
+                raise RpcError(f"client to {self.addr} closed")
+            existing = self._client
+            if existing is not None and existing.connected:
+                # another thread won the dial race; keep theirs
+                c.close()
+                return existing
             self._client = c
             return c
 

@@ -152,7 +152,13 @@ class _HostGroup:
                 + f" aborted: {self._aborted}",
                 group=self.name, gen=self.gen, rank=rank,
             )
-        current = _generations.get(self.name, self.gen)
+        with _lock:
+            # _generations is _lock state: an unlocked peek could let a
+            # zombie rank read a pre-re-form generation and keep waiting
+            # a full timeout instead of exiting as stale NOW (the module
+            # _lock regions never take a group's _cv, so cv -> _lock
+            # nesting here is acyclic — lock_order-pass checked)
+            current = _generations.get(self.name, self.gen)
         if current > self.gen:
             raise StaleGenerationError(
                 f"collective group {self.name!r} re-formed at gen {current}; "

@@ -108,7 +108,11 @@ class Actor:
 
     def _stale(self, mailbox: queue.Queue) -> bool:
         """True if this thread's mailbox was swapped out by a restart."""
-        return mailbox is not self._mailbox
+        with self._lock:
+            # the restart path (kill with restarts left) swaps _mailbox
+            # under _lock; an unlocked read here could let a dying
+            # incarnation mark the RESTARTED actor DEAD in _drain_dead
+            return mailbox is not self._mailbox
 
     def _sync_main(self, mailbox: queue.Queue) -> None:
         conc = max(1, self.options.max_concurrency)

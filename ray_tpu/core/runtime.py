@@ -43,7 +43,12 @@ class Runtime:
         namespace: str = "default",
     ):
         if num_cpus is None:
-            num_cpus = float(os.cpu_count() or 1)
+            # RAY_TPU_NUM_CPUS overrides the physical core count: local
+            # actors are THREADS, so the CPU resource is a logical
+            # concurrency budget — a 1-core CI box must still run a
+            # world_size=2 gang (tests/conftest.py sets a floor of 8)
+            env_cpus = os.environ.get("RAY_TPU_NUM_CPUS")
+            num_cpus = float(env_cpus) if env_cpus else float(os.cpu_count() or 1)
         if num_tpus is None:
             num_tpus = _detect_tpu_chips()
         total = dict(resources or {})
@@ -258,4 +263,5 @@ def shutdown_runtime() -> None:
 
 
 def is_initialized() -> bool:
-    return _runtime is not None
+    with _runtime_lock:
+        return _runtime is not None

@@ -149,7 +149,9 @@ class _WriterServer:
 
             at = threading.Thread(target=ack_loop, daemon=True)
             at.start()
-            sent = self.acked[reader_idx]  # resume after reconnect
+            with self.cond:
+                # resume point races ack_loop's writes — read under cond
+                sent = self.acked[reader_idx]
             while True:
                 with self.cond:
                     while (sent + 1) not in self.buffer and not self.closed:

@@ -221,7 +221,9 @@ class RpcKVConnector(KVConnector):
                 srv.route("kv_put", self._on_kv_put)
                 srv.start()
                 self._server = srv
-        return self._server
+            # invariant: _server is only read under _lock; returning the
+            # local binding keeps the read inside the critical section
+            return self._server
 
     def _on_kv_put(self, payload, peer):
         target_id = payload["target"]

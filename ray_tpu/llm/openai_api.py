@@ -25,14 +25,20 @@ import threading
 import time
 import uuid
 from dataclasses import dataclass, field
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 from ray_tpu import obs
 from ray_tpu.llm.engine import EngineConfig, LLMEngine, RequestOutput
 from ray_tpu.llm.sampling import SamplingParams
+
+
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("ray_tpu.llm.openai_api")
+
+
+def _noop() -> None:
+    """Release placeholder for rejected admissions (nothing reserved)."""
 
 
 # ---------------------------------------------------------------------------
@@ -338,6 +344,9 @@ class LLMServer:
         acfg = config.admission
         if isinstance(acfg, dict):
             acfg = AdmissionConfig(**acfg)
+        # admission reservation state: see _admission_check
+        self._admit_lock = threading.Lock()
+        self._admit_reserved = 0
         self.admission = AdmissionController(
             acfg or AdmissionConfig(), model_tag=config.model_id
         )
@@ -406,22 +415,29 @@ class LLMServer:
         )
 
     async def _run(self, prompt_ids: list, sp: SamplingParams,
-                   request_id: Optional[str] = None):
+                   request_id: Optional[str] = None,
+                   on_enqueued: Optional[Callable[[], None]] = None):
         """Async generator of RequestOutput. The ambient TraceContext is
         captured HERE (the caller's asyncio task) and handed to the
         engine explicitly — the engine loop is a separate thread where
         the contextvar is invisible."""
         loop = asyncio.get_running_loop()
-        if self.orchestrator is not None:
-            rid, q = self.orchestrator.submit(
-                prompt_ids, sp, request_id=request_id, trace=obs.current()
-            )
-            aborter = self.orchestrator.abort
-        else:
-            rid, q = self.runner.submit(
-                prompt_ids, sp, request_id=request_id, trace=obs.current()
-            )
-            aborter = self.runner.abort
+        try:
+            if self.orchestrator is not None:
+                rid, q = self.orchestrator.submit(
+                    prompt_ids, sp, request_id=request_id, trace=obs.current()
+                )
+                aborter = self.orchestrator.abort
+            else:
+                rid, q = self.runner.submit(
+                    prompt_ids, sp, request_id=request_id, trace=obs.current()
+                )
+                aborter = self.runner.abort
+        finally:
+            # the admission reservation hands over to the real queue entry
+            # here (or dies with a failed submit) — never held past this
+            if on_enqueued is not None:
+                on_enqueued()
         try:
             while True:
                 out: Optional[RequestOutput] = await loop.run_in_executor(None, q.get)
@@ -436,9 +452,11 @@ class LLMServer:
             aborter(rid)
 
     async def _generate_text(self, prompt_ids: list, sp: SamplingParams,
-                             request_id: Optional[str] = None):
+                             request_id: Optional[str] = None,
+                             on_enqueued: Optional[Callable[[], None]] = None):
         toks, reason = [], None
-        async for out in self._run(prompt_ids, sp, request_id=request_id):
+        async for out in self._run(prompt_ids, sp, request_id=request_id,
+                                   on_enqueued=on_enqueued):
             toks = out.output_token_ids
             reason = out.finish_reason
         # strip eos token from the visible text
@@ -455,18 +473,31 @@ class LLMServer:
         keep admitting via the streaming side door (that would hold
         has_unfinished() true and make every drain time out). Streams
         can't return an error payload, so rejection raises."""
-        rej = self._admission_check()
+        rej, admit_done = self._admission_check()
         if rej is not None:
             err = rej["error"]
             raise RuntimeError(
                 f"admission rejected ({err['code']}): {err['message']}; "
                 f"retry after {err['retry_after']}s"
             )
-        sp = self._sampling_from_body(kwargs)
-        ids = self.tokenizer.encode(prompt)
+        try:
+            sp = self._sampling_from_body(kwargs)
+            ids = self.tokenizer.encode(prompt)
+        except BaseException:
+            admit_done()  # the reservation must not outlive a dead arrival
+            raise
+        try:
+            async for delta in self._stream_deltas(ids, sp, admit_done):
+                yield delta
+        finally:
+            # idempotent backstop: covers a generator abandoned before its
+            # first iteration ever reached _run's submit (fires on close/GC)
+            admit_done()
+
+    async def _stream_deltas(self, ids, sp, admit_done):
         sent = ""
         first_mark = False
-        async for out in self._run(ids, sp):
+        async for out in self._run(ids, sp, on_enqueued=admit_done):
             toks = out.output_token_ids
             if toks and toks[-1] == self.engine.config.eos_token_id:
                 toks = toks[:-1]
@@ -599,20 +630,45 @@ class LLMServer:
         out["telemetry"] = snapshot_meta()
         return out
 
-    def _admission_check(self) -> Optional[dict]:
-        """Load-shedding decision for one arriving request (None = admit)."""
-        if self.orchestrator is not None:
-            depths = self.orchestrator.queue_depths()
-            return self.admission.check(
-                num_waiting=sum(depths["prefill"]),
-                num_running=sum(depths["decode"]),
-            )
-        with self.runner.lock:
-            num_waiting = len(self.engine.waiting)
-            num_running = len(self.engine.running)
-        return self.admission.check(
-            num_waiting=num_waiting, num_running=num_running
-        )
+    def _admission_check(self) -> tuple[Optional[dict], Callable[[], None]]:
+        """Load-shedding decision for one arriving request.
+
+        Returns ``(rejection, release)``. On admit (rejection None) a
+        RESERVATION is counted against the queue depth until ``release()``
+        runs (idempotent; _run fires it once the request is actually in
+        the engine queue, the handler's finally is the backstop).
+        Without the reservation, N concurrent arrivals could ALL pass the
+        depth check before any of them enqueues — the check-then-enqueue
+        race that let a 24-wide burst sail past max_queue_depth=3
+        un-shed (caught tuning the overload chaos test)."""
+        with self._admit_lock:
+            if self.orchestrator is not None:
+                depths = self.orchestrator.queue_depths()
+                rej = self.admission.check(
+                    num_waiting=sum(depths["prefill"]) + self._admit_reserved,
+                    num_running=sum(depths["decode"]),
+                )
+            else:
+                with self.runner.lock:
+                    num_waiting = len(self.engine.waiting)
+                    num_running = len(self.engine.running)
+                rej = self.admission.check(
+                    num_waiting=num_waiting + self._admit_reserved,
+                    num_running=num_running,
+                )
+            if rej is not None:
+                return rej, _noop
+            self._admit_reserved += 1
+
+        released = [False]
+
+        def release() -> None:
+            if not released[0]:
+                released[0] = True
+                with self._admit_lock:
+                    self._admit_reserved -= 1
+
+        return None, release
 
     def models(self) -> dict:
         return {
@@ -641,9 +697,18 @@ class LLMServer:
         }
 
     async def completions(self, body: dict) -> Any:
-        rej = self._admission_check()
+        rej, admit_done = self._admission_check()
         if rej is not None:
             return rej
+        try:
+            return await self._completions_admitted(body, admit_done)
+        finally:
+            # idempotent backstop: a no-op when _run already handed the
+            # reservation to the engine queue; otherwise (parse error,
+            # encode failure, empty prompt list) the reservation dies here
+            admit_done()
+
+    async def _completions_admitted(self, body: dict, admit_done) -> Any:
         try:
             sp = self._sampling_from_body(body)
         except (ValueError, TypeError) as e:
@@ -661,12 +726,14 @@ class LLMServer:
             "num_prompts": len(prompts),
         }) as ctx:
             id_lists = [self.tokenizer.encode(str(p)) for p in prompts]
-            # one choice per prompt, generated concurrently via the engine
+            # one choice per prompt, generated concurrently via the engine;
+            # the single admission reservation rides the first submit
             results = await asyncio.gather(
                 *[
                     self._generate_text(
                         ids, sp,
                         request_id=rid if len(id_lists) == 1 else f"{rid}-{i}",
+                        on_enqueued=admit_done if i == 0 else None,
                     )
                     for i, ids in enumerate(id_lists)
                 ]
@@ -699,9 +766,15 @@ class LLMServer:
         return payload
 
     async def chat_completions(self, body: dict) -> Any:
-        rej = self._admission_check()
+        rej, admit_done = self._admission_check()
         if rej is not None:
             return rej
+        try:
+            return await self._chat_completions_admitted(body, admit_done)
+        finally:
+            admit_done()  # idempotent backstop, see completions()
+
+    async def _chat_completions_admitted(self, body: dict, admit_done) -> Any:
         try:
             sp = self._sampling_from_body(body)
         except (ValueError, TypeError) as e:
@@ -715,7 +788,9 @@ class LLMServer:
         }) as ctx:
             prompt = default_chat_template(messages)
             ids = self.tokenizer.encode(prompt)
-            text, toks, reason = await self._generate_text(ids, sp, request_id=rid)
+            text, toks, reason = await self._generate_text(
+                ids, sp, request_id=rid, on_enqueued=admit_done
+            )
             payload = {
                 "id": rid,
                 "object": "chat.completion",

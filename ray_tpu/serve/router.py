@@ -67,6 +67,12 @@ class Router:
         import ray_tpu
 
         now = time.time()
+        # invariant (lock-guard allowlist): this staleness fast-path reads
+        # _replicas/_last_refresh WITHOUT _lock on purpose — both are
+        # GIL-atomic reads, a stale value costs at most one redundant
+        # refresh RPC or 0.25s of extra staleness, and taking _lock here
+        # measurably serializes the dispatch fan-out (overload shedding
+        # depends on concurrent arrivals; see test_overload_sheds_429)
         if not block and self._replicas and now - self._last_refresh < 0.25:
             return
         try:
@@ -99,8 +105,9 @@ class Router:
         deadline = time.time() + timeout
         while time.time() < deadline:
             self._refresh(block=True)
-            if self._replicas:
-                return
+            with self._lock:
+                if self._replicas:
+                    return
             time.sleep(0.05)
         raise TimeoutError(
             f"no running replicas for deployment "
@@ -168,13 +175,15 @@ class Router:
         if len(replicas) == 1:
             return replicas[0]
         a, b = random.sample(replicas, 2)
+        # invariant (lock-guard allowlist): p2c is a heuristic — these are
+        # GIL-atomic int reads and a stale counter only skews ONE pick
+        # toward the busier replica; the accounting itself (increment on
+        # dispatch, decrement on completion) stays under _lock. Locking
+        # here would put a hot mutex on every dispatch for zero
+        # correctness gain.
         na = self._inflight.get(a[0], 0)
         nb = self._inflight.get(b[0], 0)
         return a if na <= nb else b
-
-    def total_inflight(self) -> int:
-        with self._lock:
-            return sum(self._inflight.values())
 
     def replica_ids(self, refresh: bool = True) -> list[str]:
         """Current running replica ids (pool enumeration for pool-aware
@@ -217,9 +226,13 @@ class Router:
         request's trace."""
         t0 = time.perf_counter()
         self._refresh()
-        if self._max_queued >= 0 and self.total_inflight() >= self._max_queued + len(
-            self._replicas
-        ):
+        with self._lock:
+            # one consistent snapshot: inflight sum and replica count move
+            # together under _lock, so backpressure prices a real state
+            over_queued = self._max_queued >= 0 and sum(
+                self._inflight.values()
+            ) >= self._max_queued + len(self._replicas)
+        if over_queued:
             raise BackpressureError(
                 f"deployment {self._app}/{self._deployment}: "
                 f"max_queued_requests={self._max_queued} exceeded"

@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Blocking-call-under-lock lint (CI gate, imported as a tier-1 test).
+
+Flags RPC sends, socket recvs, sleeps, joins, ``kv_wait`` parks, and
+chaos-hook ``fire`` sites executed while holding a lock: a stalled peer
+must never stall every other caller of that lock. Condition waits on
+their own lock are exempt (waiting releases it). Rules + allowlist:
+``ray_tpu/analysis/blocking.py``.
+
+Run standalone: ``python scripts/check_blocking_under_lock.py``
+(exit 1 on problems).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from ray_tpu.analysis.blocking import (  # noqa: E402,F401 — re-exported
+    ALLOWLIST,
+    BLOCKING_CALLS,
+    check_model,
+    collect_violations,
+)
+
+
+def main() -> int:
+    problems = collect_violations()
+    if problems:
+        print(f"check_blocking_under_lock: {len(problems)} problem(s)")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print("check_blocking_under_lock: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

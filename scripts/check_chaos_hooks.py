@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""Chaos-coverage lint (CI gate, imported as a tier-1 test).
+
+Every ``FaultKind`` declared in ``ray_tpu/chaos/schedule.py`` must have
+at least one firing site (an in-process ``fire(...)`` hook naming it or
+a runner executor branch) AND at least one test referencing it — a dead
+fault kind is untested robustness. Logic:
+``ray_tpu/analysis/chaos_coverage.py``.
+
+Run standalone: ``python scripts/check_chaos_hooks.py`` (exit 1 on problems).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from ray_tpu.analysis.chaos_coverage import (  # noqa: E402,F401 — re-exported
+    collect_violations,
+    declared_kinds,
+    firing_sites,
+    test_references,
+)
+
+
+def main() -> int:
+    problems = collect_violations()
+    if problems:
+        print(f"check_chaos_hooks: {len(problems)} problem(s)")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    kinds = declared_kinds()
+    print(f"check_chaos_hooks: ok ({len(kinds)} fault kinds fired + tested)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Lock-guard inference lint (CI gate, imported as a tier-1 test).
+
+Infers which ``threading`` lock guards which ``self._*`` attribute from
+``with self._lock:`` bodies across ray_tpu's threaded planes, then flags
+reads/mutations of a majority-guarded attribute outside any acquisition
+of that lock. Rules + allowlist: ``ray_tpu/analysis/lock_guards.py``.
+
+Run standalone: ``python scripts/check_lock_guards.py`` (exit 1 on problems).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from ray_tpu.analysis.lock_guards import (  # noqa: E402,F401 — re-exported
+    ALLOWLIST,
+    check_model,
+    collect_violations,
+    infer_guards,
+)
+
+
+def main() -> int:
+    problems = collect_violations()
+    if problems:
+        print(f"check_lock_guards: {len(problems)} problem(s)")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print("check_lock_guards: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

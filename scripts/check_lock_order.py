@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Lock-order deadlock lint (CI gate, imported as a tier-1 test).
+
+Builds the global lock-acquisition graph (nested ``with`` plus one hop
+through self-method calls) over ray_tpu's threaded planes and fails on
+cycles and non-reentrant self-acquisitions — the deadlocks chaos only
+finds by luck. Rules + allowlist: ``ray_tpu/analysis/lock_order.py``.
+
+Run standalone: ``python scripts/check_lock_order.py`` (exit 1 on problems).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from ray_tpu.analysis.lock_order import (  # noqa: E402,F401 — re-exported
+    ALLOWLIST,
+    build_edges,
+    check_model,
+    collect_violations,
+)
+
+
+def main() -> int:
+    problems = collect_violations()
+    if problems:
+        print(f"check_lock_order: {len(problems)} problem(s)")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print("check_lock_order: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

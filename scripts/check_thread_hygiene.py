@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Thread-hygiene lint (CI gate, imported as a tier-1 test).
+
+Every ``threading.Thread(...)`` in the scanned packages (plus
+``benchmarks/``) must set ``daemon=True`` or be joined on a reachable
+shutdown path in the same file — a leaked non-daemon thread outlives
+``main()``. Rules + allowlist: ``ray_tpu/analysis/thread_hygiene.py``.
+
+Run standalone: ``python scripts/check_thread_hygiene.py``
+(exit 1 on problems).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from ray_tpu.analysis.thread_hygiene import (  # noqa: E402,F401 — re-exported
+    ALLOWLIST,
+    SCAN_PACKAGES,
+    check_model,
+    collect_violations,
+)
+
+
+def main() -> int:
+    problems = collect_violations()
+    if problems:
+        print(f"check_thread_hygiene: {len(problems)} problem(s)")
+        for p in problems:
+            print(f"  {p}")
+        return 1
+    print("check_thread_hygiene: ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

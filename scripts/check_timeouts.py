@@ -1,236 +1,33 @@
 #!/usr/bin/env python
 """Static blocking-call timeout lint (CI gate, imported as a tier-1 test).
 
-The control plane's availability story (heartbeat death verdicts, lease
-retries, chaos-driven failover) only works if no thread can block
-FOREVER on a peer that silently died: every blocking socket/RPC receive
-in ``ray_tpu/cluster/``, ``ray_tpu/native/`` and ``ray_tpu/collective/``
-(r12: the trainer's gang plane — a hung allreduce is a hung pod) must
-carry an explicit timeout. This walks those files' ASTs and fails on:
-
- * ``settimeout(None)`` — an explicit opt-in to unbounded blocking;
- * bare receive-family calls (``recv`` / ``recv_into`` / ``recvfrom`` /
-   ``recv_bytes`` / ``readexactly`` / ``accept``) with no ``timeout``
-   argument in a scope that never set a bounded socket timeout;
- * zero-argument ``.wait()`` / ``.get()`` / ``.result()`` — unbounded
-   thread parks (Event/Condition/queue/Future).
-
-Audited exceptions live in ``ALLOWLIST`` keyed by (path suffix,
-enclosing function, call name) with a justification — an entry without a
-reason is itself a violation.
+Thin CLI shim: the linter lives in ``ray_tpu/analysis/timeouts.py`` on
+the shared analysis framework (walker + allowlist with stale-entry
+detection). Verdict strings are unchanged from the pre-framework
+version; see that module's docstring for the rules.
 
 Run standalone: ``python scripts/check_timeouts.py`` (exit 1 on problems).
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-RECV_CALLS = {
-    "recv", "recv_into", "recvfrom", "recv_bytes", "readexactly", "accept",
-}
-PARK_CALLS = {"wait", "get", "result"}
-# park-calls whose timeout is a REQUIRED trailing positional (or kwarg):
-# Condition.wait_for(pred[, timeout]) and the GCS kv_wait(key, ns,
-# timeout) — the collective plane's rendezvous primitives. Calling them
-# without the timeout operand is an unbounded park.
-BOUNDED_PARK_MIN_ARGS = {"wait_for": 2, "kv_wait": 3}
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
-# (path suffix, enclosing function name, call attr) -> reason
-ALLOWLIST: dict[tuple[str, str, str], str] = {
-    ("cluster/rpc.py", "connect", "settimeout"): (
-        "clears create_connection's lingering timeout: timeout-mode "
-        "sendall can abandon a frame mid-write (bytes sent indeterminate) "
-        "and corrupt the stream; sends must block, the read loop bounds "
-        "itself with select() polls"
-    ),
-    ("cluster/rpc.py", "_on_conn", "readexactly"): (
-        "asyncio server-side connection reader: a stalled client parks one "
-        "coroutine (not a thread); connection close/cancellation unblocks it"
-    ),
-    ("cluster/gcs_service.py", "main", "wait"): (
-        "daemon main(): intentional forever-park of the entry thread; "
-        "SIGINT/SIGTERM are the designed wakeups"
-    ),
-    ("cluster/node_daemon.py", "main", "wait"): (
-        "daemon main(): intentional forever-park; SIGTERM triggers the "
-        "graceful-drain handler"
-    ),
-    ("cluster/worker_main.py", "main", "wait"): (
-        "worker main(): intentional forever-park; the daemon kills the "
-        "process when its lease ends"
-    ),
-}
-
-SCAN_DIRS = (
-    "ray_tpu/cluster", "ray_tpu/native", "ray_tpu/collective",
-    # r13: the compiled-DAG channel plane — exec loops ride the same
-    # peer-may-die substrate as the collectives, so its reads/parks must
-    # be bounded too (ChannelTimeoutError instead of a hung loop)
-    "ray_tpu/dag",
+from ray_tpu.analysis.timeouts import (  # noqa: E402,F401 — re-exported API
+    ALLOWLIST,
+    BOUNDED_PARK_MIN_ARGS,
+    PARK_CALLS,
+    RECV_CALLS,
+    SCAN_DIRS,
+    collect_violations,
+    lint_source,
+    main,
 )
-
-
-def _has_timeout_arg(call: ast.Call) -> bool:
-    if any(kw.arg == "timeout" for kw in call.keywords):
-        return True
-    # positional-timeout conventions: Event.wait(t) / queue.get(block, t) /
-    # recv has no positional timeout — treat ANY positional arg on a
-    # park-call as its timeout form handled by the caller-specific checks
-    return False
-
-
-class _Linter(ast.NodeVisitor):
-    def __init__(self, rel_path: str):
-        self.rel = rel_path
-        self.func_stack: list[str] = []
-        # scopes where a bounded settimeout() was seen (function names)
-        self.bounded_scopes: set[str] = set()
-        self.violations: list[str] = []
-        self.used_allowlist: set[tuple] = set()
-
-    # -- scope tracking -------------------------------------------------------
-
-    def _enter(self, node):
-        self.func_stack.append(node.name)
-        self.generic_visit(node)
-        self.func_stack.pop()
-
-    visit_FunctionDef = _enter
-    visit_AsyncFunctionDef = _enter
-
-    def _scope(self) -> str:
-        return self.func_stack[-1] if self.func_stack else "<module>"
-
-    def _allowed(self, call_name: str) -> bool:
-        for fn in self.func_stack or ["<module>"]:
-            key = (self.rel, fn, call_name)
-            if key in ALLOWLIST:
-                self.used_allowlist.add(key)
-                if not ALLOWLIST[key]:
-                    self.violations.append(
-                        f"{self.rel}:{fn}: allowlist entry for {call_name!r} "
-                        "has no justification"
-                    )
-                return True
-        return False
-
-    # -- the rules ------------------------------------------------------------
-
-    def visit_Call(self, node: ast.Call):
-        name = node.func.attr if isinstance(node.func, ast.Attribute) else (
-            node.func.id if isinstance(node.func, ast.Name) else None
-        )
-        if name == "settimeout":
-            args = node.args
-            if args and isinstance(args[0], ast.Constant) and args[0].value is None:
-                if not self._allowed("settimeout"):
-                    self.violations.append(
-                        f"{self.rel}:{node.lineno}: settimeout(None) — "
-                        "unbounded socket block; set a poll timeout and "
-                        "re-check a stop flag"
-                    )
-            elif args:
-                for fn in self.func_stack:
-                    self.bounded_scopes.add(fn)
-        elif name == "select" and len(node.args) >= 4:
-            # select.select(r, w, x, timeout): a readability poll with a
-            # timeout bounds the recv that follows it in this scope
-            for fn in self.func_stack:
-                self.bounded_scopes.add(fn)
-        elif name in RECV_CALLS and isinstance(node.func, ast.Attribute):
-            covered = any(fn in self.bounded_scopes for fn in self.func_stack)
-            if not covered and not _has_timeout_arg(node):
-                if not self._allowed(name):
-                    self.violations.append(
-                        f"{self.rel}:{node.lineno}: blocking {name}() with no "
-                        "timeout in scope (no bounded settimeout on this "
-                        "path, no timeout= argument)"
-                    )
-        elif (
-            name in PARK_CALLS
-            and isinstance(node.func, ast.Attribute)
-            and not node.args
-            and not node.keywords
-        ):
-            if not self._allowed(name):
-                self.violations.append(
-                    f"{self.rel}:{node.lineno}: zero-argument .{name}() — "
-                    "unbounded park; pass a timeout and loop on a stop flag"
-                )
-        elif (
-            name in BOUNDED_PARK_MIN_ARGS
-            and isinstance(node.func, ast.Attribute)
-            and len(node.args) < BOUNDED_PARK_MIN_ARGS[name]
-            and not _has_timeout_arg(node)
-        ):
-            if not self._allowed(name):
-                self.violations.append(
-                    f"{self.rel}:{node.lineno}: .{name}() without its "
-                    "timeout operand — unbounded park on a peer that may "
-                    "never arrive"
-                )
-        self.generic_visit(node)
-
-
-def lint_source(src: str, rel_path: str,
-                used_allowlist: "set | None" = None) -> list[str]:
-    """Lint one file's source; returns violation strings. Consumed
-    ALLOWLIST keys are added to ``used_allowlist`` when given."""
-    tree = ast.parse(src)
-    # two passes: settimeout()/select() may appear after a nested
-    # function's definition but cover calls made at runtime — collect
-    # bounded scopes first, then judge
-    first = _Linter(rel_path)
-    first.visit(tree)
-    second = _Linter(rel_path)
-    second.bounded_scopes = first.bounded_scopes
-    second.visit(tree)
-    if used_allowlist is not None:
-        used_allowlist.update(second.used_allowlist)
-    return second.violations
-
-
-def collect_violations(repo_root: str | None = None) -> list[str]:
-    root = repo_root or os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))
-    )
-    out: list[str] = []
-    used: set = set()
-    for scan in SCAN_DIRS:
-        base = os.path.join(root, scan)
-        for dirpath, _dirs, files in os.walk(base):
-            for f in sorted(files):
-                if not f.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, f)
-                rel = os.path.relpath(path, root).replace(os.sep, "/")
-                rel = rel.removeprefix("ray_tpu/")
-                with open(path, encoding="utf-8") as fh:
-                    out.extend(lint_source(fh.read(), rel, used))
-    # stale allowlist entries are violations too: an audited exception
-    # that no longer matches any code is a lie waiting to mask the next
-    # unbounded call introduced under the same (file, function) key
-    for key in sorted(set(ALLOWLIST) - used):
-        out.append(
-            f"{key[0]}: stale allowlist entry {key[1]}/{key[2]} — the call "
-            "it audited no longer exists; remove it"
-        )
-    return out
-
-
-def main() -> int:
-    problems = collect_violations()
-    if problems:
-        print(f"check_timeouts: {len(problems)} problem(s)")
-        for p in problems:
-            print(f"  {p}")
-        return 1
-    print("check_timeouts: ok")
-    return 0
-
 
 if __name__ == "__main__":
     sys.exit(main())

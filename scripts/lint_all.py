@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Umbrella runner for every static-analysis pass: one exit code,
+per-pass summary, ``--json`` for machines.
+
+    python scripts/lint_all.py            # human summary, exit 1 on any fail
+    python scripts/lint_all.py --json     # {"passes": {...}, "ok": bool}
+
+Individual passes remain runnable standalone (scripts/check_*.py) and
+are each imported as a tier-1 test; this runner exists for pre-commit /
+CI convenience and for `ray_tpu status`-style tooling to shell out to.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+
+def _passes():
+    """(name, thunk) pairs, cheap AST passes first, the live-registry
+    lint (heavy imports) last."""
+    from ray_tpu.analysis import (
+        blocking,
+        chaos_coverage,
+        lock_guards,
+        lock_order,
+        thread_hygiene,
+        timeouts,
+    )
+    return [
+        ("check_timeouts", timeouts.collect_violations),
+        ("check_lock_guards", lock_guards.collect_violations),
+        ("check_lock_order", lock_order.collect_violations),
+        ("check_blocking_under_lock", blocking.collect_violations),
+        ("check_chaos_hooks", chaos_coverage.collect_violations),
+        ("check_thread_hygiene", thread_hygiene.collect_violations),
+        ("check_metrics", _run_metrics),
+    ]
+
+
+def _run_metrics() -> list[str]:
+    from ray_tpu.analysis import metrics_registry
+
+    return metrics_registry.run_check()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    args = ap.parse_args(argv)
+
+    results: dict[str, list[str]] = {}
+    for name, thunk in _passes():
+        try:
+            results[name] = thunk()
+        except Exception as e:  # noqa: BLE001 — a crashed pass is a failure
+            results[name] = [f"{name}: pass crashed: {e!r}"]
+
+    ok = all(not v for v in results.values())
+    if args.as_json:
+        print(json.dumps({
+            "ok": ok,
+            "passes": {
+                name: {"ok": not v, "problems": v}
+                for name, v in results.items()
+            },
+        }, indent=2))
+    else:
+        for name, v in results.items():
+            status = "ok" if not v else f"{len(v)} problem(s)"
+            print(f"{name}: {status}")
+            for p in v:
+                print(f"  {p}")
+        print("lint_all:", "ok" if ok else "FAILED")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,6 +17,12 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Logical CPU floor for the in-process runtime: local actors are threads,
+# so the CPU resource is a concurrency budget, not a core reservation. A
+# 1-core CI box must still auto-init enough room for a world_size=2 gang
+# (tests that care pass num_cpus explicitly; this only lifts the default).
+os.environ.setdefault("RAY_TPU_NUM_CPUS", "8")
+
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
