@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Fabric transfer microbench: bytes/s + per-handoff latency per
+backend -> benchmarks/FABRIC_transfer_r15.json.
+
+One synthetic KV handoff of a configurable page size rides each of the
+three ``KVConnector`` backends end to end — send, bounded recv, and the
+receiver-side integrity check the orchestrator always performs — plus
+the generic ``send_arrays`` weight-publish shape:
+
+ * ``inproc``  — reference-passing queue (the serve-replica fast path);
+ * ``rpc``     — pickled chunked frames over a real localhost socket
+   (the cross-host path; includes serialization + CRC);
+ * ``device``  — device-array moves over ``fabric.transport``
+   (``jax.device_put`` between CPU devices here, ICI on a TPU slice —
+   REFRESH THIS CAPTURE ON THE TPU: the CPU numbers price the software
+   overhead only, not the interconnect).
+
+The checked-in CPU capture is tier-1 gated on the structural claim that
+must hold wherever the software runs: the device path's in-process
+handoff latency does not exceed the RPC path's (it skips pickling,
+framing, and the socket entirely).
+
+Run: JAX_PLATFORMS=cpu python benchmarks/fabric_bench.py [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _percentile(xs, q):
+    xs = sorted(xs)
+    if not xs:
+        return None
+    i = min(len(xs) - 1, max(0, int(round(q / 100.0 * (len(xs) - 1)))))
+    return xs[i]
+
+
+def make_handoff(num_kv_tokens: int, seed: int = 0):
+    """A synthetic position-ordered handoff with LLAMA_TINY-shaped pages
+    (the real export layout [L, KVH, n_kv, D]), host-sealed."""
+    import numpy as np
+
+    from ray_tpu.llm.disagg.handoff import KVHandoff
+    from ray_tpu.llm.sampling import SamplingParams
+
+    rng = np.random.default_rng(seed)
+    L, KVH, D = 2, 2, 16
+    prompt = [int(x) for x in rng.integers(3, 120, num_kv_tokens)]
+    h = KVHandoff(
+        request_id=f"bench-{seed}",
+        prompt_token_ids=prompt,
+        output_token_ids=[int(rng.integers(3, 120))],
+        sampling_params=SamplingParams(max_tokens=8, temperature=0.0),
+        key_data=np.zeros(2, np.uint32),
+        num_kv_tokens=num_kv_tokens,
+        k_pages=rng.standard_normal((L, KVH, num_kv_tokens, D)).astype(np.float32),
+        v_pages=rng.standard_normal((L, KVH, num_kv_tokens, D)).astype(np.float32),
+        model_sig=(L, KVH, D),
+    )
+    return h.seal()
+
+
+def bench_backend(kind: str, handoff, iters: int) -> dict:
+    """send -> recv -> verify round trips through one connector."""
+    import dataclasses
+
+    from ray_tpu.llm.disagg.connector import make_connector
+
+    conn = make_connector(kind, **(
+        {"namespace": f"fabric-bench-{kind}"} if kind != "rpc" else {}
+    ))
+    lat = []
+    try:
+        tgt = conn.register_target("bench0")
+        # warmup: dial/compile outside the timed region
+        warm = dataclasses.replace(handoff)
+        if kind == "device":
+            warm = warm.seal(device=True)
+        conn.send(tgt, warm)
+        got = conn.recv("bench0", timeout_s=10.0)
+        assert got is not None and got.verify()
+        for i in range(iters):
+            h = dataclasses.replace(handoff, request_id=f"bench-{kind}-{i}")
+            if kind == "device":
+                h = h.seal(device=True)
+            t0 = time.perf_counter()
+            conn.send(tgt, h)
+            got = conn.recv("bench0", timeout_s=10.0)
+            ok = got is not None and got.verify()
+            lat.append(time.perf_counter() - t0)
+            assert ok, f"{kind}: handoff {i} lost or corrupt"
+    finally:
+        conn.close()
+    total_bytes = handoff.nbytes * iters
+    total_s = sum(lat)
+    return {
+        "iters": iters,
+        "handoff_bytes": int(handoff.nbytes),
+        "mean_latency_s": total_s / iters,
+        "p50_latency_s": _percentile(lat, 50),
+        "p99_latency_s": _percentile(lat, 99),
+        "bytes_per_s": total_bytes / total_s if total_s > 0 else None,
+    }
+
+
+def bench_weight_publish(iters: int) -> dict:
+    """The second send_arrays client: a params-pytree publish."""
+    import jax
+
+    from ray_tpu.fabric import DeviceTransport
+    from ray_tpu.models import llama
+    from ray_tpu.train.weight_sync import WeightPublisher, WeightSubscriber
+
+    params = llama.init_params(llama.LLAMA_TINY, jax.random.key(0))
+    nbytes = int(sum(x.nbytes for x in jax.tree_util.tree_leaves(params)))
+    pub = WeightPublisher(transport=DeviceTransport(namespace="fabric-bench-w"))
+    try:
+        tgt = pub.register_rollout("rollout0")
+        sub = WeightSubscriber(pub.transport, "rollout0")
+        lat = []
+        pub.publish(params, [tgt])  # warmup (reductions compile)
+        assert sub.poll(timeout_s=10.0) is not None
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            pub.publish(params, [tgt])
+            got = sub.poll(timeout_s=10.0)
+            lat.append(time.perf_counter() - t0)
+            assert got is not None
+    finally:
+        pub.transport.close()
+    total_s = sum(lat)
+    return {
+        "iters": iters,
+        "params_bytes": nbytes,
+        "mean_latency_s": total_s / iters,
+        "bytes_per_s": nbytes * iters / total_s if total_s > 0 else None,
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "FABRIC_transfer_r15.json"
+    ))
+    p.add_argument("--iters", type=int, default=50)
+    p.add_argument("--kv-tokens", type=int, default=512)
+    args = p.parse_args()
+
+    import jax
+
+    handoff = make_handoff(args.kv_tokens)
+    results = {}
+    for kind in ("inproc", "rpc", "device"):
+        results[kind] = bench_backend(kind, handoff, args.iters)
+        print(f"{kind:>7}: mean {results[kind]['mean_latency_s'] * 1e6:8.1f}us  "
+              f"{(results[kind]['bytes_per_s'] or 0) / 1e6:8.1f} MB/s")
+    weights = bench_weight_publish(max(5, args.iters // 5))
+    print(f"weights: mean {weights['mean_latency_s'] * 1e6:8.1f}us  "
+          f"{(weights['bytes_per_s'] or 0) / 1e6:8.1f} MB/s")
+
+    doc = {
+        "metric": "fabric_transfer_microbench",
+        "platform": jax.devices()[0].platform,
+        "num_devices": len(jax.devices()),
+        "kv_tokens": args.kv_tokens,
+        "backends": results,
+        "weight_publish": weights,
+        # the structural gate the checked-in capture enforces tier-1
+        "device_le_rpc_latency": (
+            results["device"]["mean_latency_s"]
+            <= results["rpc"]["mean_latency_s"]
+        ),
+    }
+    with open(args.out, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+    print(json.dumps({"metric": doc["metric"], "out": args.out,
+                      "device_le_rpc_latency": doc["device_le_rpc_latency"]}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

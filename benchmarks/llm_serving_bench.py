@@ -579,7 +579,7 @@ def main():
                     "TPOT benchmark instead")
     ap.add_argument("--disagg-out", default=_DISAGG_OUT)
     ap.add_argument("--disagg-connector", default="inproc",
-                    choices=["inproc", "rpc"])
+                    choices=["inproc", "rpc", "device"])
     ap.add_argument("--chaos", action="store_true",
                     help="run the availability-SLO benchmark under seeded "
                     "engine preemption instead")

@@ -71,6 +71,9 @@ SCAN_DIRS = (
     # peer-may-die substrate as the collectives, so its reads/parks must
     # be bounded too (ChannelTimeoutError instead of a hung loop)
     "ray_tpu/dag",
+    # r15: the fabric transfer plane — endpoint receives must poll
+    # bounded (a transfer plane never parks a consumer loop forever)
+    "ray_tpu/fabric",
 )
 
 

@@ -27,6 +27,9 @@ DEFAULT_PACKAGES = (
     "ray_tpu/obs",
     "ray_tpu/train",
     "ray_tpu/chaos",
+    # the device-direct transfer plane: sender/receiver loops + topology
+    # state ride the same peer-may-die, lock-guarded substrate
+    "ray_tpu/fabric",
     # the native socket/shm plane rides the same peer-may-die substrate
     # the timeouts pass already scans — the lock passes cover it too
     "ray_tpu/native",

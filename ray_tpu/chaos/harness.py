@@ -27,11 +27,13 @@ import time
 from typing import Optional
 
 from ray_tpu.chaos.schedule import (  # noqa: F401 — re-exported for hook sites
+    CORRUPT_DEVICE_TRANSFER,
     CORRUPT_FRAME,
     CORRUPT_KV_TRANSFER,
     DELAY_RPC,
     DROP_CHANNEL,
     DROP_COLLECTIVE,
+    DROP_DEVICE_TRANSFER,
     DROP_KV_TRANSFER,
     DROP_RPC,
     KILL_GCS,

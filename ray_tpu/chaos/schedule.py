@@ -75,6 +75,14 @@ STALL_GCS = "stall_gcs"                  # GCS-bound RPCs get transport loss
 # the collective fault kinds' semantics on the channel substrate.
 DROP_CHANNEL = "drop_channel"            # written value lost in flight
 STALL_CHANNEL = "stall_channel"          # channel op delayed by delay_s
+# device-direct transfer plane (ray_tpu/fabric/transport.py): the same
+# two failure modes the KV-transfer kinds model, on the ICI/device
+# substrate — a device transfer that never lands vs one whose pages
+# arrive bit-flipped (caught by the device-side checksum at import).
+# Distinct kinds so a schedule can fault ONLY the device edges and the
+# orchestrator's RPC-fallback path is what gets exercised.
+DROP_DEVICE_TRANSFER = "drop_device_transfer"        # device xfer lost
+CORRUPT_DEVICE_TRANSFER = "corrupt_device_transfer"  # pages flipped on device
 
 KINDS = frozenset({
     KILL_WORKER, KILL_REPLICA, DROP_RPC, DELAY_RPC, STALL_HEARTBEAT,
@@ -82,6 +90,7 @@ KINDS = frozenset({
     DROP_KV_TRANSFER, CORRUPT_KV_TRANSFER,
     KILL_RANK, STALL_COLLECTIVE, DROP_COLLECTIVE, PARTIAL_PARTITION,
     KILL_GCS, STALL_GCS, DROP_CHANNEL, STALL_CHANNEL,
+    DROP_DEVICE_TRANSFER, CORRUPT_DEVICE_TRANSFER,
 })
 
 # kinds the in-process hook ignores (a runner executes them instead)

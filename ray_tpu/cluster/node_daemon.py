@@ -1466,6 +1466,14 @@ def main() -> None:
     p.add_argument("--telemetry-interval", type=float, default=2.0,
                    help="seconds between metrics snapshots piggybacked on "
                         "heartbeats (0 disables)")
+    p.add_argument("--slice", default=None,
+                   help="ICI slice id this host belongs to; advertises the "
+                        "slice:<id> resource that fabric slice pools "
+                        "(ray_tpu.fabric.pool) pin placement-group bundles "
+                        "to, count = --slice-chips")
+    p.add_argument("--slice-chips", type=float, default=4.0,
+                   help="units of the slice:<id> resource to advertise "
+                        "(chips of this slice hosted here)")
     args = p.parse_args()
     host, port = args.gcs.rsplit(":", 1)
     resources: dict[str, float] = {}
@@ -1473,6 +1481,11 @@ def main() -> None:
         if kv:
             k, v = kv.split("=")
             resources[k] = float(v)
+    if args.slice:
+        # same name fabric.pool.slice_resource() generates — a host
+        # belongs to exactly one ICI slice, and slice pools STRICT_PACK
+        # their bundles against this resource
+        resources.setdefault(f"slice:{args.slice}", args.slice_chips)
     worker_env: dict[str, str] = {}
     for kv in args.worker_env.split(","):
         if kv:

@@ -1,0 +1,54 @@
+"""ray_tpu.fabric — device-direct array transfer plane + multi-slice
+pool fabric.
+
+Three pieces (ROADMAP item 1, shaped for item 5's weight sync too):
+
+ * **transport** — the generic ``send_arrays``/``recv_arrays`` API:
+   named device arrays move between registered device endpoints by
+   ``jax.device_put`` (ICI DMA on TPU slices, device-to-device memcpy
+   on CPU CI devices), sealed with a device-computed checksum so
+   multi-MB payloads never cross to the host for integrity.
+ * **device_connector** — ``DeviceKVConnector``, the third
+   ``KVConnector`` backend: prefill→decode KV handoffs as device-array
+   bundles (zero host staging), same checksum/timeout failure modes as
+   the host-path connectors.
+ * **topology / pool** — role-tagged pools pinned to ICI slices via
+   placement groups, a topology map recording which pool-pairs share a
+   device mesh, and stateful per-edge transport selection (device where
+   meshes are shared, RPC elsewhere, fault ⇒ degrade the edge to its
+   RPC fallback).
+
+Clients: the ``DisaggOrchestrator`` (per-edge ICI-vs-RPC KV transfer)
+and ``train.weight_sync`` (learner→rollout weight publishes) — both go
+through ``send_arrays``.
+"""
+
+from ray_tpu.fabric.device_connector import DeviceKVConnector
+from ray_tpu.fabric.pool import (
+    FabricPlan,
+    SlicePoolSpec,
+    build_fabric,
+    build_topology,
+    slice_resource,
+)
+from ray_tpu.fabric.topology import FabricTopology
+from ray_tpu.fabric.transport import (
+    ArrayBundle,
+    DeviceTransport,
+    FabricTransferError,
+    device_checksum,
+)
+
+__all__ = [
+    "ArrayBundle",
+    "DeviceKVConnector",
+    "DeviceTransport",
+    "FabricPlan",
+    "FabricTopology",
+    "FabricTransferError",
+    "SlicePoolSpec",
+    "build_fabric",
+    "build_topology",
+    "device_checksum",
+    "slice_resource",
+]

@@ -1,0 +1,46 @@
+"""Fabric observability: the transfer-plane metrics `ray_tpu status`
+renders in its ``== fabric ==`` block.
+
+Construct-per-call like obs/slo.py (same-name re-registration shares
+storage in util/metrics, so a test's ``clear_registry()`` can never
+strand a stale cached instance). Both metrics are telemetry-plane
+(``ray_tpu_fabric_`` is in ``obs.telemetry.AGGREGATED_PREFIXES``) and
+declare their aggregation kinds, so ``check_metrics`` /
+``check_aggregations`` hold them to the same contract as every other
+cluster-rolled metric.
+"""
+
+from __future__ import annotations
+
+
+def edges_active_gauge():
+    """Directed pool-pair edges this orchestrator currently serves, per
+    transport backend. SUM across reporters: the fleet value is the
+    total edge count, and the per-backend series are the backend mix."""
+    from ray_tpu.obs.telemetry import cluster_gauge
+
+    return cluster_gauge(
+        "fabric_edges_active",
+        description="active fabric transfer edges (directed pool pairs) "
+        "by transport backend (device/rpc/inproc)",
+        tag_keys=("model", "backend"),
+    )
+
+
+def transfer_fallbacks_counter():
+    """Device edges degraded to their RPC fallback after a
+    device-transfer fault (counters default to SUM aggregation)."""
+    from ray_tpu.obs.telemetry import cluster_counter
+
+    return cluster_counter(
+        "fabric_transfer_fallbacks_total",
+        description="fabric edges degraded from device-direct transfer "
+        "to the RPC fallback after a device-transfer fault",
+        tag_keys=("model", "edge"),
+    )
+
+
+def register_metrics() -> None:
+    """scripts/check_metrics.py hook: force lazy metrics to register."""
+    edges_active_gauge()
+    transfer_fallbacks_counter()

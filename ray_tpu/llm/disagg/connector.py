@@ -1,6 +1,7 @@
 """KVConnector: pluggable transfer plane for prefill->decode KV handoffs.
 
-Two backends ship:
+Three backends ship (the third lives in ``ray_tpu/fabric`` and builds
+on this interface):
 
  * ``InProcessConnector`` — queue handoff inside one process (tests,
    CPU smoke, serve replicas which are in-process async actors). The
@@ -8,16 +9,16 @@ Two backends ship:
    checksum gate so chaos corruption is exercised end to end.
  * ``RpcKVConnector`` — cluster transfer over the ``cluster/rpc.py``
    length-prefixed frame protocol: each decode target runs one shared
-   RpcServer route (``kv_put``); prefill-side sends go through a
+   RpcServer route (``kv_put_chunk``); prefill-side sends go through a
    ``ClientPool`` with bounded call timeouts, so a stalled decode host
    fails the transfer (-> re-prefill) instead of wedging the sender.
-
-The interface is deliberately shaped so an ICI/device-to-device backend
-can slot in later: ``send`` takes an opaque target token from
-``register_target`` and a position-ordered ``KVHandoff`` — a TPU
-backend would register a device mesh endpoint, move ``k_pages``/
-``v_pages`` by device DMA, and surface the same checksum/timeout
-failure modes; nothing in the orchestrator changes.
+   Oversized handoffs chunk into seq-numbered multi-frame sends.
+ * ``fabric.device_connector.DeviceKVConnector`` — the ICI/device-direct
+   backend this interface was shaped for: ``register_target`` binds a
+   device mesh endpoint, ``k_pages``/``v_pages`` move as device arrays
+   (``jax.device_put`` — ICI DMA on TPU, device memcpy on CPU CI), and
+   the same checksum/timeout failure modes surface; nothing in the
+   orchestrator's failure handling changes.
 
 Chaos: every send passes through the ``disagg.kv_transfer`` hook site —
 ``DROP_KV_TRANSFER`` raises ``KVTransferError`` before the send,
@@ -188,28 +189,53 @@ class InProcessConnector(KVConnector):
 # ---------------------------------------------------------------------------
 
 
+# envelope headroom per chunk frame: the pickled RPC tuple around the
+# raw chunk bytes (method name, target/xfer ids, seq ints, crc, the
+# uint32 length prefix). Measured envelopes are <300 bytes; 4 KiB keeps
+# every chunk frame strictly under the connector's frame budget.
+CHUNK_MARGIN = 4096
+
+
 class RpcKVConnector(KVConnector):
     """KV transfer over cluster/rpc.py framing.
 
     One connector instance can play both sides: ``register_target``
     lazily starts a local RpcServer (one per connector, shared across
-    targets) routing ``kv_put`` frames into per-target queues; ``send``
-    dials the peer's (host, port) through a ClientPool with the
-    transfer timeout bounding the call — large KV frames ride the same
-    pickled length-prefixed protocol the control plane uses.
+    targets) routing ``kv_put_chunk`` frames into per-target queues;
+    ``send`` dials the peer's (host, port) through a ClientPool with the
+    transfer timeout bounding each call.
+
+    Large handoffs degrade to MORE FRAMES, never a hard failure: the
+    pickled handoff is split into seq-numbered chunks sized to stay
+    under ``max_frame_bytes`` (default: the protocol's MAX_FRAME — the
+    r10 client-side guard that used to fail multi-frame-sized exports
+    loudly), reassembled receiver-side and CRC-verified over the whole
+    blob before unpickling. A torn multi-frame send (sender died
+    mid-transfer) is garbage-collected after the transfer timeout and
+    the orchestrator re-prefills exactly as for a lost single frame.
     """
 
     name = "rpc"
 
-    def __init__(self, host: str = "127.0.0.1", timeout_s: float = 30.0):
+    def __init__(self, host: str = "127.0.0.1", timeout_s: float = 30.0,
+                 max_frame_bytes: Optional[int] = None):
         super().__init__()
-        from ray_tpu.cluster.rpc import ClientPool
+        from ray_tpu.cluster.rpc import MAX_FRAME, ClientPool
 
         self._host = host
         self._timeout = timeout_s
+        self.max_frame_bytes = int(max_frame_bytes or MAX_FRAME)
+        if self.max_frame_bytes <= CHUNK_MARGIN:
+            raise ValueError(
+                f"max_frame_bytes must exceed {CHUNK_MARGIN} "
+                f"(envelope headroom), got {self.max_frame_bytes}"
+            )
         self._pool = ClientPool(timeout=timeout_s)
         self._server = None
         self._queues: dict[str, "queue.Queue[KVHandoff]"] = {}
+        # in-flight multi-frame reassembly: xfer_id -> {target, total,
+        # parts: {seq: bytes}, crc, deadline}
+        self._partial: dict[str, dict] = {}
         self._lock = threading.Lock()
 
     def _ensure_server(self):
@@ -218,21 +244,57 @@ class RpcKVConnector(KVConnector):
         with self._lock:
             if self._server is None:
                 srv = RpcServer(host=self._host)
-                srv.route("kv_put", self._on_kv_put)
+                srv.route("kv_put_chunk", self._on_kv_chunk)
                 srv.start()
                 self._server = srv
             # invariant: _server is only read under _lock; returning the
             # local binding keeps the read inside the critical section
             return self._server
 
-    def _on_kv_put(self, payload, peer):
+    def _on_kv_chunk(self, payload, peer):
+        """One seq-numbered chunk of a pickled handoff. The final chunk
+        (all present) joins, CRC-verifies the blob, unpickles, and
+        delivers; mid-transfer state is bounded by the deadline GC."""
+        import pickle
+        import zlib
+
         target_id = payload["target"]
+        xfer = payload["xfer"]
+        total = int(payload["total"])
         with self._lock:
             q = self._queues.get(target_id)
-        if q is None:
-            raise KVTransferError(f"no such KV target {target_id!r} here")
-        q.put(payload["handoff"])
-        return {"ok": True}
+            if q is None:
+                raise KVTransferError(f"no such KV target {target_id!r} here")
+            now = time.time()
+            # GC torn transfers whose sender gave up (re-prefilled):
+            # partial chunk sets must not accumulate forever
+            for xid in [x for x, rec in self._partial.items()
+                        if rec["deadline"] < now]:
+                del self._partial[xid]
+            rec = self._partial.setdefault(xfer, {
+                "target": target_id, "total": total, "parts": {},
+                "crc": int(payload["crc"]),
+            })
+            # deadline refreshes on EVERY chunk: a live sender (each of
+            # whose calls is individually bounded by ttl_s) can stream an
+            # N-chunk transfer for N*ttl_s without being GC'd mid-flight;
+            # only a sender that went silent past ttl_s — whose own call
+            # timed out, so it already re-prefilled — loses the partial
+            rec["deadline"] = now + float(payload.get("ttl_s", 60.0))
+            rec["parts"][int(payload["seq"])] = payload["data"]
+            done = len(rec["parts"]) == rec["total"]
+            if done:
+                del self._partial[xfer]
+        if not done:
+            return {"ok": True, "have": int(payload["seq"]) + 1}
+        blob = b"".join(rec["parts"][i] for i in range(rec["total"]))
+        if (zlib.crc32(blob) & 0xFFFFFFFF) != rec["crc"]:
+            raise KVTransferError(
+                f"reassembled KV transfer {xfer!r} failed blob CRC "
+                f"({rec['total']} chunks) — torn in flight"
+            )
+        q.put(pickle.loads(blob))
+        return {"ok": True, "delivered": True}
 
     def register_target(self, target_id: str) -> tuple:
         srv = self._ensure_server()
@@ -243,22 +305,52 @@ class RpcKVConnector(KVConnector):
 
     def send(self, target: tuple, handoff: KVHandoff,
              timeout_s: Optional[float] = None) -> None:
+        import pickle
+        import uuid
+        import zlib
+
         from ray_tpu.cluster.rpc import RemoteError, RpcError
 
         host, port, target_id = target
         handoff = self._chaos_gate(handoff, f"{host}:{port}/{target_id}")
+        timeout = timeout_s if timeout_s is not None else self._timeout
+        blob = pickle.dumps(handoff, protocol=5)
+        crc = zlib.crc32(blob) & 0xFFFFFFFF
+        cap = self.max_frame_bytes - CHUNK_MARGIN
+        chunks = [blob[i : i + cap] for i in range(0, len(blob), cap)] or [b""]
+        xfer = f"{handoff.request_id}-{uuid.uuid4().hex[:8]}"
+        # timeout bounds the WHOLE transfer, not each chunk: a peer
+        # answering every chunk just under a per-call bound would
+        # otherwise hold the sender (and the orchestrator's transfer
+        # thread) for N*timeout with the re-prefill budget never
+        # consulted
+        deadline = time.monotonic() + timeout
         try:
-            self._pool.get((host, port)).call(
-                "kv_put", {"target": target_id, "handoff": handoff},
-                timeout=timeout_s if timeout_s is not None else self._timeout,
-            )
+            client = self._pool.get((host, port))
+            for seq, data in enumerate(chunks):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise KVTransferError(
+                        f"KV transfer of {handoff.request_id!r} to "
+                        f"{host}:{port}/{target_id} exceeded {timeout}s "
+                        f"after {seq}/{len(chunks)} chunks"
+                    )
+                client.call(
+                    "kv_put_chunk",
+                    {"target": target_id, "xfer": xfer, "seq": seq,
+                     "total": len(chunks), "crc": crc, "data": data,
+                     "ttl_s": timeout},
+                    timeout=remaining,
+                )
         except (RpcError, RemoteError) as e:
-            # the frame may or may not have landed; the orchestrator's
-            # re-prefill path is idempotent (delivery watermarks), so
-            # at-most-once here is the right failure mode
+            # the frames may or may not have landed (the receiver GCs a
+            # torn chunk set); the orchestrator's re-prefill path is
+            # idempotent (delivery watermarks), so at-most-once here is
+            # the right failure mode
             raise KVTransferError(
                 f"KV transfer of {handoff.request_id!r} to "
-                f"{host}:{port}/{target_id} failed: {e}"
+                f"{host}:{port}/{target_id} failed "
+                f"(chunk {len(chunks)} max): {e}"
             ) from e
         self.num_sent += 1
         self.bytes_sent += handoff.nbytes
@@ -280,6 +372,7 @@ class RpcKVConnector(KVConnector):
         with self._lock:
             srv, self._server = self._server, None
             self._queues.clear()
+            self._partial.clear()
         if srv is not None:
             srv.stop()
 
@@ -289,4 +382,11 @@ def make_connector(kind: str, **kwargs) -> KVConnector:
         return InProcessConnector(**kwargs)
     if kind == "rpc":
         return RpcKVConnector(**kwargs)
-    raise ValueError(f"unknown KV connector {kind!r}; one of: inproc, rpc")
+    if kind == "device":
+        # deferred import: ray_tpu.fabric builds ON this interface
+        from ray_tpu.fabric.device_connector import DeviceKVConnector
+
+        return DeviceKVConnector(**kwargs)
+    raise ValueError(
+        f"unknown KV connector {kind!r}; one of: inproc, rpc, device"
+    )

@@ -15,6 +15,15 @@ ids; `verify()` re-checks it on the receive side. A transfer plane that
 bit-flips in flight (chaos: CORRUPT_KV_TRANSFER, or a real torn wire)
 is detected at import time and handled as a lost transfer (re-prefill),
 never silently decoded from garbage K/V.
+
+Device path (ray_tpu.fabric): when the pages are device arrays riding
+the ICI/device transport, `seal(device=True)` computes the page sum ON
+the pages' device (`fabric.transport.device_checksum` — only a 4-byte
+scalar crosses to the host) and records `checksum_kind="device_u32"`;
+`verify()` dispatches on the kind, so the same handoff object flows
+through either plane and a bit-flip is caught either way. `to_host()`
+converts a device handoff back to host ndarrays + CRC sealing — the
+orchestrator uses it when a device edge falls back to RPC.
 """
 
 from __future__ import annotations
@@ -47,9 +56,20 @@ class KVHandoff:
     t_first_token: Optional[float] = None
     t_export: float = 0.0           # prefill-side export time (span start)
     trace: Optional[dict] = None    # TraceContext.to_dict wire form
+    # which prefill engine exported this handoff (fabric edge
+    # attribution: a corrupt arrival degrades exactly the faulted
+    # (src -> dst) edge); advisory, not covered by the checksum
+    src_engine: Optional[int] = None
     checksum: int = 0
+    checksum_kind: str = "crc32"    # "crc32" (host) | "device_u32" (fabric)
 
     # -- integrity -----------------------------------------------------------
+
+    def _token_crc(self) -> int:
+        return zlib.crc32(
+            np.asarray(self.prompt_token_ids + self.output_token_ids,
+                       np.int64).tobytes()
+        ) & 0xFFFFFFFF
 
     def _crc(self) -> int:
         crc = zlib.crc32(np.ascontiguousarray(self.k_pages).tobytes())
@@ -61,12 +81,44 @@ class KVHandoff:
         )
         return crc & 0xFFFFFFFF
 
-    def seal(self) -> "KVHandoff":
-        self.checksum = self._crc()
+    def _device_sum(self) -> int:
+        # page sums reduce on the pages' own device; token ids are a
+        # tiny host list (CRC'd host-side) — the multi-MB payload never
+        # crosses to the host for integrity. Delegates to the ONE
+        # chained-fold implementation (ArrayBundle._sum: name-bound, so
+        # K and V delivered swapped fail verify like the host CRC
+        # would), then folds the token CRC on top.
+        from ray_tpu.fabric.transport import ArrayBundle
+
+        crc = ArrayBundle("", {"k_pages": self.k_pages,
+                               "v_pages": self.v_pages})._sum()
+        return zlib.crc32(self._token_crc().to_bytes(4, "big"), crc) & 0xFFFFFFFF
+
+    def seal(self, device: bool = False) -> "KVHandoff":
+        if device:
+            self.checksum_kind = "device_u32"
+            self.checksum = self._device_sum()
+        else:
+            self.checksum_kind = "crc32"
+            self.checksum = self._crc()
         return self
 
     def verify(self) -> bool:
+        if self.checksum_kind == "device_u32":
+            return self.checksum == self._device_sum()
         return self.checksum == self._crc()
+
+    def to_host(self) -> "KVHandoff":
+        """Host-side copy (np pages, CRC-sealed): the form the pickling
+        RPC/in-process connectors ship. A handoff already on the host is
+        returned as-is."""
+        if self.checksum_kind == "crc32" and isinstance(self.k_pages, np.ndarray):
+            return self
+        return dataclasses.replace(
+            self,
+            k_pages=np.asarray(self.k_pages),
+            v_pages=np.asarray(self.v_pages),
+        ).seal()
 
     @property
     def nbytes(self) -> int:

@@ -40,6 +40,8 @@ import time
 import uuid
 from typing import Any, Optional
 
+import numpy as np
+
 from ray_tpu.llm.disagg.connector import (
     InProcessConnector,
     KVConnector,
@@ -63,7 +65,7 @@ class DisaggConfig:
     engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
     num_prefill: int = 1
     num_decode: int = 1
-    connector: str = "inproc"           # "inproc" | "rpc"
+    connector: str = "inproc"           # "inproc" | "rpc" | "device"
     transfer_timeout_s: float = 30.0
     # re-prefill budget per request across transfer losses / prefill
     # deaths; exceeding it fails the request loudly (crash loop, not a
@@ -71,6 +73,14 @@ class DisaggConfig:
     max_handoff_retries: int = 2
     # decode pick: queue depth first, prefix-cache awareness as tiebreak
     cache_aware_pick: bool = True
+    # multi-slice fabric topology (fabric.FabricTopology or its dict
+    # wire form): which slice each pool is pinned to and which
+    # pool-pairs share a device mesh. The orchestrator consults it per
+    # (prefill -> decode) edge: device-direct where meshes are shared,
+    # RPC elsewhere, device-fault => degrade that edge to RPC under the
+    # re-prefill budget. None with connector="device" assumes one
+    # shared slice (the single-host CI shape).
+    fabric: Any = None
 
     def __post_init__(self):
         if isinstance(self.engine, dict):
@@ -126,24 +136,95 @@ class DisaggOrchestrator:
         for d in self._decode:
             d.engine.model_tag = f"{model_tag}-decode{d.index}"
 
+        # -- fabric: topology + per-edge transport selection ------------------
+        from ray_tpu.fabric.topology import FabricTopology
+
+        # the EFFECTIVE primary plane: an injected connector instance
+        # outranks config.connector (which may sit at its "inproc"
+        # default) — the degenerate topology below must see the same
+        # answer, or an injected device plane would silently route
+        # every edge over the auto-built RPC fallback
+        aliases = {"in_process": "inproc", "inprocess": "inproc"}
         if connector is not None:
-            self.connector = connector
-        elif config.connector in ("inproc", "in_process", "inprocess"):
-            # unique namespace per orchestrator: two orchestrators with
-            # the same model_tag in one process (num_replicas=2 of an
-            # LLMConfig(disagg=...) deployment) must never steal each
-            # other's handoffs off the process-global queues
-            self.connector = InProcessConnector(
-                namespace=f"{model_tag}-{uuid.uuid4().hex[:8]}"
+            primary = connector.name
+        else:
+            primary = aliases.get(config.connector, config.connector)
+        self._primary = primary
+
+        topo = config.fabric
+        if isinstance(topo, dict):
+            topo = FabricTopology.from_dict(topo)
+        if topo is None:
+            # degenerate topology: a device-primary fabric with no map
+            # assumes one shared slice (single-host CI / one ICI
+            # domain); host-path primaries get distinct slices so the
+            # map honestly says "no shared mesh"
+            shared = primary == "device"
+            topo = FabricTopology()
+            topo.add_pool("prefill", "prefill", "slice0", config.num_prefill)
+            topo.add_pool("decode", "decode",
+                          "slice0" if shared else "slice1", config.num_decode)
+        self.topology = topo
+        self._prefill_pool = topo.pool_of_role("prefill") or "prefill"
+        self._decode_pool = topo.pool_of_role("decode") or "decode"
+
+        # unique namespace per orchestrator: two orchestrators with the
+        # same model_tag in one process (num_replicas=2 of an
+        # LLMConfig(disagg=...) deployment) must never steal each
+        # other's handoffs off the process-global queues
+        self._ns = f"{model_tag}-{uuid.uuid4().hex[:8]}"
+        if connector is not None:
+            self.connectors: dict[str, KVConnector] = {primary: connector}
+        else:
+            self.connectors = {primary: self._build_connector(primary)}
+        if primary == "device":
+            # the RPC fallback plane stays warm: a faulted device edge
+            # degrades to it instead of retrying a broken DMA path
+            self.connectors.setdefault("rpc", self._build_connector("rpc"))
+        # back-compat alias: stats()/tests address "the" connector
+        self.connector = self.connectors[primary]
+
+        # (prefill engine, decode engine) -> transport backend. Device
+        # edges exist only when the primary plane is device-direct AND
+        # the topology says the pools share a mesh; every edge degrades
+        # independently on a device-transfer fault.
+        if primary == "device":
+            pool_edge = topo.edge_backend(self._prefill_pool, self._decode_pool)
+            # a topology override may name a plane we haven't built yet
+            # (e.g. an explicit "inproc" edge): build it, or every
+            # transfer on that edge would KeyError at send time
+            self.connectors.setdefault(
+                pool_edge, self._build_connector(pool_edge)
             )
         else:
-            self.connector = make_connector(config.connector)
-        self._targets = [
-            self.connector.register_target(f"{model_tag}-decode{i}")
-            for i in range(config.num_decode)
-        ]
+            pool_edge = primary
+        self._edge_backend: dict[tuple, str] = {
+            (p.index, d.index): pool_edge
+            for p in self._prefill for d in self._decode
+        }
+        self.num_fallbacks = 0
+        self.transfers_by_backend: dict[str, int] = {}
+
+        self._targets: dict[str, list] = {}
+        for name, conn in self.connectors.items():
+            if name == "device":
+                # endpoint = the decode engine's own KV-cache device, so
+                # the transport's device_put IS the final hop
+                self._targets[name] = [
+                    conn.register_target(
+                        f"{model_tag}-decode{i}",
+                        device=d.engine.kv_cache_device(),
+                    )
+                    for i, d in enumerate(self._decode)
+                ]
+            else:
+                self._targets[name] = [
+                    conn.register_target(f"{model_tag}-decode{i}")
+                    for i in range(config.num_decode)
+                ]
 
         self._lock = threading.Lock()
+        self._update_fabric_gauges()
         # orchestrator-minted request ids: every engine counts its own
         # "req-N", so two prefill engines would both mint "req-0" and the
         # second submit would orphan the first's output queue
@@ -182,6 +263,15 @@ class DisaggOrchestrator:
             )
             t.start()
             self._threads.append(t)
+
+    def _build_connector(self, kind: str) -> KVConnector:
+        if kind == "inproc":
+            return InProcessConnector(namespace=self._ns)
+        if kind == "device":
+            from ray_tpu.fabric.device_connector import DeviceKVConnector
+
+            return DeviceKVConnector(namespace=self._ns)
+        return make_connector(kind)
 
     # -- public API -----------------------------------------------------------
 
@@ -283,15 +373,33 @@ class DisaggOrchestrator:
         lookup = sum(
             p.engine.prefix_lookup_tokens for p in self._prefill + self._decode
         )
+        xfer = self.connector.stats()
+        if len(self.connectors) > 1:
+            # totals span every plane; "connector" stays the primary
+            snaps = [c.stats() for c in self.connectors.values()]
+            for field in ("num_sent", "num_received", "num_dropped",
+                          "bytes_sent"):
+                xfer[field] = sum(s.get(field, 0) for s in snaps)
+        with self._lock:
+            fabric = {
+                "edges": [
+                    {"src": f"prefill{s}", "dst": f"decode{d}", "backend": b}
+                    for (s, d), b in sorted(self._edge_backend.items())
+                ],
+                "backends": dict(self.transfers_by_backend),
+                "fallbacks": self.num_fallbacks,
+            }
+        fabric["topology"] = self.topology.to_dict()
         return {
             "prefill": [p.engine.stats() for p in self._prefill],
             "decode": [d.engine.stats() for d in self._decode],
             "transfer": {
-                **self.connector.stats(),
+                **xfer,
                 "kv_transfers": self.num_transfers,
                 "reprefills": self.num_reprefills,
                 "transfer_failures": self.num_transfer_failures,
             },
+            "fabric": fabric,
             "prefix_cache": {
                 "hit_tokens": hit,
                 "lookup_tokens": lookup,
@@ -304,7 +412,8 @@ class DisaggOrchestrator:
         self._wake.set()
         for t in self._threads:
             t.join(timeout=5)
-        self.connector.close()
+        for conn in self.connectors.values():
+            conn.close()
 
     # -- delivery (watermarked, idempotent across re-prefills) ----------------
 
@@ -341,13 +450,25 @@ class DisaggOrchestrator:
                 self._wake.clear()
                 continue
             handoffs: list[KVHandoff] = []
+            # device-resident export when any edge out of this engine is
+            # device-direct (the pages then never stage through host RAM;
+            # an RPC edge chosen later converts with to_host())
+            with self._lock:
+                export_dev = any(
+                    self._edge_backend.get((pe.index, d.index)) == "device"
+                    for d in self._decode
+                )
             try:
                 with pe.lock:
                     outputs = pe.engine.step()
                     # everything still RUNNING after a prefill-pool step
                     # was just admitted: export it before it ever decodes
                     for req in list(pe.engine.running):
-                        handoffs.append(pe.engine.export_request(req.request_id))
+                        h = pe.engine.export_request(
+                            req.request_id, keep_on_device=export_dev
+                        )
+                        h.src_engine = pe.index
+                        handoffs.append(h)
             except BaseException as e:  # noqa: BLE001 — re-home in-flight work
                 if self._stop:
                     return
@@ -441,24 +562,92 @@ class DisaggOrchestrator:
 
     def _transfer(self, handoff: KVHandoff) -> None:
         idx = self._pick_decode(handoff)
+        src = handoff.src_engine if handoff.src_engine is not None else 0
+        with self._lock:
+            backend = self._edge_backend.get((src, idx), self._primary)
+        conn = self.connectors[backend]
+        if backend != "device":
+            # host-path edge (or a degraded device edge): the pickling
+            # connectors need host ndarrays + CRC sealing
+            handoff = handoff.to_host()
         try:
-            self.connector.send(
-                self._targets[idx], handoff,
+            conn.send(
+                self._targets[backend][idx], handoff,
                 timeout_s=self.config.transfer_timeout_s,
             )
             self.num_transfers += 1
+            with self._lock:
+                self.transfers_by_backend[backend] = (
+                    self.transfers_by_backend.get(backend, 0) + 1
+                )
         except KVTransferError as e:
-            self._transfer_failed(handoff, e)
+            self._transfer_failed(handoff, e, backend=backend,
+                                  edge=(src, idx))
 
-    def _transfer_failed(self, handoff: KVHandoff, exc: BaseException) -> None:
+    def _fallback_edge(self, edge: tuple, reason: str) -> None:
+        """Degrade one faulted device edge to its RPC fallback (counted
+        once per edge); subsequent transfers on it — including this
+        request's budgeted re-prefill — ride the wire."""
+        src, dst = edge
+        with self._lock:
+            if self._edge_backend.get((src, dst)) != "device":
+                return
+            self._edge_backend[(src, dst)] = "rpc"
+            self.num_fallbacks += 1
+            # pool-level topology state degrades only when NO engine
+            # edge between the pools still rides the device plane —
+            # otherwise topology.edges() would contradict the live
+            # per-engine edge list (partial degradation is per-edge)
+            pool_degraded = all(
+                b != "device" for b in self._edge_backend.values()
+            )
+        if pool_degraded:
+            self.topology.mark_fallback(self._prefill_pool,
+                                        self._decode_pool, reason)
+        logger.warning(
+            "fabric edge prefill%d->decode%d degraded to rpc (%s)",
+            src, dst, reason[:120],
+        )
+        try:
+            from ray_tpu.fabric import metrics as fabric_metrics
+
+            fabric_metrics.transfer_fallbacks_counter().inc(1, tags={
+                "model": self.model_tag,
+                "edge": f"prefill{src}->decode{dst}",
+            })
+        except Exception:  # noqa: BLE001 — observability never breaks serving
+            pass
+        self._update_fabric_gauges()
+
+    def _update_fabric_gauges(self) -> None:
+        try:
+            from ray_tpu.fabric import metrics as fabric_metrics
+
+            g = fabric_metrics.edges_active_gauge()
+            with self._lock:
+                counts: dict[str, int] = {}
+                for b in self._edge_backend.values():
+                    counts[b] = counts.get(b, 0) + 1
+            for b in ("device", "rpc", "inproc"):
+                if counts.get(b) or b == self._primary:
+                    g.set(counts.get(b, 0),
+                          tags={"model": self.model_tag, "backend": b})
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _transfer_failed(self, handoff: KVHandoff, exc: BaseException,
+                         backend: Optional[str] = None,
+                         edge: Optional[tuple] = None) -> None:
         self.num_transfer_failures += 1
-        self._obs_transfer_event(handoff, error=str(exc))
+        self._obs_transfer_event(handoff, error=str(exc), backend=backend)
+        if backend == "device" and edge is not None:
+            self._fallback_edge(edge, reason=f"{type(exc).__name__}: {exc}")
         with self._lock:
             rec = self._inflight.get(handoff.request_id)
             if rec is not None:
                 # the sampler key rides the retry: the re-prefilled request
                 # continues the exact stream the lost handoff carried
-                rec["key_data"] = handoff.key_data
+                rec["key_data"] = np.asarray(handoff.key_data)
         self._requeue(handoff.request_id, reason=f"transfer:{exc}")
 
     def _requeue(self, rid: str, exclude_index: Optional[int] = None,
@@ -514,26 +703,37 @@ class DisaggOrchestrator:
 
     def _decode_loop(self, de: _PoolEngine) -> None:
         target_id = f"{self.model_tag}-decode{de.index}"
-        pending: list[tuple[KVHandoff, float]] = []  # (handoff, deadline)
+        # (handoff, deadline, backend) — the backend that delivered it
+        pending: list[tuple] = []
         consec_failures = 0
+        conns = list(self.connectors.items())
         while not self._stop:
             with de.lock:
                 busy = de.engine.has_unfinished()
-            # bounded receive: poll fast while decoding, park briefly idle
-            h = self.connector.recv(
-                target_id, timeout_s=0.001 if (busy or pending) else 0.05
-            )
+            # bounded receive across every live transfer plane: poll
+            # fast while decoding, park briefly idle
+            per_conn = (0.001 if (busy or pending) else 0.05) / len(conns)
+            h, src_backend = None, None
+            for name, conn in conns:
+                h = conn.recv(target_id, timeout_s=max(per_conn, 0.001))
+                if h is not None:
+                    src_backend = name
+                    break
             if h is not None:
                 if not h.verify():
+                    edge = ((h.src_engine, de.index)
+                            if h.src_engine is not None else None)
                     self._transfer_failed(
                         h, KVTransferError(
                             f"handoff {h.request_id!r} failed checksum on "
                             f"{target_id} (corrupt in flight)"
                         ),
+                        backend=src_backend, edge=edge,
                     )
                 else:
                     pending.append(
-                        (h, time.time() + self.config.transfer_timeout_s)
+                        (h, time.time() + self.config.transfer_timeout_s,
+                         src_backend)
                     )
             if pending:
                 pending = self._try_imports(de, pending)
@@ -593,7 +793,7 @@ class DisaggOrchestrator:
         from ray_tpu.llm.kv_cache import NoFreeBlocksError
 
         still: list = []
-        for h, deadline in pending:
+        for h, deadline, backend in pending:
             with self._lock:
                 live = h.request_id in self._inflight
             if not live:
@@ -607,30 +807,33 @@ class DisaggOrchestrator:
                     self._transfer_failed(h, KVTransferError(
                         f"decode engine {de.index} had no KV room for "
                         f"{h.request_id!r} within the transfer deadline"
-                    ))
+                    ), backend=backend)
                 else:
-                    still.append((h, deadline))
+                    still.append((h, deadline, backend))
                 continue
             except BaseException as e:  # noqa: BLE001 — bad handoff state
-                self._transfer_failed(h, e)
+                self._transfer_failed(h, e, backend=backend)
                 continue
-            self._obs_transfer_span(h, de.index, t_import0, time.time())
+            self._obs_transfer_span(h, de.index, t_import0, time.time(),
+                                    backend=backend)
         return still
 
     # -- observability --------------------------------------------------------
 
     def _obs_transfer_span(self, h: KVHandoff, decode_index: int,
-                           t_import0: float, t_done: float) -> None:
+                           t_import0: float, t_done: float,
+                           backend: Optional[str] = None) -> None:
         """llm.kv_transfer span: prefill-span end -> import complete.
         Tiles between engine.prefill and the first decode round so the
         request's e2e span coverage survives disaggregation."""
+        backend = backend or self._primary
         try:
             ctx = trace_context.TraceContext.from_dict(h.trace)
             trace_recorder.get_recorder().record(
                 "llm.kv_transfer", min(h.t_export, t_done), t_done, ctx=ctx,
                 attrs={
                     "request_id": h.request_id,
-                    "connector": self.connector.name,
+                    "backend": backend,
                     "decode_engine": decode_index,
                     "kv_tokens": h.num_kv_tokens,
                     "bytes": h.nbytes,
@@ -640,20 +843,21 @@ class DisaggOrchestrator:
             from ray_tpu.obs import slo
 
             slo.record_kv_transfer(
-                self.model_tag, self.connector.name,
+                self.model_tag, backend,
                 seconds=max(0.0, t_done - h.t_export), nbytes=h.nbytes,
             )
         except Exception:  # noqa: BLE001 — tracing must not break serving
             pass
 
-    def _obs_transfer_event(self, h: KVHandoff, error: str) -> None:
+    def _obs_transfer_event(self, h: KVHandoff, error: str,
+                            backend: Optional[str] = None) -> None:
         try:
             ctx = trace_context.TraceContext.from_dict(h.trace)
             now = time.time()
             trace_recorder.get_recorder().record(
                 "llm.kv_transfer_failed", now, now, ctx=ctx,
                 attrs={"request_id": h.request_id, "error": error[:200],
-                       "connector": self.connector.name},
+                       "backend": backend or self._primary},
                 status="error",
             )
         except Exception:  # noqa: BLE001

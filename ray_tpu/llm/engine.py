@@ -740,6 +740,12 @@ class LLMEngine:
     # with num_tokens N has KV written for positions 0..N-2 (the newest
     # sampled token is fed — and its KV written — by the NEXT step).
 
+    def kv_cache_device(self):
+        """The device this engine's paged KV cache lives on — the fabric
+        transport endpoint for device-direct imports (registering the
+        cache's own device makes the final import hop zero-copy)."""
+        return next(iter(self.cache["k"].devices()))
+
     def peek_prefix_tokens(self, prompt_token_ids: list,
                            lora_id: Optional[str] = None) -> int:
         """Read-only probe: prompt tokens a prefix-cache hit would cover
@@ -748,12 +754,16 @@ class LLMEngine:
             list(map(int, prompt_token_ids)), self._lora_slot(lora_id)
         )
 
-    def export_request(self, request_id: str):
+    def export_request(self, request_id: str, keep_on_device: bool = False):
         """Export a RUNNING request as a KVHandoff and drop local
         ownership. The request's blocks are released (full prompt blocks
         stay resurrectable in this engine's prefix cache — a re-prefill
         after a lost transfer hits them); callers transfer the handoff
-        and import it on a decode engine."""
+        and import it on a decode engine. With ``keep_on_device`` the
+        gathered pages stay device arrays (the fabric's device-direct
+        path: the handoff is device-sealed and never staged through
+        host RAM; use ``handoff.to_host()`` if an RPC edge ends up
+        carrying it after all)."""
         from ray_tpu.llm.disagg.handoff import KVHandoff
 
         req = self.requests.get(request_id)
@@ -767,14 +777,19 @@ class LLMEngine:
         slots = req.seq.slots_for_range(0, n_kv)
         # pad the gather to a power-of-two width (compiled-shape
         # bucketing on TPU); pad rows read the trash page and are
-        # sliced off host-side after the device->host copy
+        # sliced off host-side after the device->host copy (device-side
+        # on the keep_on_device path — the slice is a device op)
         width = max(1, 1 << (n_kv - 1).bit_length()) if n_kv else 1
         num_slots = c.num_blocks * c.block_size
         sl = np.full(width, num_slots, np.int32)
         sl[:n_kv] = slots
         sl = jnp.asarray(sl)
-        k_pages = np.asarray(self.cache["k"][:, :, sl, :])[:, :, :n_kv, :]
-        v_pages = np.asarray(self.cache["v"][:, :, sl, :])[:, :, :n_kv, :]
+        if keep_on_device:
+            k_pages = self.cache["k"][:, :, sl, :][:, :, :n_kv, :]
+            v_pages = self.cache["v"][:, :, sl, :][:, :, :n_kv, :]
+        else:
+            k_pages = np.asarray(self.cache["k"][:, :, sl, :])[:, :, :n_kv, :]
+            v_pages = np.asarray(self.cache["v"][:, :, sl, :])[:, :, :n_kv, :]
         lora_id = None
         if req.lora_slot:
             lora_id = next(
@@ -804,7 +819,7 @@ class LLMEngine:
                       else time.time()),
             trace=req.trace.to_dict() if req.trace is not None else None,
         )
-        handoff.seal()
+        handoff.seal(device=keep_on_device)
         # drop local ownership; sealed full blocks stay in the prefix cache
         self.running.remove(req)
         req.seq.release()
@@ -878,11 +893,28 @@ class LLMEngine:
         sl = np.full(width, num_slots, np.int32)  # pad rows hit the trash page
         sl[:n_kv] = seq.slots_for_range(0, n_kv)
         dt = self.cache["k"].dtype
-        k = np.zeros(handoff.k_pages.shape[:2] + (width,) + handoff.k_pages.shape[3:],
-                     handoff.k_pages.dtype)
-        v = np.zeros_like(k)
-        k[:, :, :n_kv] = handoff.k_pages
-        v[:, :, :n_kv] = handoff.v_pages
+        if isinstance(handoff.k_pages, jax.Array):
+            # fabric device path: the pages arrived as device arrays on
+            # this engine's endpoint device — pad and scatter entirely
+            # on-device, never staging the multi-MB payload through host
+            # RAM (device_put here is the final hop when the transport
+            # endpoint differs from the cache's device)
+            cache_devs = self.cache["k"].devices()
+            kp, vp = handoff.k_pages, handoff.v_pages
+            if kp.devices() != cache_devs:
+                dev = next(iter(cache_devs))
+                kp = jax.device_put(kp, dev)
+                vp = jax.device_put(vp, dev)
+            pad = [(0, 0), (0, 0), (0, width - n_kv), (0, 0)]
+            k = jnp.pad(kp.astype(dt), pad)
+            v = jnp.pad(vp.astype(dt), pad)
+        else:
+            k = np.zeros(
+                handoff.k_pages.shape[:2] + (width,) + handoff.k_pages.shape[3:],
+                handoff.k_pages.dtype)
+            v = np.zeros_like(k)
+            k[:, :, :n_kv] = handoff.k_pages
+            v[:, :, :n_kv] = handoff.v_pages
         self.cache = self._kv_import_fn(width)(
             self.cache, jnp.asarray(k, dt), jnp.asarray(v, dt), jnp.asarray(sl)
         )

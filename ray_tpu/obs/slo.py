@@ -87,9 +87,10 @@ def kv_transfer_histogram() -> Histogram:
     return Histogram(
         "llm_kv_transfer_seconds",
         description="disaggregated serving: prefill-side export -> "
-        "decode-side import complete for one KV handoff, seconds",
+        "decode-side import complete for one KV handoff, seconds, by "
+        "transport backend (inproc/rpc/device)",
         boundaries=_KV_TRANSFER_BOUNDARIES,
-        tag_keys=("model", "connector"),
+        tag_keys=("model", "backend"),
     )
 
 
@@ -99,8 +100,8 @@ def kv_transfer_bytes_counter():
     return Counter(
         "llm_kv_transfer_bytes_total",
         description="disaggregated serving: KV page bytes moved "
-        "prefill -> decode",
-        tag_keys=("model", "connector"),
+        "prefill -> decode, by transport backend (inproc/rpc/device)",
+        tag_keys=("model", "backend"),
     )
 
 
@@ -152,11 +153,12 @@ def record_request_slo(
         pass
 
 
-def record_kv_transfer(model: str, connector: str, *, seconds: float,
+def record_kv_transfer(model: str, backend: str, *, seconds: float,
                        nbytes: int) -> None:
-    """One completed KV handoff (disaggregated serving)."""
+    """One completed KV handoff (disaggregated serving), labelled by
+    the transport backend that carried it (inproc/rpc/device)."""
     try:
-        tags = {"model": model, "connector": connector}
+        tags = {"model": model, "backend": backend}
         kv_transfer_histogram().observe(seconds, tags=tags)
         kv_transfer_bytes_counter().inc(max(0, int(nbytes)), tags=tags)
     except Exception:  # noqa: BLE001

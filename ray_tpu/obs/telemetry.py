@@ -66,6 +66,7 @@ AGGREGATED_PREFIXES = (
     "ray_tpu_llm_",
     "ray_tpu_profiler_",
     "ray_tpu_train_",
+    "ray_tpu_fabric_",
 )
 
 _AGGREGATIONS: dict[str, str] = {}
@@ -958,10 +959,42 @@ class TelemetryStore:
             "ranks_lost_total": counter("train_ranks_lost_total"),
         }
 
+    def fabric_health(self, agg: Optional[dict] = None) -> dict:
+        """Transfer-fabric rollup for `ray_tpu status` (r15): active
+        edges per transport backend (summed over orchestrators),
+        device→rpc fallbacks burned, and the KV-byte mix per backend
+        (from the backend-labelled transfer counter). All None/empty
+        when no fabric is reporting."""
+        if agg is None:
+            agg = self.cluster_metrics()
+        edges: dict[str, int] = {}
+        acc = agg["gauges"].get(_fq("fabric_edges_active"))
+        if acc:
+            for skey, v in acc["series"].items():
+                backend = self._parse_tags_key(skey).get("backend", "")
+                edges[backend] = edges.get(backend, 0) + int(v)
+        fallbacks = None
+        acc = agg["counters"].get(_fq("fabric_transfer_fallbacks_total"))
+        if acc:
+            fallbacks = int(acc["total"])
+        bytes_by_backend: dict[str, float] = {}
+        acc = agg["counters"].get(_fq("llm_kv_transfer_bytes_total"))
+        if acc:
+            for skey, v in acc["series"].items():
+                backend = self._parse_tags_key(skey).get("backend", "")
+                bytes_by_backend[backend] = (
+                    bytes_by_backend.get(backend, 0.0) + float(v)
+                )
+        return {
+            "edges_by_backend": edges,
+            "fallbacks_total": fallbacks,
+            "kv_bytes_by_backend": bytes_by_backend,
+        }
+
     def status_payload(self, thresholds: Optional[SLOThresholds] = None) -> dict:
         """Everything `ray_tpu status` needs beyond the node table — the
         GCS assembles this so the CLI is ONE RPC. The full aggregation
-        pass (every series, under the lock) runs ONCE and feeds all five
+        pass (every series, under the lock) runs ONCE and feeds all six
         views."""
         agg = self.cluster_metrics()
         return {
@@ -971,6 +1004,7 @@ class TelemetryStore:
             "utilization": self.utilization(agg),
             "slo": self.slo_report(thresholds, agg),
             "trainer": self.trainer_health(agg),
+            "fabric": self.fabric_health(agg),
         }
 
 
@@ -1060,6 +1094,26 @@ def format_status(report: dict) -> str:
             f"  recoveries {int(rec) if rec is not None else 0}"
             f"  ranks lost {int(lost) if lost is not None else 0}"
         )
+    fabric = report.get("fabric") or {}
+    if fabric.get("edges_by_backend"):
+        # the transfer fabric must SHOW here: which edges ride the chip
+        # interconnect vs the wire, and how many device edges have been
+        # burned down to their RPC fallback
+        eb = fabric["edges_by_backend"]
+        total_edges = sum(eb.values())
+        mix = " ".join(f"{b}={n}" for b, n in sorted(eb.items()) if n)
+        lines.append("== fabric ==")
+        line = f"  edges {total_edges} ({mix})"
+        fb = fabric.get("fallbacks_total")
+        line += f"  fallbacks {int(fb) if fb is not None else 0}"
+        lines.append(line)
+        bb = fabric.get("kv_bytes_by_backend") or {}
+        if bb:
+            lines.append(
+                "  kv bytes " + " ".join(
+                    f"{b}={_fmt_bytes(n)}" for b, n in sorted(bb.items()) if n
+                )
+            )
     u = report.get("utilization", {})
     occ = u.get("kv_page_occupancy")
     lines.append("== utilization ==")

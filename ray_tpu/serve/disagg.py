@@ -64,8 +64,10 @@ def _build_engine(llm_config):
 def _make_connector(kind: str, namespace: str):
     from ray_tpu.llm.disagg.connector import make_connector
 
-    if kind in ("inproc", "in_process", "inprocess"):
-        return make_connector("inproc", namespace=namespace)
+    if kind in ("inproc", "in_process", "inprocess", "device"):
+        # namespaced planes: replicas of one app share endpoints through
+        # the process-global maps without cross-delivering another app's
+        return make_connector(kind, namespace=namespace)
     return make_connector(kind)
 
 
@@ -80,6 +82,10 @@ class PrefillServer:
         self.engine = _build_engine(llm_config)
         self.engine.model_tag = f"{llm_config.model_id}-prefill"
         self.connector = _make_connector(connector_kind, namespace)
+        # device plane: export device-resident + device-sealed, so the
+        # pages go gather -> device_put without ever staging through
+        # host RAM (and without a host-CRC + device-reseal round trip)
+        self._export_dev = getattr(self.connector, "name", "") == "device"
         self._lock = threading.Lock()
         self._outs: dict[str, Any] = {}
         self._handoffs: dict[str, Any] = {}
@@ -111,7 +117,7 @@ class PrefillServer:
                     # own export up from the shared dict)
                     for r in list(self.engine.running):
                         self._handoffs[r.request_id] = self.engine.export_request(
-                            r.request_id
+                            r.request_id, keep_on_device=self._export_dev
                         )
                 elif request_id not in self._outs:
                     raise RuntimeError(
@@ -156,7 +162,14 @@ class DecodeServer:
         self.engine.model_tag = f"{llm_config.model_id}-decode"
         self.connector = _make_connector(connector_kind, namespace)
         self._target_id = f"decode-{uuid.uuid4().hex[:12]}"
-        self._target = self.connector.register_target(self._target_id)
+        if getattr(self.connector, "name", "") == "device":
+            # device plane: pin the endpoint to this engine's KV-cache
+            # device so the sender's device_put IS the final hop
+            self._target = self.connector.register_target(
+                self._target_id, device=self.engine.kv_cache_device()
+            )
+        else:
+            self._target = self.connector.register_target(self._target_id)
         self._lock = threading.Lock()
         self._done: dict[str, Any] = {}     # rid -> final RequestOutput
         self._failed: dict[str, str] = {}   # rid -> reason (corrupt/no room)

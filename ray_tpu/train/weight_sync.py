@@ -1,0 +1,188 @@
+"""Learner -> rollout weight publishing over the fabric transfer plane.
+
+The second client of ``fabric.transport.send_arrays`` (the first is the
+disaggregated KV handoff), and the missing piece ROADMAP item 5 names:
+"Podracer architectures for scalable RL" (PAPERS.md) decouples actor
+and learner pools on one pod, which makes weight sync a *device-array
+move between pools* — exactly the shape of a KV handoff, so it rides
+the same plane instead of growing a second bespoke one.
+
+``WeightPublisher`` (learner side) flattens a params pytree and ships
+the leaves as one versioned bundle per rollout endpoint;
+``WeightSubscriber`` (rollout side) polls its endpoint between
+generation rounds, verifies the bundle's device checksum, and swaps the
+serving engine's params **bitwise** (params are jit *arguments*
+throughout llm/engine.py, never closed-over constants, so a swap takes
+effect on the very next step with zero recompiles for same-shape
+leaves). Versions are monotonic: a delayed older publish landing after
+a newer one is dropped, never applied backwards.
+
+Leaf order is the pytree's own deterministic ``tree_leaves`` order; the
+subscriber unflattens against the *receiving* engine's tree structure,
+so the treedef itself never needs to cross the wire (both sides hold a
+same-architecture params tree, the precondition weight sync has
+anyway). A leaf-count mismatch fails loudly — a silent partial apply
+would serve a chimera model.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ray_tpu.fabric.transport import DeviceTransport, FabricTransferError
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.train.weight_sync")
+
+
+class WeightSyncError(Exception):
+    """A publish failed, arrived corrupt, or doesn't match the
+    subscriber's params structure."""
+
+
+def _leaf_key(i: int) -> str:
+    return f"w{i:05d}"  # fixed width: sorted() order == leaf order
+
+
+class WeightPublisher:
+    """Learner-side: publish a params pytree to rollout endpoints."""
+
+    def __init__(self, transport: Optional[DeviceTransport] = None,
+                 namespace: str = "weights"):
+        # owns the transport iff it constructed it: close() then removes
+        # the registered endpoints from the process-global plane (each
+        # queue can pin up to endpoint_capacity full params copies on
+        # device — an abandoned publisher must not leak that forever)
+        self._owns_transport = transport is None
+        self.transport = transport or DeviceTransport(namespace=namespace)
+        self._version = 0
+        self.num_published = 0
+
+    def register_rollout(self, endpoint_id: str, device: Any = None) -> tuple:
+        """Bind one rollout engine's receive endpoint (pass the engine's
+        param/cache device so the put lands where generation reads)."""
+        return self.transport.register_endpoint(endpoint_id, device=device)
+
+    def publish(self, params: Any, targets: list,
+                version: Optional[int] = None,
+                timeout_s: float = 30.0) -> int:
+        """Ship ``params`` to every target as one sealed device bundle;
+        returns the published version (monotonic when auto-assigned)."""
+        import jax
+
+        leaves = jax.tree_util.tree_leaves(params)
+        if version is None:
+            self._version += 1
+            version = self._version
+        else:
+            self._version = max(self._version, int(version))
+        arrays = {_leaf_key(i): leaf for i, leaf in enumerate(leaves)}
+        meta = {"version": int(version), "num_leaves": len(leaves)}
+        for target in targets:
+            try:
+                self.transport.send_arrays(
+                    target, arrays, meta=meta, timeout_s=timeout_s,
+                    bundle_id=f"weights-v{version}",
+                )
+            except FabricTransferError as e:
+                raise WeightSyncError(
+                    f"weight publish v{version} to {target!r} failed: {e}"
+                ) from e
+        self.num_published += 1
+        return int(version)
+
+    def close(self) -> None:
+        if self._owns_transport:
+            self.transport.close()
+
+
+class WeightSubscriber:
+    """Rollout-side: poll for publishes, apply the newest to an engine."""
+
+    def __init__(self, transport: DeviceTransport, endpoint_id: str):
+        self.transport = transport
+        self.endpoint_id = endpoint_id
+        self.version = 0          # newest applied (or held) version
+        self.num_applied = 0
+        self.num_stale_dropped = 0
+        self.num_corrupt_dropped = 0
+
+    def poll(self, timeout_s: float = 0.05):
+        """Drain the endpoint; returns the newest verified (version,
+        leaves) newer than anything seen, or None. Corrupt bundles are
+        counted and dropped (the learner's next publish supersedes —
+        weight sync is idempotent by version, there is nothing to
+        re-prefill)."""
+        newest = None
+        while True:
+            b = self.transport.recv_arrays(self.endpoint_id,
+                                           timeout_s=timeout_s)
+            if b is None:
+                break
+            timeout_s = 0.0  # only the first wait blocks; then drain
+            if not b.verify():
+                self.num_corrupt_dropped += 1
+                logger.warning("dropping corrupt weight bundle %r",
+                               b.bundle_id)
+                continue
+            v = int(b.meta.get("version", 0))
+            if v <= self.version or (newest and v <= newest[0]):
+                self.num_stale_dropped += 1
+                continue
+            leaves = [b.arrays[k] for k in sorted(b.arrays)]
+            if len(leaves) != int(b.meta.get("num_leaves", len(leaves))):
+                self.num_corrupt_dropped += 1
+                continue
+            newest = (v, leaves)
+        return newest
+
+    def apply_to_engine(self, engine: Any, timeout_s: float = 0.05) -> Optional[int]:
+        """Poll and, if a newer version arrived, swap ``engine.params``
+        in place (unflattened against the engine's own tree structure).
+        Returns the applied version or None. Callers swap between
+        generation rounds — mid-request decode keeps reading the old
+        tree it was dispatched with until the next step picks this up."""
+        import jax
+
+        got = self.poll(timeout_s=timeout_s)
+        if got is None:
+            return None
+        version, leaves = got
+        treedef = jax.tree_util.tree_structure(engine.params)
+        if treedef.num_leaves != len(leaves):
+            raise WeightSyncError(
+                f"weight bundle v{version} has {len(leaves)} leaves, "
+                f"engine params tree has {treedef.num_leaves} — "
+                "publisher and rollout engine disagree on architecture"
+            )
+        engine.params = jax.tree_util.tree_unflatten(treedef, leaves)
+        # sealed prefix KV was computed with the OLD weights: a hit
+        # against it after the swap would splice stale keys/values into
+        # new-weight attention. Running requests keep their own
+        # refcounted blocks (they finish on the weights they started
+        # with); only the zero-ref reuse pool is dropped.
+        allocator = getattr(engine, "allocator", None)
+        if allocator is not None:
+            allocator.drop_prefix_cache()
+        self.version = version
+        self.num_applied += 1
+        return version
+
+    def stats(self) -> dict:
+        return {
+            "endpoint": self.endpoint_id,
+            "version": self.version,
+            "num_applied": self.num_applied,
+            "num_stale_dropped": self.num_stale_dropped,
+            "num_corrupt_dropped": self.num_corrupt_dropped,
+        }
+
+    def close(self) -> None:
+        """Drop any queued bundles; the transport (publisher-owned)
+        outlives the subscriber, so only the backlog is drained here."""
+        try:
+            while self.transport.recv_arrays(self.endpoint_id,
+                                             timeout_s=0.0) is not None:
+                pass
+        except FabricTransferError:
+            pass  # endpoint already gone (publisher closed first)

@@ -1,0 +1,873 @@
+"""ray_tpu.fabric tests: device-direct transfer plane + multi-slice
+pool fabric + per-edge transport selection.
+
+Contracts under test:
+ * the generic transport: arrays land on the target endpoint's device,
+   sealed with a device-computed checksum; device-side corruption is
+   detected at verify (DROP_DEVICE_TRANSFER / CORRUPT_DEVICE_TRANSFER);
+ * disagg over ``DeviceKVConnector`` is byte-identical to colocated
+   with ZERO decode-side prefill recompute, and a seeded device fault
+   degrades exactly the faulted edge to its RPC fallback under the
+   existing re-prefill budget (no hang, no lost/dup tokens);
+ * ``send_arrays`` is exercised by BOTH clients: the KV handoff and the
+   learner→rollout weight publish (rollout serves the updated weights
+   bitwise, stale/corrupt publishes dropped);
+ * ``RpcKVConnector`` large handoffs degrade to chunked multi-frame
+   sends — regression at exactly the single-frame boundary;
+ * topology: mesh-group edges, fallback state, slice pools reserved via
+   STRICT_PACK placement groups (all-or-nothing);
+ * fabric observability: backend-labelled transfer metrics, edge/
+   fallback gauges with declared aggregations (check_metrics green),
+   and the ``== fabric ==`` block in `ray_tpu status`.
+"""
+
+import dataclasses
+import json
+import os
+import pickle
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.fabric import (
+    ArrayBundle,
+    DeviceKVConnector,
+    DeviceTransport,
+    FabricTopology,
+    FabricTransferError,
+    SlicePoolSpec,
+    build_fabric,
+    build_topology,
+)
+from ray_tpu.fabric.transport import corrupt_on_device
+from ray_tpu.llm.disagg import (
+    DisaggConfig,
+    DisaggOrchestrator,
+    KVTransferError,
+    RpcKVConnector,
+)
+from ray_tpu.llm.disagg.connector import CHUNK_MARGIN
+from ray_tpu.llm.engine import EngineConfig, LLMEngine
+from ray_tpu.llm.sampling import SamplingParams
+from ray_tpu.models import llama
+
+pytestmark = pytest.mark.fabric
+
+FP32_TINY = dataclasses.replace(llama.LLAMA_TINY, dtype=jnp.float32)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GREEDY = SamplingParams(max_tokens=10, temperature=0.0, ignore_eos=True)
+
+
+def engine_config(**kw):
+    kw.setdefault("model", FP32_TINY)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_num_seqs", 4)
+    kw.setdefault("max_prefill_len", 64)
+    return EngineConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama.init_params(FP32_TINY, jax.random.key(0))
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(1)
+    return [
+        [int(x) for x in rng.integers(3, 120, rng.integers(8, 24))]
+        for _ in range(4)
+    ]
+
+
+@pytest.fixture(scope="module")
+def colocated_out(tiny_params, prompts):
+    eng = LLMEngine(engine_config(), params=tiny_params, seed=0)
+    return eng.generate(prompts, GREEDY)
+
+
+# ---------------------------------------------------------------------------
+# transport: send_arrays / recv_arrays + device integrity
+# ---------------------------------------------------------------------------
+
+
+def test_device_transport_roundtrip_lands_on_endpoint_device():
+    t = DeviceTransport(namespace="t-roundtrip")
+    try:
+        dev = jax.devices()[min(1, len(jax.devices()) - 1)]
+        tok = t.register_endpoint("e0", device=dev)
+        a = jnp.arange(256, dtype=jnp.float32).reshape(16, 16)
+        t.send_arrays(tok, {"x": a}, meta={"v": 7})
+        b = t.recv_arrays("e0", timeout_s=2.0)
+        assert b is not None and b.verify()
+        assert b.meta["v"] == 7
+        # the move happened: the received array lives on the endpoint's
+        # device (the ICI hop on real hardware)
+        assert b.arrays["x"].devices() == {dev}
+        np.testing.assert_array_equal(np.asarray(b.arrays["x"]), np.asarray(a))
+        # bounded receive: empty endpoint returns None, never parks
+        assert t.recv_arrays("e0", timeout_s=0.01) is None
+        with pytest.raises(FabricTransferError, match="unknown"):
+            t.send_arrays(("t-roundtrip", "nope"), {"x": a})
+    finally:
+        t.close()
+
+
+def test_cross_instance_transport_shares_one_plane():
+    """Sender and receiver hold SEPARATE transport instances in one
+    process (the serve-replica shape: each replica constructs its own
+    connector): the endpoint's queue AND device pin resolve through the
+    process-global namespaced plane, so the put still lands on the
+    receiver's device."""
+    recv_t = DeviceTransport(namespace="t-xinst")
+    send_t = DeviceTransport(namespace="t-xinst")
+    try:
+        dev = jax.devices()[-1]
+        tok = recv_t.register_endpoint("e0", device=dev)
+        a = jnp.arange(64, dtype=jnp.float32)
+        send_t.send_arrays(tok, {"x": a})
+        b = recv_t.recv_arrays("e0", timeout_s=2.0)
+        assert b is not None and b.verify()
+        assert b.arrays["x"].devices() == {dev}
+    finally:
+        recv_t.close()
+        send_t.close()
+
+
+def test_openai_stats_surface_fabric_view(tiny_params):
+    """LLMConfig(disagg={connector: device}) serves through the device
+    plane and GET /v1/stats carries the fabric edge/backend picture."""
+    import asyncio
+
+    from ray_tpu.llm.openai_api import LLMConfig, LLMServer
+
+    class Req:
+        def __init__(self, path, method, body=None):
+            self.path, self.method, self._b = path, method, body
+
+        def json(self):
+            return self._b
+
+    body = {"prompt": "fabric stats", "max_tokens": 6, "temperature": 0.0}
+    srv = LLMServer(LLMConfig(model_id="t-oai-ref", engine=engine_config(),
+                              params=tiny_params))
+    try:
+        expected = asyncio.run(srv.completions(dict(body)))
+    finally:
+        srv.shutdown()
+    dsrv = LLMServer(LLMConfig(
+        model_id="t-oai-fab", engine=engine_config(), params=tiny_params,
+        disagg={"num_prefill": 1, "num_decode": 1, "connector": "device"},
+    ))
+    try:
+        out = asyncio.run(dsrv.completions(dict(body)))
+        assert out["choices"][0]["text"] == expected["choices"][0]["text"]
+        stats = asyncio.run(dsrv.__call__(Req("/v1/stats", "GET")))
+        assert stats["mode"] == "disagg"
+        assert stats["fabric"]["backends"] == {"device": 1}
+        assert all(e["backend"] == "device" for e in stats["fabric"]["edges"])
+        assert stats["fabric"]["fallbacks"] == 0
+        assert stats["decode"][0]["num_prefill_batches"] == 0
+    finally:
+        dsrv.shutdown()
+
+
+def test_transport_backlog_full_fails_sender_not_memory():
+    """Bounded endpoints: a consumer that stopped draining fails the
+    SENDER with the documented timeout failure mode instead of pinning
+    device arrays without bound (review-found: unbounded queues +
+    unused timeout_s)."""
+    t = DeviceTransport(namespace="t-backlog", endpoint_capacity=2)
+    try:
+        tok = t.register_endpoint("e0")
+        a = jnp.arange(16, dtype=jnp.float32)
+        t.send_arrays(tok, {"x": a}, timeout_s=0.5)
+        t.send_arrays(tok, {"x": a}, timeout_s=0.5)
+        with pytest.raises(FabricTransferError, match="backlog"):
+            t.send_arrays(tok, {"x": a}, timeout_s=0.05)
+        assert t.num_dropped == 1
+        # draining one slot unblocks the sender again
+        assert t.recv_arrays("e0", timeout_s=1.0) is not None
+        t.send_arrays(tok, {"x": a}, timeout_s=0.5)
+    finally:
+        t.close()
+
+
+def test_device_checksum_catches_on_device_corruption():
+    a = jnp.arange(128, dtype=jnp.float32)
+    bundle = ArrayBundle("b0", {"w": a}).seal()
+    assert bundle.verify()
+    bad = dataclasses.replace(bundle, arrays={"w": corrupt_on_device(a)})
+    assert not bad.verify()
+    assert bundle.verify()  # copy-on-corrupt: the original is untouched
+    # bf16 lanes corrupt + detect too (itemsize-2 bitcast path)
+    h = jnp.ones(64, jnp.bfloat16)
+    hb = ArrayBundle("b1", {"w": h}).seal()
+    assert not dataclasses.replace(
+        hb, arrays={"w": corrupt_on_device(h)}
+    ).verify()
+
+
+def test_device_checksum_catches_swapped_arrays():
+    """The fold is CHAINED, not commutative: delivering two same-shape
+    arrays with their contents swapped must fail verify (a commutative
+    sum-of-sums would pass it — review-found weakness)."""
+    a = jnp.arange(64, dtype=jnp.float32)
+    b = jnp.arange(64, dtype=jnp.float32) + 1.0
+    bundle = ArrayBundle("b0", {"k_pages": a, "v_pages": b}).seal()
+    swapped = dataclasses.replace(bundle, arrays={"k_pages": b, "v_pages": a})
+    assert not swapped.verify()
+    # same property on the device-sealed handoff path
+    from ray_tpu.llm.disagg.handoff import KVHandoff
+
+    h = KVHandoff(
+        request_id="swap", prompt_token_ids=[1, 2], output_token_ids=[3],
+        sampling_params=None, key_data=np.zeros(2, np.uint32),
+        num_kv_tokens=2, k_pages=jnp.asarray(a).reshape(1, 1, 2, 32),
+        v_pages=jnp.asarray(b).reshape(1, 1, 2, 32), model_sig=(1, 1, 32),
+    ).seal(device=True)
+    assert h.verify()
+    assert not dataclasses.replace(
+        h, k_pages=h.v_pages, v_pages=h.k_pages
+    ).verify()
+
+
+def test_device_sealed_handoff_export_verify(tiny_params, prompts):
+    pre = LLMEngine(engine_config(), params=tiny_params, seed=0)
+    pre.add_request(prompts[0], GREEDY, request_id="d1")
+    pre.step()
+    h = pre.export_request("d1", keep_on_device=True)
+    assert h.checksum_kind == "device_u32"
+    assert isinstance(h.k_pages, jax.Array)
+    assert h.verify()
+    bad = dataclasses.replace(h, k_pages=corrupt_on_device(h.k_pages))
+    assert not bad.verify()
+    # to_host converts to ndarray + CRC sealing for the pickling planes
+    host = h.to_host()
+    assert host.checksum_kind == "crc32" and host.verify()
+    assert isinstance(host.k_pages, np.ndarray)
+    np.testing.assert_array_equal(host.k_pages, np.asarray(h.k_pages))
+
+
+# ---------------------------------------------------------------------------
+# topology + slice pools
+# ---------------------------------------------------------------------------
+
+
+def test_topology_mesh_groups_edges_and_fallback():
+    topo = FabricTopology()
+    topo.add_pool("prefill", "prefill", "s0", 2)
+    topo.add_pool("decode", "decode", "s1", 2)
+    topo.add_pool("draft", "draft", "s2", 1)
+    # distinct slices: no shared mesh -> rpc
+    assert topo.edge_backend("prefill", "decode") == "rpc"
+    topo.link("s0", "s1")
+    assert topo.shares_mesh("prefill", "decode")
+    assert topo.edge_backend("prefill", "decode") == "device"
+    assert topo.edge_backend("prefill", "draft") == "rpc"
+    # transitive mesh grouping: s2 joins the s0/s1 domain
+    topo.link("s1", "s2")
+    assert topo.edge_backend("decode", "draft") == "device"
+    # fallback degrades the edge, once
+    assert topo.mark_fallback("prefill", "decode", "chaos")
+    assert not topo.mark_fallback("prefill", "decode", "again")
+    assert topo.edge_backend("prefill", "decode") == "rpc"
+    assert topo.fallbacks() == {"prefill->decode": "chaos"}
+    # the reverse edge is independent state
+    assert topo.edge_backend("decode", "prefill") == "device"
+    # wire roundtrip carries declaration, not runtime fallback state
+    clone = FabricTopology.from_dict(topo.to_dict())
+    assert clone.edge_backend("prefill", "decode") == "device"
+    with pytest.raises(ValueError, match="backend"):
+        topo.set_edge_backend("prefill", "decode", "carrier-pigeon")
+    topo.set_edge_backend("draft", "prefill", "inproc")
+    assert topo.edge_backend("draft", "prefill") == "inproc"
+    assert {(e["src"], e["dst"]): e["backend"] for e in topo.edges()}[
+        ("draft", "prefill")
+    ] == "inproc"
+
+
+def test_slice_pools_reserve_placement_groups_all_or_nothing():
+    import ray_tpu
+    from ray_tpu.core import runtime as rt
+    from ray_tpu.core.errors import PlacementGroupUnavailableError
+
+    if rt.is_initialized():
+        rt.shutdown_runtime()
+    ray_tpu.init(num_cpus=8, resources={"slice:s0": 4, "slice:s1": 4})
+    try:
+        specs = [
+            SlicePoolSpec("prefill", "prefill", "s0", size=2),
+            SlicePoolSpec("decode", "decode", "s1", size=2,
+                          resources={"CPU": 1}),
+        ]
+        plan = build_fabric(specs, links=[("s0", "s1")])
+        try:
+            desc = plan.describe()
+            assert set(desc["pools"]) == {"prefill", "decode"}
+            edges = {(e["src"], e["dst"]): e["backend"]
+                     for e in desc["edges"]}
+            assert edges[("prefill", "decode")] == "device"  # linked slices
+            avail = ray_tpu.available_resources()
+            # bundles actually reserved against the slice resources
+            assert avail.get("slice:s0", 0) == 2
+            assert avail.get("slice:s1", 0) == 2
+        finally:
+            plan.remove()
+        # a pool pinned to a slice nobody advertises fails loudly, and
+        # the half-reserved fabric is rolled back (all-or-nothing)
+        with pytest.raises(PlacementGroupUnavailableError):
+            build_fabric(
+                [SlicePoolSpec("prefill", "prefill", "s0", size=2),
+                 SlicePoolSpec("decode", "decode", "s9", size=1)],
+                ready_timeout_s=0.3,
+            )
+        deadline = time.time() + 5
+        while (ray_tpu.available_resources().get("slice:s0", 0) < 4
+               and time.time() < deadline):
+            time.sleep(0.05)  # pg removal drains async
+        assert ray_tpu.available_resources().get("slice:s0", 0) == 4
+    finally:
+        rt.shutdown_runtime()
+
+
+def test_build_fabric_raises_on_pending_at_deadline(monkeypatch):
+    """PlacementGroup.ready() returns False (no raise) for a group still
+    PENDING at the deadline — a transiently-full slice. build_fabric
+    must fail the whole plan and roll back, not hand the transfer plane
+    a topology describing unreserved pools (review-found gap)."""
+    import ray_tpu
+    from ray_tpu.core.errors import PlacementGroupUnavailableError
+
+    removed = []
+
+    class _PendingPG:
+        name = "stub"
+
+        def ready(self, timeout=None):
+            return False  # still PENDING, not infeasible
+
+        def remove(self):
+            removed.append(self)
+
+    monkeypatch.setattr(ray_tpu, "placement_group",
+                        lambda *a, **k: _PendingPG(), raising=False)
+    monkeypatch.setattr(ray_tpu, "remove_placement_group",
+                        lambda pg: pg.remove(), raising=False)
+    with pytest.raises(PlacementGroupUnavailableError, match="PENDING"):
+        build_fabric([SlicePoolSpec("prefill", "prefill", "s0", size=1)],
+                     ready_timeout_s=0.1)
+    assert len(removed) == 1  # all-or-nothing rollback ran
+
+
+# ---------------------------------------------------------------------------
+# disagg over the device backend: identity + per-edge fallback
+# ---------------------------------------------------------------------------
+
+
+def test_greedy_identity_device_backend_zero_recompute(tiny_params, prompts,
+                                                       colocated_out):
+    orch = DisaggOrchestrator(
+        DisaggConfig(engine=engine_config(), num_prefill=1, num_decode=2,
+                     connector="device"),
+        params=tiny_params, seed=0, model_tag="t-device",
+    )
+    try:
+        out = orch.generate(prompts, GREEDY, timeout_s=120)
+        assert out == colocated_out  # byte-identical
+        s = orch.stats()
+        # zero prefill recompute on the decode side
+        assert all(e["num_prefill_batches"] == 0 for e in s["decode"])
+        assert sum(e.get("num_kv_imports", 0) for e in s["decode"]) == len(prompts)
+        # every transfer rode the device plane; edges all device-direct
+        assert s["fabric"]["backends"] == {"device": len(prompts)}
+        assert s["fabric"]["fallbacks"] == 0
+        assert all(e["backend"] == "device" for e in s["fabric"]["edges"])
+        assert s["transfer"]["kv_transfers"] == len(prompts)
+        assert s["transfer"]["bytes_sent"] > 0
+    finally:
+        orch.shutdown()
+
+
+def test_seeded_sampling_identity_over_device_backend(tiny_params, prompts):
+    """A seeded temperature>0 request is token-identical colocated vs
+    over the device plane: key_data rides the bundle meta."""
+    sp = SamplingParams(max_tokens=10, temperature=0.9, top_k=8, top_p=0.95,
+                        seed=1234, ignore_eos=True)
+    rid = "seeded-fabric-1"
+    eng = LLMEngine(engine_config(), params=tiny_params, seed=0)
+    eng.add_request(prompts[0], sp, request_id=rid)
+    colocated = None
+    while eng.has_unfinished():
+        for out in eng.step():
+            if out.finished:
+                colocated = out.output_token_ids
+    assert colocated is not None
+    orch = DisaggOrchestrator(
+        DisaggConfig(engine=engine_config(), num_prefill=1, num_decode=1,
+                     connector="device"),
+        params=tiny_params, seed=0, model_tag="t-dev-seeded",
+    )
+    try:
+        _rid, q = orch.submit(prompts[0], sp, request_id=rid)
+        disagg = None
+        deadline = time.time() + 120
+        while disagg is None and time.time() < deadline:
+            out = q.get(timeout=120)
+            if isinstance(out, BaseException):
+                raise out
+            if out is not None and out.finished:
+                disagg = out.output_token_ids
+    finally:
+        orch.shutdown()
+    assert disagg == colocated
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("kind", ["drop_device_transfer",
+                                  "corrupt_device_transfer"])
+def test_device_fault_falls_back_to_rpc_edge_under_budget(
+        tiny_params, prompts, colocated_out, kind):
+    """A seeded device-transfer fault (lost before the move / corrupt
+    on arrival, caught at import by the device checksum) degrades
+    exactly the faulted edge to RPC and re-prefills under the existing
+    budget: bounded wall clock, byte-identical output, no lost/dup
+    tokens."""
+    from ray_tpu.chaos import harness as chaos
+    from ray_tpu.chaos.schedule import FaultSchedule, FaultSpec
+
+    sched = FaultSchedule(7, [
+        FaultSpec(kind, site="disagg.kv_transfer", max_fires=1),
+    ])
+    chaos.install(sched)
+    try:
+        orch = DisaggOrchestrator(
+            DisaggConfig(engine=engine_config(), num_prefill=1, num_decode=1,
+                         connector="device"),
+            params=tiny_params, seed=0, model_tag=f"t-{kind}",
+        )
+        try:
+            t0 = time.time()
+            out = orch.generate(prompts, GREEDY, timeout_s=120)
+            assert time.time() - t0 < 60  # bounded, not a hang
+            assert out == colocated_out  # the RPC retry is lossless
+            s = orch.stats()
+            assert orch.num_reprefills == 1
+            assert s["fabric"]["fallbacks"] == 1
+            # the faulted edge now rides the wire; the retry (and
+            # everything after) counted against the rpc plane
+            edges = {(e["src"], e["dst"]): e["backend"]
+                     for e in s["fabric"]["edges"]}
+            assert edges[("prefill0", "decode0")] == "rpc"
+            assert s["fabric"]["backends"].get("rpc", 0) >= 1
+            assert sched.fired_kinds() == [kind]
+        finally:
+            orch.shutdown()
+    finally:
+        chaos.uninstall()
+
+
+@pytest.mark.chaos
+def test_device_drop_budget_exhausts_loudly(tiny_params, prompts):
+    """Device edges degrade to RPC after the first fault — so to burn
+    the budget the schedule must also kill the RPC retries; the caller
+    then gets a typed error, never a hang."""
+    from ray_tpu.chaos import harness as chaos
+    from ray_tpu.chaos.schedule import FaultSchedule, FaultSpec
+
+    sched = FaultSchedule(3, [
+        FaultSpec("drop_device_transfer", site="disagg.kv_transfer"),
+        FaultSpec("drop_kv_transfer", site="disagg.kv_transfer"),
+    ])
+    chaos.install(sched)
+    try:
+        orch = DisaggOrchestrator(
+            DisaggConfig(engine=engine_config(), num_prefill=1, num_decode=1,
+                         connector="device", max_handoff_retries=1),
+            params=tiny_params, seed=0, model_tag="t-dev-budget",
+        )
+        try:
+            with pytest.raises(KVTransferError, match="budget"):
+                orch.generate([prompts[0]], GREEDY, timeout_s=60)
+        finally:
+            orch.shutdown()
+    finally:
+        chaos.uninstall()
+
+
+def test_injected_device_connector_gets_device_edges(tiny_params, prompts,
+                                                     colocated_out):
+    """An injected DeviceKVConnector instance outranks config.connector
+    (left at its 'inproc' default): the degenerate topology must key on
+    the EFFECTIVE primary, or every transfer would silently ride the
+    auto-built RPC fallback (review-found)."""
+    conn = DeviceKVConnector(namespace="t-injected")
+    orch = DisaggOrchestrator(
+        DisaggConfig(engine=engine_config(), num_prefill=1, num_decode=1),
+        params=tiny_params, seed=0, model_tag="t-injected",
+        connector=conn,
+    )
+    try:
+        out = orch.generate(prompts[:2], GREEDY, timeout_s=120)
+        assert out == colocated_out[:2]
+        s = orch.stats()
+        assert all(e["backend"] == "device" for e in s["fabric"]["edges"])
+        assert s["fabric"]["backends"] == {"device": 2}
+    finally:
+        orch.shutdown()
+
+
+@pytest.mark.chaos
+def test_partial_edge_fallback_keeps_pool_topology_device(tiny_params,
+                                                          prompts):
+    """One faulted engine edge out of two degrades ONLY itself: the
+    pool-level topology stays device while any engine edge still rides
+    the device plane (review-found: pool-granular mark contradicted the
+    per-engine edge list)."""
+    from ray_tpu.chaos import harness as chaos
+    from ray_tpu.chaos.schedule import FaultSchedule, FaultSpec
+
+    sched = FaultSchedule(7, [
+        FaultSpec("drop_device_transfer", site="disagg.kv_transfer",
+                  max_fires=1),
+    ])
+    chaos.install(sched)
+    try:
+        orch = DisaggOrchestrator(
+            DisaggConfig(engine=engine_config(), num_prefill=1, num_decode=2,
+                         connector="device"),
+            params=tiny_params, seed=0, model_tag="t-partial-fb",
+        )
+        try:
+            out = orch.generate(prompts, GREEDY, timeout_s=120)
+            assert all(o for o in out)
+            s = orch.stats()
+            assert s["fabric"]["fallbacks"] == 1
+            backends = {(e["src"], e["dst"]): e["backend"]
+                        for e in s["fabric"]["edges"]}
+            assert sorted(backends.values()) == ["device", "rpc"]
+            # the pool pair still has a live device edge -> not marked
+            assert orch.topology.edge_backend("prefill", "decode") == "device"
+            assert orch.topology.fallbacks() == {}
+        finally:
+            orch.shutdown()
+    finally:
+        chaos.uninstall()
+
+
+def test_fabric_topology_config_selects_rpc_for_unlinked_slices(
+        tiny_params, prompts, colocated_out):
+    """An explicit topology whose pools do NOT share a mesh keeps every
+    edge on RPC even with the device connector configured — transport
+    selection is the topology's call, not the connector default's."""
+    topo = build_topology([
+        SlicePoolSpec("prefill", "prefill", "s0", 1),
+        SlicePoolSpec("decode", "decode", "s1", 2),
+    ])  # no link: distinct ICI domains
+    orch = DisaggOrchestrator(
+        DisaggConfig(engine=engine_config(), num_prefill=1, num_decode=2,
+                     connector="device", fabric=topo),
+        params=tiny_params, seed=0, model_tag="t-topo-rpc",
+    )
+    try:
+        out = orch.generate(prompts[:2], GREEDY, timeout_s=120)
+        assert out == colocated_out[:2]
+        s = orch.stats()
+        assert all(e["backend"] == "rpc" for e in s["fabric"]["edges"])
+        assert s["fabric"]["backends"] == {"rpc": 2}
+    finally:
+        orch.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# chunked multi-frame RPC sends (satellite: MAX_FRAME)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def exported_handoff(tiny_params, prompts):
+    pre = LLMEngine(engine_config(), params=tiny_params, seed=0)
+    pre.add_request(prompts[0], GREEDY, request_id="c1")
+    pre.step()
+    return pre.export_request("c1")
+
+
+def test_rpc_chunked_send_at_exact_frame_boundary(exported_handoff):
+    """Regression at exactly the r10 frame-guard boundary: a handoff
+    whose pickled blob fits the chunk budget exactly rides ONE frame;
+    one byte over degrades to seq-numbered chunks — both arrive
+    byte-identical, neither raises."""
+    h = exported_handoff
+    blob_len = len(pickle.dumps(h, protocol=5))
+    for max_frame in (blob_len + CHUNK_MARGIN,      # exactly one full chunk
+                      blob_len + CHUNK_MARGIN - 1,  # one byte over: 2 chunks
+                      CHUNK_MARGIN + 2048):         # many small chunks
+        conn = RpcKVConnector(max_frame_bytes=max_frame)
+        try:
+            tgt = conn.register_target("d0")
+            conn.send(tgt, h)
+            got = conn.recv("d0", timeout_s=10.0)
+            assert got is not None and got.verify(), max_frame
+            np.testing.assert_array_equal(got.k_pages, h.k_pages)
+            np.testing.assert_array_equal(got.v_pages, h.v_pages)
+            assert got.output_token_ids == h.output_token_ids
+        finally:
+            conn.close()
+
+
+def test_rpc_chunk_reassembly_crc_rejects_torn_blob(exported_handoff):
+    """A reassembled blob whose CRC disagrees (torn mid-transfer) fails
+    typed at the receiver — the sender sees KVTransferError, never a
+    poisoned queue entry."""
+    conn = RpcKVConnector(max_frame_bytes=CHUNK_MARGIN + 1024)
+    try:
+        tgt = conn.register_target("d0")
+        blob = pickle.dumps(exported_handoff, protocol=5)
+        cap = 1024
+        chunks = [blob[i:i + cap] for i in range(0, len(blob), cap)]
+        bad = bytes([chunks[0][0] ^ 0xFF]) + chunks[0][1:]
+        with pytest.raises(KVTransferError, match="CRC"):
+            for seq, data in enumerate([bad] + chunks[1:]):
+                conn._on_kv_chunk(
+                    {"target": "d0", "xfer": "torn-1", "seq": seq,
+                     "total": len(chunks), "data": data,
+                     "crc": __import__("zlib").crc32(blob) & 0xFFFFFFFF},
+                    ("127.0.0.1", 0),
+                )
+        assert conn.recv("d0", timeout_s=0.05) is None  # nothing delivered
+        assert conn._partial == {}  # reassembly state fully drained
+    finally:
+        conn.close()
+
+
+def test_rpc_connector_rejects_frame_budget_below_margin():
+    with pytest.raises(ValueError, match="headroom"):
+        RpcKVConnector(max_frame_bytes=CHUNK_MARGIN)
+
+
+def test_rpc_chunked_send_bounded_by_overall_timeout(exported_handoff):
+    """timeout_s bounds the WHOLE multi-chunk transfer, not each chunk:
+    an exhausted deadline raises typed mid-transfer instead of letting
+    one handoff hold the sender for N*timeout (review-found)."""
+    conn = RpcKVConnector(max_frame_bytes=CHUNK_MARGIN + 512)
+    try:
+        tgt = conn.register_target("d0")
+        with pytest.raises(KVTransferError, match="exceeded"):
+            conn.send(tgt, exported_handoff, timeout_s=1e-9)
+    finally:
+        conn.close()
+
+
+def test_rpc_chunk_deadline_refreshes_per_chunk(exported_handoff):
+    """A slow-but-live multi-chunk sender must not be GC'd mid-flight:
+    the reassembly deadline extends on every arriving chunk (each
+    sender call is itself bounded by ttl_s, so N chunks may legally
+    take up to N*ttl_s total — review-found hang)."""
+    import zlib
+
+    conn = RpcKVConnector(max_frame_bytes=CHUNK_MARGIN + 1024)
+    try:
+        conn.register_target("d0")
+        blob = pickle.dumps(exported_handoff, protocol=5)
+        cap = 1024
+        chunks = [blob[i:i + cap] for i in range(0, len(blob), cap)]
+        assert len(chunks) >= 3
+        crc = zlib.crc32(blob) & 0xFFFFFFFF
+        for seq, data in enumerate(chunks):
+            # each inter-chunk gap exceeds ttl_s; total transfer time is
+            # several times ttl_s — still delivered, because every chunk
+            # pushes the deadline out by another ttl_s
+            time.sleep(0.12)
+            conn._on_kv_chunk(
+                {"target": "d0", "xfer": "slow-1", "seq": seq,
+                 "total": len(chunks), "data": data, "crc": crc,
+                 "ttl_s": 0.2},
+                ("127.0.0.1", 0),
+            )
+        got = conn.recv("d0", timeout_s=1.0)
+        assert got is not None and got.verify()
+        assert conn._partial == {}
+    finally:
+        conn.close()
+
+
+# ---------------------------------------------------------------------------
+# weight publish: the second send_arrays client
+# ---------------------------------------------------------------------------
+
+
+def test_weight_publish_rollout_serves_updated_weights_bitwise(tiny_params,
+                                                               prompts):
+    from ray_tpu.train.weight_sync import WeightPublisher, WeightSubscriber
+
+    p_new = llama.init_params(FP32_TINY, jax.random.key(42))
+    prompt = prompts[0]
+    ref = LLMEngine(engine_config(), params=p_new, seed=0).generate(
+        [prompt], GREEDY)
+
+    rollout = LLMEngine(engine_config(), params=tiny_params, seed=0)
+    before = rollout.generate([prompt], GREEDY)  # also warms prefix cache
+    assert before != ref
+
+    pub = WeightPublisher(namespace="t-wsync")
+    tgt = pub.register_rollout("rollout0", device=rollout.kv_cache_device())
+    sub = WeightSubscriber(pub.transport, "rollout0")
+    v = pub.publish(p_new, [tgt])
+    assert sub.apply_to_engine(rollout) == v == 1
+    from ray_tpu.fabric.transport import _ENDPOINT_QUEUES
+    # bitwise: every leaf equals the published tree exactly
+    for a, b in zip(jax.tree_util.tree_leaves(rollout.params),
+                    jax.tree_util.tree_leaves(p_new)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # ...and SERVING reflects it bitwise despite the warm (old-weight)
+    # prefix cache: apply invalidates sealed prefixes
+    assert rollout.generate([prompt], GREEDY) == ref
+    # an older (or equal) version landing late is dropped, never applied
+    pub.publish(tiny_params, [tgt], version=1)
+    assert sub.apply_to_engine(rollout) is None
+    assert sub.num_stale_dropped == 1
+    assert rollout.generate([prompt], GREEDY) == ref
+    # lifecycle: close() removes the endpoint from the process-global
+    # plane (an abandoned publisher must not pin queued params forever)
+    sub.close()
+    pub.close()
+    assert not any(ns == "t-wsync" for ns, _ in _ENDPOINT_QUEUES)
+
+
+@pytest.mark.chaos
+def test_weight_publish_corrupt_bundle_dropped_not_applied(tiny_params):
+    """CORRUPT_DEVICE_TRANSFER on the weight plane: the subscriber's
+    verify rejects the bundle; the engine keeps serving the old weights
+    (the learner's next publish supersedes — nothing to re-prefill)."""
+    from ray_tpu.chaos import harness as chaos
+    from ray_tpu.chaos.schedule import FaultSchedule, FaultSpec
+    from ray_tpu.train.weight_sync import WeightPublisher, WeightSubscriber
+
+    p_new = llama.init_params(FP32_TINY, jax.random.key(42))
+    rollout = LLMEngine(engine_config(), params=tiny_params, seed=0)
+    old_leaves = [np.asarray(x) for x in
+                  jax.tree_util.tree_leaves(rollout.params)]
+    sched = FaultSchedule(11, [
+        FaultSpec("corrupt_device_transfer", site="disagg.kv_transfer",
+                  max_fires=1),
+    ])
+    chaos.install(sched)
+    try:
+        pub = WeightPublisher(namespace="t-wsync-chaos")
+        tgt = pub.register_rollout("rollout0")
+        sub = WeightSubscriber(pub.transport, "rollout0")
+        pub.publish(p_new, [tgt])
+        assert sub.apply_to_engine(rollout) is None
+        assert sub.num_corrupt_dropped == 1
+        for a, b in zip(jax.tree_util.tree_leaves(rollout.params), old_leaves):
+            np.testing.assert_array_equal(np.asarray(a), b)
+        # the retry (fault budget burned) applies cleanly
+        v = pub.publish(p_new, [tgt])
+        assert sub.apply_to_engine(rollout) == v
+        assert sched.fired_kinds() == ["corrupt_device_transfer"]
+        pub.close()
+    finally:
+        chaos.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# observability: backend labels, fabric gauges, status block
+# ---------------------------------------------------------------------------
+
+
+def test_fabric_metrics_labels_and_status_block(tiny_params, prompts):
+    from ray_tpu.chaos import harness as chaos
+    from ray_tpu.chaos.schedule import FaultSchedule, FaultSpec
+    from ray_tpu.obs.telemetry import TelemetryStore, annotated_snapshot
+    from ray_tpu.util.metrics import registry_snapshot
+
+    sched = FaultSchedule(7, [
+        FaultSpec("drop_device_transfer", site="disagg.kv_transfer",
+                  max_fires=1),
+    ])
+    chaos.install(sched)
+    try:
+        orch = DisaggOrchestrator(
+            DisaggConfig(engine=engine_config(), num_prefill=1, num_decode=1,
+                         connector="device"),
+            params=tiny_params, seed=0, model_tag="t-fab-obs",
+        )
+        try:
+            orch.generate(prompts[:2], GREEDY, timeout_s=120)
+        finally:
+            orch.shutdown()
+    finally:
+        chaos.uninstall()
+    names = {m.name for m in registry_snapshot()}
+    assert "ray_tpu_fabric_edges_active" in names
+    assert "ray_tpu_fabric_transfer_fallbacks_total" in names
+    # transfer SLO series carry the backend label now
+    hist = next(m for m in registry_snapshot()
+                if m.name.endswith("llm_kv_transfer_seconds"))
+    assert "backend" in hist.tag_keys
+    # the whole registry (incl. the fabric plane) stays lint-clean with
+    # aggregation kinds declared
+    from ray_tpu.analysis import metrics_registry
+    assert metrics_registry.run_check() == []
+
+    # GCS-side rollup + `ray_tpu status` rendering from one snapshot
+    store = TelemetryStore()
+    store.ingest("fab-reporter", annotated_snapshot())
+    health = store.fabric_health()
+    assert health["edges_by_backend"].get("rpc", 0) >= 1  # degraded edge
+    assert health["fallbacks_total"] >= 1
+    assert health["kv_bytes_by_backend"]  # backend-labelled byte mix
+    from ray_tpu.obs.telemetry import format_status
+    text = format_status({"nodes": [], **store.status_payload()})
+    assert "== fabric ==" in text
+    assert "fallbacks" in text
+
+
+# ---------------------------------------------------------------------------
+# bench capture gates + smoke
+# ---------------------------------------------------------------------------
+
+
+def test_checked_in_fabric_capture_gates():
+    """The checked-in microbench capture keeps the structural claim:
+    the device path's in-process handoff latency does not exceed RPC's
+    (it skips pickling, framing, and the socket). Refresh on the TPU —
+    the CPU capture prices software overhead, not the interconnect."""
+    doc = json.loads(open(
+        os.path.join(REPO, "benchmarks", "FABRIC_transfer_r15.json")
+    ).read())
+    assert doc["metric"] == "fabric_transfer_microbench"
+    assert doc["device_le_rpc_latency"] is True
+    for backend in ("inproc", "rpc", "device"):
+        b = doc["backends"][backend]
+        assert b["bytes_per_s"] > 0
+        assert b["mean_latency_s"] > 0
+        assert b["handoff_bytes"] > 0
+    assert doc["backends"]["device"]["mean_latency_s"] <= \
+        doc["backends"]["rpc"]["mean_latency_s"]
+    assert doc["weight_publish"]["bytes_per_s"] > 0
+
+
+@pytest.mark.slow
+def test_bench_fabric_smoke_cpu(tmp_path):
+    out = str(tmp_path / "fabric.json")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO})
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "fabric_bench.py"),
+         "--out", out, "--iters", "10", "--kv-tokens", "128"],
+        env=env, capture_output=True, text=True, timeout=540,
+    )
+    assert p.returncode == 0, (p.stdout[-800:], p.stderr[-800:])
+    doc = json.loads(open(out).read())
+    # completion-shaped smoke only: latency ORDERING on a loaded CI box
+    # is asserted against the checked-in capture, not a live run
+    for backend in ("inproc", "rpc", "device"):
+        assert doc["backends"][backend]["bytes_per_s"] > 0
+    assert doc["weight_publish"]["bytes_per_s"] > 0

@@ -176,7 +176,7 @@ def test_connector_roundtrip_inproc_and_rpc(exported):
 # ---------------------------------------------------------------------------
 
 
-@pytest.mark.parametrize("connector", ["inproc", "rpc"])
+@pytest.mark.parametrize("connector", ["inproc", "rpc", "device"])
 def test_greedy_identity_colocated_vs_disagg(tiny_params, prompts,
                                              colocated_out, connector):
     orch = DisaggOrchestrator(
