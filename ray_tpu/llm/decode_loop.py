@@ -17,6 +17,8 @@ engine makes the same trade in its multi-step scheduling mode.
 
 from __future__ import annotations
 
+import time
+
 import jax
 import jax.numpy as jnp
 
@@ -25,6 +27,20 @@ from ray_tpu.models.llama_decode import decode_step
 
 
 _chunk_hist = None
+_runtime_hooks = None  # (get_runtime, TaskState), resolved once
+
+
+def _timeline_hooks():
+    """One-time resolution of the timeline-export hooks: the runtime
+    import is heavyweight and record_chunk sits on the decode hot path
+    (it used to pay these imports EVERY chunk)."""
+    global _runtime_hooks
+    if _runtime_hooks is None:
+        from ray_tpu.core import runtime as rt
+        from ray_tpu.core.events import TaskState
+
+        _runtime_hooks = (rt.get_runtime, TaskState)
+    return _runtime_hooks
 
 
 def chunk_histogram():
@@ -55,12 +71,8 @@ def record_chunk(ms: float, n_steps: int, mode: str, batch_size: int) -> None:
         chunk_histogram().observe(
             ms, tags={"n_steps": str(n_steps), "mode": mode}
         )
-        import time
-
-        from ray_tpu.core import runtime as rt
-        from ray_tpu.core.events import TaskState
-
-        buf = rt.get_runtime().task_events
+        get_runtime, TaskState = _timeline_hooks()
+        buf = get_runtime().task_events
         end = time.time()
         span = f"profile-decode-chunk-{time.monotonic_ns()}"
         name = f"profile:decode_chunk:{n_steps}x{batch_size}"

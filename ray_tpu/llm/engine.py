@@ -143,7 +143,19 @@ class EngineConfig:
     # DEVICE per host round-trip (llm/decode_loop.py). 1 = classic
     # one-sync-per-token stepping. Chunks shrink automatically near a
     # request's max_tokens/max_seq; EOS overshoot is discarded host-side.
+    # With pipeline_decode this is only the adaptive controller's
+    # STARTING chunk; measured host-gap/device-step times take over.
     decode_chunk: int = 8
+    # pipelined decode (llm/pipeline.py): batch state lives on device
+    # across chunks, stop conditions evaluate in-graph (finished rows
+    # freeze + all-done early-out), and chunk N+1 dispatches before
+    # chunk N's tokens are synced so host bookkeeping overlaps device
+    # compute; chunk length adapts to the measured host gap. Token
+    # streams are bitwise-identical to the sync path. False keeps the
+    # classic sync path (also taken automatically for batches with
+    # > pipeline.STOP_WIDTH_CAP stop ids, and by spec decoding, which
+    # has its own round structure).
+    pipeline_decode: bool = True
     # profile=True: every decode round trip lands in the
     # llm_decode_chunk_ms histogram + timeline (ray_tpu.profiler
     # surfaces); profile_decode() gives the full roofline breakdown
@@ -176,6 +188,11 @@ class EngineConfig:
         # a prefill bucket longer than the context window can never be
         # used; clamping keeps bucket compilation bounded by the model
         self.max_prefill_len = min(self.max_prefill_len, self.model.max_seq)
+        # chunk lengths compile per value: clamp to the bounded bucket
+        # set so the jit cache can never grow past it
+        from ray_tpu.llm.pipeline import CHUNK_BUCKETS
+
+        self.decode_chunk = min(self.decode_chunk, CHUNK_BUCKETS[-1])
         if self.spec is not None:
             from ray_tpu.llm.spec import SpecConfig
 
@@ -358,6 +375,17 @@ class LLMEngine:
         self.num_prefill_batches = 0
         self.num_kv_imports = 0
 
+        # pipelined decode (llm/pipeline.py): device-resident batch
+        # state, the in-flight double-buffered chunk, the adaptive chunk
+        # controller, and outputs produced by internal flushes (returned
+        # by the next step() so no token/finish event is ever dropped)
+        self._pipe_state = None
+        self._pipe_inflight = None
+        self._pipe_ctl = None
+        self._pipe_stats = None
+        self._pipe_last_sync_t = None
+        self._pending_outputs: list[RequestOutput] = []
+
         # speculative decoding: drafter + verify program cache + stats
         self.drafter = None
         self.spec_stats = None
@@ -386,8 +414,21 @@ class LLMEngine:
             )
         return cache
 
+    @staticmethod
+    def _assert_chunk_bucket(n_steps: int) -> None:
+        """The (n_steps, mode) jit caches are bounded BY CONSTRUCTION to
+        the adaptive bucket set — a novel n_steps would silently compile
+        (and retain) a new program forever."""
+        from ray_tpu.llm.pipeline import CHUNK_BUCKETS
+
+        assert n_steps in CHUNK_BUCKETS, (
+            f"decode chunk n_steps={n_steps} outside the bounded bucket "
+            f"set {CHUNK_BUCKETS}; quantize via pipeline.chunk_bucket"
+        )
+
     def _decode_chunk_fn(self, n_steps: int, sample_mode: str = "full"):
         c = self.config
+        self._assert_chunk_bucket(n_steps)
         fn = self._decode_chunks.get((n_steps, sample_mode))
         if fn is None:
             from ray_tpu.llm.decode_loop import decode_chunk
@@ -405,6 +446,36 @@ class LLMEngine:
                 donate_argnums=(5,),
             )
             self._decode_chunks[(n_steps, sample_mode)] = fn
+        return fn
+
+    def _pipe_chunk_fn(self, n_steps: int, sample_mode: str, stop_w: int):
+        """Jitted masked/early-exiting chunk (llm/pipeline.py) for the
+        pipelined path; cache keyed (and bounded) by the chunk-bucket +
+        stop-width sets."""
+        c = self.config
+        self._assert_chunk_bucket(n_steps)
+        from ray_tpu.llm.pipeline import STOP_WIDTHS, decode_chunk_masked
+
+        assert stop_w in STOP_WIDTHS, (
+            f"stop width {stop_w} outside the bounded set {STOP_WIDTHS}"
+        )
+        key = (n_steps, sample_mode, "masked", stop_w)
+        fn = self._decode_chunks.get(key)
+        if fn is None:
+            fn = jax.jit(
+                lambda params, t, p, bt, cl, cache, temps, tks, tps, keys,
+                starts, max_toks, done, stop_ids, stop_on_eos, lora:
+                decode_chunk_masked(
+                    params, t, p, bt, cl, cache, temps, tks, tps, keys,
+                    starts, max_toks, done, stop_ids, stop_on_eos,
+                    c.model, n_steps=n_steps, block_size=c.block_size,
+                    trash_slot=c.num_blocks * c.block_size,
+                    eos_id=c.eos_token_id, attn_impl=c.attn_impl,
+                    sample_mode=sample_mode, lora=lora,
+                ),
+                donate_argnums=(5,),
+            )
+            self._decode_chunks[key] = fn
         return fn
 
     def _verify_fn(self, width: int):
@@ -577,6 +648,14 @@ class LLMEngine:
         if req is None or req.status in (RequestStatus.FINISHED, RequestStatus.ABORTED):
             return
         if req in self.running:
+            # removing a decode-batch row is a membership change: land
+            # the in-flight pipelined chunk first (its outputs are
+            # delivered by the next step()); the flush may finish this
+            # request normally, in which case there is nothing to abort
+            self._pipe_flush(deliver=True)
+            if req.status in (RequestStatus.FINISHED, RequestStatus.ABORTED):
+                return
+        if req in self.running:
             self.running.remove(req)
         if req in self.waiting:
             self.waiting.remove(req)
@@ -606,7 +685,11 @@ class LLMEngine:
             self.drafter.release(request_id)
 
     def has_unfinished(self) -> bool:
-        return bool(self.waiting or self.running)
+        # _pending_outputs counts: an internal pipeline flush (abort /
+        # handoff) may have finished the LAST running request — its
+        # finish event still needs a step() call to deliver, and every
+        # driver loop gates step() on this predicate
+        return bool(self.waiting or self.running or self._pending_outputs)
 
     def step(self) -> list[RequestOutput]:
         """One engine iteration: admit + prefill waiting requests, else decode.
@@ -639,7 +722,32 @@ class LLMEngine:
             # per decode step
             self._telemetry_next = now_m + 0.2
             self.update_telemetry_gauges()
-        if self.waiting and len(self.running) < self.config.max_num_seqs:
+        if self._pending_outputs:
+            # outputs produced by an internal pipeline flush (abort /
+            # handoff / recovery forced a sync outside step()): deliver
+            # before doing anything else so no finish event is dropped
+            out, self._pending_outputs = self._pending_outputs, []
+            return out
+        if (
+            self.waiting
+            and len(self.running) < self.config.max_num_seqs
+            # cheap read-only precheck: can the head of the queue
+            # actually admit? Free blocks must cover its (recompute)
+            # prompt MINUS live-shared prefix-cache hits, which adopt
+            # by refcount and cost no free blocks. Without the check, a
+            # block-starved waiting queue would flush the pipeline (and
+            # force a full DeviceBatchState rebuild) every round just
+            # to fail admission again; without the cache discount, a
+            # prefix-sharing request would starve behind a free-pool
+            # check its cache hit satisfies
+            and self._admission_need(self.waiting[0])
+            <= self.allocator.num_free
+        ):
+            # admission is a membership change: the in-flight pipelined
+            # chunk (dispatched for the OLD batch) must land first
+            flushed = self._pipe_flush()
+            if flushed:
+                return flushed
             admitted: list = []  # (req, last-token logits [1, V]) pairs
             while self.waiting and len(self.running) < self.config.max_num_seqs:
                 got = self._prefill_one()
@@ -683,6 +791,10 @@ class LLMEngine:
         the prefix cache dies with them, correctness doesn't.
 
         Returns the re-enqueued request ids (post-mortem / logging)."""
+        # the in-flight pipelined chunk may BE what crashed: drop it
+        # un-synced (its tokens were never booked, so the re-admission
+        # recompute covers exactly the delivered prefix)
+        self._pipe_drop()
         now = time.time()
         victims = sorted(self.running, key=lambda r: r.arrival, reverse=True)
         self.running.clear()
@@ -764,6 +876,9 @@ class LLMEngine:
         path: the handoff is device-sealed and never staged through
         host RAM; use ``handoff.to_host()`` if an RPC edge ends up
         carrying it after all)."""
+        # the exported pages must reflect the host's view of num_tokens:
+        # land any in-flight pipelined chunk before gathering
+        self._pipe_flush(deliver=True)
         from ray_tpu.llm.disagg.handoff import KVHandoff
 
         req = self.requests.get(request_id)
@@ -851,6 +966,9 @@ class LLMEngine:
         position). Raises NoFreeBlocksError when the cache can't hold it
         right now (callers may retry after decode frees blocks) and
         ValueError on a model/cache mismatch."""
+        # joining the decode batch is a membership change: land the
+        # in-flight pipelined chunk so the import sees settled state
+        self._pipe_flush(deliver=True)
         c = self.config
         sig = (c.model.n_layers, c.model.n_kv_heads, c.model.head_dim)
         if tuple(handoff.model_sig) != sig:
@@ -991,6 +1109,10 @@ class LLMEngine:
             out["num_kv_imports"] = self.num_kv_imports
         if self.spec_stats is not None:
             out["spec"] = self.spec_stats.to_dict()
+        if self._pipe_stats is not None and self._pipe_stats.dispatches:
+            # the `pipeline` row of /v1/stats: chunk-size distribution,
+            # host/device split, overlap ratio, early-exit savings
+            out["pipeline"] = self._pipe_stats.to_dict()
         return out
 
     def profile_decode(
@@ -1153,6 +1275,17 @@ class LLMEngine:
                 pass
 
     # -- scheduling internals -------------------------------------------------
+
+    def _admission_need(self, req) -> int:
+        """Free-pool blocks admitting ``req`` would actually consume
+        (kv_cache.probe_admission_need over the recompute prompt, with
+        the request's LoRA salt; the full count when prefix caching is
+        off)."""
+        if not self.config.enable_prefix_caching:
+            return self.allocator.blocks_needed(req.num_tokens)
+        return self.allocator.probe_admission_need(
+            req.prompt_token_ids + req.output_token_ids, req.lora_slot
+        )
 
     def _pad_to_bucket(self, n: int, buckets: list) -> int:
         for b in buckets:
@@ -1324,7 +1457,201 @@ class LLMEngine:
     def _decode_step(self) -> list[RequestOutput]:
         if self.config.spec is not None:
             return self._spec_decode_step()
+        if self.config.pipeline_decode:
+            return self._pipelined_decode_step()
         return self._plain_decode_step()
+
+    # -- pipelined decode (ray_tpu.llm.pipeline) ------------------------------
+    # Chunk N+1 is dispatched from the device-resident carry BEFORE chunk
+    # N's tokens are synced, so host bookkeeping overlaps device compute.
+    # Membership changes (admission/abort/handoff/recovery) flush first;
+    # rows that finish DURING the overlap are already `done` on device
+    # (the stop ladder runs in-graph), so the early-dispatched chunk
+    # computes the identical stream for live rows and nothing for dead
+    # ones. Token identity vs the sync path is the contract.
+
+    def _pipe_flush(self, deliver: bool = False) -> list[RequestOutput]:
+        """Land the in-flight chunk (if any) and invalidate the
+        device-resident state (callers flush precisely because
+        membership is about to change). Returns the synced outputs;
+        with ``deliver`` they are queued for the next step() instead."""
+        rec, self._pipe_inflight = self._pipe_inflight, None
+        self._pipe_state = None
+        if rec is None:
+            return []
+        if self._pipe_stats is not None:
+            self._pipe_stats.flushes += 1
+        outs = self._pipe_sync(rec)
+        # the gap to the next dispatch spans a membership change
+        # (admission/prefill, abort, handoff) — none of it amortizes
+        # with chunk length, so keep it out of the controller's
+        # per-round overhead signal
+        self._pipe_last_sync_t = None
+        if deliver and outs:
+            self._pending_outputs.extend(outs)
+            return []
+        return outs
+
+    def _pipe_drop(self) -> None:
+        """Crash-path reset: discard the in-flight chunk WITHOUT syncing
+        (the device program may be the thing that died). Un-synced
+        tokens were never booked into output_token_ids, so recovery's
+        recompute-from-prefix contract holds."""
+        self._pipe_inflight = None
+        self._pipe_state = None
+        self._pipe_last_sync_t = None
+
+    def _pipelined_decode_step(self) -> list[RequestOutput]:
+        from ray_tpu.llm import pipeline as pl
+
+        c = self.config
+        if self._pipe_ctl is None:
+            self._pipe_ctl = pl.ChunkController(initial=max(1, c.decode_chunk))
+            self._pipe_stats = pl.PipelineStats()
+        if any(
+            len(r.sampling_params.stop_token_ids) > pl.STOP_WIDTH_CAP
+            for r in self.running
+        ):
+            # unbounded stop sets don't fit the padded on-device matrix;
+            # serve this batch on the sync path (identical tokens)
+            self._pipe_stats.sync_fallbacks += 1
+            outs = self._pipe_flush()
+            return outs if outs else self._plain_decode_step()
+
+        t_prep0 = time.perf_counter()
+        wall0 = time.time()
+        prev = self._pipe_inflight
+        self._pipe_inflight = None
+
+        # chunk length: adaptive from the measured host round overhead
+        # vs chunk wall, capped by the batch's largest remaining budget
+        gap_ms = (
+            (t_prep0 - self._pipe_last_sync_t) * 1e3
+            if self._pipe_last_sync_t is not None else 0.0
+        )
+        cap = max((self._remaining(r) for r in self.running), default=1)
+        n_steps = self._pipe_ctl.next_steps(cap=cap)
+
+        # reserve KV for the chunk's writes (per-row clamped to budget
+        # and the max_seq wall — done rows freeze in-graph, so the chunk
+        # itself never needs the whole batch shrunk to the shortest row).
+        # CRUCIALLY the horizon includes the un-synced in-flight chunk:
+        # this dispatch continues from the device carry, which sits up
+        # to prev_steps tokens past the host's num_tokens, and a write
+        # past the reserved blocks would read block-table padding (0)
+        # and clobber another sequence's block 0
+        pending = prev["n_steps"] if prev is not None else 0
+        try:
+            for r in self.running:
+                r.seq.ensure_capacity(
+                    r.num_tokens + max(1, min(
+                        pending + n_steps, self._remaining(r),
+                        c.model.max_seq - r.num_tokens,
+                    ))
+                )
+        except NoFreeBlocksError:
+            # real cache pressure: preemption is a membership change —
+            # land the in-flight chunk first so its tokens aren't lost,
+            # then preempt and let the next round rebuild
+            if prev is not None:
+                self._pipe_inflight = prev
+                return self._pipe_flush()
+            self._pipe_state = None
+            if not self._preempt_one():
+                raise  # single running request can't fit: cache too small
+            return []
+
+        state = self._pipe_state
+        if state is None:
+            state = pl.DeviceBatchState.build(self, self.running)
+            self._pipe_state = state
+            if prev is None:
+                self._pipe_stats.rebuilds += 1
+        elif not state.refresh_block_tables(self.running):
+            # a row outgrew the padded block-table width: flush + rebuild
+            if prev is not None:
+                self._pipe_inflight = prev
+                return self._pipe_flush()
+            state = pl.DeviceBatchState.build(self, self.running)
+            self._pipe_state = state
+            self._pipe_stats.rebuilds += 1
+
+        # dispatch chunk N+1 from the device-resident carry (async: this
+        # does NOT wait for chunk N)
+        fn = self._pipe_chunk_fn(n_steps, state.sample_mode, state.stop_w)
+        lora = None
+        if self._lora is not None:
+            lora = {"ids": state.lora_ids, **self._lora}
+        t_dispatch = time.perf_counter()
+        toks, lps, n_emit, steps_run, carry, self.cache = fn(
+            self.params, state.tokens, state.positions, state.block_tables,
+            state.context_lens, self.cache, state.temps, state.top_ks,
+            state.top_ps, state.keys, state.starts, state.max_toks,
+            state.done, state.stop_ids, state.stop_on_eos, lora,
+        )
+        state.adopt_carry(carry)
+        host_prep_ms = (t_dispatch - t_prep0) * 1e3
+        self._pipe_stats.record_dispatch(n_steps, host_prep_ms)
+        if c.profile:
+            pl.record_host_prep(host_prep_ms)
+        self._pipe_inflight = {
+            "batch": list(self.running),
+            "row_of": dict(state.row_of),
+            "toks": toks, "lps": lps, "n_emit": n_emit,
+            "steps_run": steps_run, "n_steps": n_steps,
+            "sample_mode": state.sample_mode,
+            "t_dispatch": t_dispatch, "wall0": wall0, "gap_ms": gap_ms,
+        }
+        if prev is None:
+            # cold start: nothing to overlap with yet; the next step()
+            # dispatches chunk 2 and syncs this one
+            return []
+        return self._pipe_sync(prev)
+
+    def _pipe_sync(self, rec) -> list[RequestOutput]:
+        """Sync one dispatched chunk's tokens and run the host
+        bookkeeping ladder for the rows still alive."""
+        from ray_tpu.llm import pipeline as pl
+
+        c = self.config
+        t0 = time.perf_counter()
+        toks = np.asarray(rec["toks"])          # the host sync
+        lps = np.asarray(rec["lps"])
+        n_emit = np.asarray(rec["n_emit"])
+        steps_run = int(rec["steps_run"])
+        t1 = time.perf_counter()
+        self._pipe_last_sync_t = t1
+        sync_wait_ms = (t1 - t0) * 1e3
+        chunk_ms = (t1 - rec["t_dispatch"]) * 1e3
+        self._pipe_ctl.note_overhead(rec["gap_ms"] + sync_wait_ms)
+        self._pipe_ctl.note_chunk(chunk_ms, rec["n_steps"], steps_run)
+        self._pipe_stats.record_sync(
+            steps_run=steps_run, sync_wait_ms=sync_wait_ms, chunk_ms=chunk_ms
+        )
+        if c.profile:
+            pl.record_sync_wait(sync_wait_ms)
+            from ray_tpu.llm.decode_loop import record_chunk
+
+            record_chunk(chunk_ms, rec["n_steps"], rec["sample_mode"],
+                         len(rec["batch"]))
+        # rows that finished in an earlier sync are done on device and
+        # emitted nothing; only live rows get bookkeeping (their seq is
+        # released on finish)
+        live = [
+            r for r in rec["batch"]
+            if r.status == RequestStatus.RUNNING and r.seq is not None
+        ]
+        if not live:
+            return []
+        cols = [rec["row_of"][r.request_id] for r in live]
+        outputs = self._append_chunk(
+            live, toks[:, cols], lps[:, cols],
+            row_counts=[int(n_emit[j]) for j in cols],
+        )
+        return self._obs_decode_round(
+            live, outputs, rec["wall0"], "engine.decode_chunk",
+            rec["n_steps"],
+        )
 
     def _spec_decode_step(self) -> list[RequestOutput]:
         """One speculative round: draft -> one batched verify pass ->
@@ -1529,24 +1856,17 @@ class LLMEngine:
         B_pad = self._pad_to_bucket(B, c.decode_buckets())
         num_slots = c.num_blocks * c.block_size
 
-        tokens = np.zeros(B_pad, np.int32)
-        positions = np.zeros(B_pad, np.int32)
-        context_lens = np.zeros(B_pad, np.int32)
-        lora_ids = np.zeros(B_pad, np.int32)
-        bt = np.zeros(
-            (B_pad, self._bt_width([len(r.seq.blocks) for r in batch])),
-            np.int32,
+        # per-row assembly shared with the pipelined DeviceBatchState
+        # (pipeline.assemble_batch_arrays): one source of truth for how
+        # a Request becomes batch rows — the bitwise-identity contract
+        # between the two paths depends on it
+        from ray_tpu.llm.pipeline import assemble_batch_arrays
+
+        a, keys = assemble_batch_arrays(
+            batch, B_pad, self._bt_width([len(r.seq.blocks) for r in batch])
         )
-        for i, r in enumerate(batch):
-            last_tok = (
-                r.output_token_ids[-1] if r.output_token_ids else r.prompt_token_ids[-1]
-            )
-            pos = r.num_tokens - 1  # position of the token being fed
-            tokens[i] = last_tok
-            positions[i] = pos
-            context_lens[i] = r.num_tokens
-            lora_ids[i] = r.lora_slot
-            bt[i, : len(r.seq.blocks)] = r.seq.blocks
+        tokens, positions = a["tokens"], a["positions"]
+        context_lens, lora_ids, bt = a["context_lens"], a["lora_ids"], a["bt"]
 
         if n_steps == 1:
             slot_mapping = np.full(B_pad, num_slots, np.int32)
@@ -1575,25 +1895,14 @@ class LLMEngine:
                 "engine.decode_chunk", 1,
             )
 
-        # multi-step chunk: decode+sample n_steps times on device, one sync
-        temps = np.ones(B_pad, np.float32)
-        top_ks = np.zeros(B_pad, np.int32)
-        top_ps = np.ones(B_pad, np.float32)
+        # multi-step chunk: decode+sample n_steps times on device, one
+        # sync. keys derive from (stable request key, absolute output
+        # index — a["starts"]): identical sampling regardless of how
+        # co-running requests partition the chunks. remaining = this
+        # chunk's keep-capacity (writes past it hit the trash page)
         remaining = np.zeros(B_pad, np.int32)
-        starts = np.zeros(B_pad, np.int32)
-        keys = [jax.random.key(0)] * B_pad
         for i, r in enumerate(batch):
-            temps[i] = r.sampling_params.temperature
-            top_ks[i] = r.sampling_params.top_k
-            top_ps[i] = r.sampling_params.top_p
-            # keep-capacity this chunk (writes past it hit the trash page)
             remaining[i] = self._remaining(r)
-            # keys derive from (stable request key, absolute output index):
-            # identical sampling regardless of how co-running requests
-            # partition the chunks (a per-chunk split would make a seeded
-            # request's tokens depend on batch-mates' load)
-            starts[i] = len(r.output_token_ids)
-            keys[i] = r._key
         toks, logprobs, self.cache = self._decode_chunk_fn(
             n_steps, self._sample_mode(batch)
         )(
@@ -1603,11 +1912,11 @@ class LLMEngine:
             jnp.asarray(bt),
             jnp.asarray(context_lens),
             self.cache,
-            jnp.asarray(temps),
-            jnp.asarray(top_ks),
-            jnp.asarray(top_ps),
+            jnp.asarray(a["temps"]),
+            jnp.asarray(a["top_ks"]),
+            jnp.asarray(a["top_ps"]),
             jnp.stack(keys),
-            jnp.asarray(starts),
+            jnp.asarray(a["starts"]),
             jnp.asarray(remaining),
             self._lora_arg(lora_ids),
         )

@@ -175,6 +175,28 @@ class BlockAllocator:
             matched += 1
         return matched * self.block_size
 
+    def probe_admission_need(self, tokens: list[int], salt: int = 0) -> int:
+        """Blocks a full prefill of ``tokens`` must take FROM THE FREE
+        POOL, accounting for the prefix cache: a matched block that is
+        LIVE-shared (refcount > 0) is adopted by refcount alone and
+        costs nothing, while a matched zero-ref cached block still
+        consumes a ``num_free`` slot when resurrected. Read-only (no
+        refs taken, no LRU perturbation) — the engine's admission
+        precheck uses it so a prefix-sharing request is never starved
+        behind a free-pool check its cache hit would have satisfied."""
+        need = self.blocks_needed(len(tokens))
+        h = salt
+        n_full = len(tokens) // self.block_size
+        for i in range(n_full):
+            blk = tuple(tokens[i * self.block_size : (i + 1) * self.block_size])
+            h = self.chain_hash(h, blk)
+            b = self._hash_to_block.get(h)
+            if b is None:
+                break
+            if self._refcount.get(b, 0) > 0:
+                need -= 1  # live shared: adoption is a refcount bump
+        return need
+
     def match_prefix(self, tokens: list[int],
                      salt: int = 0) -> tuple[list[int], int, int]:
         """Longest cached chain of FULL blocks prefixing `tokens`.

@@ -77,6 +77,7 @@ def sample_tokens(
     top_ps: jax.Array,        # [B] (1.0 = off)
     keys: jax.Array,          # [B] PRNG keys
     mode: str = "full",       # static: "greedy" | "categorical" | "full" | "full_sort"
+    done: Optional[jax.Array] = None,  # [B] bool: finished-row mask
 ) -> tuple[jax.Array, jax.Array]:
     """Returns (tokens [B], logprobs [B]). All knobs vectorized per row.
 
@@ -89,13 +90,24 @@ def sample_tokens(
       * full_sort: exact filtering over the whole vocab — required when
         any row's top_k exceeds TOP_CAP (the capped path would clamp
         it and truncate any nucleus wider than TOP_CAP).
-    """
+
+    `done` (the pipelined decode loop's on-device stop mask): finished
+    rows' logits come from trash-slot reads, so they are replaced with
+    a constant one-hot BEFORE any softmax/sort (garbage stays out of
+    the filtering numerics) and the row deterministically emits token 0
+    with logprob 0 — the host discards it via per-row ``n_emitted``.
+    The masking is where-based, so live rows' draws are bitwise
+    untouched (a row's stream must not depend on batch-mates being
+    finished)."""
+    if done is not None:
+        onehot = jnp.zeros_like(logits).at[:, 0].set(1.0)
+        logits = jnp.where(done[:, None], onehot, logits)
     if mode == "greedy":
         tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         logprob = jnp.take_along_axis(
             jax.nn.log_softmax(logits, axis=-1), tok[:, None], axis=-1
         )[:, 0]
-        return tok, logprob
+        return _mask_done(tok, logprob, done)
 
     greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     t = jnp.where(temperatures <= 0.0, 1.0, temperatures)[:, None]
@@ -142,7 +154,18 @@ def sample_tokens(
     logprob = jnp.take_along_axis(
         jax.nn.log_softmax(logits, axis=-1), tok[:, None], axis=-1
     )[:, 0]
-    return tok, logprob
+    return _mask_done(tok, logprob, done)
+
+
+def _mask_done(tok, logprob, done):
+    """Deterministic pad output for finished rows (pipelined stop
+    masks): token 0, logprob 0. Live rows pass through untouched."""
+    if done is None:
+        return tok, logprob
+    return (
+        jnp.where(done, 0, tok).astype(jnp.int32),
+        jnp.where(done, 0.0, logprob),
+    )
 
 
 def target_probs(

@@ -120,10 +120,12 @@ def profile_decode_step(
     """Roofline-attributed profile of one serving decode step.
 
     Segments: embed / qkv_rope / kv_write / kv_read_attn / block_mlp /
-    lm_head / sampling (+ host_sync from the fenced-every-step delta,
-    + a standalone prefill probe). The decode step is rebuilt from the
-    same llama_decode/sampling pieces the engine jits, over a scratch
-    paged cache, so profiling never touches live engine state.
+    lm_head / sampling / stop_mask (+ host_sync from the
+    fenced-every-step delta, + standalone prefill and host_overlap
+    probes — host_overlap prices what double-buffered dispatch recovers
+    of host_sync). The decode step is rebuilt from the same
+    llama_decode/sampling/pipeline pieces the engine jits, over a
+    scratch paged cache, so profiling never touches live engine state.
     """
     parts, whole_fn = decode_step_segments(
         config, params,
@@ -135,10 +137,10 @@ def profile_decode_step(
     segments = profile_segments(
         parts, iters=iters, warmup=warmup, with_costs=with_costs
     )
-    # the reference is the REAL decode_step + sampler program, measured
-    # independently of the ladder — coverage then reports ladder
-    # fidelity instead of being ~100% by construction
-    chained_real_ms, synced_ms = whole_fn()
+    # the reference is the REAL decode_step + sampler + stop-mask
+    # program, measured independently of the ladder — coverage then
+    # reports ladder fidelity instead of being ~100% by construction
+    chained_real_ms, synced_ms, pipelined_ms = whole_fn()
     # host_sync: what one-token-per-round-trip serving pays on top of the
     # pure device step; the engine's multi-step decode_chunk amortizes it
     segments.append(
@@ -147,6 +149,17 @@ def profile_decode_step(
             ms=max(0.0, synced_ms - chained_real_ms),
             cum_ms=synced_ms,
             in_step=True,
+        )
+    )
+    # host_overlap (standalone): the slice of host_sync the pipelined
+    # engine hides by dispatching chunk N+1 before fencing chunk N —
+    # measured, not inferred (same program, double-buffered fencing)
+    segments.append(
+        SegmentTiming(
+            name="host_overlap",
+            ms=max(0.0, synced_ms - pipelined_ms),
+            cum_ms=pipelined_ms,
+            in_step=False,
         )
     )
     profile = StepProfile.build(

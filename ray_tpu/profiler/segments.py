@@ -140,6 +140,29 @@ def _timed(jfn, make_carry, *, iters, warmup, repeats, fence_each=False) -> floa
     return best
 
 
+def _timed_pipelined(jfn, make_carry, *, iters, warmup, repeats) -> float:
+    """Per-iteration seconds with DOUBLE-BUFFERED fencing: dispatch
+    iteration N+1 before fencing iteration N's result, so the host
+    round-trip overlaps device compute (JAX async dispatch) — the
+    measurement model of the engine's pipelined decode path. ``jfn``
+    must not donate its carry (the lag-1 fence still reads it)."""
+    carry = make_carry()
+    for _ in range(max(1, warmup)):
+        carry = jfn(carry)
+    _fence(carry)
+    best = float("inf")
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        prev = jfn(carry)
+        for _ in range(iters - 1):
+            cur = jfn(prev)
+            _fence(prev)  # overlaps cur's device work
+            prev = cur
+        _fence(prev)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
 def profile_segments(
     fn_parts: list[FnPart],
     *,
@@ -492,6 +515,27 @@ def decode_step_segments(
     keys = jax.vmap(jax.random.key)(jnp.arange(B, dtype=jnp.uint32))
     cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
     hd = c.head_dim
+    # stop-mask probe constants (the pipelined chunk's in-graph stop
+    # ladder, llm/pipeline.py): a 2-wide stop set, per-row budgets, an
+    # all-live done mask — representative shapes, never actually firing
+    sm_stop_ids = jnp.full((B, 2), -1, jnp.int32).at[:, 0].set(5)
+    sm_max_toks = jnp.full((B,), 1 << 20, jnp.int32)
+    sm_starts = jnp.zeros((B,), jnp.int32)
+    sm_done = jnp.zeros((B,), bool)
+    sm_stop_eos = jnp.ones((B,), bool)
+
+    def _stop_mask_epilogue(nxt, lp_):
+        """The per-step stop-ladder math the pipelined decode chunk
+        runs in-graph: stop-set match + EOS + budget/wall folds + the
+        emitted-count update + output masking."""
+        hit = jnp.any(sm_stop_ids == nxt[:, None], axis=-1)
+        dn = sm_done | hit | (sm_stop_eos & (nxt == 2))
+        dn = dn | ((sm_starts + 1) >= sm_max_toks)
+        dn = dn | (jnp.full((B,), ctx + 2, jnp.int32) >= c.max_seq)
+        ne = (~dn).astype(jnp.int32)
+        nxt = jnp.where(dn, 0, nxt)   # output masking
+        lp_ = jnp.where(dn, 0.0, lp_)
+        return nxt, lp_, _token(dn) + _token(ne)
 
     def mk_carry():
         cache = init_cache(c, num_slots, trash_slots=block_size)
@@ -502,7 +546,7 @@ def decode_step_segments(
     # variant body references locals like `q`/`o`/`logits` produced by
     # the earlier features, so a non-cumulative set would NameError at
     # trace time deep inside the scan)
-    _ORDER = ("qkv", "write", "attn", "mlp", "head", "sample")
+    _ORDER = ("qkv", "write", "attn", "mlp", "head", "sample", "mask")
 
     def _variant(parts_on: frozenset):
         on = [f for f in _ORDER if f in parts_on]
@@ -579,6 +623,9 @@ def decode_step_segments(
                     logits, temps, top_ks, top_ps, step_keys, mode=sample_mode
                 )
                 acc = acc + _token(lp_)
+                if "mask" in parts_on:
+                    nxt, lp_, tok_m = _stop_mask_epilogue(nxt, lp_)
+                    acc = acc + tok_m
             else:
                 nxt = toks
             nxt = (nxt + (acc * 0).astype(jnp.int32)) % c.vocab_size
@@ -594,6 +641,7 @@ def decode_step_segments(
         ("block_mlp", frozenset({"qkv", "write", "attn", "mlp"})),
         ("lm_head", frozenset({"qkv", "write", "attn", "mlp", "head"})),
         ("sampling", frozenset({"qkv", "write", "attn", "mlp", "head", "sample"})),
+        ("stop_mask", frozenset(_ORDER)),
     ]
     parts = [
         FnPart(name, _variant(on), mk_carry, donate=True)
@@ -636,9 +684,10 @@ def decode_step_segments(
 
     def real_step(carry):
         """The REFERENCE program: llama_decode.decode_step + the jitted
-        sampler — the same composition LLMEngine dispatches per decode
-        round trip (n_steps=1 path). Independent of the ladder's
-        reconstruction, so coverage actually measures ladder fidelity."""
+        sampler + the pipelined stop-mask epilogue — the same per-step
+        composition LLMEngine dispatches per decode round trip.
+        Independent of the ladder's reconstruction, so coverage
+        actually measures ladder fidelity."""
         from ray_tpu.models.llama_decode import decode_step
 
         toks, cache = carry
@@ -651,14 +700,18 @@ def decode_step_segments(
         nxt, lp_ = sample_tokens(
             logits, temps, top_ks, top_ps, step_keys, mode=sample_mode
         )
-        nxt = (nxt + (_token(lp_) * 0).astype(jnp.int32)) % c.vocab_size
+        nxt, lp_, tok_m = _stop_mask_epilogue(nxt, lp_)
+        nxt = (nxt + ((_token(lp_) + tok_m) * 0).astype(jnp.int32)) % c.vocab_size
         return (nxt, new_cache)
 
     def whole_fn(*, iters_=iters, warmup_=warmup, repeats_=3):
-        """(chained_ms, synced_ms) of the real decode-step program:
-        chained = pure device step; synced = a host fence every
-        iteration (what one-token-per-sync serving pays). The delta is
-        the host_sync segment; synced is the measured whole step."""
+        """(chained_ms, synced_ms, pipelined_ms) of the real decode-step
+        program: chained = pure device step; synced = a host fence every
+        iteration (what one-token-per-sync serving pays); pipelined =
+        double-buffered fencing (dispatch step N+1, THEN fence step N —
+        what the async pipelined engine pays). synced - chained is the
+        host_sync segment; synced - pipelined is the host_overlap
+        saving the r16 pipelined path recovers."""
         jfn = jax.jit(
             real_step,
             donate_argnums=(0,) if _effective_donate(True) else (),
@@ -667,7 +720,12 @@ def decode_step_segments(
                          repeats=repeats_)
         synced = _timed(jfn, mk_carry, iters=iters_, warmup=warmup_,
                         repeats=repeats_, fence_each=True)
-        return chained * 1e3, synced * 1e3
+        # the overlap probe must NOT donate: the lag-1 fence reads a
+        # carry the next dispatch has already consumed
+        jfn_nd = jax.jit(real_step)
+        pipelined = _timed_pipelined(jfn_nd, mk_carry, iters=iters_,
+                                     warmup=warmup_, repeats=repeats_)
+        return chained * 1e3, synced * 1e3, pipelined * 1e3
 
     return parts, whole_fn
 

@@ -22,7 +22,7 @@ TRAIN_SEGMENTS = {
 }
 DECODE_SEGMENTS = {
     "embed", "qkv_rope", "kv_write", "kv_read_attn", "block_mlp",
-    "lm_head", "sampling", "host_sync",
+    "lm_head", "sampling", "stop_mask", "host_sync",
 }
 
 
@@ -126,10 +126,13 @@ def test_decode_step_segments_cover_whole_step(decode_profile):
     prof = decode_profile
     names = {s.name for s in prof.segments if s.in_step}
     assert names == DECODE_SEGMENTS
-    # + the standalone prefill probe
+    # + the standalone prefill and host-overlap probes (host_overlap =
+    # the slice of host_sync double-buffered dispatch recovers)
     assert any(
         s.name.startswith("prefill") and not s.in_step for s in prof.segments
     )
+    overlap = [s for s in prof.segments if s.name == "host_overlap"]
+    assert overlap and not overlap[0].in_step and overlap[0].ms >= 0.0
     assert prof.coverage_pct >= 90.0, prof.to_markdown()
     by_name = {s.name: s for s in prof.segments}
     assert by_name["kv_read_attn"].bytes_accessed > 0
@@ -209,7 +212,7 @@ def test_checked_in_captures_keep_coverage():
                              "benchmarks")
     for name, step in [
         ("PROFILE_trainstep_r06.json", "train_step"),
-        ("PROFILE_decode_r06.json", "decode_step"),
+        ("PROFILE_decode_r16.json", "decode_step"),
     ]:
         path = os.path.join(bench_dir, name)
         assert os.path.exists(path), f"missing checked-in capture {name}"
