@@ -74,6 +74,9 @@ SCAN_DIRS = (
     # r15: the fabric transfer plane — endpoint receives must poll
     # bounded (a transfer plane never parks a consumer loop forever)
     "ray_tpu/fabric",
+    # r17: the tiered prefix cache — object-store gets and index RPCs
+    # sit on the prefill admission path, so every park must be bounded
+    "ray_tpu/llm/kvtier",
 )
 
 

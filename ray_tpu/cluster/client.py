@@ -403,6 +403,22 @@ class ClusterClient:
 
     # -- kv -------------------------------------------------------------------
 
+    def kvtier_update(self, payload: dict, timeout: float = 5.0) -> dict:
+        """Ship one engine's prefix-index snapshot to the GCS
+        (llm/kvtier; epoch-banked — a dropped or delayed snapshot can
+        only cost freshness, the next one supersedes it)."""
+        return self.gcs.call("kvtier_update", payload, timeout=timeout)
+
+    def kvtier_lookup(self, hashes: list, timeout: float = 5.0) -> dict:
+        """Longest indexed KV prefix per engine for these chain hashes
+        (prefix-aware routing; callers treat failure as a dark index
+        and fall back to their queue-depth ladder)."""
+        return self.gcs.call("kvtier_lookup", {"hashes": list(hashes)},
+                             timeout=timeout)
+
+    def kvtier_stats(self, timeout: float = 5.0) -> dict:
+        return self.gcs.call("kvtier_stats", None, timeout=timeout)
+
     def kv_put(self, key: bytes, value: bytes, ns: str = "default") -> None:
         self.gcs.call("kv_put", {"ns": ns, "key": key, "value": value})
 

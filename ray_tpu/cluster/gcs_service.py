@@ -133,6 +133,17 @@ class GcsService:
         # persisted: metrics are a freshness surface; a restarted GCS
         # repopulates within one reporting interval.
         self.telemetry = TelemetryStore()
+        # cluster-level KV prefix index (llm/kvtier): chain hash ->
+        # {engine, tier, n_tokens}, fed by engine snapshots over
+        # kvtier_update and consumed by prefix-aware routing. Like the
+        # telemetry store it is deliberately NOT persisted — a restarted
+        # GCS repopulates within one flush interval, and routers fall
+        # back to the queue-depth ladder until it does. (The store lives
+        # in cluster/prefix_index.py so the control plane never imports
+        # the serving stack.)
+        from ray_tpu.cluster.prefix_index import PrefixIndexStore
+
+        self.prefix_index = PrefixIndexStore()
         if persist_path:
             self._load_snapshot()
 
@@ -517,7 +528,30 @@ class GcsService:
         out = {"nodes": self.rpc_list_nodes(None, peer)}
         out.update(self.telemetry.status_payload(th))
         out["gcs_ft"] = self.rpc_gcs_ft(None, peer)
+        out["kvtier_index"] = self.prefix_index.stats()
         return out
+
+    def rpc_kvtier_update(self, payload, peer):
+        """One engine's prefix-index snapshot (epoch-banked, seq-guarded:
+        stale or replayed snapshots are dropped, never merged)."""
+        return self.prefix_index.update(payload)
+
+    def rpc_kvtier_lookup(self, payload, peer):
+        """Longest indexed prefix per engine over the request's chain
+        hashes — the prefix-aware routing signal. Engines with stale
+        snapshots are omitted: a router seeing nothing falls back to
+        its queue-depth/p2c ladder."""
+        return self.prefix_index.lookup((payload or {}).get("hashes", []))
+
+    def rpc_kvtier_drop(self, payload, peer):
+        """Remove one engine's rows outright (orderly teardown). A
+        crashed engine that never calls this is reaped by the store's
+        expire horizon instead."""
+        self.prefix_index.drop_engine(str((payload or {}).get("engine", "")))
+        return {"ok": True}
+
+    def rpc_kvtier_stats(self, payload, peer):
+        return self.prefix_index.stats()
 
     def rpc_gcs_ft(self, payload, peer):
         """Control-plane FT counters: restarts + reconcile deltas (the

@@ -11,6 +11,7 @@ from ray_tpu.llm.batch import ProcessorConfig, build_processor
 from ray_tpu.llm.disagg import DisaggConfig
 from ray_tpu.llm.engine import EngineConfig, LLMEngine, Request, RequestOutput
 from ray_tpu.llm.kv_cache import BlockAllocator, KVCacheConfig
+from ray_tpu.llm.kvtier import KVTierConfig
 from ray_tpu.llm.openai_api import ByteTokenizer, LLMConfig, LLMServer, build_openai_app
 from ray_tpu.llm.sampling import SamplingParams
 from ray_tpu.llm.spec import SpecConfig
@@ -21,6 +22,7 @@ __all__ = [
     "DisaggConfig",
     "EngineConfig",
     "KVCacheConfig",
+    "KVTierConfig",
     "LLMConfig",
     "LLMEngine",
     "LLMServer",

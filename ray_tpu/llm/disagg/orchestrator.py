@@ -73,6 +73,15 @@ class DisaggConfig:
     max_handoff_retries: int = 2
     # decode pick: queue depth first, prefix-cache awareness as tiebreak
     cache_aware_pick: bool = True
+    # prefix-aware routing (r17): among engines whose queue depth is
+    # within ``depth_slack`` of the least-loaded one, prefer the engine
+    # already holding the longest TIER-DISCOUNTED prefix of the prompt
+    # (an HBM hit outranks a host hit outranks an object-store hit
+    # outranks a miss). When no engine holds anything — or the engines
+    # have no tiered cache — the pick degrades to the existing
+    # queue-depth/peek ladder unchanged.
+    prefix_aware_routing: bool = True
+    depth_slack: int = 4
     # multi-slice fabric topology (fabric.FabricTopology or its dict
     # wire form): which slice each pool is pinned to and which
     # pool-pairs share a device mesh. The orchestrator consults it per
@@ -289,7 +298,7 @@ class DisaggOrchestrator:
         sp = sampling_params or SamplingParams()
         trace = trace or trace_context.current()
         rid = request_id or f"dreq-{next(self._counter)}"
-        pe = min(self._prefill, key=lambda p: p.depth())
+        pe = self._pick_prefill(list(prompt_token_ids))
         q: queue.Queue = queue.Queue()
         with pe.lock:
             pe.engine.add_request(
@@ -537,17 +546,61 @@ class DisaggOrchestrator:
 
     # -- transfer + decode pick ----------------------------------------------
 
+    def _prefix_discounted(self, pe: _PoolEngine, prompt_token_ids: list,
+                           lora_id=None) -> float:
+        """Tier-discounted prefix score of ``prompt`` on one engine
+        (read-only probe across HBM + host + object tiers). Caller
+        holds pe.lock."""
+        try:
+            return float(
+                pe.engine.peek_prefix_tiered(prompt_token_ids,
+                                             lora_id)["discounted"]
+            )
+        except ValueError:
+            return 0.0  # adapter not loaded there
+
+    def _pick_prefill(self, prompt_token_ids: list) -> "_PoolEngine":
+        """Prefill pick: the engine already holding the longest
+        tier-discounted prefix of this prompt, bounded by depth slack
+        (cache affinity must not pile onto a hot engine); depth ladder
+        when nobody holds anything — the prefix-blind behavior."""
+        if len(self._prefill) == 1:
+            return self._prefill[0]
+        depths = {p.index: p.depth() for p in self._prefill}
+        if self.config.prefix_aware_routing:
+            floor = min(depths.values())
+            best = None
+            for p in self._prefill:
+                if depths[p.index] > floor + self.config.depth_slack:
+                    continue
+                with p.lock:
+                    disc = self._prefix_discounted(p, prompt_token_ids)
+                if disc <= 0.0:
+                    continue
+                cand = (disc, -depths[p.index], -p.index)
+                if best is None or cand > best[0]:
+                    best = (cand, p)
+            if best is not None:
+                return best[1]
+        return min(self._prefill, key=lambda p: depths[p.index])
+
     def _pick_decode(self, handoff: KVHandoff) -> int:
-        """Queue depth first; prefix-cache awareness (how many of this
-        prompt's tokens the replica already holds sealed, then its
-        overall hit rate) breaks ties — the replica most likely to serve
-        the NEXT same-prefix prompt from cache keeps accumulating it."""
+        """Prefix-aware decode pick: among replicas within depth slack
+        of the least-loaded one, route to the replica already holding
+        the longest TIER-DISCOUNTED prefix of this prompt (an HBM hit
+        outranks a host hit outranks an object-store hit outranks a
+        miss — resurrection beats recompute, residency beats both).
+        When no replica holds anything the pick falls back to the
+        existing ladder: queue depth first, HBM peek + overall hit rate
+        as tiebreaks."""
         scores = []
+        discounted = []
         for d in self._decode:
             with d.lock:
                 depth = d.depth()
                 peek = 0
                 hit_rate = 0.0
+                disc = 0.0
                 if self.config.cache_aware_pick:
                     try:
                         peek = d.engine.peek_prefix_tokens(
@@ -557,7 +610,22 @@ class DisaggOrchestrator:
                         peek = 0  # adapter not loaded there
                     lk = d.engine.prefix_lookup_tokens
                     hit_rate = d.engine.prefix_hit_tokens / lk if lk else 0.0
+                if self.config.prefix_aware_routing:
+                    disc = self._prefix_discounted(
+                        d, handoff.prompt_token_ids, handoff.lora_id
+                    )
             scores.append((depth, -peek, -hit_rate, d.index))
+            discounted.append((disc, depth, d.index))
+        if self.config.prefix_aware_routing:
+            floor = min(depth for _d, depth, _i in discounted)
+            slack = self.config.depth_slack
+            best = max(
+                ((disc, -depth, -i) for disc, depth, i in discounted
+                 if depth <= floor + slack),
+                default=None,
+            )
+            if best is not None and best[0] > 0.0:
+                return -best[2]
         return min(scores)[-1]
 
     def _transfer(self, handoff: KVHandoff) -> None:

@@ -46,16 +46,20 @@ logger = get_logger("ray_tpu.llm.engine")
 
 
 def prefix_cache_hit_counter():
-    """Prompt tokens served from the prefix cache instead of recomputed.
+    """Prompt tokens served from the prefix cache instead of recomputed,
+    split by the TIER that held them (hbm = resident paged cache,
+    host / object = resurrected by llm/kvtier with zero recompute).
     Alongside the lookup counter it gives the fleet-level hit rate the
-    disaggregated decode pick consumes (llm/disagg/orchestrator.py)."""
+    disaggregated decode pick consumes (llm/disagg/orchestrator.py);
+    the tier label is the `== kv tiers ==` mix `ray_tpu status` shows."""
     from ray_tpu.util.metrics import Counter
 
     return Counter(
         "llm_prefix_cache_hit_tokens_total",
         description="prompt tokens whose KV was reused from the prefix "
-        "cache at prefill admission (no recompute)",
-        tag_keys=("model",),
+        "cache at prefill admission (no recompute), by serving tier "
+        "(hbm/host/object)",
+        tag_keys=("model", "tier"),
     )
 
 
@@ -167,6 +171,12 @@ class EngineConfig:
     # a plain decode step inside the same program; if NO row has a
     # draft, the round falls back to the classic decode/chunk path.
     spec: Any = None
+    # tiered prefix cache (llm/kvtier): sealed full blocks evicted from
+    # the HBM allocator spill to a host-DRAM LRU and then the object
+    # store instead of being discarded, and prefill admission resurrects
+    # them with a verified scatter (zero recompute). True / a dict / a
+    # KVTierConfig enables it; None keeps the HBM-only cache.
+    kvtier: Any = None
 
     def __post_init__(self):
         if isinstance(self.model, str):
@@ -201,6 +211,18 @@ class EngineConfig:
             if not isinstance(self.spec, SpecConfig):
                 raise ValueError(
                     f"EngineConfig.spec must be a SpecConfig, got {type(self.spec)}"
+                )
+        if self.kvtier is not None:
+            from ray_tpu.llm.kvtier import KVTierConfig
+
+            if self.kvtier is True:
+                self.kvtier = KVTierConfig()
+            elif isinstance(self.kvtier, dict):
+                self.kvtier = KVTierConfig(**self.kvtier)
+            if not isinstance(self.kvtier, KVTierConfig):
+                raise ValueError(
+                    f"EngineConfig.kvtier must be a KVTierConfig, True, or a "
+                    f"dict, got {type(self.kvtier)}"
                 )
 
     def prefill_buckets(self) -> list[int]:
@@ -372,8 +394,21 @@ class LLMEngine:
         self._kv_imports: dict[int, Any] = {}
         self.prefix_hit_tokens = 0
         self.prefix_lookup_tokens = 0
+        # hit tokens split by serving tier (hbm resident vs host/object
+        # resurrected) — the per-tier view stats()/metrics expose
+        self.tier_hit_tokens: dict[str, int] = {}
         self.num_prefill_batches = 0
         self.num_kv_imports = 0
+
+        # tiered prefix cache (llm/kvtier): listens to the allocator's
+        # seal/evict/drop events, owns the host-DRAM + object-store
+        # tiers, and publishes this engine's resident chains to the
+        # cluster prefix index when one is attached
+        self.kvtier = None
+        if c.kvtier is not None:
+            from ray_tpu.llm.kvtier import KVTierManager
+
+            self.kvtier = KVTierManager(self, c.kvtier)
 
         # pipelined decode (llm/pipeline.py): device-resident batch
         # state, the in-flight double-buffered chunk, the adaptive chunk
@@ -814,6 +849,11 @@ class LLMEngine:
             c = self.config
             self.allocator = BlockAllocator(c.num_blocks, c.block_size)
             self.cache = self._init_kv_cache()
+            if self.kvtier is not None:
+                # fresh allocator: re-attach the tier listeners and drop
+                # the (now wrong) HBM metadata; spilled host/object
+                # copies were sealed from correct pages and stay usable
+                self.kvtier.rebind_allocator()
             for r in victims:
                 r.seq = None  # blocks died with the old allocator
         moved = []
@@ -865,6 +905,31 @@ class LLMEngine:
         return self.allocator.probe_prefix(
             list(map(int, prompt_token_ids)), self._lora_slot(lora_id)
         )
+
+    def peek_prefix_tiered(self, prompt_token_ids: list,
+                           lora_id: Optional[str] = None) -> dict:
+        """Read-only TIERED probe: the longest contiguous prefix of the
+        prompt this engine can serve without recompute across ALL tiers
+        (HBM resident + host/object resurrectable), with the
+        tier-discounted score prefix-aware routing ranks replicas by.
+        Returns {"n_tokens", "discounted", "by_tier"}."""
+        tokens = list(map(int, prompt_token_ids))
+        salt = self._lora_slot(lora_id)
+        if self.kvtier is not None:
+            return self.kvtier.probe_tiers(tokens, salt)
+        n = self.allocator.probe_prefix(tokens, salt)
+        return {"n_tokens": n, "discounted": float(n),
+                "by_tier": ({"hbm": n} if n else {})}
+
+    def drop_prefix_cache(self) -> None:
+        """Invalidate the prefix cache across EVERY tier: the HBM
+        allocator's reuse pool, the host-DRAM and object-store spill
+        tiers, and this engine's rows in the cluster prefix index (an
+        empty snapshot ships immediately). The one entry point a weight
+        swap must call — dropping HBM alone would leave deeper tiers
+        serving K/V computed with the OLD weights."""
+        # the allocator's drop_listener cascades into the tier manager
+        self.allocator.drop_prefix_cache()
 
     def export_request(self, request_id: str, keep_on_device: bool = False):
         """Export a RUNNING request as a KVHandoff and drop local
@@ -1086,6 +1151,11 @@ class LLMEngine:
             g["kv_hbm_bytes"].set(self._kv_cache_nbytes, tags=tags)
             g["queue_depth"].set(len(self.waiting), tags=tags)
             g["running"].set(len(self.running), tags=tags)
+            if self.kvtier is not None:
+                self.kvtier.update_gauges()
+                # piggyback the prefix-index snapshot on the same
+                # throttle (telemetry-style freshness, no extra timer)
+                self.kvtier.flush_index()
         except Exception:  # noqa: BLE001 — observability must not break serving
             pass
 
@@ -1103,8 +1173,13 @@ class LLMEngine:
                     round(self.prefix_hit_tokens / self.prefix_lookup_tokens, 4)
                     if self.prefix_lookup_tokens else 0.0
                 ),
+                "by_tier": dict(self.tier_hit_tokens),
             },
         }
+        if self.kvtier is not None:
+            # the tier breakdown GET /v1/stats surfaces (rides
+            # engine.stats() through the serving layer unchanged)
+            out["kv_tiers"] = self.kvtier.stats()
         if self.num_kv_imports:
             out["num_kv_imports"] = self.num_kv_imports
         if self.spec_stats is not None:
@@ -1312,6 +1387,7 @@ class LLMEngine:
         # sequences under different adapters never share cached blocks
         salt = req.lora_slot
         seq.chain = salt
+        tier_counts: dict[str, int] = {}
         if c.enable_prefix_caching:
             blocks, matched, chain = self.allocator.match_prefix(prompt, salt)
             if matched >= len(prompt):
@@ -1319,6 +1395,20 @@ class LLMEngine:
                 # re-match against prompt[:-1] to leave >=1 token to prefill
                 self.allocator.free(blocks)
                 blocks, matched, chain = self.allocator.match_prefix(prompt[:-1], salt)
+            if matched:
+                tier_counts["hbm"] = matched
+            if self.kvtier is not None:
+                # tiered resurrection: blocks past the HBM match may sit
+                # spilled in host DRAM / the object store — scatter them
+                # back (verified, zero recompute) and extend the match
+                rblocks, rtokens, chain, rcounts = self._resurrect_tiers(
+                    prompt, matched, chain, salt
+                )
+                if rblocks:
+                    blocks = list(blocks) + rblocks
+                    matched += rtokens
+                    for t, n in rcounts.items():
+                        tier_counts[t] = tier_counts.get(t, 0) + n
             if blocks:
                 seq.adopt_prefix(blocks, chain, matched)
                 matched_blocks = blocks
@@ -1338,14 +1428,16 @@ class LLMEngine:
         if req.num_preemptions == 0:
             self.prefix_lookup_tokens += len(req.prompt_token_ids)
             self.prefix_hit_tokens += min(matched, len(req.prompt_token_ids))
+            for t, n in tier_counts.items():
+                self.tier_hit_tokens[t] = self.tier_hit_tokens.get(t, 0) + n
             try:
                 tags = {"model": self.model_tag}
                 prefix_cache_lookup_counter().inc(
                     len(req.prompt_token_ids), tags=tags
                 )
-                if matched > 0:
+                for t, n in tier_counts.items():
                     prefix_cache_hit_counter().inc(
-                        min(matched, len(req.prompt_token_ids)), tags=tags
+                        n, tags={"model": self.model_tag, "tier": t}
                     )
             except Exception:  # noqa: BLE001 — metrics must not break admission
                 pass
@@ -1395,6 +1487,100 @@ class LLMEngine:
         req.status = RequestStatus.RUNNING
         self.running.append(req)
         return req, logits
+
+    def _resurrect_tiers(self, prompt: list, matched: int, chain: int,
+                         salt: int) -> tuple:
+        """Pull spilled full blocks past the HBM match back into the
+        paged cache: walk the prompt's chain hashes from ``chain``,
+        take each verified SpilledBlock from the deepest tiers, and
+        scatter all their pages in ONE jitted set (the import_handoff
+        shape — ``num_cached_tokens`` covers every resurrected position,
+        zero recompute). A corrupt entry stops the walk (recompute from
+        there); so does allocation pressure. Returns
+        (blocks, n_tokens, chain, {tier: tokens})."""
+        mgr = self.kvtier
+        c = self.config
+        bs = c.block_size
+        # >=1 token must stay un-cached so prefill yields next-token
+        # logits — the same contract the HBM whole-prompt re-match keeps
+        limit = (len(prompt) - 1) // bs
+        start = matched // bs
+        entries: list[tuple] = []  # (hash, tier|"hbm", SpilledBlock|block_id)
+        h = chain
+        for i in range(start, limit):
+            blk = tuple(prompt[i * bs : (i + 1) * bs])
+            h2 = self.allocator.chain_hash(h, blk)
+            got = mgr.take_verified(h2, blk)
+            if got is None:
+                # head-first eviction leaves mid-chain blocks RESIDENT
+                # past a spilled head (match_prefix stopped at the gap):
+                # adopt them by refcount instead of recomputing KV this
+                # engine still holds (probe_tiers counts them; the
+                # admission path must serve what routing advertises)
+                b = self.allocator.lookup(h2)
+                if b is None:
+                    break
+                entries.append((h2, "hbm", b))
+            else:
+                entries.append((h2, got[0], got[1]))
+            h = h2
+        deep = [e for e in entries if e[1] != "hbm"]
+        if not entries or not deep:
+            # nothing spilled to pull back: pure-HBM adoption would be
+            # wrong here (these refs belong past a gap match_prefix
+            # never saw ONLY when a deep block bridged it) — release
+            if entries:
+                self.allocator.free([b for _h, _t, b in entries])
+            return [], 0, chain, {}
+        try:
+            new_blocks = self.allocator.allocate(len(deep))
+        except NoFreeBlocksError:
+            # deep entries stay spilled (take_verified is non-destructive
+            # on success); adopted HBM refs must be returned
+            self.allocator.free([b for _h, t, b in entries if t == "hbm"])
+            return [], 0, chain, {}
+        n_kv = len(deep) * bs
+        k = np.concatenate([sb.handoff.k_pages for _h, _t, sb in deep], axis=2)
+        v = np.concatenate([sb.handoff.v_pages for _h, _t, sb in deep], axis=2)
+        width = max(1, 1 << (n_kv - 1).bit_length())
+        num_slots = c.num_blocks * c.block_size
+        sl = np.full(width, num_slots, np.int32)  # pad rows hit the trash page
+        pos = 0
+        for b in new_blocks:
+            sl[pos : pos + bs] = np.arange(b * bs, (b + 1) * bs)
+            pos += bs
+        dt = self.cache["k"].dtype
+        kp = np.zeros(k.shape[:2] + (width,) + k.shape[3:], k.dtype)
+        vp = np.zeros_like(kp)
+        kp[:, :, :n_kv] = k
+        vp[:, :, :n_kv] = v
+        self.cache = self._kv_import_fn(width)(
+            self.cache, jnp.asarray(kp, dt), jnp.asarray(vp, dt),
+            jnp.asarray(sl),
+        )
+        tier_counts: dict[str, int] = {}
+        blocks: list[int] = []
+        it_new = iter(new_blocks)
+        parent = chain
+        for idx, (h2, tier, payload) in enumerate(entries):
+            if tier == "hbm":
+                blocks.append(payload)  # adopted resident block, ref held
+            else:
+                b = next(it_new)
+                # re-register in HBM (the seal listener re-advertises the
+                # hbm row) and drop the deep-tier copy it came from
+                self.allocator.register_full_block(
+                    b, h2, parent_hash=parent, tokens=payload.tokens,
+                    n_prefix_tokens=(start + idx + 1) * bs,
+                )
+                mgr.promoted(h2, tier)
+                blocks.append(b)
+            tier_counts[tier] = tier_counts.get(tier, 0) + bs
+            parent = h2
+        for tier, n in tier_counts.items():
+            if tier != "hbm":  # adopted residents are hits, not resurrections
+                mgr.count_resurrected(tier, n)
+        return blocks, len(entries) * bs, parent, tier_counts
 
     def _preempt_one(self) -> bool:
         """Kick the newest running request back to waiting (recompute)."""

@@ -72,6 +72,16 @@ class BlockAllocator:
         self._block_hash: dict[int, int] = {}
         # LRU order of zero-ref cached blocks (eviction candidates)
         self._zero_ref_lru: list[int] = []
+        # tiered-cache hooks (llm/kvtier): seal_listener(block_id, hash,
+        # parent_hash, tokens, n_prefix_tokens) fires when a full block
+        # becomes canonical under its hash; evict_listener(block_id,
+        # hash) fires just before a zero-ref cached block is reused
+        # (the pages are still intact — the spill path's window);
+        # drop_listener() fires on drop_prefix_cache (invalidation,
+        # never a spill: the cached K/V itself went stale)
+        self.seal_listener = None
+        self.evict_listener = None
+        self.drop_listener = None
 
     # -- stats ---------------------------------------------------------------
 
@@ -92,6 +102,15 @@ class BlockAllocator:
             h = self._block_hash.pop(victim, None)
             if h is not None:
                 self._hash_to_block.pop(h, None)
+                if self.evict_listener is not None:
+                    # spill window: the victim's pages are still intact
+                    # (its new owner writes only after this allocation
+                    # returns). A failed spill must never break the
+                    # allocation it rode on.
+                    try:
+                        self.evict_listener(victim, h)
+                    except Exception:  # noqa: BLE001
+                        pass
             return victim
         raise NoFreeBlocksError("KV cache exhausted")
 
@@ -139,14 +158,40 @@ class BlockAllocator:
         self._zero_ref_lru.clear()
         self._hash_to_block.clear()
         self._block_hash.clear()
+        if self.drop_listener is not None:
+            # cascade: deeper tiers (llm/kvtier) hold K/V computed with
+            # the same now-stale weights/adapters — invalidation, not
+            # spill, and it must reach every tier plus the prefix index
+            try:
+                self.drop_listener()
+            except Exception:  # noqa: BLE001
+                pass
 
-    def register_full_block(self, block_id: int, content_hash: int) -> None:
-        """Mark a just-written full block reusable under its content hash."""
+    def register_full_block(self, block_id: int, content_hash: int,
+                            parent_hash: Optional[int] = None,
+                            tokens: Optional[tuple] = None,
+                            n_prefix_tokens: int = 0) -> None:
+        """Mark a just-written full block reusable under its content hash.
+        ``parent_hash``/``tokens``/``n_prefix_tokens`` carry the chain
+        metadata the tiered cache's spill path needs (sealers that don't
+        care pass nothing; the listener then never fires for them)."""
         existing = self._hash_to_block.get(content_hash)
         if existing is not None and existing != block_id:
             return  # another copy already canonical; keep ours private
         self._hash_to_block[content_hash] = block_id
         self._block_hash[block_id] = content_hash
+        if self.seal_listener is not None and tokens is not None:
+            try:
+                self.seal_listener(block_id, content_hash,
+                                   parent_hash if parent_hash is not None else 0,
+                                   tokens, n_prefix_tokens)
+            except Exception:  # noqa: BLE001 — bookkeeping, not correctness
+                pass
+
+    def contains_hash(self, content_hash: int) -> bool:
+        """Read-only membership probe (no refs, no LRU motion) — the
+        tiered probe walks per-block across HBM and the deep tiers."""
+        return content_hash in self._hash_to_block
 
     def lookup(self, content_hash: int) -> Optional[int]:
         """Take a reference on a cached block if present."""
@@ -250,8 +295,12 @@ class SequenceBlocks:
         h = self.chain
         for i in range(self.num_sealed_tokens // bs, n_full):
             blk = tuple(tokens[i * bs : (i + 1) * bs])
+            parent = h
             h = self.allocator.chain_hash(h, blk)
-            self.allocator.register_full_block(self.blocks[i], h)
+            self.allocator.register_full_block(
+                self.blocks[i], h, parent_hash=parent, tokens=blk,
+                n_prefix_tokens=(i + 1) * bs,
+            )
         self.chain = h
         self.num_sealed_tokens = n_full * bs
 

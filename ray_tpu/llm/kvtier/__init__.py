@@ -1,0 +1,49 @@
+"""ray_tpu.llm.kvtier — cluster-wide tiered KV/prefix cache.
+
+The HBM prefix cache (llm/kv_cache.py BlockAllocator) is tier 0 of a
+three-deep ladder:
+
+    HBM (paged device cache)  ->  host DRAM (bounded LRU of page arrays)
+        ->  object store (core/object_store.py, serialized + bounded)
+
+Sealed full blocks evicted from the HBM allocator under allocation
+pressure SPILL down the ladder instead of being discarded; a later
+prompt sharing the prefix RESURRECTS them with a verified scatter
+(import_handoff-shaped: the pages go straight back into the paged
+cache, ``num_cached_tokens`` covers every resurrected position, zero
+recompute). Every spilled block is CRC-sealed via the r10 ``KVHandoff``
+seal machinery, so a corrupt host/object copy fails ``verify()`` and
+falls back to recompute — counted, never wrong tokens.
+
+A cluster-level prefix index (``index.PrefixIndexStore`` in the GCS,
+``LocalPrefixIndex`` in-process) maps chain hashes to
+{engine, tier, n_tokens} so the serve router and the disagg
+orchestrator can route each request to the replica already holding its
+longest prefix, tier-discounted (an HBM hit outranks an object-store
+hit outranks a miss), falling back to the existing queue-depth/p2c
+ladder whenever the index is dark or stale.
+"""
+
+from ray_tpu.llm.kvtier.config import KVTierConfig, TIER_HBM, TIER_HOST, TIER_OBJECT
+from ray_tpu.llm.kvtier.index import (
+    GcsPrefixIndex,
+    LocalPrefixIndex,
+    PrefixIndexStore,
+    chain_hashes,
+    get_local_index,
+)
+from ray_tpu.llm.kvtier.tiers import KVTierManager, SpilledBlock
+
+__all__ = [
+    "KVTierConfig",
+    "KVTierManager",
+    "SpilledBlock",
+    "PrefixIndexStore",
+    "LocalPrefixIndex",
+    "GcsPrefixIndex",
+    "get_local_index",
+    "chain_hashes",
+    "TIER_HBM",
+    "TIER_HOST",
+    "TIER_OBJECT",
+]

@@ -1,0 +1,57 @@
+"""KVTierConfig: shape of one engine's tiered prefix cache."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+# tier names + index wire codes are owned by the control-plane half
+# (cluster/prefix_index.py — the GCS hosts the table without importing
+# the serving stack); re-exported here for engine-side callers
+from ray_tpu.cluster.prefix_index import (  # noqa: F401
+    TIER_CODES,
+    TIER_HBM,
+    TIER_HOST,
+    TIER_NAMES,
+    TIER_OBJECT,
+)
+
+
+@dataclasses.dataclass
+class KVTierConfig:
+    """Budgets + routing weights for the HBM -> host -> object ladder.
+
+    A tier with a zero budget is disabled; blocks falling past the last
+    enabled tier are discarded (exactly the pre-kvtier behavior). The
+    ``tier_weights`` discount what a cached prefix is worth to the
+    router per tier: resurrecting from the object store still beats a
+    recompute, but an HBM hit costs nothing at all, so routing must
+    prefer the replica holding the prefix in the cheapest tier.
+    """
+
+    # host DRAM LRU budget for spilled page arrays (bytes; 0 disables)
+    host_bytes: int = 64 << 20
+    # object-store tier budget (bytes; 0 disables). Entries are
+    # serialized through core/object_store.py — the plasma-shaped
+    # boundary a multi-process deployment would cross.
+    object_bytes: int = 256 << 20
+    # optional shared ObjectStore instance (defaults to a private one);
+    # entries are namespaced by engine key either way
+    object_store: Any = None
+    # routing discount per tier (missing tier = 0.0: never preferred)
+    tier_weights: tuple = ((TIER_HBM, 1.0), (TIER_HOST, 0.6), (TIER_OBJECT, 0.35))
+    # prefix-aware picks only prefer a prefix-holder whose queue depth
+    # is within this slack of the least-loaded candidate — cache
+    # affinity must not pile every request onto one hot replica
+    depth_slack: int = 4
+    # min seconds between full index snapshots shipped to the prefix
+    # index (piggybacks on the engine's throttled telemetry refresh)
+    index_flush_interval_s: float = 0.2
+    # index rows older than this are treated as dark by routing helpers
+    index_stale_after_s: float = 30.0
+
+    def weight(self, tier: Optional[str]) -> float:
+        for t, w in self.tier_weights:
+            if t == tier:
+                return float(w)
+        return 0.0

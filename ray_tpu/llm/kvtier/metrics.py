@@ -1,0 +1,80 @@
+"""KV-tier observability: the series `ray_tpu status` renders in its
+``== kv tiers ==`` block and /v1/stats breaks down per tier.
+
+Construct-per-call like obs/slo.py and fabric/metrics.py (same-name
+re-registration shares storage in util/metrics, so a test's
+``clear_registry()`` can never strand a stale cached instance). All
+series are telemetry-plane (``llm_`` is in
+``obs.telemetry.AGGREGATED_PREFIXES``) and declare their aggregation
+kinds, so ``check_metrics`` / ``check_aggregations`` hold them to the
+same contract as every other cluster-rolled metric.
+
+The per-tier prefix-cache HIT accounting itself lives on the existing
+``llm_prefix_cache_hit_tokens_total`` counter (llm/engine.py), which
+r17 splits by a ``tier`` label — hbm / host / object — so the fleet
+hit rate and its tier mix come from ONE series family.
+"""
+
+from __future__ import annotations
+
+
+def spilled_bytes_counter():
+    """Bytes of sealed KV pages spilled DOWN the ladder, by destination
+    tier (host = evicted from HBM into host DRAM, object = demoted from
+    host into the object store). Counters aggregate by SUM."""
+    from ray_tpu.obs.telemetry import cluster_counter
+
+    return cluster_counter(
+        "llm_kvtier_spilled_bytes_total",
+        description="KV page bytes spilled from the HBM prefix cache "
+        "into a deeper tier (labelled by destination tier)",
+        tag_keys=("model", "tier"),
+    )
+
+
+def resident_bytes_gauge():
+    """Bytes of spilled KV pages currently resident per deep tier
+    (host/object). SUM across engines: the fleet value is the total
+    spilled-cache footprint."""
+    from ray_tpu.obs.telemetry import cluster_gauge
+
+    return cluster_gauge(
+        "llm_kvtier_resident_bytes",
+        description="KV page bytes currently held by this engine's "
+        "host-DRAM / object-store prefix-cache tiers",
+        tag_keys=("model", "tier"),
+    )
+
+
+def resurrected_tokens_counter():
+    """Prompt tokens resurrected back into HBM with zero recompute, by
+    source tier."""
+    from ray_tpu.obs.telemetry import cluster_counter
+
+    return cluster_counter(
+        "llm_kvtier_resurrected_tokens_total",
+        description="prompt tokens whose KV was resurrected into the "
+        "paged cache from a deeper tier (no recompute), by source tier",
+        tag_keys=("model", "tier"),
+    )
+
+
+def corrupt_dropped_counter():
+    """Spilled blocks whose CRC/token check failed at resurrection —
+    dropped and recomputed, never decoded from garbage pages."""
+    from ray_tpu.obs.telemetry import cluster_counter
+
+    return cluster_counter(
+        "llm_kvtier_corrupt_dropped_total",
+        description="spilled KV blocks dropped because seal "
+        "verification failed at resurrection (fell back to recompute)",
+        tag_keys=("model", "tier"),
+    )
+
+
+def register_metrics() -> None:
+    """scripts/check_metrics.py hook: force lazy metrics to register."""
+    spilled_bytes_counter()
+    resident_bytes_gauge()
+    resurrected_tokens_counter()
+    corrupt_dropped_counter()

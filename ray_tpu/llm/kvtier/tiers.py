@@ -1,0 +1,509 @@
+"""Tiered spill/resurrect machinery behind one engine's prefix cache.
+
+``KVTierManager`` hangs off an ``LLMEngine`` and listens to its
+``BlockAllocator``:
+
+ * ``on_seal`` — a full block was registered reusable: remember its
+   chain metadata (parent hash, tokens, prefix length) and advertise
+   the HBM row to the prefix index.
+ * ``on_evict`` — allocation pressure is about to reuse a zero-ref
+   cached block: gather its pages off the device (one contiguous slice
+   per block — slots are block-major, so this is basic slicing, not a
+   gather) and push them down the ladder as a CRC-sealed
+   ``SpilledBlock`` (the r10 ``KVHandoff`` seal machinery, so spill
+   integrity and handoff integrity are ONE code path).
+
+Resurrection runs in the engine's prefill admission
+(``LLMEngine._resurrect_tiers``): blocks past the HBM match are pulled
+back with ``take_verified`` (seal + token check — a corrupt copy is
+dropped and counted, never scattered) and re-enter the paged cache via
+the same jitted scatter ``import_handoff`` uses.
+
+Thread model: every mutating entry point runs on the engine's own
+serving thread (allocator calls, prefill admission, telemetry
+refresh) — the engine is single-threaded by contract (orchestrator
+pools take ``pe.lock`` around every engine call), so the manager
+needs no lock of its own; the shared index objects are thread-safe.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import time
+from collections import OrderedDict
+from typing import Any, Optional
+
+import numpy as np
+
+from ray_tpu.chaos import harness as _chaos
+from ray_tpu.llm.kvtier.config import (
+    TIER_CODES,
+    TIER_HBM,
+    TIER_HOST,
+    TIER_OBJECT,
+    KVTierConfig,
+)
+from ray_tpu.utils.ids import ObjectID
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.llm.kvtier")
+
+
+@dataclasses.dataclass
+class SpilledBlock:
+    """One sealed full block outside HBM: its pages as a CRC-sealed
+    KVHandoff (pages [L, KVH, block_size, D], prompt_token_ids = the
+    block's tokens) plus the chain metadata resurrection re-links."""
+
+    handoff: Any          # llm.disagg.handoff.KVHandoff
+    parent_hash: int
+    n_prefix_tokens: int  # prompt tokens covered through this block
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.handoff.nbytes)
+
+    @property
+    def tokens(self) -> tuple:
+        return tuple(self.handoff.prompt_token_ids)
+
+
+class KVTierManager:
+    """HBM -> host DRAM -> object store ladder for one engine."""
+
+    def __init__(self, engine: Any, config: Optional[KVTierConfig] = None):
+        self.engine = engine
+        self.config = config or KVTierConfig()
+        c = self.config
+        # chain metadata for hashes currently sealed in HBM: the spill
+        # path needs (parent, tokens, prefix length) the allocator's
+        # hash->block map doesn't carry. Bounded by the HBM block count.
+        self._meta: dict[int, tuple] = {}  # h -> (parent, tokens, n_prefix)
+        # host DRAM tier: bounded LRU of SpilledBlocks
+        self._host: "OrderedDict[int, SpilledBlock]" = OrderedDict()
+        self._host_bytes = 0
+        # object-store tier: LRU of ids into the (possibly shared) store
+        from ray_tpu.core.object_store import ObjectStore
+
+        self._store = c.object_store or ObjectStore()
+        self._obj: "OrderedDict[int, tuple]" = OrderedDict()  # h -> (oid, nbytes, parent, n_prefix)
+        self._obj_bytes = 0
+        # prefix index publishing (telemetry-style epoch banking: the
+        # epoch survives this object, the seq only this incarnation)
+        self.index: Any = None
+        self.engine_key: str = getattr(engine, "model_tag", "engine")
+        self._epoch = int(time.time() * 1000)
+        self._seq = 0
+        self._index_dirty = True
+        self._index_next = 0.0
+        self._index_refresh_next = 0.0
+        # stats
+        self.spilled_bytes = {TIER_HOST: 0, TIER_OBJECT: 0}
+        self.resurrected_tokens = {TIER_HOST: 0, TIER_OBJECT: 0}
+        self.corrupt_dropped = {TIER_HOST: 0, TIER_OBJECT: 0}
+        self.spills_dropped = 0   # chaos DROP_KV_TRANSFER at the spill site
+        self.evicted_blocks = 0   # fell off the deepest tier (gone for good)
+        self._bind_allocator()
+
+    # -- allocator listeners ---------------------------------------------------
+
+    def _bind_allocator(self) -> None:
+        alloc = self.engine.allocator
+        alloc.seal_listener = self.on_seal
+        alloc.evict_listener = self.on_evict
+        alloc.drop_listener = self.on_drop_all
+
+    def rebind_allocator(self) -> None:
+        """The engine rebuilt its allocator/KV cache (recover(rebuild_kv)):
+        HBM rows are gone, but spilled copies were written from pages
+        that were correct when sealed — they stay resurrectable."""
+        self._meta.clear()
+        self._bind_allocator()
+        self._index_dirty = True
+
+    def on_seal(self, block_id: int, content_hash: int, parent_hash: int,
+                tokens: tuple, n_prefix_tokens: int) -> None:
+        self._meta[content_hash] = (parent_hash, tuple(tokens),
+                                    int(n_prefix_tokens))
+        self._index_dirty = True
+
+    def on_evict(self, block_id: int, content_hash: int) -> None:
+        """A zero-ref sealed block is being reused by the allocator:
+        spill its pages down the ladder before they are overwritten.
+        Never throws into allocation (the allocator call site also
+        guards) — a failed spill is just a future cache miss."""
+        meta = self._meta.pop(content_hash, None)
+        self._index_dirty = True
+        if meta is None:
+            return  # sealed before the manager attached, or already spilled
+        if self.config.host_bytes <= 0 and self.config.object_bytes <= 0:
+            return
+        parent, tokens, n_prefix = meta
+        try:
+            sb = self._spill_block(block_id, content_hash, parent, tokens,
+                                   n_prefix)
+        except Exception:  # noqa: BLE001 — spill must never break allocation
+            logger.exception("kvtier spill of block %d failed", block_id)
+            return
+        if sb is None:
+            return
+        if self.config.host_bytes > 0:
+            self._host_insert(content_hash, sb)
+        else:
+            self._object_insert(content_hash, sb)
+
+    def on_drop_all(self) -> None:
+        """The allocator invalidated its whole prefix cache (weight
+        swap / LoRA slot reuse): cached K/V no longer matches what the
+        current weights would compute, in EVERY tier. Cascade."""
+        self.invalidate_all()
+
+    # -- spill path ------------------------------------------------------------
+
+    def _spill_block(self, block_id: int, content_hash: int, parent: int,
+                     tokens: tuple, n_prefix: int) -> Optional[SpilledBlock]:
+        from ray_tpu.llm.disagg.handoff import KVHandoff
+
+        c = self.engine.config
+        bs = c.block_size
+        lo, hi = block_id * bs, (block_id + 1) * bs
+        # contiguous slot range: one basic slice per page array, then a
+        # host copy — the only device->host traffic the tier ladder does
+        k = np.asarray(self.engine.cache["k"][:, :, lo:hi, :])
+        v = np.asarray(self.engine.cache["v"][:, :, lo:hi, :])
+        h = KVHandoff(
+            request_id=f"kvtier-{content_hash & 0xFFFFFFFF:08x}",
+            prompt_token_ids=list(tokens),
+            output_token_ids=[],
+            sampling_params=None,
+            key_data=np.zeros(1, np.uint32),
+            num_kv_tokens=bs,
+            k_pages=k,
+            v_pages=v,
+            model_sig=(c.model.n_layers, c.model.n_kv_heads,
+                       c.model.head_dim),
+        ).seal()
+        if _chaos.ACTIVE is not None:
+            for _f in _chaos.fire(
+                "llm.kvtier.spill",
+                kinds=(_chaos.DROP_KV_TRANSFER, _chaos.CORRUPT_KV_TRANSFER),
+                chain=content_hash,
+            ):
+                if _f.kind == _chaos.DROP_KV_TRANSFER:
+                    # the spill is silently lost: a later probe misses
+                    # and recomputes — the failure mode of a torn host
+                    self.spills_dropped += 1
+                    return None
+                if _f.kind == _chaos.CORRUPT_KV_TRANSFER:
+                    # bit-flip AFTER sealing (copy-on-corrupt: the
+                    # gathered view may be read-only): resurrection's
+                    # verify() must catch it (never wrong tokens)
+                    kc = np.array(h.k_pages, copy=True)
+                    flat = kc.view(np.uint8).reshape(-1)
+                    if flat.size:
+                        mid = flat.size // 2
+                        span = max(1, min(16, flat.size - mid))
+                        flat[mid:mid + span] ^= 0xFF
+                    h.k_pages = kc
+        return SpilledBlock(handoff=h, parent_hash=parent,
+                            n_prefix_tokens=n_prefix)
+
+    def _host_insert(self, content_hash: int, sb: SpilledBlock) -> None:
+        old = self._host.get(content_hash)
+        if old is not None:
+            # re-spill of a hash still resident (resurrection aborted on
+            # allocation pressure, then the recompute re-sealed and
+            # re-evicted it): replace, don't double-count the bytes
+            self._host_bytes -= old.nbytes
+        self._host[content_hash] = sb
+        self._host.move_to_end(content_hash)
+        self._host_bytes += sb.nbytes
+        self.spilled_bytes[TIER_HOST] += sb.nbytes
+        self._count_spill(TIER_HOST, sb.nbytes)
+        while self._host_bytes > self.config.host_bytes and self._host:
+            old_h, old = self._host.popitem(last=False)
+            self._host_bytes -= old.nbytes
+            if self.config.object_bytes > 0:
+                self._object_insert(old_h, old)
+            else:
+                self.evicted_blocks += 1
+        self._index_dirty = True
+
+    def _object_insert(self, content_hash: int, sb: SpilledBlock) -> None:
+        from ray_tpu.core.object_store import serialize
+
+        old = self._obj.pop(content_hash, None)
+        if old is not None:
+            # replace-in-place: release the old store ref and its bytes
+            # before re-putting under the same (hash-derived) object id
+            self._obj_bytes -= old[1]
+            self._store.remove_ref(old[0])
+        oid = self._object_id(content_hash)
+        payload, buffers = serialize(sb)
+        self._store.put_serialized(oid, payload, buffers)
+        self._obj[content_hash] = (oid, sb.nbytes, sb.parent_hash,
+                                   sb.n_prefix_tokens)
+        self._obj.move_to_end(content_hash)
+        self._obj_bytes += sb.nbytes
+        self.spilled_bytes[TIER_OBJECT] += sb.nbytes
+        self._count_spill(TIER_OBJECT, sb.nbytes)
+        while self._obj_bytes > self.config.object_bytes and self._obj:
+            old_h, (old_oid, old_n, _p, _np_) = self._obj.popitem(last=False)
+            self._obj_bytes -= old_n
+            self._store.remove_ref(old_oid)
+            self.evicted_blocks += 1
+        self._index_dirty = True
+
+    def _object_id(self, content_hash: int) -> ObjectID:
+        digest = hashlib.blake2b(
+            f"kvtier:{self.engine_key}:{content_hash}".encode(),
+            digest_size=16,
+        ).digest()
+        return ObjectID(digest)
+
+    def _count_spill(self, tier: str, nbytes: int) -> None:
+        try:
+            from ray_tpu.llm.kvtier import metrics as kvtier_metrics
+
+            kvtier_metrics.spilled_bytes_counter().inc(
+                nbytes, tags={"model": self.engine.model_tag, "tier": tier}
+            )
+        except Exception:  # noqa: BLE001 — observability never breaks serving
+            pass
+
+    # -- resurrect path --------------------------------------------------------
+
+    def peek(self, content_hash: int) -> Optional[str]:
+        """Which deep tier holds this hash (read-only; no LRU motion)."""
+        if content_hash in self._host:
+            return TIER_HOST
+        if content_hash in self._obj:
+            return TIER_OBJECT
+        return None
+
+    def get(self, content_hash: int) -> Optional[tuple]:
+        """(tier, SpilledBlock) without removing the entry — the caller
+        commits with ``promoted`` only after the scatter landed."""
+        sb = self._host.get(content_hash)
+        if sb is not None:
+            self._host.move_to_end(content_hash)
+            return TIER_HOST, sb
+        rec = self._obj.get(content_hash)
+        if rec is not None:
+            from ray_tpu.core.object_store import deserialize
+
+            oid = rec[0]
+            try:
+                payload, buffers = self._store.serialized_get(oid, timeout=1.0)
+                sb = deserialize(payload, buffers)
+            except Exception:  # noqa: BLE001 — torn store entry = miss
+                self._drop_entry(content_hash, TIER_OBJECT)
+                return None
+            self._obj.move_to_end(content_hash)
+            return TIER_OBJECT, sb
+        return None
+
+    def take_verified(self, content_hash: int,
+                      expect_tokens: tuple) -> Optional[tuple]:
+        """(tier, SpilledBlock) iff the seal verifies AND the stored
+        tokens match the prompt's block — a corrupt or hash-colliding
+        entry is dropped and counted, and the caller recomputes from
+        this block on (never wrong tokens)."""
+        got = self.get(content_hash)
+        if got is None:
+            return None
+        tier, sb = got
+        ok = False
+        try:
+            ok = tuple(sb.tokens) == tuple(expect_tokens) and sb.handoff.verify()
+        except Exception:  # noqa: BLE001 — malformed entry = corrupt
+            ok = False
+        if not ok:
+            self.corrupt_dropped[tier] += 1
+            self._drop_entry(content_hash, tier)
+            try:
+                from ray_tpu.llm.kvtier import metrics as kvtier_metrics
+
+                kvtier_metrics.corrupt_dropped_counter().inc(
+                    1, tags={"model": self.engine.model_tag, "tier": tier}
+                )
+            except Exception:  # noqa: BLE001
+                pass
+            logger.warning(
+                "kvtier: dropped corrupt %s-tier block (chain %x); "
+                "falling back to recompute", tier, content_hash & 0xFFFFFFFF,
+            )
+            return None
+        return tier, sb
+
+    def promoted(self, content_hash: int, tier: str) -> None:
+        """The block is back in HBM (resurrected + re-registered): drop
+        the deep-tier copy; the seal listener re-advertises it as hbm."""
+        self._drop_entry(content_hash, tier)
+
+    def count_resurrected(self, tier: str, n_tokens: int) -> None:
+        self.resurrected_tokens[tier] = (
+            self.resurrected_tokens.get(tier, 0) + n_tokens
+        )
+        try:
+            from ray_tpu.llm.kvtier import metrics as kvtier_metrics
+
+            kvtier_metrics.resurrected_tokens_counter().inc(
+                n_tokens, tags={"model": self.engine.model_tag, "tier": tier}
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _drop_entry(self, content_hash: int, tier: str) -> None:
+        if tier == TIER_HOST:
+            sb = self._host.pop(content_hash, None)
+            if sb is not None:
+                self._host_bytes -= sb.nbytes
+        else:
+            rec = self._obj.pop(content_hash, None)
+            if rec is not None:
+                self._obj_bytes -= rec[1]
+                self._store.remove_ref(rec[0])
+        self._index_dirty = True
+
+    # -- probes (read-only; the routing signal) --------------------------------
+
+    def probe_tiers(self, tokens: list, salt: int = 0) -> dict:
+        """Longest contiguous resurrectable prefix of ``tokens`` across
+        ALL tiers, tier-discounted. Read-only: no refs, no LRU motion.
+        Returns {"n_tokens", "discounted", "by_tier": {tier: tokens}}."""
+        from ray_tpu.llm.kv_cache import BlockAllocator
+
+        alloc = self.engine.allocator
+        bs = alloc.block_size
+        c = self.config
+        h = salt
+        n = 0
+        discounted = 0.0
+        by_tier: dict[str, int] = {}
+        for i in range(len(tokens) // bs):
+            blk = tuple(tokens[i * bs : (i + 1) * bs])
+            h = BlockAllocator.chain_hash(h, blk)
+            if alloc.contains_hash(h):
+                tier = TIER_HBM
+            else:
+                tier = self.peek(h)
+                if tier is None:
+                    break
+            n += bs
+            discounted += c.weight(tier) * bs
+            by_tier[tier] = by_tier.get(tier, 0) + bs
+        return {"n_tokens": n, "discounted": discounted, "by_tier": by_tier}
+
+    # -- invalidation ----------------------------------------------------------
+
+    def invalidate_all(self) -> None:
+        """Weight swap / adapter churn: every tier's cached K/V is stale.
+        Drops host + object entries, forgets HBM metadata, and ships an
+        EMPTY index snapshot so the cluster stops routing here for
+        prefixes this engine no longer holds."""
+        self._meta.clear()
+        self._host.clear()
+        self._host_bytes = 0
+        for oid, _n, _p, _np_ in self._obj.values():
+            try:
+                self._store.remove_ref(oid)
+            except Exception:  # noqa: BLE001
+                pass
+        self._obj.clear()
+        self._obj_bytes = 0
+        self._index_dirty = True
+        self.flush_index(force=True)
+
+    # -- prefix-index publishing ----------------------------------------------
+
+    def attach_index(self, index: Any, engine_key: Optional[str] = None) -> None:
+        self.index = index
+        if engine_key is not None:
+            self.engine_key = engine_key
+        self._index_dirty = True
+        self.flush_index(force=True)
+
+    # silent publishers' rows are omitted from lookups at the store's
+    # stale_after_s and reaped past its expire horizon, so an engine in
+    # steady state (nothing sealing or evicting) must still re-publish
+    # on this heartbeat — it also repopulates a restarted GCS
+    INDEX_REFRESH_S = 10.0
+
+    def flush_index(self, force: bool = False) -> None:
+        """Ship a full snapshot of resident chain hashes (throttled;
+        called from the engine's telemetry refresh). Full snapshots +
+        (epoch, seq) guarding give telemetry-style staleness semantics:
+        a delayed re-send can never resurrect rows a newer snapshot
+        dropped. A failed publish re-arms the dirty flag so the next
+        throttle tick retries instead of going silent."""
+        if self.index is None:
+            return
+        now = time.monotonic()
+        due = self._index_dirty or now >= self._index_refresh_next
+        if not force and (not due or now < self._index_next):
+            return
+        self._index_next = now + self.config.index_flush_interval_s
+        self._index_refresh_next = now + self.INDEX_REFRESH_S
+        rows = []
+        for h, (_p, _tokens, n_prefix) in self._meta.items():
+            rows.append([h, TIER_CODES[TIER_HBM], n_prefix])
+        for h, sb in self._host.items():
+            rows.append([h, TIER_CODES[TIER_HOST], sb.n_prefix_tokens])
+        for h, (_oid, _n, _parent, n_prefix) in self._obj.items():
+            rows.append([h, TIER_CODES[TIER_OBJECT], n_prefix])
+        self._seq += 1
+        self._index_dirty = False
+        ok = False
+        try:
+            got = self.index.update({
+                "engine": self.engine_key,
+                "epoch": self._epoch,
+                "seq": self._seq,
+                "rows": rows,
+            })
+            # GcsPrefixIndex returns a bool; the store returns {"ok": ...}.
+            # A "stale" verdict is NOT a failure to retry — it means a
+            # newer snapshot (ours: seq only moves forward) already landed.
+            ok = bool(got) if not isinstance(got, dict) else bool(got.get("ok"))
+            if isinstance(got, dict) and got.get("reason") == "stale":
+                ok = True
+        except Exception:  # noqa: BLE001 — a dark index costs freshness only
+            ok = False
+        if not ok:
+            self._index_dirty = True
+
+    # -- observability ---------------------------------------------------------
+
+    def update_gauges(self) -> None:
+        try:
+            from ray_tpu.llm.kvtier import metrics as kvtier_metrics
+
+            g = kvtier_metrics.resident_bytes_gauge()
+            tag = {"model": self.engine.model_tag}
+            g.set(self._host_bytes, tags={**tag, "tier": TIER_HOST})
+            g.set(self._obj_bytes, tags={**tag, "tier": TIER_OBJECT})
+        except Exception:  # noqa: BLE001
+            pass
+
+    def stats(self) -> dict:
+        return {
+            "host": {
+                "entries": len(self._host),
+                "resident_bytes": self._host_bytes,
+                "capacity_bytes": self.config.host_bytes,
+            },
+            "object": {
+                "entries": len(self._obj),
+                "resident_bytes": self._obj_bytes,
+                "capacity_bytes": self.config.object_bytes,
+            },
+            "spilled_bytes_total": dict(self.spilled_bytes),
+            "resurrected_tokens": dict(self.resurrected_tokens),
+            "corrupt_dropped": dict(self.corrupt_dropped),
+            "spills_dropped": self.spills_dropped,
+            "evicted_blocks": self.evicted_blocks,
+            "index_attached": self.index is not None,
+            "engine_key": self.engine_key,
+        }

@@ -991,11 +991,45 @@ class TelemetryStore:
             "kv_bytes_by_backend": bytes_by_backend,
         }
 
+    def kvtier_health(self, agg: Optional[dict] = None) -> dict:
+        """Tiered-KV-cache rollup for `ray_tpu status` (r17): resident
+        spilled bytes per deep tier (gauge sum over engines), cumulative
+        spilled bytes per destination tier, prefix-cache hit tokens per
+        serving tier (the tier-labelled hit counter), resurrected
+        tokens, and corrupt drops. All empty when no tiered cache is
+        reporting."""
+        if agg is None:
+            agg = self.cluster_metrics()
+
+        def by_tier(table: str, name: str) -> dict:
+            out: dict[str, float] = {}
+            acc = agg[table].get(_fq(name))
+            if acc:
+                for skey, v in acc["series"].items():
+                    tier = self._parse_tags_key(skey).get("tier", "")
+                    out[tier] = out.get(tier, 0.0) + float(v)
+            return out
+
+        corrupt = agg["counters"].get(_fq("llm_kvtier_corrupt_dropped_total"))
+        return {
+            "resident_bytes_by_tier": by_tier(
+                "gauges", "llm_kvtier_resident_bytes"),
+            "spilled_bytes_by_tier": by_tier(
+                "counters", "llm_kvtier_spilled_bytes_total"),
+            "hit_tokens_by_tier": by_tier(
+                "counters", "llm_prefix_cache_hit_tokens_total"),
+            "resurrected_tokens_by_tier": by_tier(
+                "counters", "llm_kvtier_resurrected_tokens_total"),
+            "corrupt_dropped_total": (
+                int(corrupt["total"]) if corrupt else None
+            ),
+        }
+
     def status_payload(self, thresholds: Optional[SLOThresholds] = None) -> dict:
         """Everything `ray_tpu status` needs beyond the node table — the
         GCS assembles this so the CLI is ONE RPC. The full aggregation
-        pass (every series, under the lock) runs ONCE and feeds all six
-        views."""
+        pass (every series, under the lock) runs ONCE and feeds all
+        seven views."""
         agg = self.cluster_metrics()
         return {
             "reporters": agg["reporters"],
@@ -1005,6 +1039,7 @@ class TelemetryStore:
             "slo": self.slo_report(thresholds, agg),
             "trainer": self.trainer_health(agg),
             "fabric": self.fabric_health(agg),
+            "kvtier": self.kvtier_health(agg),
         }
 
 
@@ -1113,6 +1148,39 @@ def format_status(report: dict) -> str:
                 "  kv bytes " + " ".join(
                     f"{b}={_fmt_bytes(n)}" for b, n in sorted(bb.items()) if n
                 )
+            )
+    kvt = report.get("kvtier") or {}
+    if (kvt.get("resident_bytes_by_tier") or kvt.get("spilled_bytes_by_tier")
+            or kvt.get("hit_tokens_by_tier")):
+        # the tier ladder must SHOW here: how much spilled prefix cache
+        # each deep tier holds, which tier is actually serving hits, and
+        # whether any spilled copy ever failed its seal
+        lines.append("== kv tiers ==")
+        res = kvt.get("resident_bytes_by_tier") or {}
+        lines.append(
+            "  resident "
+            + (" ".join(f"{t}={_fmt_bytes(n)}" for t, n in sorted(res.items()))
+               or "-")
+            + "  spilled "
+            + (" ".join(
+                f"{t}={_fmt_bytes(n)}"
+                for t, n in sorted((kvt.get("spilled_bytes_by_tier")
+                                    or {}).items()) if n) or "-")
+        )
+        hits = kvt.get("hit_tokens_by_tier") or {}
+        if hits:
+            line = "  hit tokens " + " ".join(
+                f"{t}={int(n)}" for t, n in sorted(hits.items()) if n
+            )
+            cd = kvt.get("corrupt_dropped_total")
+            if cd:
+                line += f"  corrupt dropped {int(cd)}"
+            lines.append(line)
+        idx = report.get("kvtier_index") or {}
+        if idx.get("rows"):
+            lines.append(
+                f"  index {idx['rows']} rows / {idx['engines']} engines "
+                f"({' '.join(f'{t}={n}' for t, n in sorted((idx.get('rows_by_tier') or {}).items()))})"
             )
     u = report.get("utilization", {})
     occ = u.get("kv_page_occupancy")

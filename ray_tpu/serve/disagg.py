@@ -77,10 +77,22 @@ class PrefillServer:
 
     def __init__(self, llm_config, connector_kind: str = "inproc",
                  namespace: str = "disagg"):
+        import uuid as _uuid
+
         from ray_tpu import obs  # noqa: F401 — engine tracing rides requests
 
         self.engine = _build_engine(llm_config)
         self.engine.model_tag = f"{llm_config.model_id}-prefill"
+        # prefix-aware routing (llm/kvtier): publish this replica's
+        # resident chains into the app's shared prefix index under a
+        # stable key the ingress maps back to a replica id
+        self._index_key = f"prefill-{_uuid.uuid4().hex[:12]}"
+        if self.engine.kvtier is not None:
+            from ray_tpu.llm.kvtier import get_local_index
+
+            self.engine.kvtier.attach_index(
+                get_local_index(namespace), engine_key=self._index_key
+            )
         self.connector = _make_connector(connector_kind, namespace)
         # device plane: export device-resident + device-sealed, so the
         # pages go gather -> device_put without ever staging through
@@ -142,6 +154,11 @@ class PrefillServer:
             "handed_off": handoff is not None,
         }
 
+    def index_key(self) -> str:
+        """This replica's key in the app's prefix index (the ingress
+        reverse-maps lookup winners onto replica ids)."""
+        return self._index_key
+
     def stats(self) -> dict:
         with self._lock:
             return {**self.engine.stats(), "connector": self.connector.stats()}
@@ -162,6 +179,12 @@ class DecodeServer:
         self.engine.model_tag = f"{llm_config.model_id}-decode"
         self.connector = _make_connector(connector_kind, namespace)
         self._target_id = f"decode-{uuid.uuid4().hex[:12]}"
+        if self.engine.kvtier is not None:
+            from ray_tpu.llm.kvtier import get_local_index
+
+            self.engine.kvtier.attach_index(
+                get_local_index(namespace), engine_key=self._target_id
+            )
         if getattr(self.connector, "name", "") == "device":
             # device plane: pin the endpoint to this engine's KV-cache
             # device so the sender's device_put IS the final hop
@@ -186,6 +209,11 @@ class DecodeServer:
         """Opaque connector address of THIS replica (the ingress maps
         replica_id -> target for pinned KV-affinity dispatch)."""
         return self._target
+
+    def index_key(self) -> str:
+        """This replica's key in the app's prefix index (same id the
+        kv target rides, so one poll covers both)."""
+        return self._target_id
 
     def stats(self) -> dict:
         with self._lock:
@@ -272,7 +300,8 @@ class DisaggIngress:
     STATS_TTL_S = 0.5
     MAX_RETRIES = 2
 
-    def __init__(self, llm_config, prefill_handle, decode_handle):
+    def __init__(self, llm_config, prefill_handle, decode_handle,
+                 namespace: str = "disagg", index=None):
         from ray_tpu.llm.admission import AdmissionConfig, AdmissionController
         from ray_tpu.llm.openai_api import ByteTokenizer
 
@@ -282,6 +311,17 @@ class DisaggIngress:
         )
         self.prefill_handle = prefill_handle
         self.decode_handle = decode_handle
+        # prefix-aware routing (llm/kvtier): the index the pool replicas
+        # publish their resident chains into. In-process serving shares
+        # the app-namespaced LocalPrefixIndex; a cluster deployment
+        # injects a GcsPrefixIndex — either way a dark/stale index makes
+        # the picks below degrade to the existing queue-depth/p2c ladder
+        # (no hang, no wrong-replica pin).
+        self.index = index
+        if self.index is None and llm_config.engine.kvtier is not None:
+            from ray_tpu.llm.kvtier import get_local_index
+
+            self.index = get_local_index(namespace)
         acfg = llm_config.admission
         if isinstance(acfg, dict):
             acfg = AdmissionConfig(**acfg)
@@ -292,7 +332,13 @@ class DisaggIngress:
         self._targets: dict[str, Any] = {}   # decode replica_id -> kv target
         self._stats: dict[str, dict] = {}    # decode replica_id -> stats
         self._stats_at = 0.0
+        # replica_id -> prefix-index key, per pool (polled with the same
+        # TTL discipline as targets/stats)
+        self._decode_keys: dict[str, str] = {}
+        self._prefill_keys: dict[str, str] = {}
+        self._prefill_at = 0.0
         self.num_reprefills = 0
+        self.num_prefix_routed = 0
 
     # -- decode-pool discovery + pick -----------------------------------------
 
@@ -311,13 +357,17 @@ class DisaggIngress:
         # fire every poll before collecting any: the waits overlap, so a
         # hung (not yet evicted) replica costs one timeout window, not
         # one per replica, on the request path that called us
-        target_futs, stat_futs = {}, {}
+        target_futs, stat_futs, key_futs = {}, {}, {}
         for rid in rids:
             try:
                 if rid not in known:
                     target_futs[rid] = self.decode_handle.options(
                         pin_replica=rid
                     ).kv_target.remote()
+                    if self.index is not None:
+                        key_futs[rid] = self.decode_handle.options(
+                            pin_replica=rid
+                        ).index_key.remote()
                 stat_futs[rid] = self.decode_handle.options(
                     pin_replica=rid
                 ).stats.remote()
@@ -330,6 +380,13 @@ class DisaggIngress:
                 continue
             with self._lock:
                 self._targets[rid] = target
+        for rid, fut in key_futs.items():
+            try:
+                key = fut.result(timeout_s=10)
+            except Exception:  # noqa: BLE001
+                continue
+            with self._lock:
+                self._decode_keys[rid] = key
         stats = {}
         for rid, fut in stat_futs.items():
             try:
@@ -342,25 +399,113 @@ class DisaggIngress:
             dead = set(self._targets) - set(rids)
             for rid in dead:
                 self._targets.pop(rid, None)
+                self._decode_keys.pop(rid, None)
         return rids
 
-    def _pick_decode(self) -> tuple[str, Any]:
-        """Queue depth first, prefix-cache hit rate as tiebreak — the
-        serve-mode mirror of DisaggOrchestrator._pick_decode."""
+    def _refresh_prefill(self) -> dict:
+        """Prefill replica_id -> index key, with the same TTL (the
+        prefer hint needs a replica id, the index speaks in keys)."""
+        if self.index is None:
+            return {}
+        now = time.time()
+        with self._lock:
+            if now - self._prefill_at < self.STATS_TTL_S and self._prefill_keys:
+                return dict(self._prefill_keys)
+        try:
+            rids = self.prefill_handle._get_router().replica_ids()
+        except Exception:  # noqa: BLE001 — controller refresh racing death
+            return {}
+        futs = {}
+        with self._lock:
+            known = dict(self._prefill_keys)
+        for rid in rids:
+            if rid in known:
+                continue
+            try:
+                futs[rid] = self.prefill_handle.options(
+                    pin_replica=rid
+                ).index_key.remote()
+            except Exception:  # noqa: BLE001
+                continue
+        for rid, fut in futs.items():
+            try:
+                known[rid] = fut.result(timeout_s=10)
+            except Exception:  # noqa: BLE001
+                continue
+        with self._lock:
+            self._prefill_keys = {r: k for r, k in known.items() if r in rids}
+            self._prefill_at = now
+            return dict(self._prefill_keys)
+
+    def _prefix_hashes(self, prompt_ids: list) -> list:
+        from ray_tpu.llm.kvtier import chain_hashes
+
+        return chain_hashes(prompt_ids, self.config.engine.block_size)
+
+    def _index_lookup(self, prompt_ids: list):
+        """ONE index lookup per attempt, shared by the prefill prefer
+        and the decode pick (hashing the prompt and hitting the index —
+        two RPCs on the GCS-backed path — must not happen twice per
+        request). None = index off/dark = no information."""
+        if self.index is None:
+            return None
+        try:
+            return self.index.lookup(self._prefix_hashes(prompt_ids))
+        except Exception:  # noqa: BLE001 — dark index = no information
+            return None
+
+    def _prefer_prefill(self, lookup):
+        """Prefill replica already holding this prompt's longest
+        tier-discounted prefix, or None (-> plain p2c)."""
+        if lookup is None:
+            return None
+        from ray_tpu.llm.kvtier.index import best_prefix_replica
+
+        keys = self._refresh_prefill()
+        if not keys:
+            return None
+        got = best_prefix_replica(
+            lookup, {rid: 0 for rid in keys}, key_of=keys,
+        )
+        if got is not None:
+            self.num_prefix_routed += 1
+        return got
+
+    def _pick_decode(self, lookup=None) -> tuple[str, Any]:
+        """Prefix-aware first (the replica already holding this
+        prompt's longest tier-discounted prefix, via the app's prefix
+        index, bounded by depth slack), then the existing ladder —
+        queue depth with prefix-cache hit rate as tiebreak — whenever
+        the index is dark, stale, or holds nothing for this prompt.
+        The serve-mode mirror of DisaggOrchestrator._pick_decode."""
         from ray_tpu.serve.router import ReplicaPinError
 
         rids = self._refresh_decode()
         with self._lock:
             scored = []
+            depths = {}
             for rid in rids:
                 if rid not in self._targets:
                     continue
                 s = self._stats.get(rid, {})
                 depth = s.get("num_waiting", 0) + s.get("num_running", 0)
                 hit = s.get("prefix_cache", {}).get("hit_rate", 0.0)
+                depths[rid] = depth
                 scored.append((depth, -hit, rid))
             if not scored:
                 raise ReplicaPinError("no decode replicas available")
+            key_of = dict(self._decode_keys)
+        if lookup is not None and key_of:
+            from ray_tpu.llm.kvtier.index import best_prefix_replica
+
+            got = best_prefix_replica(lookup, depths, key_of=key_of)
+            if got is not None:
+                with self._lock:
+                    target = self._targets.get(got)
+                if target is not None:
+                    self.num_prefix_routed += 1
+                    return got, target
+        with self._lock:
             _, _, rid = min(scored)
             return rid, self._targets[rid]
 
@@ -384,8 +529,17 @@ class DisaggIngress:
             if attempt > 0:
                 self.num_reprefills += 1
             try:
-                decode_rid, target = self._pick_decode()
-                pre = self.prefill_handle.prefill.remote(
+                lookup = self._index_lookup(prompt_ids)
+                decode_rid, target = self._pick_decode(lookup)
+                prefill_handle = self.prefill_handle
+                prefer = self._prefer_prefill(lookup)
+                if prefer is not None:
+                    # soft prefix affinity: the handle's router honors it
+                    # only while the replica is healthy and not overloaded
+                    prefill_handle = prefill_handle.options(
+                        prefer_replica=prefer
+                    )
+                pre = prefill_handle.prefill.remote(
                     prompt_ids, sampling, rid, target
                 ).result(timeout_s=180)
                 if pre["finished"]:
@@ -485,6 +639,7 @@ class DisaggIngress:
                 "decode": dict(self._stats),
                 "admission": self.admission.stats(),
                 "reprefills": self.num_reprefills,
+                "prefix_routed": self.num_prefix_routed,
             }
 
     async def __call__(self, request):
@@ -531,5 +686,6 @@ def build_disagg_openai_app(
         llm_config,
         prefill_dep.bind(llm_config, connector, name),
         decode_dep.bind(llm_config, connector, name),
+        name,
     )
     return serve.run(app, name=name, route_prefix=route_prefix)

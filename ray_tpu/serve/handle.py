@@ -298,6 +298,7 @@ class DeploymentHandle:
         streaming: bool = False,
         system_retries: int = 2,
         pin_replica: Optional[str] = None,
+        prefer_replica: Optional[str] = None,
     ):
         self.deployment_name = deployment_name
         self.app_name = app_name
@@ -311,6 +312,11 @@ class DeploymentHandle:
         # raise ReplicaPinError — pinned calls never failover-retry, the
         # state they target died with the replica
         self._pin_replica = pin_replica
+        # soft prefix affinity (r17 prefix-aware routing): PREFER this
+        # replica (it already holds the request's KV prefix in some
+        # tier) but fall back to p2c when it is dead, suspected, or
+        # overloaded — unlike a pin, a stale hint can never fail a call
+        self._prefer_replica = prefer_replica
 
     # Handles carry no live state — the router is process-local, looked up
     # on each dispatch — so pickling is trivially safe.
@@ -322,12 +328,14 @@ class DeploymentHandle:
             "_streaming": self._streaming,
             "_system_retries": self._system_retries,
             "_pin_replica": self._pin_replica,
+            "_prefer_replica": self._prefer_replica,
         }
 
     def __setstate__(self, state):
         self.__dict__.update(state)
         self.__dict__.setdefault("_system_retries", 2)
         self.__dict__.setdefault("_pin_replica", None)
+        self.__dict__.setdefault("_prefer_replica", None)
 
     def _get_router(self) -> Router:
         return _shared_router(self.app_name, self.deployment_name)
@@ -339,6 +347,7 @@ class DeploymentHandle:
         stream: Optional[bool] = None,
         system_retries: Optional[int] = None,
         pin_replica: Optional[str] = None,
+        prefer_replica: Optional[str] = None,
         use_new_handle_api: bool = True,  # accepted for reference parity
     ) -> "DeploymentHandle":
         return DeploymentHandle(
@@ -348,6 +357,7 @@ class DeploymentHandle:
             stream if stream is not None else self._streaming,
             self._system_retries if system_retries is None else system_retries,
             pin_replica if pin_replica is not None else self._pin_replica,
+            prefer_replica if prefer_replica is not None else self._prefer_replica,
         )
 
     def __getattr__(self, name: str):
@@ -378,12 +388,12 @@ class DeploymentHandle:
             with trace_context.use(child):
                 rid, ref = router.dispatch(
                     self._method_name, args, kwargs, self._streaming,
-                    pin=self._pin_replica,
+                    pin=self._pin_replica, prefer=self._prefer_replica,
                 )
         else:
             rid, ref = router.dispatch(
                 self._method_name, args, kwargs, self._streaming,
-                pin=self._pin_replica,
+                pin=self._pin_replica, prefer=self._prefer_replica,
             )
         if self._streaming:
             # streaming calls never auto-retry: items may already have

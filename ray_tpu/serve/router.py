@@ -142,11 +142,22 @@ class Router:
 
     # -- scheduling -----------------------------------------------------------
 
-    def _pick(self, exclude: Optional[set] = None):
+    # prefix-affinity slack: a preferred (cache-holding) replica is only
+    # honored while its in-flight count is within this many requests of
+    # the least-loaded candidate — affinity must not overload one replica
+    PREFER_SLACK = 4
+
+    def _pick(self, exclude: Optional[set] = None,
+              prefer: Optional[str] = None):
         """Power-of-two-choices on local in-flight counts; skips replicas at
         max_ongoing_requests when an alternative exists. ``exclude``
         (failover retries) removes replicas this request already died on —
-        falling back to them only when nothing else exists."""
+        falling back to them only when nothing else exists. ``prefer`` is
+        a SOFT affinity hint (prefix-aware routing: that replica already
+        holds this request's KV prefix): honored only when the replica is
+        live, un-suspected, not excluded, and not overloaded past
+        PREFER_SLACK — in every other case the normal ladder decides, so
+        a stale hint can never pin a request onto a corpse."""
         now = time.time()
         with self._lock:
             for rid in [r for r, t in self._suspect.items() if t <= now]:
@@ -172,6 +183,19 @@ class Router:
             with self._lock:
                 replicas = list(self._replicas)
             replicas = _avoiding(replicas)
+        if prefer is not None and len(replicas) > 1:
+            preferred = next((r for r in replicas if r[0] == prefer), None)
+            if preferred is not None:
+                # one consistent snapshot: the overload check compares
+                # counts against each other, so they must come from the
+                # same instant (unlike the p2c reads below, which compare
+                # two independent heuristic samples)
+                with self._lock:
+                    counts = {
+                        r[0]: self._inflight.get(r[0], 0) for r in replicas
+                    }
+                if counts.get(prefer, 0) <= min(counts.values()) + self.PREFER_SLACK:
+                    return preferred
         if len(replicas) == 1:
             return replicas[0]
         a, b = random.sample(replicas, 2)
@@ -211,12 +235,16 @@ class Router:
         )
 
     def dispatch(self, method_name: Optional[str], args, kwargs, streaming: bool,
-                 exclude: Optional[set] = None, pin: Optional[str] = None):
+                 exclude: Optional[set] = None, pin: Optional[str] = None,
+                 prefer: Optional[str] = None):
         """Route one request; returns (replica_id, ObjectRef-or-generator).
 
         ``pin`` routes to exactly that replica (replica-resident state:
         a transferred KV sequence lives on ONE decode replica) or raises
-        ReplicaPinError; otherwise power-of-two-choices picks.
+        ReplicaPinError; ``prefer`` is the soft prefix-affinity variant —
+        honored when healthy and not overloaded, silently ignored
+        otherwise (a dark/stale prefix index degrades to plain p2c, it
+        never mis-pins); otherwise power-of-two-choices picks.
 
         The dispatch wall-clock (refresh + pick + submit — the router's
         own contribution to request latency) lands in the
@@ -240,7 +268,7 @@ class Router:
         if pin is not None:
             rid, handle, _max_ongoing = self._pick_pinned(pin)
         else:
-            rid, handle, _max_ongoing = self._pick(exclude)
+            rid, handle, _max_ongoing = self._pick(exclude, prefer=prefer)
         with self._lock:
             self._inflight[rid] = self._inflight.get(rid, 0) + 1
         try:

@@ -160,10 +160,18 @@ class WeightSubscriber:
         # against it after the swap would splice stale keys/values into
         # new-weight attention. Running requests keep their own
         # refcounted blocks (they finish on the weights they started
-        # with); only the zero-ref reuse pool is dropped.
-        allocator = getattr(engine, "allocator", None)
-        if allocator is not None:
-            allocator.drop_prefix_cache()
+        # with); only the zero-ref reuse pool is dropped. Invalidation
+        # must CASCADE through every tier (engine.drop_prefix_cache:
+        # HBM + host DRAM + object store + this engine's prefix-index
+        # rows) — dropping HBM alone would let a post-swap request
+        # resurrect pre-swap K/V from a deeper tier.
+        drop = getattr(engine, "drop_prefix_cache", None)
+        if drop is not None:
+            drop()
+        else:
+            allocator = getattr(engine, "allocator", None)
+            if allocator is not None:
+                allocator.drop_prefix_cache()
         self.version = version
         self.num_applied += 1
         return version

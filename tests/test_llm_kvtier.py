@@ -1,0 +1,641 @@
+"""Tiered KV/prefix cache (ray_tpu.llm.kvtier): spill/resurrect
+correctness, chaos on the spill path, cluster prefix index semantics,
+prefix-aware routing, weight-swap cascade, and the checked-in capture
+gate."""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from ray_tpu import chaos
+from ray_tpu.llm.engine import EngineConfig, LLMEngine
+from ray_tpu.llm.kvtier import KVTierConfig, chain_hashes, get_local_index
+from ray_tpu.llm.kvtier.index import (
+    GcsPrefixIndex,
+    LocalPrefixIndex,
+    PrefixIndexStore,
+    best_prefix_replica,
+)
+from ray_tpu.llm.sampling import SamplingParams
+
+pytestmark = pytest.mark.kvtier
+
+BS = 16
+SYS = list(np.random.RandomState(0).randint(3, 200, size=5 * BS))  # 80 tokens
+
+
+def _cfg(**kv):
+    kvt = kv.pop("kvtier", True)
+    return EngineConfig(num_blocks=16, block_size=BS, max_num_seqs=4,
+                        max_prefill_len=128, kvtier=kvt, **kv)
+
+
+def _gen(eng, prompt, sp, rid):
+    """Run one request to completion under a PINNED request id (the
+    sampler key derives from (seed, rid) — identity tests must pin it)."""
+    eng.add_request(prompt, sp, request_id=rid)
+    toks = cached = None
+    while eng.has_unfinished():
+        for o in eng.step():
+            if o.finished and o.request_id == rid:
+                toks, cached = o.output_token_ids, o.num_cached_tokens
+    assert toks is not None
+    return toks, cached
+
+
+def _suffix(seed, n=BS):
+    return list(np.random.RandomState(seed).randint(3, 200, size=n))
+
+
+def _fill_to_evict(eng, rounds=4):
+    """Thrash the 16-block cache with distinct prompts so the shared
+    prefix's sealed blocks are evicted (and spill)."""
+    for i in range(rounds):
+        _gen(eng, list(np.random.RandomState(100 + i).randint(3, 200, size=112)),
+             SamplingParams(max_tokens=4, temperature=0.0), f"fill-{i}")
+
+
+# -- spill + resurrect --------------------------------------------------------
+
+
+def test_host_tier_spill_and_resurrect_counts():
+    eng = LLMEngine(_cfg(), seed=0)
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    _gen(eng, SYS + _suffix(1), sp, "warm")
+    assert eng.kvtier.stats()["host"]["entries"] == 0  # nothing evicted yet
+    _fill_to_evict(eng)
+    assert eng.kvtier.stats()["host"]["entries"] > 0  # evictions spilled
+    toks, cached = _gen(eng, SYS + _suffix(2), sp, "res")
+    st = eng.stats()
+    # the whole shared prefix came back from the host tier, no recompute
+    assert st["prefix_cache"]["by_tier"].get("host", 0) >= len(SYS)
+    assert st["kv_tiers"]["resurrected_tokens"]["host"] >= len(SYS)
+    assert cached >= len(SYS)  # num_cached_tokens covers resurrected positions
+    assert st["kv_tiers"]["corrupt_dropped"] == {"host": 0, "object": 0}
+
+
+def test_greedy_bitwise_identity_host_tier():
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    eng = LLMEngine(_cfg(), seed=0)
+    _gen(eng, SYS + _suffix(1), sp, "warm")
+    _fill_to_evict(eng)
+    warm_toks, warm_cached = _gen(eng, SYS + _suffix(2), sp, "the-req")
+    cold = LLMEngine(_cfg(kvtier=None), seed=0)
+    cold_toks, cold_cached = _gen(cold, SYS + _suffix(2), sp, "the-req")
+    assert warm_toks == cold_toks
+    assert warm_cached >= len(SYS) and cold_cached == 0
+
+
+def test_seeded_bitwise_identity_object_tier():
+    """host_bytes=1 demotes every spill straight to the object store;
+    a seeded-sampling request resurrected from there is bit-identical
+    to a cold prefill of the same prompt + rid."""
+    sp = SamplingParams(max_tokens=8, temperature=1.0, seed=1234, top_k=5)
+    cfg = _cfg(kvtier=KVTierConfig(host_bytes=1, object_bytes=256 << 20))
+    eng = LLMEngine(cfg, seed=0)
+    _gen(eng, SYS + _suffix(1), sp, "warm")
+    _fill_to_evict(eng)
+    assert eng.kvtier.stats()["object"]["entries"] > 0
+    warm_toks, warm_cached = _gen(eng, SYS + _suffix(2), sp, "the-req")
+    assert eng.stats()["prefix_cache"]["by_tier"].get("object", 0) >= len(SYS)
+    cold = LLMEngine(cfg, seed=0)
+    cold_toks, _ = _gen(cold, SYS + _suffix(2), sp, "the-req")
+    assert warm_toks == cold_toks
+    assert warm_cached >= len(SYS)
+
+
+def test_probe_tiers_and_peek_prefix_tiered():
+    eng = LLMEngine(_cfg(), seed=0)
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    prompt = SYS + _suffix(1)
+    assert eng.peek_prefix_tiered(prompt) == {
+        "n_tokens": 0, "discounted": 0.0, "by_tier": {}}
+    _gen(eng, prompt, sp, "warm")
+    probe = eng.peek_prefix_tiered(SYS + _suffix(2))
+    assert probe["by_tier"].get("hbm", 0) >= len(SYS)
+    assert probe["discounted"] == pytest.approx(probe["n_tokens"])  # hbm = 1.0
+    _fill_to_evict(eng)
+    probe = eng.peek_prefix_tiered(SYS + _suffix(2))
+    assert probe["by_tier"].get("host", 0) >= len(SYS)
+    # host discount < hbm discount for the same tokens
+    assert 0 < probe["discounted"] < probe["n_tokens"]
+
+
+# -- chaos on the spill path --------------------------------------------------
+
+
+def test_corrupt_spill_falls_back_to_recompute():
+    """CORRUPT_KV_TRANSFER at llm.kvtier.spill bit-flips the sealed
+    pages: resurrection's verify() must fail, count the drop, and the
+    request recomputes — tokens stay exactly right."""
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    eng = LLMEngine(_cfg(), seed=0)
+    chaos.install(chaos.FaultSchedule(7, [
+        chaos.FaultSpec("corrupt_kv_transfer", site="llm.kvtier.spill",
+                        max_fires=1000),
+    ]))
+    try:
+        _gen(eng, SYS + _suffix(1), sp, "warm")
+        _fill_to_evict(eng)
+        assert eng.kvtier.stats()["host"]["entries"] > 0
+        warm_toks, warm_cached = _gen(eng, SYS + _suffix(2), sp, "the-req")
+    finally:
+        chaos.uninstall()
+    st = eng.stats()
+    assert st["kv_tiers"]["corrupt_dropped"]["host"] >= 1      # counted
+    assert st["prefix_cache"]["by_tier"].get("host", 0) == 0   # never served
+    cold = LLMEngine(_cfg(kvtier=None), seed=0)
+    cold_toks, _ = _gen(cold, SYS + _suffix(2), sp, "the-req")
+    assert warm_toks == cold_toks  # never wrong tokens
+
+
+def test_dropped_spill_is_a_miss_not_an_error():
+    """DROP_KV_TRANSFER at the spill site loses the spill silently; the
+    later same-prefix request just misses and recomputes correctly."""
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    eng = LLMEngine(_cfg(), seed=0)
+    chaos.install(chaos.FaultSchedule(3, [
+        chaos.FaultSpec("drop_kv_transfer", site="llm.kvtier.spill",
+                        max_fires=1000),
+    ]))
+    try:
+        _gen(eng, SYS + _suffix(1), sp, "warm")
+        _fill_to_evict(eng)
+        assert eng.kvtier.stats()["host"]["entries"] == 0
+        assert eng.kvtier.stats()["spills_dropped"] > 0
+        warm_toks, _ = _gen(eng, SYS + _suffix(2), sp, "the-req")
+    finally:
+        chaos.uninstall()
+    cold = LLMEngine(_cfg(kvtier=None), seed=0)
+    cold_toks, _ = _gen(cold, SYS + _suffix(2), sp, "the-req")
+    assert warm_toks == cold_toks
+
+
+def test_mid_chain_hbm_blocks_are_adopted_not_recomputed():
+    """Head-first eviction spills the chain's FIRST blocks while later
+    ones stay sealed in HBM; resurrection must bridge the gap and adopt
+    the resident tail by refcount instead of recomputing it (what
+    probe_tiers advertises, admission must serve)."""
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    eng = LLMEngine(_cfg(), seed=0)
+    _gen(eng, SYS + _suffix(1), sp, "warm")
+    # force exactly two LRU evictions: the zero-ref pool frees in block
+    # order, so the chain's HEAD spills and its tail stays resident
+    alloc = eng.allocator
+    taken = alloc.allocate(len(alloc._free) + 2)
+    alloc.free(taken)
+    probe = eng.peek_prefix_tiered(SYS + _suffix(2))
+    assert probe["by_tier"].get("host", 0) == 2 * BS
+    assert probe["by_tier"].get("hbm", 0) >= 3 * BS  # tail still resident
+    warm_toks, warm_cached = _gen(eng, SYS + _suffix(2), sp, "the-req")
+    bt = eng.stats()["prefix_cache"]["by_tier"]
+    assert bt.get("host", 0) == 2 * BS           # head resurrected
+    assert bt.get("hbm", 0) >= 3 * BS            # tail ADOPTED, not recomputed
+    assert warm_cached >= len(SYS)
+    cold = LLMEngine(_cfg(kvtier=None), seed=0)
+    cold_toks, _ = _gen(cold, SYS + _suffix(2), sp, "the-req")
+    assert warm_toks == cold_toks
+
+
+def test_respill_does_not_double_count_tier_bytes():
+    """Re-inserting a hash already resident in a tier replaces the entry
+    without inflating the byte accounting (and without leaking an
+    object-store ref on the object path)."""
+    eng = LLMEngine(_cfg(), seed=0)
+    _gen(eng, SYS + _suffix(1), SamplingParams(max_tokens=4, temperature=0.0),
+         "warm")
+    _fill_to_evict(eng, rounds=2)
+    mgr = eng.kvtier
+    h, sb = next(iter(mgr._host.items()))
+    before = mgr._host_bytes
+    mgr._host_insert(h, sb)
+    assert mgr._host_bytes == before
+    mgr._object_insert(h, sb)
+    obj_before = mgr._obj_bytes
+    mgr._object_insert(h, sb)
+    assert mgr._obj_bytes == obj_before
+    assert mgr._store.stats()["num_objects"] == len(mgr._obj)
+
+
+def test_flush_index_retries_after_failed_publish():
+    """A dark index during flush re-arms the dirty flag (the next tick
+    retries) instead of going silent with the table unpopulated; and
+    the steady-state refresh heartbeat republishes even a clean engine
+    so a restarted GCS repopulates."""
+
+    class FlakyIndex:
+        def __init__(self):
+            self.fail, self.updates = True, []
+
+        def update(self, payload):
+            if self.fail:
+                return False  # the GcsPrefixIndex dark-GCS shape
+            self.updates.append(payload)
+            return True
+
+    eng = LLMEngine(_cfg(), seed=0)
+    _gen(eng, SYS + _suffix(1), SamplingParams(max_tokens=4, temperature=0.0),
+         "warm")
+    idx = FlakyIndex()
+    mgr = eng.kvtier
+    mgr.index = idx
+    mgr.engine_key = "e0"
+    mgr._index_dirty = True
+    mgr.flush_index(force=True)
+    assert mgr._index_dirty and not idx.updates     # failed -> re-armed
+    idx.fail = False
+    mgr.flush_index(force=True)
+    assert not mgr._index_dirty and len(idx.updates) == 1
+    # clean engine, refresh heartbeat due -> republish anyway
+    mgr._index_refresh_next = 0.0
+    mgr._index_next = 0.0
+    mgr.flush_index()
+    assert len(idx.updates) == 2
+    assert idx.updates[1]["seq"] > idx.updates[0]["seq"]
+
+
+# -- weight-swap cascade (satellite regression) -------------------------------
+
+
+def test_weight_swap_invalidates_every_tier():
+    """After a WeightPublisher swap, a request must NEVER resurrect a
+    pre-swap block: host + object tiers and the engine's index rows are
+    dropped, and outputs match a fresh engine on the NEW weights."""
+    import jax
+
+    from ray_tpu.fabric.transport import DeviceTransport
+    from ray_tpu.models import llama
+    from ray_tpu.train.weight_sync import WeightPublisher, WeightSubscriber
+
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    cfg = _cfg()
+    eng = LLMEngine(cfg, seed=0)
+    idx = LocalPrefixIndex()
+    eng.kvtier.attach_index(idx, engine_key="e0")
+    _gen(eng, SYS + _suffix(1), sp, "warm")
+    _fill_to_evict(eng)
+    assert eng.kvtier.stats()["host"]["entries"] > 0
+    eng.kvtier.flush_index(force=True)
+    assert idx.lookup(chain_hashes(SYS, BS))["engines"]  # indexed pre-swap
+
+    new_params = llama.init_params(cfg.model, jax.random.key(99))
+    transport = DeviceTransport(namespace="kvtier-swap-test")
+    pub = WeightPublisher(transport=transport)
+    target = pub.register_rollout("e0")
+    sub = WeightSubscriber(transport, "e0")
+    pub.publish(new_params, [target])
+    assert sub.apply_to_engine(eng) == 1
+    # cascade: every tier empty, index rows for this engine gone
+    st = eng.kvtier.stats()
+    assert st["host"]["entries"] == 0 and st["object"]["entries"] == 0
+    assert not idx.lookup(chain_hashes(SYS, BS))["engines"]
+    before = dict(eng.kvtier.resurrected_tokens)
+    warm_toks, warm_cached = _gen(eng, SYS + _suffix(2), sp, "post-swap")
+    assert dict(eng.kvtier.resurrected_tokens) == before  # zero resurrection
+    assert warm_cached == 0
+    fresh = LLMEngine(cfg, params=new_params, seed=0)
+    fresh_toks, _ = _gen(fresh, SYS + _suffix(2), sp, "post-swap")
+    assert warm_toks == fresh_toks  # served on the NEW weights
+    pub.close()
+
+
+# -- prefix index semantics ---------------------------------------------------
+
+
+def test_index_epoch_seq_staleness():
+    store = PrefixIndexStore()
+    rows = [[h, 0, (i + 1) * BS]
+            for i, h in enumerate(chain_hashes(SYS, BS))]
+    assert store.update({"engine": "e0", "epoch": 5, "seq": 1,
+                         "rows": rows})["ok"]
+    # replayed / out-of-order seq drops (a delayed re-send never regresses)
+    assert not store.update({"engine": "e0", "epoch": 5, "seq": 1,
+                             "rows": []})["ok"]
+    # older epoch drops (a pre-restart snapshot landing late)
+    assert not store.update({"engine": "e0", "epoch": 4, "seq": 99,
+                             "rows": []})["ok"]
+    got = store.lookup(chain_hashes(SYS, BS))["engines"]
+    assert got["e0"]["n_tokens"] == len(SYS) and got["e0"]["tier"] == "hbm"
+    # lookup is longest-prefix: probing only the first block matches 1*BS
+    got = store.lookup(chain_hashes(SYS[:BS], BS))["engines"]
+    assert got["e0"]["n_tokens"] == BS
+    # a NEW epoch atomically replaces the dead incarnation's rows
+    assert store.update({"engine": "e0", "epoch": 6, "seq": 1,
+                         "rows": []})["ok"]
+    assert not store.lookup(chain_hashes(SYS, BS))["engines"]
+    assert store.num_stale_dropped == 2
+
+
+def test_index_stale_age_rows_omitted_and_dead_engines_reaped():
+    store = PrefixIndexStore(stale_after_s=0.0)  # everything instantly stale
+    rows = [[h, 0, BS] for h in chain_hashes(SYS[:BS], BS)]
+    store.update({"engine": "e0", "epoch": 1, "seq": 1, "rows": rows})
+    assert store.lookup(chain_hashes(SYS[:BS], BS))["engines"] == {}
+    # uuid-keyed replica churn: entries silent past the expire horizon
+    # are deleted outright (stats must not report dead replicas' rows)
+    store2 = PrefixIndexStore(expire_after_s=60.0)
+    store2.update({"engine": "dead-1", "epoch": 1, "seq": 1, "rows": rows})
+    store2.update({"engine": "live", "epoch": 1, "seq": 1, "rows": rows})
+    store2._engines["dead-1"].ts -= 120  # silent past the horizon
+    st = store2.stats()
+    assert st["engines"] == 1 and st["expired"] == 1
+    assert "live" in store2._engines and "dead-1" not in store2._engines
+
+
+def test_best_prefix_replica_tier_discount_and_slack():
+    cfg = KVTierConfig()
+    lookup = {"engines": {
+        "a": {"tier": "object", "n_tokens": 80, "age_s": 0.1},
+        "b": {"tier": "hbm", "n_tokens": 48, "age_s": 0.1},
+    }}
+    # hbm 48 * 1.0 > object 80 * 0.35: residency outranks depth of match
+    assert best_prefix_replica(lookup, {"a": 0, "b": 0}, cfg) == "b"
+    # the preferred holder is overloaded past the slack -> other holder
+    assert best_prefix_replica(lookup, {"a": 0, "b": 99}, cfg) == "a"
+    # dark index / nothing held -> None (caller's ladder decides)
+    assert best_prefix_replica(None, {"a": 0}, cfg) is None
+    assert best_prefix_replica({"engines": {}}, {"a": 0}, cfg) is None
+    # stale rows are no information
+    stale = {"engines": {"a": {"tier": "hbm", "n_tokens": 80,
+                               "age_s": 1e9}}}
+    assert best_prefix_replica(stale, {"a": 0}, cfg) is None
+
+
+def test_gcs_prefix_index_rpcs_and_stall_gcs_fallback():
+    """The GCS-backed index end to end — and under the r13 STALL_GCS
+    chaos window the lookup answers None (dark) within the bounded
+    timeout instead of hanging, so routing falls back to the ladder."""
+    from ray_tpu.cluster.gcs_service import GcsServer
+    from ray_tpu.cluster.rpc import ReconnectingRpcClient
+
+    server = GcsServer(port=0)
+    host, port = server.start()
+    try:
+        client = ReconnectingRpcClient(host, port, timeout=5).connect()
+        idx = GcsPrefixIndex(client, timeout_s=5)
+        rows = [[h, 1, (i + 1) * BS]
+                for i, h in enumerate(chain_hashes(SYS, BS))]
+        assert idx.update({"engine": "d0", "epoch": 1, "seq": 1,
+                           "rows": rows})
+        got = idx.lookup(chain_hashes(SYS, BS))
+        assert got["engines"]["d0"] == {
+            "tier": "host", "n_tokens": len(SYS),
+            "age_s": got["engines"]["d0"]["age_s"],
+        }
+        assert best_prefix_replica(got, {"d0": 0}) == "d0"
+        assert server.service.prefix_index.stats()["rows"] == len(rows)
+
+        chaos.install(chaos.FaultSchedule(11, [
+            chaos.FaultSpec(chaos.STALL_GCS, site="gcs.call", max_fires=4),
+        ]))
+        try:
+            # dark window: every call fails fast -> None, no hang
+            assert idx.lookup(chain_hashes(SYS, BS)) is None
+            assert not idx.update({"engine": "d0", "epoch": 1, "seq": 2,
+                                   "rows": rows})
+            assert idx.num_dark == 2
+            # the ladder fallback: None lookup -> no preference
+            assert best_prefix_replica(
+                idx.lookup(chain_hashes(SYS, BS)), {"d0": 0}) is None
+        finally:
+            chaos.uninstall()
+        # plane came back: same index answers again (no poisoned state)
+        got = idx.lookup(chain_hashes(SYS, BS))
+        assert got["engines"]["d0"]["n_tokens"] == len(SYS)
+        # orderly drop removes the rows WITHOUT poisoning the key: the
+        # same engine key can re-register at its next snapshot
+        assert idx.drop_engine("d0")
+        assert idx.lookup(chain_hashes(SYS, BS))["engines"] == {}
+        assert idx.update({"engine": "d0", "epoch": 1, "seq": 3,
+                           "rows": rows})
+        assert idx.lookup(chain_hashes(SYS, BS))["engines"]["d0"][
+            "n_tokens"] == len(SYS)
+        client.close()
+    finally:
+        server.stop()
+
+
+# -- prefix-aware picks -------------------------------------------------------
+
+
+def test_orchestrator_prefix_aware_decode_pick():
+    """_pick_decode routes to the decode replica already holding the
+    prompt's prefix (tier-discounted); prefix-blind config keeps the
+    old depth ladder (index-0 tiebreak)."""
+    from ray_tpu.llm.disagg.handoff import KVHandoff
+    from ray_tpu.llm.disagg.orchestrator import DisaggConfig, DisaggOrchestrator
+
+    cfg = DisaggConfig(
+        engine=_cfg(), num_prefill=1, num_decode=2, connector="inproc",
+    )
+    orch = DisaggOrchestrator(cfg, seed=0, model_tag="kvt-pick")
+    try:
+        # warm decode engine 1's cache directly (bypassing the pick),
+        # then thrash it so the shared prefix lives only in its HOST
+        # tier — the pre-r17 peek (HBM-only) can no longer see it
+        d1 = orch._decode[1]
+        with d1.lock:
+            d1.engine.add_request(SYS + _suffix(1),
+                                  SamplingParams(max_tokens=4,
+                                                 temperature=0.0),
+                                  request_id="warm-d1")
+            while d1.engine.has_unfinished():
+                d1.engine.step()
+            _fill_to_evict(d1.engine)
+        probe = SYS + _suffix(2)
+        with d1.lock:
+            assert d1.engine.peek_prefix_tokens(probe) == 0  # HBM-blind
+            assert d1.engine.peek_prefix_tiered(probe)["by_tier"].get(
+                "host", 0) >= len(SYS)
+        h = KVHandoff(
+            request_id="probe", prompt_token_ids=probe,
+            output_token_ids=[1], sampling_params=None,
+            key_data=np.zeros(1, np.uint32), num_kv_tokens=0,
+            k_pages=np.zeros((1, 1, 0, 1)), v_pages=np.zeros((1, 1, 0, 1)),
+            model_sig=(1, 1, 1),
+        )
+        assert orch._pick_decode(h) == 1   # prefix-aware: follows the cache
+        orch.config.prefix_aware_routing = False
+        assert orch._pick_decode(h) == 0   # blind ladder: depth tie -> 0
+    finally:
+        orch.shutdown()
+
+
+def test_orchestrator_prefix_aware_prefill_pick_and_depth_slack():
+    from ray_tpu.llm.disagg.orchestrator import DisaggConfig, DisaggOrchestrator
+
+    cfg = DisaggConfig(
+        engine=_cfg(), num_prefill=2, num_decode=1, connector="inproc",
+        depth_slack=2,
+    )
+    orch = DisaggOrchestrator(cfg, seed=0, model_tag="kvt-pre")
+    try:
+        p1 = orch._prefill[1]
+        with p1.lock:
+            p1.engine.add_request(SYS + _suffix(1),
+                                  SamplingParams(max_tokens=4,
+                                                 temperature=0.0),
+                                  request_id="warm-p1")
+            while p1.engine.has_unfinished():
+                p1.engine.step()
+        assert orch._pick_prefill(SYS + _suffix(2)) is p1
+        # pile queue depth onto p1 past the slack: affinity must yield
+        with p1.lock:
+            for i in range(4):
+                p1.engine.add_request(_suffix(50 + i, 32),
+                                      SamplingParams(max_tokens=1),
+                                      request_id=f"load-{i}")
+        assert orch._pick_prefill(SYS + _suffix(3)) is orch._prefill[0]
+    finally:
+        orch.shutdown()
+
+
+def test_router_prefer_is_soft():
+    """Router._pick honors a healthy, un-overloaded preferred replica
+    and silently ignores a dead/suspect/overloaded one — prefer can
+    never fail a dispatch the way pin does."""
+    from ray_tpu.serve.router import Router
+
+    r = Router.__new__(Router)
+    r._lock = threading.Lock()
+    r._replicas = [("a", None, 8), ("b", None, 8)]
+    r._inflight = {"a": 0, "b": 0}
+    r._suspect = {}
+    assert r._pick(prefer="b")[0] == "b"
+    assert r._pick(prefer="gone") is not None            # unknown -> p2c
+    r._inflight = {"a": 0, "b": Router.PREFER_SLACK + 1}
+    assert r._pick(prefer="b")[0] == "a"                 # overloaded -> p2c
+    r._inflight = {"a": 0, "b": 0}
+    import time as _t
+
+    r._suspect = {"b": _t.time() + 60}
+    assert r._pick(prefer="b")[0] == "a"                 # suspect -> avoided
+    r._suspect = {}
+    assert r._pick(exclude={"b"}, prefer="b")[0] == "a"  # excluded -> hard no
+
+
+# -- observability ------------------------------------------------------------
+
+
+def test_tier_labelled_metrics_status_block_and_stats():
+    from ray_tpu.obs.telemetry import TelemetryStore, format_status
+    from ray_tpu.util.metrics import registry_snapshot, snapshot_registry
+
+    eng = LLMEngine(_cfg(), seed=0)
+    eng.model_tag = "kvt-obs"
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    _gen(eng, SYS + _suffix(1), sp, "warm")
+    _fill_to_evict(eng)
+    _gen(eng, SYS + _suffix(2), sp, "res")
+    eng.update_telemetry_gauges()
+    names = {m.name for m in registry_snapshot()}
+    assert "ray_tpu_llm_kvtier_spilled_bytes_total" in names
+    assert "ray_tpu_llm_kvtier_resident_bytes" in names
+    assert "ray_tpu_llm_kvtier_resurrected_tokens_total" in names
+
+    store = TelemetryStore()
+    store.ingest("host-0", snapshot_registry(), {})
+    health = store.kvtier_health()
+    assert health["spilled_bytes_by_tier"].get("host", 0) > 0
+    assert health["hit_tokens_by_tier"].get("host", 0) >= len(SYS)
+    assert health["resurrected_tokens_by_tier"].get("host", 0) >= len(SYS)
+    text = format_status({"kvtier": health, "nodes": [], "pools": {},
+                          "utilization": {}, "slo": {}})
+    assert "== kv tiers ==" in text and "host=" in text
+
+    # the /v1/stats surface: engine.stats() carries the tier breakdown
+    st = eng.stats()
+    assert st["kv_tiers"]["host"]["entries"] >= 0
+    assert st["prefix_cache"]["by_tier"].get("host", 0) >= len(SYS)
+
+
+@pytest.fixture
+def serve_instance():
+    import ray_tpu
+    from ray_tpu import serve
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init(num_cpus=32)
+    yield
+    serve.shutdown()
+
+
+def test_serve_mode_ingress_prefix_aware(serve_instance):
+    """Serve-mode wiring: a disagg app whose engines have the tiered
+    cache publishes into the app's local prefix index and the ingress
+    routes a repeat same-prefix request by it (prefix_routed counts)."""
+    from ray_tpu.llm.openai_api import LLMConfig
+    from ray_tpu.serve.disagg import build_disagg_openai_app
+
+    class Req:
+        def __init__(self, path, method, body=None):
+            self.path, self.method, self._b = path, method, body
+
+        def json(self):
+            return self._b
+
+    llm_config = LLMConfig(model_id="kvt-serve", engine=_cfg())
+    handle = build_disagg_openai_app(
+        llm_config, num_prefill=1, num_decode=1, name="kvt-serve-app",
+    )
+    body = {"prompt": "hello kv tiers " * 8, "max_tokens": 4,
+            "temperature": 0.0}
+    out1 = handle.remote(
+        Req("/v1/completions", "POST", dict(body))).result(timeout_s=180)
+    out2 = handle.remote(
+        Req("/v1/completions", "POST", dict(body))).result(timeout_s=180)
+    assert out1["choices"][0]["text"] == out2["choices"][0]["text"]
+    stats = handle.stats.remote().result(timeout_s=30)
+    assert stats["prefix_routed"] >= 1  # the repeat rode the index
+
+
+# -- bench smoke + capture gate -----------------------------------------------
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAPTURE = os.path.join(REPO, "benchmarks", "KVTIER_cache_r17.json")
+
+
+@pytest.mark.slow
+def test_bench_kvtier_smoke_cpu(tmp_path):
+    import subprocess
+    import sys
+
+    out = str(tmp_path / "kvtier.json")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO})
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "llm_serving_bench.py"),
+         "--kvtier", "--kvtier-out", out, "--kvtier-rounds", "4"],
+        env=env, capture_output=True, text=True, timeout=560,
+    )
+    assert p.returncode == 0, (p.stdout[-800:], p.stderr[-800:])
+    doc = json.loads(open(out).read())
+    assert doc["metric"] == "llm_kvtier_cache"
+    assert doc["token_identical"] is True
+    assert doc["tiers"]["host"]["hit_rate"] > doc["tiers"]["hbm_only"]["hit_rate"]
+
+
+def test_kvtier_capture_gates():
+    """The checked-in system-prompt-heavy capture must show the ladder
+    paying off: deepening tiers strictly beat HBM-only on hit rate with
+    TTFT p50 no worse, and prefix-aware routing beats prefix-blind on
+    cached-token ratio."""
+    with open(CAPTURE) as f:
+        cap = json.load(f)
+    tiers = cap["tiers"]
+    hbm = tiers["hbm_only"]
+    for name in ("host", "host_object"):
+        t = tiers[name]
+        assert t["hit_rate"] > hbm["hit_rate"], (
+            f"{name} hit rate must strictly exceed HBM-only"
+        )
+        assert t["ttft_p50_ms"] <= hbm["ttft_p50_ms"] * 1.10, (
+            f"{name} TTFT p50 regressed past the 10% guard band"
+        )
+    ab = cap["routing_ab"]
+    assert ab["aware"]["cached_token_ratio"] > ab["blind"]["cached_token_ratio"]
