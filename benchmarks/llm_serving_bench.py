@@ -74,6 +74,9 @@ _CHAOS_OUT = _os.path.join(
 _KVTIER_OUT = _os.path.join(
     _os.path.dirname(_os.path.abspath(__file__)), "KVTIER_cache_r17.json"
 )
+_KVFETCH_OUT = _os.path.join(
+    _os.path.dirname(_os.path.abspath(__file__)), "KVFETCH_cache_r18.json"
+)
 
 
 def _dist(vals: list) -> dict:
@@ -887,6 +890,244 @@ def run_kvtier_bench(args) -> dict:
     return doc
 
 
+# ---------------------------------------------------------------------------
+# --kvfetch: cross-engine resurrection + prefetch + async spill (r18)
+# ---------------------------------------------------------------------------
+
+
+def run_kvfetch_bench(args) -> dict:
+    """Three experiments, one capture (the r18 rungs of the tiered
+    cache):
+
+    1+2. CROSS-ENGINE / PREFETCH A/B — two same-weights engines share a
+       prefix index + fetch registry. Several system-prompt families
+       are warmed on the OWNER engine and thrashed into its host tier;
+       the owner then sits at queue depth past the routing slack (the
+       hot-holder pile-up case). Each measured request runs through the
+       REAL routing helper (best_prefix_replica):
+         * r17 route-to-owner arm (fetch_weight=0): the owner is past
+           slack, so the pick degrades to the depth ladder — the cold
+           engine serves it with a FULL RECOMPUTE (the r17 failure
+           mode this PR removes);
+         * fetch-aware arm: the cold engine scores fetch_weight x the
+           owner's holding, wins the pick, and its prefetch worker
+           PULLS the prefix over the fetch plane while the request
+           waits — admission finds the blocks resident.
+       Gates: identical tokens, fetch-aware cached-token ratio >=
+       route-to-owner's, and TTFT p50 with prefetch <= without.
+
+    3. ASYNC SPILL WALL — one engine thrashed identically under
+       async_spill on/off; we compare the per-eviction wall time spent
+       INSIDE the allocation path (capture-only vs the r17 blocking
+       device->host gather + CRC). Gate: async p99 < blocking p99.
+    """
+    import numpy as np
+
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine
+    from ray_tpu.llm.kvfetch import LocalFetchClient, LocalFetchRegistry
+    from ray_tpu.llm.kvtier import (
+        KVTierConfig,
+        LocalPrefixIndex,
+        chain_hashes,
+    )
+    from ray_tpu.llm.kvtier.index import best_prefix_replica
+    from ray_tpu.llm.sampling import SamplingParams
+    from ray_tpu.models import llama
+
+    BS = 16
+    # recomputing the shared prefix must cost real compute (the TTFT
+    # comparison prices recompute vs fetch+scatter): the r17 bench model
+    model = llama.LlamaConfig(
+        vocab_size=512, d_model=192, n_layers=4, n_heads=6, n_kv_heads=2,
+        d_ff=384, max_seq=512, remat=False,
+    )
+    import jax as _jax
+
+    params = llama.init_params(model, _jax.random.key(0))
+    rng = np.random.RandomState(args.kvfetch_seed)
+    n_fam = max(4, args.kvfetch_rounds)
+    families = [list(rng.randint(3, 200, size=20 * BS)) for _ in range(n_fam)]
+    greedy = SamplingParams(max_tokens=8, temperature=0.0)
+    kvt_cfg = KVTierConfig(host_bytes=64 << 20, object_bytes=0)
+
+    def eng_cfg(kvt):
+        return EngineConfig(model=model, num_blocks=40, block_size=BS,
+                            max_num_seqs=4, max_prefill_len=512, kvtier=kvt)
+
+    def run_once(eng, prompt, sp, rid, pre=None):
+        """(ttft_s, cached, toks); ``pre`` runs after add_request and
+        INSIDE the TTFT window (the prefetch wait is honestly priced)."""
+        t0 = time.perf_counter()
+        eng.add_request(prompt, sp, request_id=rid)
+        if pre is not None:
+            pre()
+        ttft = cached = None
+        toks = []
+        while eng.has_unfinished():
+            for o in eng.step():
+                if o.request_id != rid:
+                    continue
+                if ttft is None and o.new_token_ids:
+                    ttft = time.perf_counter() - t0
+                    cached = o.num_cached_tokens
+                if o.finished:
+                    toks = o.output_token_ids
+        return ttft, cached or 0, toks
+
+    def suffix(i):
+        return list(np.random.RandomState(900 + i).randint(3, 200, size=BS))
+
+    warm_fam = list(np.random.RandomState(8888).randint(3, 200, size=20 * BS))
+
+    def make_pair(tag, attach_fetch):
+        idx = LocalPrefixIndex()
+        reg = LocalFetchRegistry()
+        owner = LLMEngine(eng_cfg(kvt_cfg), params=params, seed=0)
+        cold = LLMEngine(eng_cfg(kvt_cfg), params=params, seed=0)
+        owner.kvtier.attach_index(idx, engine_key="owner")
+        cold.kvtier.attach_index(idx, engine_key="cold")
+        reg.register("owner", owner.kvtier)
+        reg.register("cold", cold.kvtier)
+        if attach_fetch:
+            # the r17 arm gets NO fetch plane: a cold replica there can
+            # only recompute (exactly the behavior this PR replaces)
+            cold.kvfetch.attach(LocalFetchClient(reg))
+        # warm every family on the owner, then thrash its 40-block HBM
+        # so the families live only in its host tier
+        for f, fam in enumerate(families + [warm_fam]):
+            run_once(owner, fam + suffix(f), greedy, f"warm-{tag}-{f}")
+        for j in range(6):
+            run_once(owner, list(np.random.RandomState(3000 + j).randint(
+                3, 200, size=24 * BS)),
+                SamplingParams(max_tokens=2, temperature=0.0),
+                f"thrash-{tag}-{j}")
+        owner.kvtier.flush_spills()
+        owner.kvtier.flush_index(force=True)
+        # jit warmup on the cold engine, excluded from measurements:
+        # the plain prefill bucket, and (fetch arm) one full
+        # fetch -> prefetch -> scatter cycle so the kv-import program
+        # compiles outside the measured TTFT window
+        run_once(cold, list(np.random.RandomState(77).randint(
+            3, 200, size=21 * BS)), greedy, f"jit-{tag}")
+        if attach_fetch:
+            run_once(cold, warm_fam + suffix(997), greedy,
+                     f"jit-fetch-{tag}",
+                     pre=lambda: (cold.kvfetch.wait_idle(20),
+                                  cold.kvfetch.tick()))
+        return idx, owner, cold
+
+    def routing_arm(fetch_aware: bool) -> dict:
+        tag = "aware" if fetch_aware else "r17"
+        idx, owner, cold = make_pair(tag, attach_fetch=fetch_aware)
+        # the owner pool sits past the routing slack (hot holder)
+        depths = {"owner": kvt_cfg.depth_slack + 2, "cold": 0}
+        fw = kvt_cfg.fetch_weight if fetch_aware else 0.0
+        engines = {"owner": owner, "cold": cold}
+        cached = prompt_toks = 0
+        picked: dict = {}
+        ttfts = []
+        token_ids = []
+        for i, fam in enumerate(families):
+            prompt = fam + suffix(1000 + i)
+            lookup = idx.lookup(chain_hashes(prompt, BS))
+            pick = best_prefix_replica(lookup, depths, cfg=kvt_cfg,
+                                       fetch_weight=fw)
+            if pick is None:
+                pick = min(depths, key=lambda k: depths[k])  # the ladder
+            picked[pick] = picked.get(pick, 0) + 1
+            eng = engines[pick]
+            pre = None
+            if pick == "cold" and fetch_aware:
+                # the prefetch pull runs while the request queues; its
+                # wall is INSIDE the measured TTFT window
+                pre = lambda: (cold.kvfetch.wait_idle(20),
+                               cold.kvfetch.tick())
+            ttft, c, toks = run_once(eng, prompt, greedy,
+                                     f"m-{tag}-{i}", pre=pre)
+            ttfts.append(ttft * 1e3)
+            cached += c
+            prompt_toks += len(prompt)
+            token_ids.append(toks)
+        st = cold.stats()
+        return {
+            "cached_token_ratio": round(cached / prompt_toks, 4),
+            "cached_tokens": cached,
+            "prompt_tokens": prompt_toks,
+            "ttft_ms": _dist(ttfts),
+            "ttft_p50_ms": _dist(ttfts)["p50"],
+            "picks": picked,
+            "cold_fetch": (st["kv_tiers"].get("fetch") or {}).get("remote"),
+            "token_ids": token_ids,
+        }
+
+    aware = routing_arm(True)
+    r17 = routing_arm(False)
+    # correctness rail: a fetched/prefetched prefix must not change one
+    # token vs the recompute arm
+    identical = aware["token_ids"] == r17["token_ids"]
+    for arm in (aware, r17):
+        del arm["token_ids"]
+
+    # -- async spill wall ------------------------------------------------------
+    def spill_arm(async_spill: bool) -> dict:
+        kvt = KVTierConfig(host_bytes=64 << 20, object_bytes=0,
+                           async_spill=async_spill, prefetch=False)
+        eng = LLMEngine(eng_cfg(kvt), params=params, seed=0)
+        for f, fam in enumerate(families[:4]):
+            run_once(eng, fam + suffix(f), greedy, f"w-{async_spill}-{f}")
+        for j in range(args.kvfetch_rounds):
+            run_once(eng, list(np.random.RandomState(5000 + j).randint(
+                3, 200, size=24 * BS)),
+                SamplingParams(max_tokens=2, temperature=0.0),
+                f"t-{async_spill}-{j}")
+        eng.kvtier.flush_spills()
+        walls = sorted(eng.kvtier.spill_wall_ms)
+
+        def pct(p):
+            return walls[min(len(walls) - 1, int(len(walls) * p))]
+
+        return {
+            "evictions": len(walls),
+            "wall_p50_ms": round(pct(0.5), 4),
+            "wall_p99_ms": round(pct(0.99), 4),
+            "wall_mean_ms": round(sum(walls) / max(1, len(walls)), 4),
+            "host_entries": eng.kvtier.stats()["host"]["entries"],
+        }
+
+    spill = {"async": spill_arm(True), "blocking": spill_arm(False)}
+
+    import jax
+
+    doc = {
+        "metric": "llm_kvfetch_cache",
+        "device": str(jax.devices()[0]),
+        "platform": jax.devices()[0].platform,
+        "workload": {
+            "families": n_fam,
+            "family_prefix_tokens": 20 * BS,
+            "suffix_tokens": BS,
+            "owner_depth_past_slack": True,
+            "hbm_blocks": 40,
+        },
+        "cross_engine": {"fetch_aware": aware, "route_to_owner": r17},
+        "token_identical": identical,
+        "spill_wall": spill,
+        "gates": {
+            "token_identical": identical,
+            "aware_ratio_at_least_r17":
+                aware["cached_token_ratio"] >= r17["cached_token_ratio"],
+            "prefetch_ttft_p50_no_worse":
+                aware["ttft_p50_ms"] <= r17["ttft_p50_ms"],
+            "async_spill_wall_p99_lower":
+                spill["async"]["wall_p99_ms"]
+                < spill["blocking"]["wall_p99_ms"],
+        },
+    }
+    with open(args.kvfetch_out, "w") as f:
+        json.dump(doc, f, indent=1)
+    return doc
+
+
 def main():
     import os
 
@@ -932,6 +1173,13 @@ def main():
     ap.add_argument("--kvtier-out", default=_KVTIER_OUT)
     ap.add_argument("--kvtier-seed", type=int, default=7)
     ap.add_argument("--kvtier-rounds", type=int, default=8)
+    ap.add_argument("--kvfetch", action="store_true",
+                    help="run the cross-engine resurrection / prefetch "
+                    "/ async-spill benchmark instead (fetch-aware vs "
+                    "r17 route-to-owner A/B)")
+    ap.add_argument("--kvfetch-out", default=_KVFETCH_OUT)
+    ap.add_argument("--kvfetch-seed", type=int, default=11)
+    ap.add_argument("--kvfetch-rounds", type=int, default=8)
     args = ap.parse_args()
 
     want = os.environ.get("JAX_PLATFORMS", "")
@@ -954,6 +1202,9 @@ def main():
         return
     if args.kvtier:
         print(json.dumps(run_kvtier_bench(args)))
+        return
+    if args.kvfetch:
+        print(json.dumps(run_kvfetch_bench(args)))
         return
 
     from ray_tpu.llm.engine import EngineConfig, LLMEngine, SamplingParams

@@ -77,6 +77,10 @@ SCAN_DIRS = (
     # r17: the tiered prefix cache — object-store gets and index RPCs
     # sit on the prefill admission path, so every park must be bounded
     "ray_tpu/llm/kvtier",
+    # r18: the cross-engine fetch plane + prefetch/spill workers — a
+    # dead fetch source or a stalled endpoint must fail typed within
+    # its bound, and the worker loops must park in bounded slices
+    "ray_tpu/llm/kvfetch",
 )
 
 

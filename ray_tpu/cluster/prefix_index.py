@@ -32,13 +32,19 @@ TIER_NAMES = {v: k for k, v in TIER_CODES.items()}
 
 
 class _EngineRows:
-    __slots__ = ("epoch", "seq", "rows", "ts")
+    __slots__ = ("epoch", "seq", "rows", "ts", "fetch_addr")
 
-    def __init__(self, epoch: int, seq: int, rows: dict, ts: float):
+    def __init__(self, epoch: int, seq: int, rows: dict, ts: float,
+                 fetch_addr=None):
         self.epoch = epoch
         self.seq = seq
         self.rows = rows  # chain_hash -> (tier_code, n_tokens)
         self.ts = ts
+        # where remote engines can PULL this engine's spilled blocks
+        # (llm/kvfetch RPC backend: a (host, port) pair; None for
+        # in-process planes). Rides each snapshot so a restarted
+        # engine's new address replaces the old one atomically.
+        self.fetch_addr = fetch_addr
 
 
 class PrefixIndexStore:
@@ -80,7 +86,10 @@ class PrefixIndexStore:
                 if epoch < cur.epoch or (epoch == cur.epoch and seq <= cur.seq):
                     self.num_stale_dropped += 1
                     return {"ok": False, "reason": "stale"}
-            self._engines[engine] = _EngineRows(epoch, seq, rows, time.time())
+            self._engines[engine] = _EngineRows(
+                epoch, seq, rows, time.time(),
+                fetch_addr=payload.get("fetch_addr"),
+            )
             self.num_updates += 1
         return {"ok": True}
 
@@ -110,11 +119,16 @@ class PrefixIndexStore:
                     if best is None or n > best[1]:
                         best = (tier_code, n)
                 if best is not None:
-                    out[engine] = {
+                    row = {
                         "tier": TIER_NAMES.get(best[0], TIER_OBJECT),
                         "n_tokens": best[1],
                         "age_s": round(age, 3),
                     }
+                    if er.fetch_addr is not None:
+                        # the kvfetch pull address: a replica that does
+                        # NOT hold this prefix can fetch it from here
+                        row["fetch_addr"] = er.fetch_addr
+                    out[engine] = row
         return {"engines": out}
 
     def stats(self) -> dict:

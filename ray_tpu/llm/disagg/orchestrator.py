@@ -82,6 +82,13 @@ class DisaggConfig:
     # queue-depth/peek ladder unchanged.
     prefix_aware_routing: bool = True
     depth_slack: int = 4
+    # fetch-cost routing (r18, llm/kvfetch): a replica holding NOTHING
+    # scores fetch_weight x the best holder's tier-discounted score —
+    # when the holder is loaded past depth_slack, the pick spreads to a
+    # cold replica that PULLS the prefix over the fetch plane instead
+    # of piling onto the hot engine (or recomputing cold). False keeps
+    # the r17 route-to-owner behavior (the bench's A/B baseline).
+    fetch_cost_routing: bool = True
     # multi-slice fabric topology (fabric.FabricTopology or its dict
     # wire form): which slice each pool is pinned to and which
     # pool-pairs share a device mesh. The orchestrator consults it per
@@ -182,6 +189,30 @@ class DisaggOrchestrator:
         # LLMConfig(disagg=...) deployment) must never steal each
         # other's handoffs off the process-global queues
         self._ns = f"{model_tag}-{uuid.uuid4().hex[:8]}"
+
+        # -- kvfetch wiring (r18): every pool engine meets on one
+        # per-orchestrator prefix index + fetch registry, so a pick
+        # that spreads load to a COLD engine lets that engine pull the
+        # prefix over the fetch plane instead of recomputing it
+        self._fetch_enabled = False
+        if config.engine.kvtier is not None:
+            from ray_tpu.llm.kvfetch import (
+                LocalFetchClient,
+                get_local_fetch_registry,
+            )
+            from ray_tpu.llm.kvtier import get_local_index
+
+            index = get_local_index(self._ns)
+            registry = get_local_fetch_registry(self._ns)
+            for pool, role in ((self._prefill, "prefill"),
+                               (self._decode, "decode")):
+                for pe in pool:
+                    key = f"{role}{pe.index}"
+                    pe.engine.kvtier.attach_index(index, engine_key=key)
+                    registry.register(key, pe.engine.kvtier)
+                    if pe.engine.kvfetch is not None:
+                        pe.engine.kvfetch.attach(LocalFetchClient(registry))
+            self._fetch_enabled = config.fetch_cost_routing
         if connector is not None:
             self.connectors: dict[str, KVConnector] = {primary: connector}
         else:
@@ -559,25 +590,50 @@ class DisaggOrchestrator:
         except ValueError:
             return 0.0  # adapter not loaded there
 
+    def _fetch_weight(self) -> float:
+        """The fetch-cost discount multiplier (0.0 = r17 route-to-owner:
+        a replica holding nothing is never preferred). Requires the
+        prefetch worker: routing a request to a cold engine that can
+        never actually pull the prefix would just be a recompute."""
+        kvt = self.config.engine.kvtier
+        if not self._fetch_enabled or kvt is None or not kvt.prefetch:
+            return 0.0
+        return float(kvt.fetch_weight)
+
     def _pick_prefill(self, prompt_token_ids: list) -> "_PoolEngine":
         """Prefill pick: the engine already holding the longest
         tier-discounted prefix of this prompt, bounded by depth slack
         (cache affinity must not pile onto a hot engine); depth ladder
-        when nobody holds anything — the prefix-blind behavior."""
+        when nobody holds anything — the prefix-blind behavior. With
+        fetch-cost routing a cold engine scores fetch_weight x the
+        best holder (it will PULL the prefix), so an overloaded holder
+        spreads instead of monopolizing its prefix."""
         if len(self._prefill) == 1:
             return self._prefill[0]
         depths = {p.index: p.depth() for p in self._prefill}
         if self.config.prefix_aware_routing:
             floor = min(depths.values())
+            fw = self._fetch_weight()
+            discs = {}
+            for p in self._prefill:
+                # beyond-slack engines matter only as FETCH SOURCES —
+                # without the discount, don't pay their lock + probe
+                if (fw <= 0.0
+                        and depths[p.index] > floor + self.config.depth_slack):
+                    continue
+                with p.lock:
+                    discs[p.index] = self._prefix_discounted(
+                        p, prompt_token_ids
+                    )
+            best_disc = max(discs.values(), default=0.0)
             best = None
             for p in self._prefill:
                 if depths[p.index] > floor + self.config.depth_slack:
                     continue
-                with p.lock:
-                    disc = self._prefix_discounted(p, prompt_token_ids)
-                if disc <= 0.0:
+                eff = max(discs.get(p.index, 0.0), fw * best_disc)
+                if eff <= 0.0:
                     continue
-                cand = (disc, -depths[p.index], -p.index)
+                cand = (eff, -depths[p.index], -p.index)
                 if best is None or cand > best[0]:
                     best = (cand, p)
             if best is not None:
@@ -619,8 +675,12 @@ class DisaggOrchestrator:
         if self.config.prefix_aware_routing:
             floor = min(depth for _d, depth, _i in discounted)
             slack = self.config.depth_slack
+            fw = self._fetch_weight()
+            best_disc = max((disc for disc, _d, _i in discounted),
+                            default=0.0)
             best = max(
-                ((disc, -depth, -i) for disc, depth, i in discounted
+                ((max(disc, fw * best_disc), -depth, -i)
+                 for disc, depth, i in discounted
                  if depth <= floor + slack),
                 default=None,
             )

@@ -405,10 +405,18 @@ class LLMEngine:
         # tiers, and publishes this engine's resident chains to the
         # cluster prefix index when one is attached
         self.kvtier = None
+        self.kvfetch = None
         if c.kvtier is not None:
             from ray_tpu.llm.kvtier import KVTierManager
 
             self.kvtier = KVTierManager(self, c.kvtier)
+            # prefetch-at-admission + cross-engine pulls (llm/kvfetch):
+            # the worker verifies/deserializes/fetches a queued
+            # request's prefix while it waits; step()'s tick scatters
+            # it into HBM before the request reaches the queue head
+            from ray_tpu.llm.kvfetch import KVFetchManager
+
+            self.kvfetch = KVFetchManager(self)
 
         # pipelined decode (llm/pipeline.py): device-resident batch
         # state, the in-flight double-buffered chunk, the adaptive chunk
@@ -676,12 +684,22 @@ class LLMEngine:
         req._key = jax.random.fold_in(key, hash(rid) & 0x7FFFFFFF)
         self.requests[rid] = req
         self.waiting.append(req)
+        if self.kvfetch is not None:
+            # kick the prefix prefetch while the request waits in the
+            # queue (deep-tier verify/deserialize + any remote fetch
+            # happen on the worker, off the admission path)
+            self.kvfetch.request_admitted(req)
         return rid
 
     def abort_request(self, request_id: str) -> None:
         req = self.requests.get(request_id)
         if req is None or req.status in (RequestStatus.FINISHED, RequestStatus.ABORTED):
             return
+        if self.kvfetch is not None:
+            # cancel/flush discipline: an abort mid-prefetch releases
+            # the request's reservation refs and staged chain NOW — an
+            # abort storm must leak zero blocks and zero endpoint slots
+            self.kvfetch.cancel(request_id)
         if req in self.running:
             # removing a decode-batch row is a membership change: land
             # the in-flight pipelined chunk first (its outputs are
@@ -763,6 +781,12 @@ class LLMEngine:
             # before doing anything else so no finish event is dropped
             out, self._pending_outputs = self._pending_outputs, []
             return out
+        if self.kvfetch is not None:
+            # land completed prefetches BEFORE the admission check: the
+            # scatter registers the blocks with reservation refs, so
+            # the queue head's match_prefix finds its prefix resident
+            # and _admission_need discounts the live-shared blocks
+            self.kvfetch.tick()
         if (
             self.waiting
             and len(self.running) < self.config.max_num_seqs
@@ -845,6 +869,12 @@ class LLMEngine:
                     and r.status in (RequestStatus.WAITING,
                                      RequestStatus.RUNNING)):
                 victims.append(r)
+        if self.kvfetch is not None:
+            # staged prefetch chains and reservations may reference the
+            # state that just crashed: drop them (deep-tier copies stay
+            # resurrectable); with rebuild_kv the block ids die with the
+            # allocator and must NOT be freed into the new one
+            self.kvfetch.reset(forget_blocks=rebuild_kv)
         if rebuild_kv:
             c = self.config
             self.allocator = BlockAllocator(c.num_blocks, c.block_size)
@@ -1023,6 +1053,32 @@ class LLMEngine:
             self._kv_imports[width] = fn
         return fn
 
+    def _scatter_block_pages(self, k, v, blocks: list) -> None:
+        """Scatter position-ordered host pages [L, KVH, n_kv, D] into
+        whole ``blocks`` with ONE jitted set (power-of-two padded, pad
+        rows hit the trash page). The single recipe tier resurrection
+        (_resurrect_tiers) and the prefetch tick share — the scatter
+        shape must never drift between them."""
+        c = self.config
+        bs = c.block_size
+        n_kv = int(k.shape[2])
+        width = max(1, 1 << (n_kv - 1).bit_length())
+        num_slots = c.num_blocks * bs
+        sl = np.full(width, num_slots, np.int32)  # pad rows hit the trash page
+        pos = 0
+        for b in blocks:
+            sl[pos:pos + bs] = np.arange(b * bs, (b + 1) * bs)
+            pos += bs
+        dt = self.cache["k"].dtype
+        kp = np.zeros(k.shape[:2] + (width,) + k.shape[3:], k.dtype)
+        vp = np.zeros_like(kp)
+        kp[:, :, :n_kv] = k
+        vp[:, :, :n_kv] = v
+        self.cache = self._kv_import_fn(width)(
+            self.cache, jnp.asarray(kp, dt), jnp.asarray(vp, dt),
+            jnp.asarray(sl),
+        )
+
     def import_handoff(self, handoff,
                        trace: Optional[trace_context.TraceContext] = None) -> str:
         """Adopt an exported request: scatter its KV pages into this
@@ -1180,6 +1236,9 @@ class LLMEngine:
             # the tier breakdown GET /v1/stats surfaces (rides
             # engine.stats() through the serving layer unchanged)
             out["kv_tiers"] = self.kvtier.stats()
+            if self.kvfetch is not None:
+                # prefetch/fetch rollup rides the same surface
+                out["kv_tiers"]["fetch"] = self.kvfetch.stats()
         if self.num_kv_imports:
             out["num_kv_imports"] = self.num_kv_imports
         if self.spec_stats is not None:
@@ -1422,6 +1481,22 @@ class LLMEngine:
             return None  # no room: fall through to decode; retry later
         self.waiting.popleft()
         self.num_prefill_batches += 1
+        if self.kvfetch is not None and matched:
+            # blocks the prefetch tick scattered ahead of admission
+            # match as HBM residents; re-attribute their hits to the
+            # tier the prefetch pulled them from, so the per-tier mix
+            # reflects where the KV actually came from. Taken only
+            # PAST the admission commit point — an ensure_capacity
+            # failure above leaves the attribution for the retry.
+            for t, n in self.kvfetch.take_attribution(
+                    req.request_id).items():
+                move = min(n, tier_counts.get("hbm", 0))
+                if move <= 0:
+                    continue
+                tier_counts["hbm"] -= move
+                tier_counts[t] = tier_counts.get(t, 0) + move
+            if tier_counts.get("hbm") == 0:
+                tier_counts.pop("hbm", None)
         # prefix-cache accounting over the ORIGINAL prompt only: a
         # preemption recompute re-matching its own just-sealed blocks
         # would otherwise inflate the hit rate the decode pick trusts
@@ -1486,6 +1561,10 @@ class LLMEngine:
         req.seq = seq
         req.status = RequestStatus.RUNNING
         self.running.append(req)
+        if self.kvfetch is not None:
+            # the sequence holds its own refs now: release the prefetch
+            # reservation and book the lead time
+            self.kvfetch.consumed(req.request_id)
         return req, logits
 
     def _resurrect_tiers(self, prompt: list, matched: int, chain: int,
@@ -1539,25 +1618,9 @@ class LLMEngine:
             # on success); adopted HBM refs must be returned
             self.allocator.free([b for _h, t, b in entries if t == "hbm"])
             return [], 0, chain, {}
-        n_kv = len(deep) * bs
         k = np.concatenate([sb.handoff.k_pages for _h, _t, sb in deep], axis=2)
         v = np.concatenate([sb.handoff.v_pages for _h, _t, sb in deep], axis=2)
-        width = max(1, 1 << (n_kv - 1).bit_length())
-        num_slots = c.num_blocks * c.block_size
-        sl = np.full(width, num_slots, np.int32)  # pad rows hit the trash page
-        pos = 0
-        for b in new_blocks:
-            sl[pos : pos + bs] = np.arange(b * bs, (b + 1) * bs)
-            pos += bs
-        dt = self.cache["k"].dtype
-        kp = np.zeros(k.shape[:2] + (width,) + k.shape[3:], k.dtype)
-        vp = np.zeros_like(kp)
-        kp[:, :, :n_kv] = k
-        vp[:, :, :n_kv] = v
-        self.cache = self._kv_import_fn(width)(
-            self.cache, jnp.asarray(kp, dt), jnp.asarray(vp, dt),
-            jnp.asarray(sl),
-        )
+        self._scatter_block_pages(k, v, new_blocks)
         tier_counts: dict[str, int] = {}
         blocks: list[int] = []
         it_new = iter(new_blocks)

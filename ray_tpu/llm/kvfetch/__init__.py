@@ -1,0 +1,58 @@
+"""ray_tpu.llm.kvfetch — cross-engine KV resurrection, prefetch-at-
+admission, and the async batched spill worker (r18).
+
+r17's tiered cache (llm/kvtier) had three perf rungs left open, and
+this package closes them:
+
+ * **cross-engine resurrection** — a spilled ``SpilledBlock`` already
+   IS a CRC-sealed ``KVHandoff``, so any same-weights replica can PULL
+   it over the fetch plane (``plane.py``: in-process registry, fabric
+   device transport, or a chunked ``kv_fetch`` RPC route) instead of
+   the router having to pile every same-prefix request onto the one
+   engine that spilled it. The prefix index's ``{engine, tier,
+   n_tokens}`` rows (+ a published ``fetch_addr``) are the discovery
+   surface; routing scores gain a ``fetch_weight`` discount so a cold
+   replica that can fetch beats recomputing — but loses to any replica
+   already holding the prefix locally.
+ * **prefetch-at-admission** — ``manager.KVFetchManager`` verifies /
+   deserializes / fetches a queued request's prefix on a bounded
+   worker while the request waits, then scatters it into HBM (with
+   reservation refs ``probe_admission_need`` discounts) on the engine
+   thread BEFORE the request reaches the head of the queue;
+   ``_prefill_one`` finds the blocks simply resident.
+ * **async batched spill** — lives in ``kvtier/tiers.py``: eviction
+   captures device slices only, a spill worker coalesces them into one
+   batched device→host gather off the allocation hot path.
+
+The bitwise-token-identity contract is unchanged on every new path:
+each fetched or prefetched block re-verifies its seal + token ids
+before a page is scattered; corrupt ⇒ counted drop + recompute, dead
+source ⇒ bounded typed ``KVFetchError`` ⇒ recompute — never wrong
+tokens, never a hang.
+"""
+
+from ray_tpu.llm.kvfetch.manager import KVFetchManager
+from ray_tpu.llm.kvfetch.plane import (
+    DeviceFetchClient,
+    FetchClient,
+    KVFetchError,
+    LocalFetchClient,
+    LocalFetchRegistry,
+    RpcFetchClient,
+    RpcFetchServer,
+    get_local_fetch_registry,
+    make_fetch_client,
+)
+
+__all__ = [
+    "KVFetchManager",
+    "KVFetchError",
+    "FetchClient",
+    "LocalFetchClient",
+    "DeviceFetchClient",
+    "RpcFetchClient",
+    "RpcFetchServer",
+    "LocalFetchRegistry",
+    "get_local_fetch_registry",
+    "make_fetch_client",
+]

@@ -50,6 +50,32 @@ class KVTierConfig:
     # index rows older than this are treated as dark by routing helpers
     index_stale_after_s: float = 30.0
 
+    # -- r18 (ray_tpu.llm.kvfetch) --------------------------------------------
+    # async batched spill: eviction only captures the block's pages as
+    # a device slice; a spill worker coalesces queued blocks into one
+    # batched device->host gather off the allocation hot path. False
+    # restores the r17 blocking gather (the bench's A/B baseline).
+    async_spill: bool = True
+    # bounded pending-spill queue (each entry pins its device slices);
+    # overflow drops the oldest capture — a counted miss, never growth
+    spill_queue_depth: int = 64
+    # prefetch-at-admission: while a request waits in the queue, a
+    # bounded worker verifies/deserializes its local deep-tier prefix
+    # and pulls remote blocks over the fetch plane, so _prefill_one
+    # finds the blocks already resident. False = r17 synchronous
+    # resurrection only.
+    prefetch: bool = True
+    prefetch_queue_depth: int = 64
+    # routing discount for a prefix held by ANOTHER engine this replica
+    # can fetch from (must stay below every holding-tier weight: a pull
+    # over the fabric beats recompute but loses to any local copy)
+    fetch_weight: float = 0.25
+    # bound on one cross-engine pull (typed KVFetchError past it: the
+    # requester degrades to local tiers + recompute, never hangs)
+    fetch_timeout_s: float = 5.0
+    # cap on blocks pulled per fetch (one queue-waiting request)
+    fetch_max_blocks: int = 64
+
     def weight(self, tier: Optional[str]) -> float:
         for t, w in self.tier_weights:
             if t == tier:

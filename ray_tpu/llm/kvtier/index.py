@@ -112,6 +112,7 @@ def best_prefix_replica(
     depths: dict,
     cfg: Optional[KVTierConfig] = None,
     key_of: Optional[dict] = None,
+    fetch_weight: float = 0.0,
 ) -> Optional[str]:
     """Tier-discounted routing pick over an index ``lookup`` result.
 
@@ -121,6 +122,14 @@ def best_prefix_replica(
     holds nothing for this prompt, or the only holders are overloaded
     past ``depth_slack`` — in every None case the caller's existing
     queue-depth/p2c ladder decides (graceful degradation, never a pin).
+
+    ``fetch_weight`` > 0 adds the r18 FETCH-COST discount: a replica
+    that holds nothing itself scores ``fetch_weight`` times the best
+    fresh holder's score — a pull over the fetch plane beats recompute
+    but loses to any local copy. With the holder loaded past the depth
+    slack, the pick now SPREADS to a cold within-slack replica that
+    will fetch the prefix, instead of piling onto (or abandoning) the
+    one hot holder.
     """
     if not lookup or not depths:
         return None
@@ -129,17 +138,28 @@ def best_prefix_replica(
     if not engines:
         return None
     min_depth = min(depths.values())
-    best: Optional[tuple] = None
-    for replica, depth in depths.items():
+
+    def held_score(replica) -> float:
         key = (key_of or {}).get(replica, replica)
         got = engines.get(key)
-        if got is None:
-            continue
-        if got.get("age_s", 0.0) > cfg.index_stale_after_s:
-            continue
+        if got is None or got.get("age_s", 0.0) > cfg.index_stale_after_s:
+            return 0.0
+        return cfg.weight(got.get("tier")) * float(got.get("n_tokens", 0))
+
+    # the fetch discount prices pulling from the best FRESH holder,
+    # whether or not that holder is a routable candidate here
+    best_held = 0.0
+    if fetch_weight > 0.0:
+        for got in engines.values():
+            if got.get("age_s", 0.0) > cfg.index_stale_after_s:
+                continue
+            s = cfg.weight(got.get("tier")) * float(got.get("n_tokens", 0))
+            best_held = max(best_held, s)
+    best: Optional[tuple] = None
+    for replica, depth in depths.items():
         if depth > min_depth + cfg.depth_slack:
             continue  # cache affinity must not overload one replica
-        score = cfg.weight(got.get("tier")) * float(got.get("n_tokens", 0))
+        score = max(held_score(replica), fetch_weight * best_held)
         if score <= 0.0:
             continue
         cand = (score, -depth, replica)

@@ -7,11 +7,20 @@
    chain metadata (parent hash, tokens, prefix length) and advertise
    the HBM row to the prefix index.
  * ``on_evict`` — allocation pressure is about to reuse a zero-ref
-   cached block: gather its pages off the device (one contiguous slice
-   per block — slots are block-major, so this is basic slicing, not a
-   gather) and push them down the ladder as a CRC-sealed
-   ``SpilledBlock`` (the r10 ``KVHandoff`` seal machinery, so spill
-   integrity and handoff integrity are ONE code path).
+   cached block. r17 gathered the pages to host SYNCHRONOUSLY here —
+   one blocking device→host copy + CRC per evicted block, on the
+   allocation hot path. r18 makes the spill ASYNC AND BATCHED: the
+   eviction window only captures the block's pages as a device-side
+   slice (cheap — the copy happens on device, off the host's critical
+   path) and queues it; a spill worker coalesces everything queued into
+   ONE batched device→host gather overlapping decode, then seals each
+   block as a CRC-sealed ``SpilledBlock`` (the r10 ``KVHandoff`` seal
+   machinery, so spill integrity and handoff integrity are ONE code
+   path). A probe/get that races the worker sees pending entries as
+   host-tier residents; ``get`` materializes on demand, so nothing the
+   sync path could serve is ever missed. If the engine dies mid-gather
+   the entry is simply dropped — a future cache miss, never a torn
+   (half-sealed) resurrection.
 
 Resurrection runs in the engine's prefill admission
 (``LLMEngine._resurrect_tiers``): blocks past the HBM match are pulled
@@ -19,19 +28,30 @@ back with ``take_verified`` (seal + token check — a corrupt copy is
 dropped and counted, never scattered) and re-enter the paged cache via
 the same jitted scatter ``import_handoff`` uses.
 
-Thread model: every mutating entry point runs on the engine's own
-serving thread (allocator calls, prefill admission, telemetry
-refresh) — the engine is single-threaded by contract (orchestrator
-pools take ``pe.lock`` around every engine call), so the manager
-needs no lock of its own; the shared index objects are thread-safe.
+Cross-engine fetch (r18, ``ray_tpu.llm.kvfetch``): ``serve_fetch`` is
+the SOURCE side of the fetch plane — any same-weights replica may pull
+this engine's spilled blocks (a ``SpilledBlock`` already IS a sealed
+``KVHandoff``, so the wire format existed since r10). Fetch reads are
+non-destructive; the REQUESTER re-verifies every block before its
+pages touch a cache. The ``llm.kvfetch`` chaos site lives here so
+DROP/CORRUPT_KV_TRANSFER cover every fetch backend through one hook.
+
+Thread model (r18): the engine's own serving thread still drives every
+allocator callback and admission, but the spill worker, the kvfetch
+prefetch worker, and OTHER engines' fetch pulls now read/mutate the
+tier tables concurrently — ``_lock`` (an RLock) guards ``_meta`` /
+``_host`` / ``_obj`` / ``_pending``. Blocking work (device→host
+copies, chaos fires, object-store serialization) happens OUTSIDE the
+lock; only dict/LRU motion happens under it.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import hashlib
+import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 from typing import Any, Optional
 
 import numpy as np
@@ -69,6 +89,22 @@ class SpilledBlock:
         return tuple(self.handoff.prompt_token_ids)
 
 
+@dataclasses.dataclass
+class _PendingSpill:
+    """An evicted block captured on-device, awaiting the worker's
+    batched gather. ``k_dev``/``v_dev`` are device arrays sliced in the
+    eviction window (the pages' value at eviction time — jax sequences
+    the slice before any later in-place cache update)."""
+
+    content_hash: int
+    parent_hash: int
+    tokens: tuple
+    n_prefix_tokens: int
+    k_dev: Any
+    v_dev: Any
+    t_enqueued: float
+
+
 class KVTierManager:
     """HBM -> host DRAM -> object store ladder for one engine."""
 
@@ -76,6 +112,10 @@ class KVTierManager:
         self.engine = engine
         self.config = config or KVTierConfig()
         c = self.config
+        # guards _meta/_host/_obj/_pending: the spill worker, the
+        # kvfetch prefetch worker, and remote fetch pulls all touch the
+        # tier tables off the engine thread (see module docstring)
+        self._lock = threading.RLock()
         # chain metadata for hashes currently sealed in HBM: the spill
         # path needs (parent, tokens, prefix length) the allocator's
         # hash->block map doesn't carry. Bounded by the HBM block count.
@@ -89,10 +129,36 @@ class KVTierManager:
         self._store = c.object_store or ObjectStore()
         self._obj: "OrderedDict[int, tuple]" = OrderedDict()  # h -> (oid, nbytes, parent, n_prefix)
         self._obj_bytes = 0
+        # async spill queue: hash -> _PendingSpill, drained by the spill
+        # worker in ONE batched gather per wakeup (bounded — a queued
+        # entry pins its device slices, so overflow drops the oldest)
+        self._pending: "OrderedDict[int, _PendingSpill]" = OrderedDict()
+        # hashes the worker has popped but not yet inserted (the gather
+        # window): get() waits for them instead of reporting a miss the
+        # sync path would have served
+        self._gathering: dict[int, bool] = {}
+        # invalidation generation: every insert that began BEFORE an
+        # invalidate_all (weight swap) must be dropped, or the worker /
+        # a prefetch fetch would re-insert KV computed under the OLD
+        # weights after the swap wiped every tier — verification cannot
+        # catch that (the pages are intact, just stale), only this can
+        self.generation = 0
+        self._spill_wake = threading.Event()
+        self._spill_stop = False
+        self._spill_thread: Optional[threading.Thread] = None
+        if c.async_spill:
+            t = threading.Thread(
+                target=self._spill_worker, name="kvtier-spill", daemon=True
+            )
+            t.start()
+            self._spill_thread = t
         # prefix index publishing (telemetry-style epoch banking: the
         # epoch survives this object, the seq only this incarnation)
         self.index: Any = None
         self.engine_key: str = getattr(engine, "model_tag", "engine")
+        # where remote engines can PULL this engine's spilled blocks
+        # (rides the index snapshot; None = in-process registry only)
+        self.fetch_addr: Any = None
         self._epoch = int(time.time() * 1000)
         self._seq = 0
         self._index_dirty = True
@@ -103,7 +169,18 @@ class KVTierManager:
         self.resurrected_tokens = {TIER_HOST: 0, TIER_OBJECT: 0}
         self.corrupt_dropped = {TIER_HOST: 0, TIER_OBJECT: 0}
         self.spills_dropped = 0   # chaos DROP_KV_TRANSFER at the spill site
+        self.spill_queue_dropped = 0  # overflowed the bounded pending queue
+        self.spill_gather_failures = 0  # worker gather died: block missed
         self.evicted_blocks = 0   # fell off the deepest tier (gone for good)
+        self.fetch_blocks_served = 0  # blocks pulled by remote engines
+        self.fetch_bytes_served = 0
+        # per-eviction wall time INSIDE the allocation path (the r18
+        # async-spill headline: capture-only vs r17's blocking gather)
+        self.spill_wall_ms: deque = deque(maxlen=1024)
+        # jitted page capture (one compiled dynamic-slice program, the
+        # block offset traced): eager slicing re-builds the op per call
+        # and costs an order of magnitude more on the allocation path
+        self._capture_fn = None
         self._bind_allocator()
 
     # -- allocator listeners ---------------------------------------------------
@@ -117,41 +194,65 @@ class KVTierManager:
     def rebind_allocator(self) -> None:
         """The engine rebuilt its allocator/KV cache (recover(rebuild_kv)):
         HBM rows are gone, but spilled copies were written from pages
-        that were correct when sealed — they stay resurrectable."""
-        self._meta.clear()
+        that were correct when sealed — they stay resurrectable (pending
+        captures included: their device slices were taken before the
+        rebuild and are independent buffers)."""
+        with self._lock:
+            self._meta.clear()
+            self._index_dirty = True
         self._bind_allocator()
-        self._index_dirty = True
 
     def on_seal(self, block_id: int, content_hash: int, parent_hash: int,
                 tokens: tuple, n_prefix_tokens: int) -> None:
-        self._meta[content_hash] = (parent_hash, tuple(tokens),
-                                    int(n_prefix_tokens))
-        self._index_dirty = True
+        with self._lock:
+            self._meta[content_hash] = (parent_hash, tuple(tokens),
+                                        int(n_prefix_tokens))
+            self._index_dirty = True
 
     def on_evict(self, block_id: int, content_hash: int) -> None:
         """A zero-ref sealed block is being reused by the allocator:
         spill its pages down the ladder before they are overwritten.
         Never throws into allocation (the allocator call site also
-        guards) — a failed spill is just a future cache miss."""
-        meta = self._meta.pop(content_hash, None)
-        self._index_dirty = True
+        guards) — a failed spill is just a future cache miss. With
+        ``async_spill`` the hot path only slices the pages ON DEVICE
+        and enqueues; the worker does the host gather off-path."""
+        t0 = time.perf_counter()
+        with self._lock:
+            meta = self._meta.pop(content_hash, None)
+            self._index_dirty = True
+            gen = self.generation
         if meta is None:
             return  # sealed before the manager attached, or already spilled
         if self.config.host_bytes <= 0 and self.config.object_bytes <= 0:
             return
         parent, tokens, n_prefix = meta
         try:
-            sb = self._spill_block(block_id, content_hash, parent, tokens,
-                                   n_prefix)
+            if self.config.async_spill:
+                k_dev, v_dev = self._capture_block(block_id)
+                entry = _PendingSpill(
+                    content_hash=content_hash, parent_hash=parent,
+                    tokens=tokens, n_prefix_tokens=n_prefix,
+                    k_dev=k_dev, v_dev=v_dev, t_enqueued=time.time(),
+                )
+                with self._lock:
+                    self._pending[content_hash] = entry
+                    self._pending.move_to_end(content_hash)
+                    while len(self._pending) > self.config.spill_queue_depth:
+                        self._pending.popitem(last=False)
+                        self.spill_queue_dropped += 1
+                self._spill_wake.set()
+            else:
+                k, v = self._capture_block(block_id)
+                sb = self._materialize(content_hash, parent, tokens,
+                                       n_prefix, k, v)
+                if sb is not None:
+                    self._insert(content_hash, sb, gen=gen)
         except Exception:  # noqa: BLE001 — spill must never break allocation
             logger.exception("kvtier spill of block %d failed", block_id)
             return
-        if sb is None:
-            return
-        if self.config.host_bytes > 0:
-            self._host_insert(content_hash, sb)
-        else:
-            self._object_insert(content_hash, sb)
+        finally:
+            with self._lock:
+                self.spill_wall_ms.append((time.perf_counter() - t0) * 1e3)
 
     def on_drop_all(self) -> None:
         """The allocator invalidated its whole prefix cache (weight
@@ -161,26 +262,43 @@ class KVTierManager:
 
     # -- spill path ------------------------------------------------------------
 
-    def _spill_block(self, block_id: int, content_hash: int, parent: int,
-                     tokens: tuple, n_prefix: int) -> Optional[SpilledBlock]:
+    def _capture_block(self, block_id: int):
+        """Slice one block's pages as DEVICE arrays (the eviction
+        window: the victim's pages are intact until its new owner
+        writes, and jax sequences this slice before any later in-place
+        cache update — the slice result is an independent buffer).
+        ONE jitted dynamic-slice program serves every eviction (the
+        offset is a traced scalar), so the allocation-path cost is a
+        single cached dispatch, not per-call op construction."""
+        bs = self.engine.config.block_size
+        if self._capture_fn is None:
+            import jax
+
+            self._capture_fn = jax.jit(lambda k, v, lo: (
+                jax.lax.dynamic_slice_in_dim(k, lo, bs, axis=2),
+                jax.lax.dynamic_slice_in_dim(v, lo, bs, axis=2),
+            ))
+        return self._capture_fn(
+            self.engine.cache["k"], self.engine.cache["v"], block_id * bs
+        )
+
+    def _materialize(self, content_hash: int, parent: int, tokens: tuple,
+                     n_prefix: int, k, v) -> Optional[SpilledBlock]:
+        """Host-side half of a spill: device→host copy, CRC seal, chaos
+        gate. Runs on the spill worker (async) or inline (sync path /
+        a ``get`` racing the worker). Never called under ``_lock``."""
         from ray_tpu.llm.disagg.handoff import KVHandoff
 
         c = self.engine.config
-        bs = c.block_size
-        lo, hi = block_id * bs, (block_id + 1) * bs
-        # contiguous slot range: one basic slice per page array, then a
-        # host copy — the only device->host traffic the tier ladder does
-        k = np.asarray(self.engine.cache["k"][:, :, lo:hi, :])
-        v = np.asarray(self.engine.cache["v"][:, :, lo:hi, :])
         h = KVHandoff(
             request_id=f"kvtier-{content_hash & 0xFFFFFFFF:08x}",
             prompt_token_ids=list(tokens),
             output_token_ids=[],
             sampling_params=None,
             key_data=np.zeros(1, np.uint32),
-            num_kv_tokens=bs,
-            k_pages=k,
-            v_pages=v,
+            num_kv_tokens=c.block_size,
+            k_pages=np.asarray(k),
+            v_pages=np.asarray(v),
             model_sig=(c.model.n_layers, c.model.n_kv_heads,
                        c.model.head_dim),
         ).seal()
@@ -209,51 +327,155 @@ class KVTierManager:
         return SpilledBlock(handoff=h, parent_hash=parent,
                             n_prefix_tokens=n_prefix)
 
-    def _host_insert(self, content_hash: int, sb: SpilledBlock) -> None:
-        old = self._host.get(content_hash)
-        if old is not None:
-            # re-spill of a hash still resident (resurrection aborted on
-            # allocation pressure, then the recompute re-sealed and
-            # re-evicted it): replace, don't double-count the bytes
-            self._host_bytes -= old.nbytes
-        self._host[content_hash] = sb
-        self._host.move_to_end(content_hash)
-        self._host_bytes += sb.nbytes
-        self.spilled_bytes[TIER_HOST] += sb.nbytes
-        self._count_spill(TIER_HOST, sb.nbytes)
-        while self._host_bytes > self.config.host_bytes and self._host:
-            old_h, old = self._host.popitem(last=False)
-            self._host_bytes -= old.nbytes
-            if self.config.object_bytes > 0:
-                self._object_insert(old_h, old)
+    def _insert(self, content_hash: int, sb: SpilledBlock,
+                gen: Optional[int] = None) -> None:
+        """Insert into the first enabled deep tier. ``gen`` is the
+        generation the caller observed when it BEGAN producing ``sb``
+        (spill capture / remote fetch): if an invalidate_all landed in
+        between, the block was computed under dead weights and must be
+        dropped — held under the (reentrant) lock so the check and the
+        insert are one atomic step."""
+        with self._lock:
+            if gen is not None and gen != self.generation:
+                return
+            if self.config.host_bytes > 0:
+                self._host_insert(content_hash, sb)
             else:
-                self.evicted_blocks += 1
-        self._index_dirty = True
+                self._object_insert(content_hash, sb)
+
+    def _spill_worker(self) -> None:
+        """Drain the pending queue in BATCHES: every wakeup converts all
+        queued device slices in one coalesced stacked gather (one
+        device→host transfer instead of one per block), then seals and
+        inserts each block. A gather that dies drops exactly the blocks
+        it carried — counted misses, never a torn entry."""
+        while not self._spill_stop:
+            # bounded park: a stop() between wakeups is honored within
+            # one poll slice
+            self._spill_wake.wait(timeout=0.1)
+            self._spill_wake.clear()
+            self._drain_pending()
+
+    def _drain_pending(self, only_hash: Optional[int] = None) -> None:
+        with self._lock:
+            gen = self.generation
+            if only_hash is not None:
+                e = self._pending.pop(only_hash, None)
+                entries = [e] if e is not None else []
+            else:
+                entries = list(self._pending.values())
+                self._pending.clear()
+            for e in entries:
+                # the gather window: get() waits for these instead of
+                # reporting a miss the sync path would have served
+                self._gathering[e.content_hash] = True
+        if not entries:
+            return
+        try:
+            try:
+                # the coalesced gather: ONE device_get over every queued
+                # slice (a pytree copy, no compilation — a jnp.stack here
+                # would recompile per batch size and contend with the
+                # engine thread's dispatches)
+                import jax
+
+                rows = jax.device_get([(e.k_dev, e.v_dev) for e in entries])
+            except Exception:  # noqa: BLE001 — died mid-gather: blocks missed
+                self.spill_gather_failures += len(entries)
+                logger.exception(
+                    "kvtier spill gather of %d block(s) failed; "
+                    "entries dropped", len(entries),
+                )
+                return
+            for e, (k, v) in zip(entries, rows):
+                try:
+                    sb = self._materialize(e.content_hash, e.parent_hash,
+                                           e.tokens, e.n_prefix_tokens, k, v)
+                except Exception:  # noqa: BLE001
+                    self.spill_gather_failures += 1
+                    continue
+                if sb is not None:
+                    self._insert(e.content_hash, sb, gen=gen)
+        finally:
+            with self._lock:
+                for e in entries:
+                    self._gathering.pop(e.content_hash, None)
+
+    def flush_spills(self, timeout_s: float = 10.0) -> bool:
+        """Block (bounded) until every pending spill has materialized —
+        tests and benches use it to observe the post-spill state the
+        sync path produced immediately."""
+        deadline = time.monotonic() + timeout_s
+        self._spill_wake.set()
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._pending:
+                    return True
+            if self._spill_thread is None:
+                self._drain_pending()
+            else:
+                self._spill_wake.set()
+                time.sleep(0.002)
+        return False
+
+    def stop(self) -> None:
+        """Tear down the spill worker (engine shutdown in tests)."""
+        self._spill_stop = True
+        self._spill_wake.set()
+        if self._spill_thread is not None:
+            self._spill_thread.join(timeout=2)
+
+    def _host_insert(self, content_hash: int, sb: SpilledBlock) -> None:
+        with self._lock:
+            old = self._host.get(content_hash)
+            if old is not None:
+                # re-spill of a hash still resident (resurrection aborted on
+                # allocation pressure, then the recompute re-sealed and
+                # re-evicted it): replace, don't double-count the bytes
+                self._host_bytes -= old.nbytes
+            self._host[content_hash] = sb
+            self._host.move_to_end(content_hash)
+            self._host_bytes += sb.nbytes
+            self.spilled_bytes[TIER_HOST] += sb.nbytes
+            demote: list = []
+            while self._host_bytes > self.config.host_bytes and self._host:
+                old_h, old = self._host.popitem(last=False)
+                self._host_bytes -= old.nbytes
+                if self.config.object_bytes > 0:
+                    demote.append((old_h, old))
+                else:
+                    self.evicted_blocks += 1
+            self._index_dirty = True
+        self._count_spill(TIER_HOST, sb.nbytes)
+        for old_h, old in demote:
+            self._object_insert(old_h, old)
 
     def _object_insert(self, content_hash: int, sb: SpilledBlock) -> None:
         from ray_tpu.core.object_store import serialize
 
-        old = self._obj.pop(content_hash, None)
-        if old is not None:
-            # replace-in-place: release the old store ref and its bytes
-            # before re-putting under the same (hash-derived) object id
-            self._obj_bytes -= old[1]
-            self._store.remove_ref(old[0])
+        # serialization (the expensive host copy) stays outside the lock
         oid = self._object_id(content_hash)
         payload, buffers = serialize(sb)
-        self._store.put_serialized(oid, payload, buffers)
-        self._obj[content_hash] = (oid, sb.nbytes, sb.parent_hash,
-                                   sb.n_prefix_tokens)
-        self._obj.move_to_end(content_hash)
-        self._obj_bytes += sb.nbytes
-        self.spilled_bytes[TIER_OBJECT] += sb.nbytes
+        with self._lock:
+            old = self._obj.pop(content_hash, None)
+            if old is not None:
+                # replace-in-place: release the old store ref and its bytes
+                # before re-putting under the same (hash-derived) object id
+                self._obj_bytes -= old[1]
+                self._store.remove_ref(old[0])
+            self._store.put_serialized(oid, payload, buffers)
+            self._obj[content_hash] = (oid, sb.nbytes, sb.parent_hash,
+                                       sb.n_prefix_tokens)
+            self._obj.move_to_end(content_hash)
+            self._obj_bytes += sb.nbytes
+            self.spilled_bytes[TIER_OBJECT] += sb.nbytes
+            while self._obj_bytes > self.config.object_bytes and self._obj:
+                old_h, (old_oid, old_n, _p, _np_) = self._obj.popitem(last=False)
+                self._obj_bytes -= old_n
+                self._store.remove_ref(old_oid)
+                self.evicted_blocks += 1
+            self._index_dirty = True
         self._count_spill(TIER_OBJECT, sb.nbytes)
-        while self._obj_bytes > self.config.object_bytes and self._obj:
-            old_h, (old_oid, old_n, _p, _np_) = self._obj.popitem(last=False)
-            self._obj_bytes -= old_n
-            self._store.remove_ref(old_oid)
-            self.evicted_blocks += 1
-        self._index_dirty = True
 
     def _object_id(self, content_hash: int) -> ObjectID:
         digest = hashlib.blake2b(
@@ -275,34 +497,56 @@ class KVTierManager:
     # -- resurrect path --------------------------------------------------------
 
     def peek(self, content_hash: int) -> Optional[str]:
-        """Which deep tier holds this hash (read-only; no LRU motion)."""
-        if content_hash in self._host:
-            return TIER_HOST
-        if content_hash in self._obj:
-            return TIER_OBJECT
+        """Which deep tier holds this hash (read-only; no LRU motion).
+        A spill still pending its gather counts as host-resident — it
+        WILL land there, and ``get`` can materialize it on demand."""
+        with self._lock:
+            if content_hash in self._host or content_hash in self._pending:
+                return TIER_HOST
+            if content_hash in self._obj:
+                return TIER_OBJECT
         return None
 
     def get(self, content_hash: int) -> Optional[tuple]:
         """(tier, SpilledBlock) without removing the entry — the caller
-        commits with ``promoted`` only after the scatter landed."""
-        sb = self._host.get(content_hash)
-        if sb is not None:
-            self._host.move_to_end(content_hash)
-            return TIER_HOST, sb
-        rec = self._obj.get(content_hash)
-        if rec is not None:
-            from ray_tpu.core.object_store import deserialize
+        commits with ``promoted`` only after the scatter landed. A
+        pending (un-gathered) spill is materialized inline so the async
+        queue never turns a sync-path hit into a miss."""
+        with self._lock:
+            pending = content_hash in self._pending
+        if pending:
+            self._drain_pending(only_hash=content_hash)
+        # mid-gather window: the worker popped this hash but hasn't
+        # inserted it yet — wait (bounded; roughly what the sync path
+        # would have paid for the gather) instead of reporting a miss
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            with self._lock:
+                busy = content_hash in self._gathering
+            if not busy:
+                break
+            time.sleep(0.001)
+        with self._lock:
+            sb = self._host.get(content_hash)
+            if sb is not None:
+                self._host.move_to_end(content_hash)
+                return TIER_HOST, sb
+            rec = self._obj.get(content_hash)
+            oid = rec[0] if rec is not None else None
+        if oid is None:
+            return None
+        from ray_tpu.core.object_store import deserialize
 
-            oid = rec[0]
-            try:
-                payload, buffers = self._store.serialized_get(oid, timeout=1.0)
-                sb = deserialize(payload, buffers)
-            except Exception:  # noqa: BLE001 — torn store entry = miss
-                self._drop_entry(content_hash, TIER_OBJECT)
-                return None
-            self._obj.move_to_end(content_hash)
-            return TIER_OBJECT, sb
-        return None
+        try:
+            payload, buffers = self._store.serialized_get(oid, timeout=1.0)
+            sb = deserialize(payload, buffers)
+        except Exception:  # noqa: BLE001 — torn store entry = miss
+            self._drop_entry(content_hash, TIER_OBJECT)
+            return None
+        with self._lock:
+            if content_hash in self._obj:
+                self._obj.move_to_end(content_hash)
+        return TIER_OBJECT, sb
 
     def take_verified(self, content_hash: int,
                       expect_tokens: tuple) -> Optional[tuple]:
@@ -314,12 +558,7 @@ class KVTierManager:
         if got is None:
             return None
         tier, sb = got
-        ok = False
-        try:
-            ok = tuple(sb.tokens) == tuple(expect_tokens) and sb.handoff.verify()
-        except Exception:  # noqa: BLE001 — malformed entry = corrupt
-            ok = False
-        if not ok:
+        if not self.verify_block(sb, expect_tokens):
             self.corrupt_dropped[tier] += 1
             self._drop_entry(content_hash, tier)
             try:
@@ -336,6 +575,17 @@ class KVTierManager:
             )
             return None
         return tier, sb
+
+    @staticmethod
+    def verify_block(sb: SpilledBlock, expect_tokens: tuple) -> bool:
+        """Seal + token check shared by local resurrection and the
+        kvfetch requester (a fetched block goes through the SAME gate
+        before its pages touch any cache)."""
+        try:
+            return (tuple(sb.tokens) == tuple(expect_tokens)
+                    and sb.handoff.verify())
+        except Exception:  # noqa: BLE001 — malformed entry = corrupt
+            return False
 
     def promoted(self, content_hash: int, tier: str) -> None:
         """The block is back in HBM (resurrected + re-registered): drop
@@ -356,16 +606,91 @@ class KVTierManager:
             pass
 
     def _drop_entry(self, content_hash: int, tier: str) -> None:
-        if tier == TIER_HOST:
-            sb = self._host.pop(content_hash, None)
-            if sb is not None:
-                self._host_bytes -= sb.nbytes
-        else:
-            rec = self._obj.pop(content_hash, None)
-            if rec is not None:
-                self._obj_bytes -= rec[1]
-                self._store.remove_ref(rec[0])
-        self._index_dirty = True
+        with self._lock:
+            self._pending.pop(content_hash, None)
+            if tier == TIER_HOST:
+                sb = self._host.pop(content_hash, None)
+                if sb is not None:
+                    self._host_bytes -= sb.nbytes
+            else:
+                rec = self._obj.pop(content_hash, None)
+                if rec is not None:
+                    self._obj_bytes -= rec[1]
+                    self._store.remove_ref(rec[0])
+            self._index_dirty = True
+
+    # -- cross-engine fetch (the llm.kvfetch source side) ----------------------
+
+    def serve_fetch(self, hashes: list, tokens_list: list) -> list:
+        """Serve spilled blocks to a REMOTE same-weights engine (the
+        source half of ``ray_tpu.llm.kvfetch``). Non-destructive: the
+        local copy stays resident (it may be promoted here later). The
+        requester re-verifies every block before scattering, so a
+        corrupt entry shipped from here is ITS counted drop.
+
+        This is the ``llm.kvfetch`` chaos site: DROP_KV_TRANSFER fails
+        the whole pull with a typed error (the requester degrades to
+        local-tiers + recompute), CORRUPT_KV_TRANSFER bit-flips the
+        first served block's pages after its seal (caught by the
+        requester's verify — never wrong tokens)."""
+        from ray_tpu.llm.kvfetch.plane import KVFetchError
+
+        corrupt = False
+        if _chaos.ACTIVE is not None:
+            for _f in _chaos.fire(
+                "llm.kvfetch",
+                kinds=(_chaos.DROP_KV_TRANSFER, _chaos.CORRUPT_KV_TRANSFER,
+                       _chaos.DELAY_RPC),
+                engine=self.engine_key, n_blocks=len(hashes),
+            ):
+                if _f.kind == _chaos.DROP_KV_TRANSFER:
+                    raise KVFetchError(
+                        f"chaos: dropped kv fetch from {self.engine_key!r}"
+                    )
+                if _f.kind == _chaos.DELAY_RPC:
+                    time.sleep(_f.delay_s)
+                if _f.kind == _chaos.CORRUPT_KV_TRANSFER:
+                    corrupt = True
+        out: list = []
+        for h, toks in zip(hashes, tokens_list):
+            got = self.get(int(h))
+            if got is None:
+                out.append(None)
+                continue
+            _tier, sb = got
+            if tuple(sb.tokens) != tuple(toks):
+                out.append(None)  # hash collision: not the caller's block
+                continue
+            if corrupt:
+                # copy-on-corrupt AFTER the seal (the resident entry
+                # stays intact): the requester's verify must catch it
+                kc = np.array(sb.handoff.k_pages, copy=True)
+                flat = kc.view(np.uint8).reshape(-1)
+                if flat.size:
+                    mid = flat.size // 2
+                    span = max(1, min(16, flat.size - mid))
+                    flat[mid:mid + span] ^= 0xFF
+                sb = SpilledBlock(
+                    handoff=dataclasses.replace(sb.handoff, k_pages=kc),
+                    parent_hash=sb.parent_hash,
+                    n_prefix_tokens=sb.n_prefix_tokens,
+                )
+                corrupt = False  # one block is enough to prove the gate
+            out.append(sb)
+            self.fetch_blocks_served += 1
+            self.fetch_bytes_served += sb.nbytes
+        return out
+
+    def adopt_fetched(self, content_hash: int, sb: SpilledBlock,
+                      gen: Optional[int] = None) -> None:
+        """A verified block PULLED from a remote engine joins the local
+        host tier (cross-engine resurrection, ray_tpu.llm.kvfetch): it
+        is now resurrectable here even if the tick scatter never runs,
+        and the next index snapshot advertises this engine as a holder
+        too. Rides the ordinary bounded-LRU insert — fetched bytes are
+        cache, never unbounded growth. ``gen`` = the generation when
+        the fetch began; a weight swap in between drops the adoption."""
+        self._insert(content_hash, sb, gen=gen)
 
     # -- probes (read-only; the routing signal) --------------------------------
 
@@ -400,29 +725,45 @@ class KVTierManager:
 
     def invalidate_all(self) -> None:
         """Weight swap / adapter churn: every tier's cached K/V is stale.
-        Drops host + object entries, forgets HBM metadata, and ships an
-        EMPTY index snapshot so the cluster stops routing here for
-        prefixes this engine no longer holds."""
-        self._meta.clear()
-        self._host.clear()
-        self._host_bytes = 0
-        for oid, _n, _p, _np_ in self._obj.values():
-            try:
-                self._store.remove_ref(oid)
-            except Exception:  # noqa: BLE001
-                pass
-        self._obj.clear()
-        self._obj_bytes = 0
-        self._index_dirty = True
+        Drops host + object entries (pending spills included), forgets
+        HBM metadata, and ships an EMPTY index snapshot so the cluster
+        stops routing here for prefixes this engine no longer holds."""
+        with self._lock:
+            # generation bump: an in-flight spill gather or remote fetch
+            # that BEGAN before this point must not land afterwards (its
+            # pages are intact but computed under the dead weights)
+            self.generation += 1
+            self._meta.clear()
+            self._host.clear()
+            self._host_bytes = 0
+            self._pending.clear()
+            for oid, _n, _p, _np_ in self._obj.values():
+                try:
+                    self._store.remove_ref(oid)
+                except Exception:  # noqa: BLE001
+                    pass
+            self._obj.clear()
+            self._obj_bytes = 0
+            self._index_dirty = True
+        kvf = getattr(self.engine, "kvfetch", None)
+        if kvf is not None:
+            # staged prefetch chains and reservations reference pre-swap
+            # KV: drop them (and free the reservation refs) NOW, before
+            # the engine-thread tick could scatter stale pages
+            kvf.reset()
         self.flush_index(force=True)
 
     # -- prefix-index publishing ----------------------------------------------
 
-    def attach_index(self, index: Any, engine_key: Optional[str] = None) -> None:
+    def attach_index(self, index: Any, engine_key: Optional[str] = None,
+                     fetch_addr: Any = None) -> None:
         self.index = index
         if engine_key is not None:
             self.engine_key = engine_key
-        self._index_dirty = True
+        if fetch_addr is not None:
+            self.fetch_addr = fetch_addr
+        with self._lock:
+            self._index_dirty = True
         self.flush_index(force=True)
 
     # silent publishers' rows are omitted from lookups at the store's
@@ -441,28 +782,37 @@ class KVTierManager:
         if self.index is None:
             return
         now = time.monotonic()
-        due = self._index_dirty or now >= self._index_refresh_next
-        if not force and (not due or now < self._index_next):
-            return
-        self._index_next = now + self.config.index_flush_interval_s
-        self._index_refresh_next = now + self.INDEX_REFRESH_S
-        rows = []
-        for h, (_p, _tokens, n_prefix) in self._meta.items():
-            rows.append([h, TIER_CODES[TIER_HBM], n_prefix])
-        for h, sb in self._host.items():
-            rows.append([h, TIER_CODES[TIER_HOST], sb.n_prefix_tokens])
-        for h, (_oid, _n, _parent, n_prefix) in self._obj.items():
-            rows.append([h, TIER_CODES[TIER_OBJECT], n_prefix])
-        self._seq += 1
-        self._index_dirty = False
+        with self._lock:
+            due = self._index_dirty or now >= self._index_refresh_next
+            if not force and (not due or now < self._index_next):
+                return
+            self._index_next = now + self.config.index_flush_interval_s
+            self._index_refresh_next = now + self.INDEX_REFRESH_S
+            rows = []
+            for h, (_p, _tokens, n_prefix) in self._meta.items():
+                rows.append([h, TIER_CODES[TIER_HBM], n_prefix])
+            for h, sb in self._host.items():
+                rows.append([h, TIER_CODES[TIER_HOST], sb.n_prefix_tokens])
+            for h, e in self._pending.items():
+                # queued spills WILL land in the host tier; advertising
+                # them now keeps the index one gather ahead of routing
+                rows.append([h, TIER_CODES[TIER_HOST], e.n_prefix_tokens])
+            for h, (_oid, _n, _parent, n_prefix) in self._obj.items():
+                rows.append([h, TIER_CODES[TIER_OBJECT], n_prefix])
+            self._seq += 1
+            self._index_dirty = False
+            seq = self._seq
         ok = False
         try:
-            got = self.index.update({
+            payload = {
                 "engine": self.engine_key,
                 "epoch": self._epoch,
-                "seq": self._seq,
+                "seq": seq,
                 "rows": rows,
-            })
+            }
+            if self.fetch_addr is not None:
+                payload["fetch_addr"] = list(self.fetch_addr)
+            got = self.index.update(payload)
             # GcsPrefixIndex returns a bool; the store returns {"ok": ...}.
             # A "stale" verdict is NOT a failure to retry — it means a
             # newer snapshot (ours: seq only moves forward) already landed.
@@ -472,7 +822,8 @@ class KVTierManager:
         except Exception:  # noqa: BLE001 — a dark index costs freshness only
             ok = False
         if not ok:
-            self._index_dirty = True
+            with self._lock:
+                self._index_dirty = True
 
     # -- observability ---------------------------------------------------------
 
@@ -482,28 +833,53 @@ class KVTierManager:
 
             g = kvtier_metrics.resident_bytes_gauge()
             tag = {"model": self.engine.model_tag}
-            g.set(self._host_bytes, tags={**tag, "tier": TIER_HOST})
-            g.set(self._obj_bytes, tags={**tag, "tier": TIER_OBJECT})
+            with self._lock:
+                host_b, obj_b = self._host_bytes, self._obj_bytes
+                pending = len(self._pending)
+            g.set(host_b, tags={**tag, "tier": TIER_HOST})
+            g.set(obj_b, tags={**tag, "tier": TIER_OBJECT})
+            from ray_tpu.llm.kvfetch import metrics as kvfetch_metrics
+
+            kvfetch_metrics.spill_queue_gauge().set(pending, tags=tag)
         except Exception:  # noqa: BLE001
             pass
 
     def stats(self) -> dict:
+        with self._lock:
+            host_entries, host_b = len(self._host), self._host_bytes
+            obj_entries, obj_b = len(self._obj), self._obj_bytes
+            pending = len(self._pending)
+            walls = sorted(self.spill_wall_ms)
+            evicted = self.evicted_blocks
+        wall_p99 = walls[min(len(walls) - 1, int(len(walls) * 0.99))] if walls else 0.0
         return {
             "host": {
-                "entries": len(self._host),
-                "resident_bytes": self._host_bytes,
+                "entries": host_entries,
+                "resident_bytes": host_b,
                 "capacity_bytes": self.config.host_bytes,
             },
             "object": {
-                "entries": len(self._obj),
-                "resident_bytes": self._obj_bytes,
+                "entries": obj_entries,
+                "resident_bytes": obj_b,
                 "capacity_bytes": self.config.object_bytes,
             },
             "spilled_bytes_total": dict(self.spilled_bytes),
             "resurrected_tokens": dict(self.resurrected_tokens),
             "corrupt_dropped": dict(self.corrupt_dropped),
             "spills_dropped": self.spills_dropped,
-            "evicted_blocks": self.evicted_blocks,
+            "evicted_blocks": evicted,
+            "spill_queue": {
+                "pending": pending,
+                "depth_cap": self.config.spill_queue_depth,
+                "dropped": self.spill_queue_dropped,
+                "gather_failures": self.spill_gather_failures,
+                "async": bool(self.config.async_spill),
+                "wall_p99_ms": round(wall_p99, 4),
+            },
+            "fetch_served": {
+                "blocks": self.fetch_blocks_served,
+                "bytes": self.fetch_bytes_served,
+            },
             "index_attached": self.index is not None,
             "engine_key": self.engine_key,
         }

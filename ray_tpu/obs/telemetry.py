@@ -1011,6 +1011,22 @@ class TelemetryStore:
             return out
 
         corrupt = agg["counters"].get(_fq("llm_kvtier_corrupt_dropped_total"))
+        # r18 (llm/kvfetch): prefetch phase totals, cross-engine fetch
+        # bytes per backend, and the async-spill backlog gauge
+        prefetch = {}
+        for phase in ("started", "completed", "wasted"):
+            acc = agg["counters"].get(
+                _fq(f"llm_kvtier_prefetch_{phase}_total"))
+            prefetch[phase] = int(acc["total"]) if acc else 0
+        fetch_by_backend: dict[str, float] = {}
+        acc = agg["counters"].get(_fq("llm_kvtier_fetch_bytes_total"))
+        if acc:
+            for skey, v in acc["series"].items():
+                backend = self._parse_tags_key(skey).get("backend", "")
+                fetch_by_backend[backend] = (
+                    fetch_by_backend.get(backend, 0.0) + float(v)
+                )
+        spillq = agg["gauges"].get(_fq("llm_kvtier_spill_queue_depth"))
         return {
             "resident_bytes_by_tier": by_tier(
                 "gauges", "llm_kvtier_resident_bytes"),
@@ -1022,6 +1038,12 @@ class TelemetryStore:
                 "counters", "llm_kvtier_resurrected_tokens_total"),
             "corrupt_dropped_total": (
                 int(corrupt["total"]) if corrupt else None
+            ),
+            "prefetch": prefetch,
+            "fetch_bytes_by_backend": fetch_by_backend,
+            "spill_queue_depth": (
+                int(spillq["value"])
+                if spillq and spillq.get("value") is not None else None
             ),
         }
 
@@ -1175,6 +1197,25 @@ def format_status(report: dict) -> str:
             cd = kvt.get("corrupt_dropped_total")
             if cd:
                 line += f"  corrupt dropped {int(cd)}"
+            lines.append(line)
+        pf = kvt.get("prefetch") or {}
+        fb = kvt.get("fetch_bytes_by_backend") or {}
+        sq = kvt.get("spill_queue_depth")
+        if pf.get("started") or fb or sq:
+            # the r18 rungs must SHOW too: how far ahead of admission
+            # prefetch runs, what crosses engines, what's still queued
+            # for the async spill gather
+            line = (
+                f"  prefetch {int(pf.get('started', 0))} started"
+                f" / {int(pf.get('completed', 0))} completed"
+                f" / {int(pf.get('wasted', 0))} wasted"
+            )
+            if fb:
+                line += "  fetched " + " ".join(
+                    f"{b}={_fmt_bytes(n)}" for b, n in sorted(fb.items()) if n
+                )
+            if sq:
+                line += f"  spill queue {int(sq)}"
             lines.append(line)
         idx = report.get("kvtier_index") or {}
         if idx.get("rows"):

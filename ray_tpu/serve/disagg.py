@@ -88,11 +88,22 @@ class PrefillServer:
         # stable key the ingress maps back to a replica id
         self._index_key = f"prefill-{_uuid.uuid4().hex[:12]}"
         if self.engine.kvtier is not None:
+            from ray_tpu.llm.kvfetch import (
+                LocalFetchClient,
+                get_local_fetch_registry,
+            )
             from ray_tpu.llm.kvtier import get_local_index
 
             self.engine.kvtier.attach_index(
                 get_local_index(namespace), engine_key=self._index_key
             )
+            # cross-engine resurrection (llm/kvfetch): this replica both
+            # SERVES its spilled blocks to the app's other replicas and
+            # PULLS prefixes the ingress routed here for fetch
+            registry = get_local_fetch_registry(namespace)
+            registry.register(self._index_key, self.engine.kvtier)
+            if self.engine.kvfetch is not None:
+                self.engine.kvfetch.attach(LocalFetchClient(registry))
         self.connector = _make_connector(connector_kind, namespace)
         # device plane: export device-resident + device-sealed, so the
         # pages go gather -> device_put without ever staging through
@@ -180,11 +191,19 @@ class DecodeServer:
         self.connector = _make_connector(connector_kind, namespace)
         self._target_id = f"decode-{uuid.uuid4().hex[:12]}"
         if self.engine.kvtier is not None:
+            from ray_tpu.llm.kvfetch import (
+                LocalFetchClient,
+                get_local_fetch_registry,
+            )
             from ray_tpu.llm.kvtier import get_local_index
 
             self.engine.kvtier.attach_index(
                 get_local_index(namespace), engine_key=self._target_id
             )
+            registry = get_local_fetch_registry(namespace)
+            registry.register(self._target_id, self.engine.kvtier)
+            if self.engine.kvfetch is not None:
+                self.engine.kvfetch.attach(LocalFetchClient(registry))
         if getattr(self.connector, "name", "") == "device":
             # device plane: pin the endpoint to this engine's KV-cache
             # device so the sender's device_put IS the final hop
@@ -454,9 +473,22 @@ class DisaggIngress:
         except Exception:  # noqa: BLE001 — dark index = no information
             return None
 
+    def _fetch_weight(self) -> float:
+        """The r18 fetch-cost discount the app's engines route with
+        (0.0 when the tiered cache or prefetch plane is off)."""
+        kvt = self.config.engine.kvtier
+        if kvt is None or not kvt.prefetch:
+            return 0.0
+        return float(kvt.fetch_weight)
+
     def _prefer_prefill(self, lookup):
         """Prefill replica already holding this prompt's longest
-        tier-discounted prefix, or None (-> plain p2c)."""
+        tier-discounted prefix, or None (-> plain p2c). Deliberately
+        NO fetch-cost discount here: the ingress has no prefill queue
+        depths (every candidate scores depth 0), so a fetch score would
+        tie EVERY replica and pin all no-holder traffic to one fixed
+        id — None keeps those requests on the router's p2c ladder, and
+        whichever replica wins still prefetches via its own engine."""
         if lookup is None:
             return None
         from ray_tpu.llm.kvtier.index import best_prefix_replica
@@ -498,7 +530,8 @@ class DisaggIngress:
         if lookup is not None and key_of:
             from ray_tpu.llm.kvtier.index import best_prefix_replica
 
-            got = best_prefix_replica(lookup, depths, key_of=key_of)
+            got = best_prefix_replica(lookup, depths, key_of=key_of,
+                                      fetch_weight=self._fetch_weight())
             if got is not None:
                 with self._lock:
                     target = self._targets.get(got)

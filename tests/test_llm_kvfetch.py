@@ -1,0 +1,589 @@
+"""Cross-engine KV resurrection over the fetch plane, prefetch-at-
+admission, async batched spill (ray_tpu.llm.kvfetch): bitwise identity
+over every backend, cancel/flush leak regressions, chaos at the
+llm.kvfetch site, STALL_GCS degradation, fetch-cost routing, and the
+checked-in capture gate."""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu import chaos
+from ray_tpu.llm.engine import EngineConfig, LLMEngine
+from ray_tpu.llm.kvfetch import (
+    DeviceFetchClient,
+    KVFetchError,
+    LocalFetchClient,
+    LocalFetchRegistry,
+    RpcFetchClient,
+    RpcFetchServer,
+)
+from ray_tpu.llm.kvtier import KVTierConfig, LocalPrefixIndex, chain_hashes
+from ray_tpu.llm.kvtier.index import best_prefix_replica
+from ray_tpu.llm.sampling import SamplingParams
+
+pytestmark = pytest.mark.kvfetch
+
+BS = 16
+SYS = list(np.random.RandomState(0).randint(3, 200, size=5 * BS))  # 80 tokens
+
+
+def _cfg(**kv):
+    kvt = kv.pop("kvtier", True)
+    return EngineConfig(num_blocks=16, block_size=BS, max_num_seqs=4,
+                        max_prefill_len=128, kvtier=kvt, **kv)
+
+
+def _gen(eng, prompt, sp, rid, prefetch_wait=False):
+    """Run one request to completion under a PINNED request id; with
+    ``prefetch_wait`` the prefetch worker drains before stepping (the
+    deterministic form of 'the request waited in the queue')."""
+    eng.add_request(prompt, sp, request_id=rid)
+    if prefetch_wait:
+        assert eng.kvfetch.wait_idle(30)
+    toks = cached = None
+    while eng.has_unfinished():
+        for o in eng.step():
+            if o.finished and o.request_id == rid:
+                toks, cached = o.output_token_ids, o.num_cached_tokens
+    assert toks is not None
+    return toks, cached
+
+
+def _suffix(seed, n=BS):
+    return list(np.random.RandomState(seed).randint(3, 200, size=n))
+
+
+def _warm_and_spill(eng, tag="w"):
+    """Warm the shared prefix, then thrash the 16-block cache so it
+    lives only in the host tier; spills flushed."""
+    _gen(eng, SYS + _suffix(1), SamplingParams(max_tokens=4, temperature=0.0),
+         f"{tag}-warm")
+    for i in range(4):
+        _gen(eng, list(np.random.RandomState(100 + i).randint(
+            3, 200, size=112)),
+            SamplingParams(max_tokens=4, temperature=0.0), f"{tag}-fill-{i}")
+    assert eng.kvtier.flush_spills()
+    assert eng.kvtier.stats()["host"]["entries"] > 0
+    eng.kvtier.flush_index(force=True)
+
+
+def _wire_pair(backend, ns):
+    """Owner engine (holds the spilled prefix) + cold engine fetching
+    over ``backend``; both publish into one LocalPrefixIndex."""
+    idx = LocalPrefixIndex()
+    reg = LocalFetchRegistry()
+    owner = LLMEngine(_cfg(), seed=0)
+    cold = LLMEngine(_cfg(), seed=0)
+    reg.register("owner", owner.kvtier)
+    reg.register("cold", cold.kvtier)
+    closers = []
+    owner_addr = None
+    if backend == "local":
+        client = LocalFetchClient(reg)
+    elif backend == "device":
+        client = DeviceFetchClient(reg, namespace=ns)
+        closers.append(client.close)
+    elif backend == "rpc":
+        srv = RpcFetchServer()
+        owner_addr = srv.register_source("owner", owner.kvtier)
+        client = RpcFetchClient()
+        closers.append(client.close)
+        closers.append(srv.stop)
+    owner.kvtier.attach_index(idx, engine_key="owner",
+                              fetch_addr=owner_addr)
+    cold.kvtier.attach_index(idx, engine_key="cold")
+    cold.kvfetch.attach(client)
+    return idx, owner, cold, closers
+
+
+# -- cross-engine bitwise identity over the fetch backends --------------------
+
+
+@pytest.mark.parametrize("backend", ["device", "rpc"])
+def test_cross_engine_identity_greedy_and_seeded(backend):
+    """A cold same-weights replica pulls the spilled prefix over the
+    fetch plane and serves greedy AND seeded requests bit-identically
+    to a cold prefill — with the whole prefix counted cached."""
+    idx, owner, cold, closers = _wire_pair(backend, f"kvf-{backend}")
+    try:
+        _warm_and_spill(owner, f"own-{backend}")
+        cases = [
+            ("greedy", SamplingParams(max_tokens=8, temperature=0.0)),
+            ("seeded", SamplingParams(max_tokens=8, temperature=1.0,
+                                      seed=1234, top_k=5)),
+        ]
+        for name, sp in cases:
+            prompt = SYS + _suffix(2 if name == "greedy" else 3)
+            toks, cached = _gen(cold, prompt, sp, f"the-{name}",
+                                prefetch_wait=True)
+            ref = LLMEngine(_cfg(kvtier=None), seed=0)
+            ref_toks, _ = _gen(ref, prompt, sp, f"the-{name}")
+            assert toks == ref_toks, f"{backend}/{name} tokens diverged"
+            assert cached >= len(SYS)
+        st = cold.kvfetch.stats()
+        assert st["remote"]["fetches"] >= 1
+        assert st["remote"]["blocks"] >= 5
+        assert st["client"]["backend"] == backend
+        assert st["client"]["bytes_fetched"] > 0
+        assert owner.kvtier.stats()["fetch_served"]["blocks"] >= 5
+    finally:
+        for c in closers:
+            c()
+
+
+def test_fetched_blocks_adopted_into_local_tier_and_reindexed():
+    """Fetched blocks join the requester's host tier, so a SECOND
+    same-prefix request there needs no remote pull — and the requester
+    advertises itself as a holder in the next index snapshot."""
+    idx, owner, cold, closers = _wire_pair("local", "kvf-adopt")
+    try:
+        _warm_and_spill(owner, "own-adopt")
+        sp = SamplingParams(max_tokens=4, temperature=0.0)
+        _gen(cold, SYS + _suffix(2), sp, "first", prefetch_wait=True)
+        fetches = cold.kvfetch.stats()["remote"]["fetches"]
+        assert fetches >= 1
+        _gen(cold, SYS + _suffix(3), sp, "second", prefetch_wait=True)
+        # served from the local adoption (HBM or host tier), no new pull
+        assert cold.kvfetch.stats()["remote"]["fetches"] == fetches
+        cold.kvtier.flush_index(force=True)
+        got = idx.lookup(chain_hashes(SYS, BS))["engines"]
+        assert "cold" in got
+    finally:
+        for c in closers:
+            c()
+
+
+# -- prefetch-at-admission ----------------------------------------------------
+
+
+def test_prefetch_vs_sync_identity_and_counters():
+    """Prefetch on vs the r17 synchronous resurrect path: identical
+    tokens, identical cached coverage; prefetch counters move and the
+    hits stay attributed to their SOURCE tier."""
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    pre = LLMEngine(_cfg(), seed=0)
+    _warm_and_spill(pre, "pre")
+    toks_pre, cached_pre = _gen(pre, SYS + _suffix(2), sp, "the-req",
+                                prefetch_wait=True)
+    sync = LLMEngine(_cfg(kvtier=KVTierConfig(prefetch=False)), seed=0)
+    _warm_and_spill(sync, "sync")
+    toks_sync, cached_sync = _gen(sync, SYS + _suffix(2), sp, "the-req")
+    assert toks_pre == toks_sync
+    assert cached_pre == cached_sync >= len(SYS)
+    # hit attribution: the prefetched blocks count under their source
+    # tier, not the HBM residency the prefetch manufactured
+    assert pre.stats()["prefix_cache"]["by_tier"].get("host", 0) >= len(SYS)
+    st = pre.kvfetch.stats()["prefetch"]
+    assert st["started"] >= 1 and st["completed"] >= 1
+    assert st["staged"] == 0 and st["reserved_blocks"] == 0
+    from ray_tpu.util.metrics import registry_snapshot
+
+    names = {m.name for m in registry_snapshot()}
+    assert "ray_tpu_llm_kvtier_prefetch_completed_total" in names
+    assert "ray_tpu_llm_kvtier_prefetch_lead_seconds" in names
+
+
+def test_abort_storm_mid_prefetch_leaks_nothing():
+    """The satellite regression: aborting a storm of queued requests
+    mid-prefetch releases every reservation block and leaves zero
+    bundles queued on the fetch endpoint — no KV blocks and no fabric
+    endpoint capacity leak."""
+    idx, owner, cold, closers = _wire_pair("device", "kvf-storm")
+    try:
+        _warm_and_spill(owner, "own-storm")
+        # saturate the decode batch so new requests actually WAIT
+        busy = SamplingParams(max_tokens=48, temperature=0.0)
+        for i in range(4):
+            cold.add_request(_suffix(700 + i, 24), busy, request_id=f"busy-{i}")
+        while len(cold.running) < 4:
+            cold.step()
+        rids = []
+        for i in range(6):
+            rid = f"storm-{i}"
+            cold.add_request(SYS + _suffix(800 + i),
+                             SamplingParams(max_tokens=4, temperature=0.0),
+                             request_id=rid)
+            rids.append(rid)
+        assert cold.kvfetch.wait_idle(30)
+        # a few steps: the tick scatters staged chains -> reservations
+        for _ in range(4):
+            cold.step()
+        assert cold.kvfetch.stats()["prefetch"]["reserved_blocks"] > 0
+        for rid in rids:
+            cold.abort_request(rid)
+        st = cold.kvfetch.stats()["prefetch"]
+        assert st["reserved_blocks"] == 0 and st["staged"] == 0
+        assert st["wasted"] >= 1
+        while cold.has_unfinished():
+            cold.step()
+        # every block back in the free pool or the zero-ref cache
+        assert cold.allocator.num_free == cold.config.num_blocks
+        # zero endpoint capacity held: the device plane's queue is empty
+        client = cold.kvfetch.client
+        assert client.transport._queue(client.endpoint_id).qsize() == 0
+    finally:
+        for c in closers:
+            c()
+
+
+# -- async batched spill ------------------------------------------------------
+
+
+def test_async_spill_crash_window_means_miss_not_torn(monkeypatch):
+    """The spill worker dying mid-gather loses exactly the queued
+    blocks — counted, never a torn (half-sealed) host entry — and the
+    next same-prefix request recomputes bit-identically."""
+    eng = LLMEngine(_cfg(), seed=0)
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    _gen(eng, SYS + _suffix(1), sp, "warm")
+    mgr = eng.kvtier
+    monkeypatch.setattr(
+        type(mgr), "_materialize",
+        lambda self, *a, **k: (_ for _ in ()).throw(RuntimeError("died")),
+    )
+    for i in range(4):
+        _gen(eng, list(np.random.RandomState(100 + i).randint(
+            3, 200, size=112)), SamplingParams(max_tokens=4, temperature=0.0),
+            f"fill-{i}")
+    assert mgr.flush_spills()
+    assert mgr.spill_gather_failures > 0
+    assert mgr.stats()["host"]["entries"] == 0  # nothing torn, nothing half-in
+    monkeypatch.undo()
+    toks, cached = _gen(eng, SYS + _suffix(2), sp, "the-req")
+    ref = LLMEngine(_cfg(kvtier=None), seed=0)
+    ref_toks, _ = _gen(ref, SYS + _suffix(2), sp, "the-req")
+    assert toks == ref_toks
+
+
+def test_spill_queue_bounded_overflow_drops_oldest():
+    """The pending-spill queue is bounded: overflow drops the oldest
+    capture (a counted miss) instead of pinning device memory."""
+    kvt = KVTierConfig(spill_queue_depth=2)
+    eng = LLMEngine(_cfg(kvtier=kvt), seed=0)
+    _gen(eng, SYS + _suffix(1), SamplingParams(max_tokens=4, temperature=0.0),
+         "warm")
+    # stop the worker so captures accumulate, then force evictions
+    eng.kvtier._spill_stop = True
+    eng.kvtier._spill_wake.set()
+    eng.kvtier._spill_thread.join(timeout=2)
+    taken = eng.allocator.allocate(eng.allocator.num_free)
+    eng.allocator.free(taken)
+    with eng.kvtier._lock:
+        assert len(eng.kvtier._pending) <= 2
+    assert eng.kvtier.spill_queue_dropped > 0
+
+
+def test_stale_insert_after_weight_swap_is_dropped():
+    """The review-found race: an in-flight spill gather (or remote
+    fetch) that BEGAN before invalidate_all (weight swap) must not land
+    afterwards — its pages verify fine but were computed under the DEAD
+    weights. The generation guard drops it; a current-generation insert
+    still lands."""
+    eng = LLMEngine(_cfg(), seed=0)
+    _warm_and_spill(eng, "gen")
+    mgr = eng.kvtier
+    with mgr._lock:
+        h, sb = next(iter(mgr._host.items()))
+    gen0 = mgr.generation
+    mgr.invalidate_all()
+    assert mgr.stats()["host"]["entries"] == 0
+    # the worker's batch (captured pre-swap) completes now: dropped
+    mgr._insert(h, sb, gen=gen0)
+    mgr.adopt_fetched(h, sb, gen=gen0)
+    assert mgr.stats()["host"]["entries"] == 0
+    # a post-swap producer lands normally
+    mgr._insert(h, sb, gen=mgr.generation)
+    assert mgr.stats()["host"]["entries"] == 1
+
+
+# -- chaos at the llm.kvfetch site + dead source ------------------------------
+
+
+def test_corrupt_fetch_is_counted_drop_never_wrong_tokens():
+    """CORRUPT_KV_TRANSFER at llm.kvfetch bit-flips a served block
+    after its seal: the requester-side verify drops it (counted) and
+    the request recomputes — tokens stay exactly right."""
+    idx, owner, cold, closers = _wire_pair("local", "kvf-corrupt")
+    try:
+        _warm_and_spill(owner, "own-corrupt")
+        chaos.install(chaos.FaultSchedule(7, [
+            chaos.FaultSpec("corrupt_kv_transfer", site="llm.kvfetch",
+                            max_fires=1000),
+        ]))
+        try:
+            sp = SamplingParams(max_tokens=8, temperature=0.0)
+            toks, _ = _gen(cold, SYS + _suffix(2), sp, "the-req",
+                           prefetch_wait=True)
+        finally:
+            chaos.uninstall()
+        assert cold.kvfetch.fetch_corrupt_dropped >= 1
+        ref = LLMEngine(_cfg(kvtier=None), seed=0)
+        ref_toks, _ = _gen(ref, SYS + _suffix(2), sp, "the-req")
+        assert toks == ref_toks
+    finally:
+        for c in closers:
+            c()
+
+
+def test_dropped_fetch_degrades_to_recompute():
+    """DROP_KV_TRANSFER at llm.kvfetch fails the pull with a typed
+    error; the prefetch degrades to local-tiers-only and the request
+    recomputes correctly — no hang, no partial scatter."""
+    idx, owner, cold, closers = _wire_pair("local", "kvf-drop")
+    try:
+        _warm_and_spill(owner, "own-drop")
+        chaos.install(chaos.FaultSchedule(3, [
+            chaos.FaultSpec("drop_kv_transfer", site="llm.kvfetch",
+                            max_fires=1000),
+        ]))
+        try:
+            sp = SamplingParams(max_tokens=8, temperature=0.0)
+            toks, cached = _gen(cold, SYS + _suffix(2), sp, "the-req",
+                                prefetch_wait=True)
+        finally:
+            chaos.uninstall()
+        assert cold.kvfetch.fetch_failures >= 1
+        assert cold.kvfetch.stats()["remote"]["blocks"] == 0
+        ref = LLMEngine(_cfg(kvtier=None), seed=0)
+        ref_toks, _ = _gen(ref, SYS + _suffix(2), sp, "the-req")
+        assert toks == ref_toks
+    finally:
+        for c in closers:
+            c()
+
+
+def test_dead_source_is_bounded_typed_failure():
+    """A fetch aimed at a dead source engine fails with a typed
+    KVFetchError within the configured bound — and the requester's
+    prefetch degrades to recompute instead of hanging."""
+    srv = RpcFetchServer()
+    eng_for_addr = LLMEngine(_cfg(), seed=0)
+    addr = srv.register_source("dead", eng_for_addr.kvtier)
+    srv.stop()  # the source is gone
+    client = RpcFetchClient(timeout_s=2.0)
+    try:
+        t0 = time.monotonic()
+        with pytest.raises(KVFetchError):
+            client.fetch("dead", addr, [123], [(1,) * BS], timeout_s=2.0)
+        assert time.monotonic() - t0 < 10.0  # bounded, typed, no hang
+        assert client.num_failures == 1
+    finally:
+        client.close()
+    # a published address nobody serves behaves the same way end to end
+    idx, owner, cold, closers = _wire_pair("rpc", "kvf-dead")
+    try:
+        _warm_and_spill(owner, "own-dead")
+        closers[-1]()  # stop the fetch server: the source engine "died"
+        closers.pop()
+        cold.kvtier.config.fetch_timeout_s = 2.0
+        sp = SamplingParams(max_tokens=8, temperature=0.0)
+        toks, _ = _gen(cold, SYS + _suffix(2), sp, "the-req",
+                       prefetch_wait=True)
+        assert cold.kvfetch.fetch_failures >= 1
+        ref = LLMEngine(_cfg(kvtier=None), seed=0)
+        ref_toks, _ = _gen(ref, SYS + _suffix(2), sp, "the-req")
+        assert toks == ref_toks
+    finally:
+        for c in closers:
+            c()
+
+
+def test_stall_gcs_fetch_degrades_to_local_tiers_only():
+    """A dark/stalled GCS index (r13 STALL_GCS) makes the prefetch
+    lookup answer None within its bound: the worker serves local tiers
+    only — no hang, bounded wall, correct tokens."""
+    from ray_tpu.cluster.gcs_service import GcsServer
+    from ray_tpu.cluster.rpc import ReconnectingRpcClient
+    from ray_tpu.llm.kvtier import GcsPrefixIndex
+
+    server = GcsServer(port=0)
+    host, port = server.start()
+    client = None
+    try:
+        client = ReconnectingRpcClient(host, port, timeout=5).connect()
+        idx = GcsPrefixIndex(client, timeout_s=2)
+        reg = LocalFetchRegistry()
+        eng = LLMEngine(_cfg(), seed=0)
+        eng.kvtier.attach_index(idx, engine_key="e0")
+        reg.register("e0", eng.kvtier)
+        eng.kvfetch.attach(LocalFetchClient(reg))
+        _warm_and_spill(eng, "gcs")
+        chaos.install(chaos.FaultSchedule(11, [
+            chaos.FaultSpec(chaos.STALL_GCS, site="gcs.call", max_fires=8),
+        ]))
+        try:
+            sp = SamplingParams(max_tokens=8, temperature=0.0)
+            t0 = time.monotonic()
+            toks, cached = _gen(eng, SYS + _suffix(2), sp, "the-req",
+                                prefetch_wait=True)
+            assert time.monotonic() - t0 < 30.0  # bounded: no hang
+        finally:
+            chaos.uninstall()
+        # local tiers still served the prefix (the index is a remote-
+        # discovery surface, not a local-correctness dependency)
+        assert cached >= len(SYS)
+        ref = LLMEngine(_cfg(kvtier=None), seed=0)
+        ref_toks, _ = _gen(ref, SYS + _suffix(2), sp, "the-req")
+        assert toks == ref_toks
+    finally:
+        if client is not None:
+            client.close()
+        server.stop()
+
+
+# -- fetch-cost routing -------------------------------------------------------
+
+
+def test_best_prefix_replica_fetch_discount():
+    cfg = KVTierConfig()
+    lookup = {"engines": {
+        "hot": {"tier": "host", "n_tokens": 320, "age_s": 0.1},
+        "small": {"tier": "hbm", "n_tokens": 16, "age_s": 0.1},
+    }}
+    # the deep holder sits past the slack and nobody else holds
+    # anything: r17 (fetch_weight=0) gives up (None -> depth ladder,
+    # cold recompute); fetch-aware spreads to the cold replica, which
+    # will PULL the 320 host-tier tokens (0.25 * 0.6 * 320 = 48)
+    depths = {"cold": 0, "hot": 99}
+    assert best_prefix_replica(lookup, depths, cfg) is None
+    assert best_prefix_replica(lookup, depths, cfg,
+                               fetch_weight=cfg.fetch_weight) == "cold"
+    # a small local holder within slack scores max(local, fetch): the
+    # fetch discount (48) outranks its 16 local tokens, so it ties
+    # with the pure fetcher instead of monopolizing the pick
+    depths = {"small": 0, "cold": 0, "hot": 99}
+    assert best_prefix_replica(lookup, depths, cfg) == "small"
+    assert best_prefix_replica(
+        lookup, depths, cfg, fetch_weight=cfg.fetch_weight,
+    ) in ("small", "cold")
+    # ...but a holder within slack still outranks every fetcher
+    depths = {"small": 0, "cold": 0, "hot": 2}
+    assert best_prefix_replica(lookup, depths, cfg,
+                               fetch_weight=cfg.fetch_weight) == "hot"
+    # dark index: fetch discount cannot invent information
+    assert best_prefix_replica(None, depths, cfg,
+                               fetch_weight=cfg.fetch_weight) is None
+
+
+def test_orchestrator_wires_fetch_plane_and_spreads():
+    """The orchestrator auto-wires pool engines onto one index + fetch
+    registry; with the holder overloaded past slack, the prefill pick
+    spreads to a cold engine (which CAN pull the prefix) instead of
+    piling on — and fetch_cost_routing=False restores r17."""
+    from ray_tpu.llm.disagg.orchestrator import DisaggConfig, DisaggOrchestrator
+
+    cfg = DisaggConfig(
+        engine=_cfg(), num_prefill=2, num_decode=1, connector="inproc",
+        depth_slack=2,
+    )
+    orch = DisaggOrchestrator(cfg, seed=0, model_tag="kvf-orch")
+    try:
+        for p in orch._prefill:
+            assert p.engine.kvfetch is not None
+            assert p.engine.kvfetch.client is not None
+            assert p.engine.kvtier.index is not None
+        p1 = orch._prefill[1]
+        with p1.lock:
+            p1.engine.add_request(SYS + _suffix(1),
+                                  SamplingParams(max_tokens=4,
+                                                 temperature=0.0),
+                                  request_id="warm-p1")
+            while p1.engine.has_unfinished():
+                p1.engine.step()
+        # holder within slack: affinity routes to it (r17 behavior kept)
+        assert orch._pick_prefill(SYS + _suffix(2)) is p1
+        # holder past slack: the fetch-aware pick spreads to engine 0
+        with p1.lock:
+            for i in range(4):
+                p1.engine.add_request(_suffix(50 + i, 32),
+                                      SamplingParams(max_tokens=1),
+                                      request_id=f"load-{i}")
+        assert orch._pick_prefill(SYS + _suffix(3)) is orch._prefill[0]
+    finally:
+        orch.shutdown()
+
+
+# -- observability ------------------------------------------------------------
+
+
+def test_kvfetch_status_block_and_stats_surface():
+    from ray_tpu.obs.telemetry import TelemetryStore, format_status
+    from ray_tpu.util.metrics import snapshot_registry
+
+    idx, owner, cold, closers = _wire_pair("local", "kvf-obs")
+    try:
+        owner.model_tag = "kvf-obs-owner"
+        cold.model_tag = "kvf-obs-cold"
+        _warm_and_spill(owner, "own-obs")
+        sp = SamplingParams(max_tokens=4, temperature=0.0)
+        _gen(cold, SYS + _suffix(2), sp, "res", prefetch_wait=True)
+        cold.update_telemetry_gauges()
+        store = TelemetryStore()
+        store.ingest("host-0", snapshot_registry(), {})
+        health = store.kvtier_health()
+        assert health["prefetch"]["started"] >= 1
+        assert health["prefetch"]["completed"] >= 1
+        assert sum(health["fetch_bytes_by_backend"].values()) > 0
+        text = format_status({"kvtier": health, "nodes": [], "pools": {},
+                              "utilization": {}, "slo": {}})
+        assert "prefetch" in text and "fetched" in text
+        # the /v1/stats surface: fetch rollup rides engine.stats()
+        st = cold.stats()["kv_tiers"]
+        assert st["fetch"]["remote"]["fetches"] >= 1
+        assert st["spill_queue"]["async"] is True
+    finally:
+        for c in closers:
+            c()
+
+
+# -- bench smoke + capture gate -----------------------------------------------
+
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CAPTURE = os.path.join(REPO, "benchmarks", "KVFETCH_cache_r18.json")
+
+
+@pytest.mark.slow
+def test_bench_kvfetch_smoke_cpu(tmp_path):
+    import subprocess
+    import sys
+
+    out = str(tmp_path / "kvfetch.json")
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "PYTHONPATH": REPO})
+    p = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "llm_serving_bench.py"),
+         "--kvfetch", "--kvfetch-out", out, "--kvfetch-rounds", "4"],
+        env=env, capture_output=True, text=True, timeout=560,
+    )
+    assert p.returncode == 0, (p.stdout[-800:], p.stderr[-800:])
+    doc = json.loads(open(out).read())
+    assert doc["metric"] == "llm_kvfetch_cache"
+    assert doc["token_identical"] is True
+    ce = doc["cross_engine"]
+    assert (ce["fetch_aware"]["cached_token_ratio"]
+            >= ce["route_to_owner"]["cached_token_ratio"])
+
+
+def test_kvfetch_capture_gates():
+    """The checked-in capture must show all three rungs paying off:
+    identical tokens, fetch-aware routing at least matching (here:
+    far exceeding) route-to-owner on cached-token ratio with the
+    holder hot, prefetch lowering TTFT p50, and the async spill taking
+    the gather off the allocation path (wall p99 below blocking)."""
+    with open(CAPTURE) as f:
+        cap = json.load(f)
+    assert cap["token_identical"] is True
+    ce = cap["cross_engine"]
+    assert (ce["fetch_aware"]["cached_token_ratio"]
+            >= ce["route_to_owner"]["cached_token_ratio"])
+    assert (ce["fetch_aware"]["ttft_p50_ms"]
+            <= ce["route_to_owner"]["ttft_p50_ms"])
+    sw = cap["spill_wall"]
+    assert sw["async"]["wall_p99_ms"] < sw["blocking"]["wall_p99_ms"]
+    assert all(cap["gates"].values())

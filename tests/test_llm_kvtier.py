@@ -52,10 +52,14 @@ def _suffix(seed, n=BS):
 
 def _fill_to_evict(eng, rounds=4):
     """Thrash the 16-block cache with distinct prompts so the shared
-    prefix's sealed blocks are evicted (and spill)."""
+    prefix's sealed blocks are evicted (and spill). Spills are async
+    (batched, r18) — flush so assertions observe the settled state the
+    r17 sync path produced inline."""
     for i in range(rounds):
         _gen(eng, list(np.random.RandomState(100 + i).randint(3, 200, size=112)),
              SamplingParams(max_tokens=4, temperature=0.0), f"fill-{i}")
+    if eng.kvtier is not None:
+        assert eng.kvtier.flush_spills(), "pending spills did not drain"
 
 
 # -- spill + resurrect --------------------------------------------------------
