@@ -340,6 +340,27 @@ def main():
         print(json.dumps(result))
         sys.exit(0 if rc == 0 else 1)
 
+    # --rlhf: delegate to the RL post-training chaos benchmark
+    # (benchmarks/rlhf_post_bench.py) in a subprocess — generate ->
+    # score -> update -> resync under seeded KILL_RANK + PREEMPT_ENGINE,
+    # writing benchmarks/RLHF_post_r19.json. Extra args pass through
+    # (--steps, --world, --seed, --lr, --out).
+    if "--rlhf" in sys.argv[1:]:
+        repo = os.path.dirname(os.path.abspath(__file__))
+        child = os.path.join(repo, "benchmarks", "rlhf_post_bench.py")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        argv = [a for a in sys.argv[1:] if a != "--rlhf"]
+        rc, out, err = _run_sub(
+            [sys.executable, child] + argv, env, FALLBACK_TIMEOUT_S,
+        )
+        result = _extract_json_line(out)
+        if result is None:
+            fail("rlhf benchmark produced no JSON line",
+                 error_tail=(err or out).strip()[-800:])
+        print(json.dumps(result))
+        sys.exit(0 if rc == 0 else 1)
+
     # --profile: the timed capture also runs the ray_tpu.profiler
     # roofline attribution and writes benchmarks/PROFILE_trainstep_r06.json
     if "--profile" in sys.argv[1:]:

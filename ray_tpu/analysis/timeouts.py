@@ -81,6 +81,10 @@ SCAN_DIRS = (
     # dead fetch source or a stalled endpoint must fail typed within
     # its bound, and the worker loops must park in bounded slices
     "ray_tpu/llm/kvfetch",
+    # r19: the RL post-training planes — a starved trajectory queue or
+    # a wedged publish must park in bounded slices (the learner gang's
+    # fault detector must never be the thing that notices)
+    "ray_tpu/rl/post_train",
 )
 
 

@@ -33,6 +33,10 @@ DEFAULT_PACKAGES = (
     # the native socket/shm plane rides the same peer-may-die substrate
     # the timeouts pass already scans — the lock passes cover it too
     "ray_tpu/native",
+    # r19: the RL post-training actor/learner plane — trajectory queue,
+    # feeder batch cache, and the async publish worker are all
+    # lock-guarded structures shared across the two tiers' threads
+    "ray_tpu/rl/post_train",
 )
 
 

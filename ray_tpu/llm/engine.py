@@ -348,6 +348,11 @@ class LLMEngine:
         # serving SLO label (llm_ttft_seconds{model=...}); the OpenAI app
         # stamps its model_id here after construction
         self.model_tag = "engine"
+        # weight-sync plane (train/weight_sync.py): the version of the
+        # last applied publish — 0 until a subscriber swaps params.
+        # Surfaced via stats()/GET /v1/stats so actor/learner skew in an
+        # RL post-training deployment is observable from one RPC.
+        self.weight_version = 0
 
         # LoRA adapter stacks: slot 0 is the zero adapter ("no lora");
         # per-target A [L, n_slots, d_in, r], B [L, n_slots, r, d_out]
@@ -1222,6 +1227,7 @@ class LLMEngine:
             "free_blocks": self.allocator.num_free,
             "total_blocks": self.config.num_blocks,
             "num_prefill_batches": self.num_prefill_batches,
+            "weight_version": self.weight_version,
             "prefix_cache": {
                 "hit_tokens": self.prefix_hit_tokens,
                 "lookup_tokens": self.prefix_lookup_tokens,

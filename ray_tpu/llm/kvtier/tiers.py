@@ -499,9 +499,15 @@ class KVTierManager:
     def peek(self, content_hash: int) -> Optional[str]:
         """Which deep tier holds this hash (read-only; no LRU motion).
         A spill still pending its gather counts as host-resident — it
-        WILL land there, and ``get`` can materialize it on demand."""
+        WILL land there, and ``get`` can materialize it on demand. That
+        includes the MID-GATHER window: the worker pops a batch out of
+        ``_pending`` into ``_gathering`` before the device→host copy,
+        and a probe landing inside that window must not read the block
+        as evicted-everywhere (``get`` already waits on ``_gathering``;
+        the probe has to agree with what ``get`` would serve)."""
         with self._lock:
-            if content_hash in self._host or content_hash in self._pending:
+            if (content_hash in self._host or content_hash in self._pending
+                    or content_hash in self._gathering):
                 return TIER_HOST
             if content_hash in self._obj:
                 return TIER_OBJECT

@@ -67,6 +67,9 @@ AGGREGATED_PREFIXES = (
     "ray_tpu_profiler_",
     "ray_tpu_train_",
     "ray_tpu_fabric_",
+    # r19: RL post-training actor/learner plane (rl/post_train) — the
+    # version-skew/trajectory-lag series behind `== rl post-train ==`
+    "ray_tpu_rl_post_",
 )
 
 _AGGREGATIONS: dict[str, str] = {}
@@ -1047,11 +1050,63 @@ class TelemetryStore:
             ),
         }
 
+    def rl_post_health(self, agg: Optional[dict] = None) -> dict:
+        """RL post-training rollup for `ray_tpu status` (r19): weight
+        version per tier (MAX over reporters — learner = last published,
+        rollout = applied by serving engines; the difference IS the
+        actor/learner skew), trajectory lag (queued between the tiers),
+        overflow/staleness drops, publishes, rollout preemptions ridden
+        out, and the worst staleness ever trained on (the audit surface
+        for the max_staleness contract). All None/empty when no
+        post-training loop is reporting."""
+        if agg is None:
+            agg = self.cluster_metrics()
+        versions: dict[str, float] = {}
+        acc = agg["gauges"].get(_fq("ray_tpu_rl_post_weight_version"))
+        if acc:
+            for skey, v in acc["series"].items():
+                tier = self._parse_tags_key(skey).get("tier", "")
+                # learner: the newest successful publish (max). rollout:
+                # the WORST engine (min over per-actor series) — the
+                # skew line must surface a laggard serving stale
+                # weights, not let a healthy peer mask it
+                if tier == "rollout" and tier in versions:
+                    versions[tier] = min(versions[tier], float(v))
+                else:
+                    versions[tier] = max(versions.get(tier, 0.0), float(v))
+
+        def counter(name):
+            c = agg["counters"].get(_fq(name))
+            return int(c["total"]) if c else None
+
+        def gauge(name):
+            g = agg["gauges"].get(_fq(name))
+            return g["value"] if g else None
+
+        return {
+            "version_by_tier": versions,
+            "queue_depth": gauge("ray_tpu_rl_post_trajectory_queue_depth"),
+            "queue_bytes": gauge("ray_tpu_rl_post_trajectory_queue_bytes"),
+            "generated_total": counter(
+                "ray_tpu_rl_post_trajectories_generated_total"),
+            "trained_total": counter(
+                "ray_tpu_rl_post_trajectories_trained_total"),
+            "dropped_total": counter(
+                "ray_tpu_rl_post_trajectories_dropped_total"),
+            "stale_dropped_total": counter(
+                "ray_tpu_rl_post_trajectories_stale_total"),
+            "publishes_total": counter("ray_tpu_rl_post_publishes_total"),
+            "rollout_preemptions_total": counter(
+                "ray_tpu_rl_post_rollout_preemptions_total"),
+            "max_trained_staleness": gauge(
+                "ray_tpu_rl_post_max_trained_staleness"),
+        }
+
     def status_payload(self, thresholds: Optional[SLOThresholds] = None) -> dict:
         """Everything `ray_tpu status` needs beyond the node table — the
         GCS assembles this so the CLI is ONE RPC. The full aggregation
         pass (every series, under the lock) runs ONCE and feeds all
-        seven views."""
+        eight views."""
         agg = self.cluster_metrics()
         return {
             "reporters": agg["reporters"],
@@ -1062,6 +1117,7 @@ class TelemetryStore:
             "trainer": self.trainer_health(agg),
             "fabric": self.fabric_health(agg),
             "kvtier": self.kvtier_health(agg),
+            "rl_post": self.rl_post_health(agg),
         }
 
 
@@ -1222,6 +1278,46 @@ def format_status(report: dict) -> str:
             lines.append(
                 f"  index {idx['rows']} rows / {idx['engines']} engines "
                 f"({' '.join(f'{t}={n}' for t, n in sorted((idx.get('rows_by_tier') or {}).items()))})"
+            )
+    rp = report.get("rl_post") or {}
+    if rp.get("version_by_tier") or rp.get("generated_total"):
+        # actor/learner skew must SHOW here: which version each tier is
+        # on, how many trajectories sit between them, and whether the
+        # staleness contract dropped anything — from ONE RPC
+        lines.append("== rl post-train ==")
+        vb = rp.get("version_by_tier") or {}
+        lv = vb.get("learner")
+        rv = vb.get("rollout")
+        skew = (
+            int(lv - rv) if lv is not None and rv is not None else None
+        )
+        lines.append(
+            "  weight version "
+            + " ".join(f"{t}={int(v)}" for t, v in sorted(vb.items()))
+            + (f"  skew {skew}" if skew is not None else "")
+        )
+        qd = rp.get("queue_depth")
+        line = (
+            f"  trajectories {int(rp.get('generated_total') or 0)} generated"
+            f" / {int(rp.get('trained_total') or 0)} trained"
+            f"  queue {int(qd) if qd is not None else '-'}"
+        )
+        if rp.get("queue_bytes"):
+            line += f" ({_fmt_bytes(rp['queue_bytes'])})"
+        dropped = rp.get("dropped_total") or 0
+        stale = rp.get("stale_dropped_total") or 0
+        if dropped or stale:
+            line += f"  dropped {int(dropped)}  stale {int(stale)}"
+        mts = rp.get("max_trained_staleness")
+        if mts is not None:
+            line += f"  max trained staleness {int(mts)}"
+        lines.append(line)
+        pub = rp.get("publishes_total")
+        pre = rp.get("rollout_preemptions_total")
+        if pub or pre:
+            lines.append(
+                f"  publishes {int(pub or 0)}"
+                f"  rollout preemptions {int(pre or 0)}"
             )
     u = report.get("utilization", {})
     occ = u.get("kv_page_occupancy")

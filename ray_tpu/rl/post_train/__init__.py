@@ -1,0 +1,59 @@
+"""ray_tpu.rl.post_train — sebulba-style decoupled actor/learner RL
+post-training: the serving engine as rollout actor.
+
+"Podracer architectures for scalable RL" (PAPERS.md) decouples the two
+tiers of an RL loop across a TPU pod: **actors** generate trajectories,
+**learners** consume them, and the only couplings are a one-way
+trajectory stream (actor -> learner) and a one-way parameter stream
+(learner -> actor). This package builds exactly that shape out of parts
+the repo already hardened:
+
+ * the **rollout tier is the serving stack**: each ``RolloutActor``
+   wraps an ``LLMEngine`` (rollout.py), so shared-prompt rollouts ride
+   the prefix cache, speculative decoding makes sampled continuations
+   cheap, and seeded ``PREEMPT_ENGINE`` chaos is survived by the same
+   ``recover()`` ladder serving uses;
+ * the **learner tier is the r12 gang**: a ``TrainerSupervisor`` drives
+   a policy-gradient-shaped update (learner.py) whose batches come from
+   the trajectory plane via a per-step batch cache (feeder.py) — so a
+   ``KILL_RANK`` recovery restores the checkpoint and replays the SAME
+   cached batches, keeping the same-world-size resume bitwise
+   loss-identical even though the data came from a live queue;
+ * the **trajectory plane** is a bounded, staleness-stamped queue
+   (trajectory.py): every trajectory carries the weight version and
+   sampler key that generated it, overflow drops oldest with a counted
+   metric, and the learner drops (or down-weights) anything older than
+   ``max_staleness`` versions;
+ * the **resync plane** is the r15 fabric weight publish
+   (``train.weight_sync``): the supervisor's post-step state is wired
+   into ``WeightPublisher.publish`` through the ``on_round`` hook, a
+   background worker coalesces publishes so resyncs hide behind device
+   work, and subscribers verify + version-gate every bundle — a torn or
+   corrupt publish is dropped, never half-applied.
+
+The tiers are mutually fault-isolated: rollout engines ride out a
+learner gang recovery (they keep serving the last good version) and the
+learner rides out rollout preemption (the queue starves, the gang does
+not fault) — both under the seeded chaos harness, gated by
+``benchmarks/rlhf_post_bench.py`` -> ``benchmarks/RLHF_post_r19.json``.
+"""
+
+from ray_tpu.rl.post_train.config import PostTrainConfig, PostTrainError
+from ray_tpu.rl.post_train.feeder import FeederError, TrajectoryFeeder
+from ray_tpu.rl.post_train.learner import make_pg_fns
+from ray_tpu.rl.post_train.loop import PostTrainLoop, PostTrainResult
+from ray_tpu.rl.post_train.rollout import RolloutActor
+from ray_tpu.rl.post_train.trajectory import Trajectory, TrajectoryQueue
+
+__all__ = [
+    "FeederError",
+    "PostTrainConfig",
+    "PostTrainError",
+    "PostTrainLoop",
+    "PostTrainResult",
+    "RolloutActor",
+    "Trajectory",
+    "TrajectoryQueue",
+    "TrajectoryFeeder",
+    "make_pg_fns",
+]

@@ -1,0 +1,90 @@
+"""Knobs of the decoupled actor/learner post-training loop."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+STALENESS_DROP = "drop"
+STALENESS_DOWN_WEIGHT = "down_weight"
+
+
+class PostTrainError(Exception):
+    """Base of post-training loop failures (the feeder's starvation
+    bound, a wedged plane) — callers catch ONE type for the subsystem;
+    terminal learner-tier faults surface in ``PostTrainResult.error``."""
+
+
+@dataclasses.dataclass
+class PostTrainConfig:
+    """One config for both tiers and the two planes between them.
+
+    The model config is shared: the learner trains the SAME architecture
+    the rollout engines serve (the weight-sync plane ships leaves by
+    pytree order, so both sides must agree — ``train.weight_sync``
+    fails loudly on a leaf-count mismatch).
+    """
+
+    model: Any                     # models/llama.LlamaConfig
+
+    # -- rollout tier (the serving stack) -------------------------------------
+    num_rollout: int = 1           # rollout engines (each its own subscriber)
+    samples_per_prompt: int = 4    # sampled continuations per shared prompt
+    max_new_tokens: int = 8
+    temperature: float = 1.0
+    sampling_seed: int = 0         # SamplingParams.seed: rollouts are seeded
+    spec: Optional[Any] = None     # llm.spec SpecConfig for drafted rollouts
+
+    # -- learner tier (the r12 TrainerSupervisor gang) ------------------------
+    world_size: int = 2
+    total_steps: int = 24
+    steps_per_round: int = 1
+    checkpoint_every: int = 4
+    step_timeout_s: float = 15.0
+    max_recoveries: int = 8
+    learning_rate: float = 1.0     # plain SGD on the PG loss
+    seed: int = 0
+    learner_backend: str = "host"  # thread gang (the r12 default)
+
+    # -- trajectory plane (rollout -> learner) --------------------------------
+    queue_max_entries: int = 4096
+    queue_max_bytes: int = 64 << 20   # bytes bound, not just entries
+    batch_size: int = 16              # trajectories per learner step
+    max_staleness: int = 4            # versions; older is dropped/down-weighted
+    staleness_mode: str = STALENESS_DROP
+    staleness_decay: float = 0.5      # down_weight: advantage *= decay**excess
+    starvation_timeout_s: float = 30.0  # park bound when the queue runs dry
+    first_batch_timeout_s: float = 120.0
+    # rollout backpressure: pause generation while the queue holds this
+    # many undrained batches (bounds staleness AND wasted rollout compute
+    # under a slow learner; the byte bound is the hard memory backstop)
+    backpressure_batches: int = 4
+
+    # -- resync plane (learner -> rollout, train.weight_sync) -----------------
+    publish_every: int = 4         # learner steps between weight publishes
+    publish_timeout_s: float = 30.0
+    namespace: str = "rl-post"     # fabric transport namespace
+    model_tag: str = "rl-post"
+
+    # optional hook: trajectory -> scalar reward. The loop requires one
+    # (passed explicitly); kept here so serialized configs can name it.
+    reward_fn: Optional[Callable] = None
+
+    def __post_init__(self):
+        if self.staleness_mode not in (STALENESS_DROP, STALENESS_DOWN_WEIGHT):
+            raise ValueError(
+                f"staleness_mode must be {STALENESS_DROP!r} or "
+                f"{STALENESS_DOWN_WEIGHT!r}, got {self.staleness_mode!r}"
+            )
+        if self.max_staleness < 0:
+            raise ValueError("max_staleness must be >= 0")
+        if self.num_rollout < 1 or self.samples_per_prompt < 1:
+            raise ValueError("num_rollout/samples_per_prompt must be >= 1")
+        if self.queue_max_entries < 1 or self.queue_max_bytes < 1:
+            raise ValueError("queue bounds must be >= 1")
+        if self.publish_every < 1:
+            raise ValueError("publish_every must be >= 1")
+        if self.batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        if not (0.0 < self.staleness_decay <= 1.0):
+            raise ValueError("staleness_decay must be in (0, 1]")

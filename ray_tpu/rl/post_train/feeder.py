@@ -1,0 +1,231 @@
+"""The learner-side feed point: queue -> per-step batch cache.
+
+The r12 deterministic-resume contract requires ``batch_fn(seed, step,
+world, rank)`` to be pure in its arguments — but post-training batches
+come from a live trajectory queue. ``TrajectoryFeeder`` squares that:
+the FIRST rank to ask for step ``s`` drains/filters a batch from the
+queue and caches it keyed by step; every other rank (and every REPLAY
+of ``s`` after a gang recovery restores the checkpoint) reads the
+cached batch. Filling happens once, deterministically thereafter — so a
+same-world-size resume recomputes bitwise-identical losses even though
+the data plane is a race between two live tiers.
+
+Staleness is enforced HERE, at consume time, against the learner's
+latest published version: a trajectory older than ``max_staleness``
+versions is dropped (counted, ``staleness_mode="drop"``) or its
+advantage is exponentially down-weighted (``"down_weight"``) — and the
+worst staleness ever admitted is tracked so "zero trajectories trained
+past max_staleness" is auditable, not asserted.
+
+Starvation (a preempted rollout tier) must never fault the gang: the
+fill parks in bounded slices up to ``starvation_timeout_s`` and then
+REUSES the previous round's batch (counted) — the gang keeps stepping
+on slightly-reheated data instead of tripping the collective timeout.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ray_tpu.rl.post_train.config import PostTrainError, STALENESS_DROP
+from ray_tpu.rl.post_train import metrics as _metrics
+from ray_tpu.rl.post_train.trajectory import Trajectory, TrajectoryQueue
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.rl.post_train.feeder")
+
+
+class FeederError(PostTrainError):
+    """The feeder could not produce a first batch within its bound (the
+    rollout tier never delivered) or a filler died mid-fill."""
+
+
+class TrajectoryFeeder:
+    def __init__(
+        self,
+        queue: TrajectoryQueue,
+        *,
+        batch_size: int,
+        max_staleness: int,
+        version_fn: Callable[[], int],
+        staleness_mode: str = STALENESS_DROP,
+        staleness_decay: float = 0.5,
+        starvation_timeout_s: float = 30.0,
+        first_batch_timeout_s: float = 120.0,
+        poll_slice_s: float = 0.05,
+        model_tag: str = "rl-post",
+    ):
+        self._queue = queue
+        self._batch_size = int(batch_size)
+        self._max_staleness = int(max_staleness)
+        self._version_fn = version_fn
+        self._mode = staleness_mode
+        self._decay = float(staleness_decay)
+        self._starve_s = float(starvation_timeout_s)
+        self._first_s = float(first_batch_timeout_s)
+        self._slice_s = float(poll_slice_s)
+        self.model_tag = model_tag
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._batches: dict[int, list[Trajectory]] = {}
+        self._filling: set[int] = set()
+        self._last_batch: Optional[list[Trajectory]] = None
+        self.num_stale_dropped = 0
+        self.num_down_weighted = 0
+        self.num_trained = 0
+        self.num_reused_rounds = 0
+        self.max_trained_staleness = 0
+
+    # -- the batch_fn surface --------------------------------------------------
+
+    def batch_for_step(self, step: int) -> list[Trajectory]:
+        """The round batch for learner step ``step`` — filled once from
+        the queue, then served from cache (replays after a recovery and
+        the other gang ranks all see the identical batch)."""
+        step = int(step)
+        # waiter bound: a filler parks at most first_batch + starvation;
+        # anything past that means the filler died outside the collective
+        # plane (where the gang's own detector would have seen it)
+        deadline = time.monotonic() + self._first_s + self._starve_s + 10.0
+        while True:
+            with self._cond:
+                got = self._batches.get(step)
+                if got is not None:
+                    return got
+                if step not in self._filling:
+                    self._filling.add(step)
+                    break  # this caller fills
+                self._cond.wait(timeout=0.2)
+            if time.monotonic() > deadline:
+                raise FeederError(
+                    f"feeder wedged: step {step} batch never materialized"
+                )
+        batch: Optional[list[Trajectory]] = None
+        try:
+            batch = self._fill(step)
+            return batch
+        finally:
+            with self._cond:
+                if batch is not None:
+                    self._batches[step] = batch
+                    self._last_batch = batch
+                self._filling.discard(step)
+                self._cond.notify_all()
+
+    def prune_below(self, step: int) -> None:
+        """Drop cached batches no recovery can ever replay (steps below
+        the latest checkpoint boundary) — the cache stays bounded by the
+        checkpoint cadence, not the run length."""
+        with self._cond:
+            for s in [s for s in self._batches if s < step]:
+                del self._batches[s]
+
+    def cached_steps(self) -> list[int]:
+        with self._lock:
+            return sorted(self._batches)
+
+    def stats(self) -> dict:
+        with self._lock:
+            cached = len(self._batches)
+        return {
+            "stale_dropped": self.num_stale_dropped,
+            "down_weighted": self.num_down_weighted,
+            "trained": self.num_trained,
+            "reused_rounds": self.num_reused_rounds,
+            "max_trained_staleness": self.max_trained_staleness,
+            "cached_batches": cached,
+        }
+
+    # -- filling ---------------------------------------------------------------
+
+    def _fill(self, step: int) -> list[Trajectory]:
+        """Drain the queue (bounded) into one staleness-filtered batch;
+        runs OUTSIDE the feeder lock — pulling blocks, publishing the
+        result doesn't."""
+        first = self._last_batch is None
+        deadline = time.monotonic() + (self._first_s if first else self._starve_s)
+        kept: list[Trajectory] = []
+        stale = 0
+        while len(kept) < self._batch_size:
+            got = self._queue.take(
+                self._batch_size - len(kept), timeout_s=self._slice_s
+            )
+            current = int(self._version_fn())
+            for t in got:
+                lag = max(0, current - int(t.weight_version))
+                if lag > self._max_staleness and self._mode == STALENESS_DROP:
+                    stale += 1
+                    continue
+                kept.append(t)
+            if kept and time.monotonic() > deadline:
+                # partial batch beats a starved gang — and a slow
+                # TRICKLE must not keep the fill (hence the rank) parked
+                # past its bound either: the supervisor's round deadline
+                # would read that as a wedged rank and replace it
+                break
+            if not kept and time.monotonic() > deadline:
+                # stale drops drained on the way HERE still happened —
+                # starving because everything was stale must reconcile
+                # (generated == trained + stale + dropped), not vanish
+                self._account_stale(stale)
+                if self._last_batch is not None:
+                    # starved: reuse the previous round (counted) — the
+                    # gang must not fault because the rollout tier is
+                    # mid-preemption; its recovery refills the queue
+                    self.num_reused_rounds += 1
+                    logger.warning(
+                        "trajectory queue starved at step %d: reusing "
+                        "previous round batch", step,
+                    )
+                    return self._last_batch
+                raise FeederError(
+                    f"no trajectories arrived within {self._first_s}s "
+                    "for the first learner batch — is the rollout tier up?"
+                )
+        self._account_stale(stale)
+        # finalize against the LAST version the filter used: re-reading
+        # the clock here would let an async publish landing mid-fill
+        # reclassify an admitted (lag <= max_staleness) trajectory as
+        # past the bound — down-weighting it in drop mode and tripping
+        # the max_trained_staleness audit the bench gates on
+        return self._finalize(kept, current)
+
+    def _account_stale(self, stale: int) -> None:
+        if not stale:
+            return
+        self.num_stale_dropped += stale
+        try:
+            _metrics.trajectories_stale_counter().inc(
+                float(stale), tags={"model": self.model_tag})
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _finalize(self, batch: list[Trajectory],
+                  current: int) -> list[Trajectory]:
+        """Advantage stamping: reward minus the round baseline, with the
+        down-weight staleness mode applied past ``max_staleness``. The
+        worst admitted staleness is recorded for the audit gate.
+        ``current`` is the version the fill's staleness filter judged
+        against (one clock read per fill)."""
+        baseline = sum(t.reward for t in batch) / max(1, len(batch))
+        for t in batch:
+            lag = max(0, current - int(t.weight_version))
+            adv = float(t.reward) - baseline
+            if lag > self._max_staleness:
+                # only reachable in down_weight mode (drop filtered above)
+                adv *= self._decay ** (lag - self._max_staleness)
+                self.num_down_weighted += 1
+            t.advantage = adv
+            self.max_trained_staleness = max(self.max_trained_staleness, lag)
+        self.num_trained += len(batch)
+        try:
+            tags = {"model": self.model_tag}
+            _metrics.trajectories_trained_counter().inc(
+                float(len(batch)), tags=tags)
+            _metrics.max_trained_staleness_gauge().set(
+                float(self.max_trained_staleness), tags=tags)
+        except Exception:  # noqa: BLE001
+            pass
+        return batch

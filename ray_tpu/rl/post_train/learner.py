@@ -1,0 +1,130 @@
+"""Policy-gradient-shaped update over scored trajectories.
+
+``make_pg_fns`` builds the four callables the r12 ``TrainerSupervisor``
+drives (``init_fn`` / ``grad_fn`` / ``apply_fn`` plus a feeder-backed
+``batch_fn``), closed over a fixed padded shape so the jitted
+forward/backward compiles exactly once:
+
+ * the REINFORCE loss: ``-sum(advantage * log p(output token)) / n``
+   over each trajectory's generated positions only (prompt positions
+   carry zero weight — the policy is trained on what it *sampled*, not
+   on the prompts it was given);
+ * the advantage is stamped by the feeder (reward minus the round
+   baseline, staleness down-weighting applied);
+ * state lives as a **numpy** pytree and ``apply_fn`` is plain SGD in
+   float32 numpy — together with the r12 gang's rank-ordered float64
+   allreduce this keeps a same-world-size resume bitwise loss-identical
+   (no device-resident optimizer state to drift across a restore).
+
+The learner never touches the serving stack: its only outputs are the
+state pytree (checkpointed by the supervisor) and the versioned weight
+publishes the loop ships over ``train.weight_sync``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from ray_tpu.rl.post_train.trajectory import Trajectory
+
+
+def pack_pg_batch(batch: list, pad_rows: int, pad_len: int):
+    """Trajectories -> fixed-shape numpy arrays (tokens, targets,
+    weights). Row ``i`` holds ``prompt+output`` shifted for next-token
+    prediction; ``weights`` carries the advantage on positions that
+    PREDICT an output token and zero elsewhere (pad rows are all-zero,
+    so padding changes nothing but the compile shape)."""
+    tokens = np.zeros((pad_rows, pad_len), np.int32)
+    targets = np.zeros((pad_rows, pad_len), np.int32)
+    weights = np.zeros((pad_rows, pad_len), np.float32)
+    n_out = 0
+    for i, t in enumerate(batch[:pad_rows]):
+        seq = list(t.prompt_token_ids) + list(t.output_token_ids)
+        seq = seq[: pad_len + 1]
+        m = len(t.prompt_token_ids)
+        inp, tgt = seq[:-1], seq[1:]
+        L = len(inp)
+        tokens[i, :L] = inp
+        targets[i, :L] = tgt
+        # positions m-1 .. m-1+k-1 predict the k output tokens
+        lo = max(0, m - 1)
+        hi = min(L, m - 1 + len(t.output_token_ids))
+        weights[i, lo:hi] = t.advantage
+        n_out += max(0, hi - lo)
+    return tokens, targets, weights, max(1, n_out)
+
+
+def make_pg_fns(
+    model_cfg,
+    *,
+    learning_rate: float,
+    pad_rows: int,
+    pad_len: int,
+) -> tuple[Callable, Callable, Callable]:
+    """(init_fn, grad_fn, apply_fn) for ``TrainerSupervisor``. The
+    returned grad_fn expects the feeder's batch (a list of advantage-
+    stamped ``Trajectory``); an empty shard yields zero loss and zero
+    gradients (a rank whose slice of a small round is empty still joins
+    the allreduce with a neutral contribution)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.models import llama
+
+    if pad_len >= model_cfg.max_seq:
+        raise ValueError(
+            f"pad_len {pad_len} must stay under model max_seq "
+            f"{model_cfg.max_seq}"
+        )
+
+    def _pg_loss(params, tokens, targets, weights, n_out):
+        logits = llama.forward(params, tokens, model_cfg).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        tok_logp = jnp.take_along_axis(
+            logp, targets[..., None], axis=-1
+        )[..., 0]
+        return -jnp.sum(weights * tok_logp) / n_out
+
+    pg_value_and_grad = jax.jit(jax.value_and_grad(_pg_loss))
+
+    def init_fn(seed: int):
+        params = llama.init_params(model_cfg, jax.random.key(int(seed)))
+        return jax.tree_util.tree_map(np.asarray, params)
+
+    def grad_fn(state, batch):
+        trajs: list[Trajectory] = batch
+        if not trajs:
+            return 0.0, jax.tree_util.tree_map(np.zeros_like, state)
+        tokens, targets, weights, n_out = pack_pg_batch(
+            trajs, pad_rows, pad_len
+        )
+        loss, grads = pg_value_and_grad(
+            state, tokens, targets, weights, float(n_out)
+        )
+        return float(loss), jax.tree_util.tree_map(np.asarray, grads)
+
+    def apply_fn(state, grads):
+        lr = np.float32(learning_rate)
+        return jax.tree_util.tree_map(
+            lambda p, g: np.asarray(
+                p - lr * g.astype(p.dtype), dtype=p.dtype
+            ),
+            state, grads,
+        )
+
+    return init_fn, grad_fn, apply_fn
+
+
+def make_batch_fn(feeder) -> Callable:
+    """The supervisor-facing ``batch_fn(seed, step, world, rank)``: the
+    feeder's cached round batch, rank-strided so each rank trains a
+    disjoint shard. Pure in its arguments AFTER the first fill (the
+    cache is the purity mechanism — see feeder.py)."""
+
+    def batch_fn(seed, step, world, rank):
+        batch = feeder.batch_for_step(int(step))
+        return batch[int(rank)::max(1, int(world))]
+
+    return batch_fn

@@ -1,0 +1,447 @@
+"""The post-training loop: two tiers, two one-way planes, one driver.
+
+``PostTrainLoop`` assembles the sebulba shape:
+
+ * N rollout actors (``LLMEngine``-backed, rollout.py) generate on a
+   background thread, paced only by queue backpressure — never by the
+   learner's step clock;
+ * the r12 ``TrainerSupervisor`` gang trains on the feeder's cached
+   batches (feeder.py) on the calling thread — ``KILL_RANK`` /
+   partition / stall recoveries are ITS problem and invisible to the
+   rollout tier;
+ * publishes ride a background ``_PublishWorker`` that coalesces to the
+   newest snapshot (a learner that outruns the fabric ships the latest
+   version, not a backlog of dead ones) — wired into the supervisor via
+   the ``on_round`` hook, the exact missing link ROADMAP item 5 named.
+
+Fault isolation contract (chaos-gated):
+
+ * learner gang recovery: rollout actors keep serving the last good
+   version (a publish torn by the dying gang is dropped by the
+   subscriber's verify/version gates, never half-applied), and resumed
+   training is bitwise loss-identical at the same world size;
+ * rollout preemption: the queue starves, the feeder reuses/waits
+   bounded, the gang does not fault; the recovered engine resubscribes
+   at the next round boundary and catches up to the newest version.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ray_tpu.llm.engine import LLMEngine
+from ray_tpu.rl.post_train import metrics as _metrics
+from ray_tpu.rl.post_train.config import PostTrainConfig, PostTrainError
+from ray_tpu.rl.post_train.feeder import TrajectoryFeeder
+from ray_tpu.rl.post_train.learner import make_batch_fn, make_pg_fns
+from ray_tpu.rl.post_train.rollout import RolloutActor
+from ray_tpu.rl.post_train.trajectory import TrajectoryQueue
+from ray_tpu.train.elastic import ElasticConfig, TrainerSupervisor
+from ray_tpu.train.weight_sync import WeightPublisher, WeightSubscriber
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.rl.post_train.loop")
+
+
+# base type lives in config.py (FeederError subclasses it there without
+# a loop->feeder->loop import cycle); re-exported here for callers
+__all__ = ["PostTrainError", "PostTrainLoop", "PostTrainResult"]
+
+
+class _PublishWorker:
+    """Async, coalescing weight publisher: the learner thread hands off
+    ``(version, state)`` and keeps training — the fabric send happens
+    here, hidden behind the next round's device work (the Podracer
+    recovery-cost bar). Superseded snapshots are dropped (counted): the
+    rollout tier wants the NEWEST version, not a faithful replay of
+    every intermediate one. Failures are counted, never raised into the
+    training loop — the next publish supersedes."""
+
+    def __init__(self, publisher: WeightPublisher, targets: list,
+                 timeout_s: float = 30.0, model_tag: str = "rl-post",
+                 on_published: Optional[Callable[[int], None]] = None):
+        self._publisher = publisher
+        self._targets = list(targets)
+        self._timeout_s = float(timeout_s)
+        self.model_tag = model_tag
+        # success hook: the loop advances its staleness clock HERE, not
+        # at submit — a down fabric must not let the feeder judge fresh
+        # trajectories against a version no rollout engine ever received
+        self._on_published = on_published
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: Optional[tuple[int, Any]] = None
+        self._stop = False
+        self._inflight = False
+        self.num_published = 0
+        self.num_coalesced = 0
+        self.num_failures = 0
+        self.last_published_version = 0
+        self._thread = threading.Thread(
+            target=self._run, name="rl-post-publish", daemon=True
+        )
+        self._thread.start()
+
+    def submit(self, version: int, state: Any) -> None:
+        with self._cond:
+            if self._pending is not None:
+                self.num_coalesced += 1
+            self._pending = (int(version), state)
+            self._cond.notify_all()
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._pending is None and not self._stop:
+                    self._cond.wait(timeout=0.2)
+                if self._pending is None and self._stop:
+                    return
+                version, state = self._pending
+                self._pending = None
+                self._inflight = True
+            try:
+                self._publisher.publish(
+                    state, self._targets, version=version,
+                    timeout_s=self._timeout_s,
+                )
+                self.num_published += 1
+                self.last_published_version = max(
+                    self.last_published_version, version
+                )
+                if self._on_published is not None:
+                    try:
+                        self._on_published(version)
+                    except Exception:  # noqa: BLE001
+                        pass
+                try:
+                    _metrics.publishes_counter().inc(
+                        tags={"model": self.model_tag})
+                except Exception:  # noqa: BLE001
+                    pass
+            except Exception as e:  # noqa: BLE001 — publish faults never fault training
+                self.num_failures += 1
+                logger.warning("weight publish v%d failed: %r", version, e)
+            finally:
+                with self._cond:
+                    self._inflight = False
+                    self._cond.notify_all()
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Park (bounded) until nothing is pending or in flight."""
+        deadline = time.monotonic() + timeout_s
+        with self._cond:
+            while self._pending is not None or self._inflight:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=min(0.2, remaining))
+        return True
+
+    def close(self, timeout_s: float = 10.0) -> None:
+        self.drain(timeout_s=timeout_s)
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=timeout_s)
+
+    def stats(self) -> dict:
+        return {
+            "published": self.num_published,
+            "coalesced": self.num_coalesced,
+            "failures": self.num_failures,
+            "last_version": self.last_published_version,
+        }
+
+
+@dataclasses.dataclass
+class PostTrainResult:
+    completed: bool
+    losses: list                 # per-learner-step mean loss
+    rounds: list                 # rollout round records (the reward curve)
+    recoveries: list             # learner-tier Recovery records (r12)
+    blackouts: list
+    rollout_preemptions: int
+    publishes: int
+    publish_failures: int
+    queue_dropped: int
+    stale_dropped: int
+    reused_rounds: int
+    max_trained_staleness: int
+    final_version: int
+    final_state: Any
+    actor_stats: list
+    error: Optional[BaseException] = None
+
+    @property
+    def reward_curve(self) -> list:
+        return [r["mean_reward"] for r in self.rounds]
+
+
+class PostTrainLoop:
+    """Build both tiers from one config, run them decoupled, return the
+    audit trail. ``engine_config`` is the rollout engines' EngineConfig
+    (model must equal ``cfg.model``); ``prompts`` are the shared prompt
+    token lists every round samples continuations of."""
+
+    def __init__(
+        self,
+        cfg: PostTrainConfig,
+        *,
+        engine_config,
+        prompts: list,
+        reward_fn: Optional[Callable[[list, list], float]] = None,
+        checkpoint_root: str,
+        params: Any = None,
+    ):
+        import jax
+
+        self.cfg = cfg
+        self.prompts = [list(map(int, p)) for p in prompts]
+        reward_fn = reward_fn or cfg.reward_fn
+        if reward_fn is None:
+            raise ValueError("a reward_fn is required (cfg.reward_fn or arg)")
+        self.reward_fn = reward_fn
+        if not self.prompts:
+            raise ValueError("at least one rollout prompt is required")
+
+        # learner state 0 == rollout params 0: both tiers start at the
+        # SAME weights under version 0, so staleness accounting is exact
+        # from the first trajectory on
+        pad_len = max(
+            len(p) for p in self.prompts
+        ) + cfg.max_new_tokens
+        self._init_fn, self._grad_fn, self._apply_fn = make_pg_fns(
+            cfg.model,
+            learning_rate=cfg.learning_rate,
+            pad_rows=cfg.batch_size,
+            pad_len=pad_len,
+        )
+        init_state = (
+            self._init_fn(cfg.seed) if params is None
+            else jax.tree_util.tree_map(np.asarray, params)
+        )
+        self._init_state = init_state
+
+        self.queue = TrajectoryQueue(
+            max_entries=cfg.queue_max_entries,
+            max_bytes=cfg.queue_max_bytes,
+            model_tag=cfg.model_tag,
+        )
+        self._published_version = 0
+        self.feeder = TrajectoryFeeder(
+            self.queue,
+            batch_size=cfg.batch_size,
+            max_staleness=cfg.max_staleness,
+            version_fn=lambda: self._published_version,
+            staleness_mode=cfg.staleness_mode,
+            staleness_decay=cfg.staleness_decay,
+            starvation_timeout_s=cfg.starvation_timeout_s,
+            first_batch_timeout_s=cfg.first_batch_timeout_s,
+            model_tag=cfg.model_tag,
+        )
+
+        # -- rollout tier: engines + subscribers over one fabric plane --------
+        if cfg.spec is not None:
+            # drafted rollouts: the spec knob rides into every rollout
+            # engine (the acceptance rule is distribution-preserving,
+            # so drafted trajectories sample the same policy)
+            engine_config = dataclasses.replace(engine_config, spec=cfg.spec)
+        self.publisher = WeightPublisher(namespace=cfg.namespace)
+        self.actors: list[RolloutActor] = []
+        self._targets: list = []
+        for i in range(cfg.num_rollout):
+            engine = LLMEngine(engine_config, params=init_state, seed=cfg.seed)
+            engine.model_tag = cfg.model_tag
+            endpoint = f"{cfg.model_tag}-rollout{i}"
+            target = self.publisher.register_rollout(
+                endpoint, device=engine.kv_cache_device()
+            )
+            self._targets.append(target)
+            sub = WeightSubscriber(self.publisher.transport, endpoint)
+            self.actors.append(RolloutActor(
+                f"a{i}", engine, sub, self.queue, self.reward_fn,
+                samples_per_prompt=cfg.samples_per_prompt,
+                max_new_tokens=cfg.max_new_tokens,
+                temperature=cfg.temperature,
+                sampling_seed=cfg.sampling_seed,
+                model_tag=cfg.model_tag,
+            ))
+        self._pub_worker: Optional[_PublishWorker] = None
+
+        # -- learner tier: the r12 supervisor gang ----------------------------
+        self.supervisor = TrainerSupervisor(
+            init_fn=lambda seed: self._init_state,
+            grad_fn=self._grad_fn,
+            apply_fn=self._apply_fn,
+            batch_fn=make_batch_fn(self.feeder),
+            total_steps=cfg.total_steps,
+            checkpoint_root=checkpoint_root,
+            config=ElasticConfig(
+                world_size=cfg.world_size,
+                group_name=f"{cfg.model_tag}-learner",
+                backend=cfg.learner_backend,
+                seed=cfg.seed,
+                step_timeout_s=cfg.step_timeout_s,
+                steps_per_round=cfg.steps_per_round,
+                checkpoint_every=cfg.checkpoint_every,
+                max_recoveries=cfg.max_recoveries,
+                sharded_checkpoints=False,
+            ),
+            on_round=self._on_round,
+        )
+
+        self.rounds: list[dict] = []
+        self._max_round_step = 0   # publish-cadence boundary tracker
+        self._stop = threading.Event()
+        self._rollout_error: Optional[BaseException] = None
+
+    # -- resync plane ----------------------------------------------------------
+
+    def _note_published(self, version: int) -> None:
+        """Publish-success hook (the staleness clock): trajectories are
+        judged against the newest version that actually REACHED the
+        fabric — a failing publish plane must degrade to 'rollouts look
+        fresh' (they are: nothing newer was delivered), never to 'every
+        fresh rollout is dropped as stale against a phantom version'."""
+        self._published_version = max(self._published_version, int(version))
+        try:
+            _metrics.weight_version_gauge().set(
+                float(self._published_version),
+                tags={"model": self.cfg.model_tag, "tier": "learner",
+                      "actor": "learner"},
+            )
+        except Exception:  # noqa: BLE001
+            pass
+
+    def _on_round(self, step: int, state_fn: Callable[[], Any]) -> None:
+        """The supervisor's post-round hook: prune the feeder's replay
+        cache below the checkpoint horizon, and on the publish cadence
+        hand the gang's post-step state to the async publisher (version
+        == step: deterministic across recoveries, so a re-published
+        step after a restore carries the same version — and bitwise the
+        same weights — the subscriber already holds or dropped)."""
+        cfg = self.cfg
+        self.feeder.prune_below(
+            (step // cfg.checkpoint_every) * cfg.checkpoint_every
+        )
+        # boundary-crossing cadence (the checkpoint rule's form): with
+        # steps_per_round > 1 the round-end step need not land ON a
+        # multiple of publish_every — crossing one must still publish
+        prev = self._max_round_step
+        self._max_round_step = max(prev, step)
+        if (
+            step // cfg.publish_every > prev // cfg.publish_every
+            or step >= cfg.total_steps
+        ):
+            state = state_fn()
+            if self._pub_worker is not None:
+                self._pub_worker.submit(step, state)
+
+    # -- rollout driver --------------------------------------------------------
+
+    def _rollout_loop(self) -> None:
+        cfg = self.cfg
+        backlog = cfg.backpressure_batches * cfg.batch_size
+        round_idx = 0
+        try:
+            while not self._stop.is_set():
+                if self.queue.depth() >= backlog:
+                    # backpressure: generating further ahead only
+                    # manufactures staleness; wait for the learner
+                    self._stop.wait(0.05)
+                    continue
+                for actor in self.actors:
+                    if self._stop.is_set():
+                        return
+                    actor.sync_weights()
+                    rec = actor.run_round(
+                        self.prompts, round_idx, stop=self._stop
+                    )
+                    if rec is None:  # aborted mid-round by shutdown
+                        return
+                    self.rounds.append(rec)
+                round_idx += 1
+        except BaseException as e:  # noqa: BLE001 — surfaced in the result
+            self._rollout_error = e
+            logger.warning("rollout loop died: %r", e)
+
+    # -- run -------------------------------------------------------------------
+
+    def run(self) -> PostTrainResult:
+        cfg = self.cfg
+        self._pub_worker = _PublishWorker(
+            self.publisher, self._targets,
+            timeout_s=cfg.publish_timeout_s, model_tag=cfg.model_tag,
+            on_published=self._note_published,
+        )
+        rollout_thread = threading.Thread(
+            target=self._rollout_loop, name="rl-post-rollout", daemon=True
+        )
+        rollout_thread.start()
+        try:
+            result = self.supervisor.fit()
+        finally:
+            self._stop.set()
+            rollout_thread.join(timeout=60.0)
+        # final resync: make sure version == total_steps actually reached
+        # the fabric (_on_round already submitted it, but a COALESCED or
+        # FAILED tail publish must not leave the tiers askew at rest —
+        # and a clean tail must not be re-shipped just to be dropped as
+        # stale by every subscriber), then apply on every actor so the
+        # run ends converged
+        if result.completed:
+            self._pub_worker.drain(timeout_s=cfg.publish_timeout_s)
+            if self._pub_worker.last_published_version < cfg.total_steps:
+                self._pub_worker.submit(cfg.total_steps, result.state)
+        self._pub_worker.close(timeout_s=cfg.publish_timeout_s)
+        if rollout_thread.is_alive():
+            # the cooperative stop should have ended the round; if the
+            # thread is somehow still inside engine.step(), touching its
+            # engines here would race a live generation — skip the final
+            # sync rather than tear the batch state
+            logger.warning(
+                "rollout thread still alive after stop; skipping final "
+                "actor resync"
+            )
+        else:
+            for actor in self.actors:
+                actor.sync_weights(timeout_s=1.0)
+        error = result.error
+        if error is None and self._rollout_error is not None:
+            error = self._rollout_error
+        return PostTrainResult(
+            completed=result.completed and self._rollout_error is None,
+            losses=list(result.losses),
+            rounds=list(self.rounds),
+            recoveries=list(result.recoveries),
+            blackouts=list(result.blackouts),
+            rollout_preemptions=sum(a.num_preemptions for a in self.actors),
+            publishes=self._pub_worker.num_published,
+            publish_failures=self._pub_worker.num_failures,
+            queue_dropped=self.queue.num_dropped,
+            stale_dropped=self.feeder.num_stale_dropped,
+            reused_rounds=self.feeder.num_reused_rounds,
+            max_trained_staleness=self.feeder.max_trained_staleness,
+            final_version=self._pub_worker.last_published_version,
+            final_state=result.state,
+            actor_stats=[a.stats() for a in self.actors],
+            error=error,
+        )
+
+    def close(self) -> None:
+        """Release the fabric endpoints (queued bundles pin device
+        memory) — idempotent, safe after a failed run()."""
+        self._stop.set()
+        if self._pub_worker is not None:
+            self._pub_worker.close(timeout_s=5.0)
+        for actor in self.actors:
+            try:
+                actor.subscriber.close()
+            except Exception:  # noqa: BLE001
+                pass
+        self.publisher.close()

@@ -1,0 +1,210 @@
+"""The rollout tier: the serving engine as RL actor.
+
+A ``RolloutActor`` wraps an ``LLMEngine`` — not a bespoke generation
+loop — so rollouts get the serving stack for free: shared prompts ride
+the prefix cache (``samples_per_prompt`` continuations of one prompt
+re-prefill nothing after the first), speculative decoding drafts cheap
+tokens when the engine carries a ``SpecConfig`` (the acceptance rule is
+distribution-preserving, so drafted rollouts sample the SAME policy),
+and preemption is survived by the exact ``recover()`` ladder serving
+uses.
+
+Weight resync is pull-based between rounds: ``sync_weights`` drains the
+actor's ``WeightSubscriber`` endpoint and applies the newest verified
+version (older/corrupt bundles drop — ``train.weight_sync``), which
+also invalidates the prefix cache so post-swap rollouts never splice
+pre-swap KV. Within a round the version is frozen: every trajectory of
+round N is stamped with the version that was serving when the round
+started, so the learner's staleness accounting sees the truth even if a
+publish lands mid-round.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ray_tpu.chaos.harness import EnginePreempted
+from ray_tpu.llm.sampling import SamplingParams
+from ray_tpu.rl.post_train import metrics as _metrics
+from ray_tpu.rl.post_train.trajectory import Trajectory, TrajectoryQueue
+from ray_tpu.utils.logging import get_logger
+
+logger = get_logger("ray_tpu.rl.post_train.rollout")
+
+
+class RolloutActor:
+    """One rollout engine + its weight subscriber + the queue it feeds."""
+
+    def __init__(
+        self,
+        actor_id: str,
+        engine,
+        subscriber,
+        queue: TrajectoryQueue,
+        reward_fn: Callable[[list, list], float],
+        *,
+        samples_per_prompt: int = 4,
+        max_new_tokens: int = 8,
+        temperature: float = 1.0,
+        sampling_seed: int = 0,
+        model_tag: str = "rl-post",
+    ):
+        self.actor_id = actor_id
+        self.engine = engine
+        self.subscriber = subscriber
+        self.queue = queue
+        self.reward_fn = reward_fn
+        self.samples_per_prompt = int(samples_per_prompt)
+        self.max_new_tokens = int(max_new_tokens)
+        self.temperature = float(temperature)
+        self.sampling_seed = int(sampling_seed)
+        self.model_tag = model_tag
+        self.num_rounds = 0
+        self.num_preemptions = 0
+        self.num_syncs = 0
+        self.num_trajectories = 0
+
+    # -- resync (learner -> rollout) ------------------------------------------
+
+    def sync_weights(self, timeout_s: float = 0.05) -> Optional[int]:
+        """Drain the subscriber endpoint; apply the newest verified
+        publish (catch-up semantics: intermediate versions are skipped,
+        stale/corrupt bundles counted + dropped). Returns the applied
+        version or None. Called between rounds and after a recovery —
+        never mid-round."""
+        applied = self.subscriber.apply_to_engine(
+            self.engine, timeout_s=timeout_s
+        )
+        if applied is not None:
+            self.num_syncs += 1
+            try:
+                _metrics.weight_version_gauge().set(
+                    float(applied),
+                    tags={"model": self.model_tag, "tier": "rollout",
+                          "actor": self.actor_id},
+                )
+            except Exception:  # noqa: BLE001
+                pass
+        return applied
+
+    # -- generation (the serving stack) ---------------------------------------
+
+    def _sampling_params(self, greedy: bool = False) -> SamplingParams:
+        return SamplingParams(
+            max_tokens=self.max_new_tokens,
+            temperature=0.0 if greedy else self.temperature,
+            seed=self.sampling_seed,
+            ignore_eos=True,
+        )
+
+    def run_round(self, prompts: list, round_idx: int,
+                  greedy: bool = False,
+                  stop: Optional[threading.Event] = None) -> Optional[dict]:
+        """Generate ``samples_per_prompt`` continuations per shared
+        prompt, score them, and push staleness-stamped trajectories.
+        Rides out ``PREEMPT_ENGINE`` via the engine's own recovery
+        ladder — a preempted round finishes (recomputed prefixes, no
+        lost/dup tokens), it does not abort. A set ``stop`` event is the
+        ONE exception: the driver is shutting down and will touch the
+        engine next (final sync), so the round aborts its in-flight
+        requests and returns None — nothing scored, nothing pushed, no
+        partial round polluting the reward curve."""
+        t0 = time.perf_counter()
+        version = int(getattr(self.engine, "weight_version", 0))
+        sp = self._sampling_params(greedy=greedy)
+        rids: dict[str, list] = {}
+        for i, prompt in enumerate(prompts):
+            for j in range(self.samples_per_prompt):
+                rid = f"{self.actor_id}-r{round_idx}-p{i}-s{j}"
+                self.engine.add_request(list(prompt), sp, request_id=rid)
+                rids[rid] = list(prompt)
+        outputs: dict[str, list] = {}
+        while self.engine.has_unfinished():
+            if stop is not None and stop.is_set():
+                for rid in rids:
+                    try:
+                        self.engine.abort_request(rid)
+                    except Exception:  # noqa: BLE001 — shutdown best-effort
+                        pass
+                return None
+            try:
+                outs = self.engine.step()
+            except EnginePreempted:
+                self._recover()
+                continue
+            for o in outs:
+                if o.finished and o.request_id in rids:
+                    outputs[o.request_id] = list(o.output_token_ids)
+        rewards = []
+        n_tokens = 0
+        for rid, prompt in rids.items():
+            out = outputs.get(rid, [])
+            reward = float(self.reward_fn(prompt, out))
+            rewards.append(reward)
+            n_tokens += len(out)
+            self.queue.put(Trajectory(
+                request_id=rid,
+                prompt_token_ids=prompt,
+                output_token_ids=out,
+                reward=reward,
+                weight_version=version,
+                sampler_key=(self.sampling_seed, rid),
+                actor_id=self.actor_id,
+            ))
+        self.num_rounds += 1
+        self.num_trajectories += len(rewards)
+        wall = time.perf_counter() - t0
+        try:
+            tags = {"model": self.model_tag}
+            _metrics.trajectories_generated_counter().inc(
+                float(len(rewards)), tags=tags)
+            hist = _metrics.reward_histogram()
+            for r in rewards:
+                hist.observe(r, tags=tags)
+        except Exception:  # noqa: BLE001
+            pass
+        cache = self.engine.stats().get("prefix_cache", {})
+        return {
+            "round": round_idx,
+            "actor_id": self.actor_id,
+            "version": version,
+            "n": len(rewards),
+            "mean_reward": (sum(rewards) / len(rewards)) if rewards else 0.0,
+            "tokens": n_tokens,
+            "wall_s": round(wall, 4),
+            "tok_s": round(n_tokens / wall, 2) if wall > 0 else 0.0,
+            "cached_token_ratio": cache.get("hit_rate", 0.0),
+        }
+
+    def _recover(self) -> None:
+        """The serving recovery ladder, scoped to a rollout round:
+        requeue in-flight requests (generated prefixes intact); if even
+        that throws, rebuild the KV cache too. The learner tier never
+        hears about any of this — mutual fault isolation is the design."""
+        self.num_preemptions += 1
+        try:
+            _metrics.rollout_preemptions_counter().inc(
+                tags={"model": self.model_tag})
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self.engine.recover()
+        except Exception:  # noqa: BLE001 — torn cache: rebuild rung
+            logger.warning(
+                "rollout %s: recover() failed, rebuilding KV cache",
+                self.actor_id,
+            )
+            self.engine.recover(rebuild_kv=True)
+
+    def stats(self) -> dict:
+        return {
+            "actor_id": self.actor_id,
+            "weight_version": int(getattr(self.engine, "weight_version", 0)),
+            "rounds": self.num_rounds,
+            "trajectories": self.num_trajectories,
+            "preemptions": self.num_preemptions,
+            "syncs": self.num_syncs,
+            "subscriber": self.subscriber.stats(),
+        }

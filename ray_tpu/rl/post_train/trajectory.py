@@ -1,0 +1,177 @@
+"""The trajectory plane: rollout -> learner, bounded and staleness-stamped.
+
+Every trajectory carries the **weight version** and **sampler key** that
+generated it — the learner's staleness filter and any replay/debugging
+of a rollout both need to know exactly which policy and which PRNG
+stream produced a continuation.
+
+``TrajectoryQueue`` rides the ``rl/replay.py`` ring-buffer discipline
+(drop-oldest, never grow) extended to variable-length entries: it is
+bounded by **entries AND bytes**, and overflow evicts the oldest
+trajectory with a counted ``ray_tpu_rl_post_trajectories_dropped_total``
+instead of growing host memory without bound under a stalled learner.
+A dropped rollout is cheap (the actor regenerates at the current
+version); an OOM'd learner is not.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Optional
+
+from ray_tpu.rl.post_train import metrics as _metrics
+
+
+@dataclasses.dataclass
+class Trajectory:
+    """One scored continuation. ``weight_version`` is the subscriber
+    version the generating engine served; ``sampler_key`` is the
+    ``(sampling_seed, request_id)`` pair the engine folds into its PRNG
+    key — together they name the exact (policy, randomness) that
+    produced ``output_token_ids``."""
+
+    request_id: str
+    prompt_token_ids: list
+    output_token_ids: list
+    reward: float
+    weight_version: int
+    sampler_key: tuple
+    actor_id: str = ""
+    created_at: float = dataclasses.field(default_factory=time.time)
+    # stamped by the feeder at consume time (reward - batch baseline,
+    # staleness down-weighting applied); never crosses the queue
+    advantage: float = 0.0
+
+    @property
+    def nbytes(self) -> int:
+        """Approximate host bytes this entry pins (token ids dominate;
+        8 bytes per int plus a flat per-entry overhead for the strings
+        and dataclass itself — the bound needs honesty, not precision)."""
+        return 8 * (len(self.prompt_token_ids) + len(self.output_token_ids)) + 200
+
+
+class TrajectoryQueue:
+    """Bounded FIFO between the tiers. ``put`` never blocks (drop-oldest
+    on either bound); ``take`` parks bounded and drains up to a batch.
+
+    Thread-safe: rollout actors push from their own threads while the
+    learner's feeder drains from gang ranks.
+    """
+
+    def __init__(self, max_entries: int = 4096, max_bytes: int = 64 << 20,
+                 model_tag: str = "rl-post"):
+        if max_entries < 1 or max_bytes < 1:
+            raise ValueError("max_entries/max_bytes must be >= 1")
+        self.max_entries = int(max_entries)
+        self.max_bytes = int(max_bytes)
+        self.model_tag = model_tag
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._items: list[Trajectory] = []
+        self._bytes = 0
+        self.num_put = 0
+        self.num_taken = 0
+        self.num_dropped = 0
+        # gauge-publication ordering: snapshots are stamped with _seq
+        # inside the queue's critical section; _pub_lock/_pub_seq let
+        # _update_gauges reject an older snapshot that lost the race to
+        # the metric store without holding the queue lock across the set
+        self._seq = 0
+        self._pub_lock = threading.Lock()
+        self._pub_seq = 0
+
+    def put(self, traj: Trajectory) -> None:
+        """Append; evict oldest-first while either bound is exceeded.
+        A single trajectory larger than ``max_bytes`` is itself dropped
+        (counted) WITHOUT being admitted — running the eviction loop on
+        it would flush every good trajectory first and still end up
+        dropping it."""
+        dropped = 0
+        with self._cond:
+            self.num_put += 1
+            if traj.nbytes > self.max_bytes:
+                self.num_dropped += 1
+                dropped = 1
+            else:
+                self._items.append(traj)
+                self._bytes += traj.nbytes
+                while self._items and (
+                    len(self._items) > self.max_entries
+                    or self._bytes > self.max_bytes
+                ):
+                    old = self._items.pop(0)
+                    self._bytes -= old.nbytes
+                    self.num_dropped += 1
+                    dropped += 1
+            self._seq += 1
+            seq, depth, nbytes = self._seq, len(self._items), self._bytes
+            self._cond.notify_all()
+        if dropped:
+            try:
+                _metrics.trajectories_dropped_counter().inc(
+                    float(dropped), tags={"model": self.model_tag})
+            except Exception:  # noqa: BLE001 — observability never blocks the plane
+                pass
+        self._update_gauges(seq, depth, nbytes)
+
+    def take(self, max_n: int, timeout_s: float = 0.1) -> list[Trajectory]:
+        """Drain up to ``max_n`` oldest trajectories; parks at most
+        ``timeout_s`` for the first one (bounded — the learner's feeder
+        loops in slices so a starved queue can never hang a gang rank
+        past its own starvation bound)."""
+        deadline = time.monotonic() + max(0.0, timeout_s)
+        with self._cond:
+            while not self._items:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return []
+                self._cond.wait(timeout=remaining)
+            n = min(int(max_n), len(self._items))
+            out = self._items[:n]
+            del self._items[:n]
+            self._bytes -= sum(t.nbytes for t in out)
+            self.num_taken += len(out)
+            self._seq += 1
+            seq, depth, nbytes = self._seq, len(self._items), self._bytes
+        self._update_gauges(seq, depth, nbytes)
+        return out
+
+    def depth(self) -> int:
+        with self._lock:
+            return len(self._items)
+
+    def total_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "depth": len(self._items),
+                "bytes": self._bytes,
+                "max_entries": self.max_entries,
+                "max_bytes": self.max_bytes,
+                "num_put": self.num_put,
+                "num_taken": self.num_taken,
+                "num_dropped": self.num_dropped,
+            }
+
+    def _update_gauges(self, seq: int, depth: int, nbytes: int) -> None:
+        """Callers pass the (seq, depth, bytes) they observed INSIDE
+        their own critical section; a snapshot that lost the race here
+        to a newer one is discarded — two threads leaving put/take out
+        of order can never park an older depth over the current one.
+        The metric set itself stays off the queue lock (put/take must
+        never contend on the metric store)."""
+        with self._pub_lock:
+            if seq <= self._pub_seq:
+                return  # a newer snapshot already published
+            self._pub_seq = seq
+            try:
+                tags = {"model": self.model_tag}
+                _metrics.queue_depth_gauge().set(float(depth), tags=tags)
+                _metrics.queue_bytes_gauge().set(float(nbytes), tags=tags)
+            except Exception:  # noqa: BLE001 — observability never blocks the plane
+                pass

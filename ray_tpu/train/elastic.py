@@ -364,11 +364,22 @@ class TrainerSupervisor:
         total_steps: int,
         checkpoint_root: str,
         config: Optional[ElasticConfig] = None,
+        on_round: Optional[Callable[[int, Callable[[], Any]], None]] = None,
     ):
         self._init_fn = init_fn
         self._grad_fn = grad_fn
         self._apply_fn = apply_fn
         self._batch_fn = batch_fn
+        # post-round hook ``on_round(step, state_fn)``: called after
+        # every SUCCESSFUL round with the step just completed and a
+        # zero-or-one-fetch state thunk (the checkpoint fetch is reused
+        # when the round also checkpointed). This is how a consumer
+        # wires the gang's post-step state into an external plane —
+        # e.g. ``WeightPublisher.publish`` for RL post-training
+        # (rl/post_train) — without coupling the supervisor to it. Hook
+        # exceptions are logged and swallowed: a broken downstream
+        # plane must never fault a healthy gang.
+        self._on_round = on_round
         self._total_steps = int(total_steps)
         self._cfg = config or ElasticConfig()
         self._root = checkpoint_root
@@ -601,6 +612,7 @@ class TrainerSupervisor:
                     # checkpoint when this round CROSSED a cadence
                     # boundary (not only when it landed exactly on one —
                     # steps_per_round need not divide checkpoint_every)
+                    fetched: Optional[Any] = None
                     if (
                         step // cfg.checkpoint_every
                         > (step - n) // cfg.checkpoint_every
@@ -608,6 +620,19 @@ class TrainerSupervisor:
                     ):
                         state = self._fetch_state()
                         self._save(state, step)
+                        fetched = state
+                    if self._on_round is not None:
+                        state_fn = (
+                            (lambda s=fetched: s) if fetched is not None
+                            else self._fetch_state
+                        )
+                        try:
+                            self._on_round(step, state_fn)
+                        except Exception:  # noqa: BLE001 — hook faults stay downstream
+                            logger.warning(
+                                "on_round hook failed at step %d", step,
+                                exc_info=True,
+                            )
                     continue
                 # -- recovery -------------------------------------------------
                 faults = self._last_faults

@@ -174,6 +174,13 @@ class WeightSubscriber:
                 allocator.drop_prefix_cache()
         self.version = version
         self.num_applied += 1
+        # surface the applied version on the engine itself: stats() /
+        # GET /v1/stats report it, so actor/learner version skew is
+        # observable from the serving surface (rl/post_train status)
+        try:
+            engine.weight_version = int(version)
+        except Exception:  # noqa: BLE001 — read-only engine stub
+            pass
         return version
 
     def stats(self) -> dict:

@@ -178,6 +178,43 @@ def test_dropped_spill_is_a_miss_not_an_error():
     assert warm_toks == cold_toks
 
 
+def test_probe_counts_mid_gather_spill_as_host_resident(monkeypatch):
+    """r19 regression (a real tier-1 flake under load): the spill
+    worker pops its batch out of ``_pending`` into ``_gathering``
+    BEFORE the device->host copy; a probe landing inside that window
+    must still read the spilled head as host-resident — ``get()`` would
+    wait and serve it, so the probe must agree, not report the block as
+    evicted-everywhere."""
+    import jax
+
+    sp = SamplingParams(max_tokens=8, temperature=0.0)
+    eng = LLMEngine(_cfg(), seed=0)
+    _gen(eng, SYS + _suffix(1), sp, "warm")
+    hold = threading.Event()
+    entered = threading.Event()
+    real_get = jax.device_get
+
+    def slow_get(x):
+        entered.set()
+        hold.wait(timeout=10.0)  # pin the worker inside the gather
+        return real_get(x)
+
+    monkeypatch.setattr(jax, "device_get", slow_get)
+    try:
+        alloc = eng.allocator
+        taken = alloc.allocate(len(alloc._free) + 2)
+        alloc.free(taken)
+        assert entered.wait(timeout=5.0)  # the worker is mid-gather NOW
+        probe = eng.peek_prefix_tiered(SYS + _suffix(2))
+        assert probe["by_tier"].get("host", 0) == 2 * BS
+    finally:
+        hold.set()
+    # and once the gather lands, the settled state reads the same
+    assert eng.kvtier.flush_spills()
+    probe = eng.peek_prefix_tiered(SYS + _suffix(2))
+    assert probe["by_tier"].get("host", 0) == 2 * BS
+
+
 def test_mid_chain_hbm_blocks_are_adopted_not_recomputed():
     """Head-first eviction spills the chain's FIRST blocks while later
     ones stay sealed in HBM; resurrection must bridge the gap and adopt

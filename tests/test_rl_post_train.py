@@ -1,0 +1,659 @@
+"""ray_tpu.rl.post_train tests: decoupled actor/learner RL post-training.
+
+Contracts under test:
+ * the trajectory plane is bounded by entries AND bytes (drop-oldest,
+   counted) and every trajectory carries weight version + sampler key;
+ * the feeder enforces the staleness contract at consume time (drop or
+   down-weight past ``max_staleness``, worst-admitted staleness audited)
+   and its per-step batch cache makes ``batch_fn`` pure on replay;
+ * starvation (a preempted rollout tier) reuses the previous round
+   instead of faulting the gang;
+ * MUTUAL FAULT ISOLATION: seeded ``KILL_RANK`` during a learner step
+   with an in-flight publish — rollout actors keep serving, no torn
+   weights, same-world-size resume bitwise loss-identical; seeded
+   ``PREEMPT_ENGINE`` on a rollout actor — the learner never faults and
+   the recovered engine resubscribes and catches up to the latest
+   version;
+ * spec-decode rollouts stay token-identical under greedy (the
+   distribution-preserving acceptance rule applied to rollout actors);
+ * the subscriber's weight version surfaces in ``LLMEngine.stats()``
+   and the ``== rl post-train ==`` status block renders version skew /
+   trajectory lag / staleness drops from one snapshot;
+ * the checked-in ``RLHF_post_r19.json`` capture keeps every gate.
+"""
+
+import dataclasses
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_tpu.llm.engine import EngineConfig, LLMEngine
+from ray_tpu.llm.sampling import SamplingParams
+from ray_tpu.models import llama
+from ray_tpu.rl.post_train import (
+    PostTrainConfig,
+    PostTrainLoop,
+    RolloutActor,
+    Trajectory,
+    TrajectoryFeeder,
+    TrajectoryQueue,
+)
+from ray_tpu.rl.post_train.learner import make_batch_fn, make_pg_fns
+from ray_tpu.train.weight_sync import WeightPublisher, WeightSubscriber
+
+pytestmark = pytest.mark.rl_post
+
+FP32_TINY = dataclasses.replace(llama.LLAMA_TINY, dtype=jnp.float32)
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GREEDY = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+
+
+def engine_config(**kw):
+    kw.setdefault("model", FP32_TINY)
+    kw.setdefault("num_blocks", 96)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_num_seqs", 8)
+    kw.setdefault("max_prefill_len", 64)
+    return EngineConfig(**kw)
+
+
+@pytest.fixture(scope="module")
+def tiny_params():
+    return llama.init_params(FP32_TINY, jax.random.key(0))
+
+
+def _traj(i, version=0, p_len=8, o_len=4, reward=None, seed=0):
+    rng = np.random.default_rng(1000 + i + seed)
+    return Trajectory(
+        request_id=f"t{i}",
+        prompt_token_ids=[int(x) for x in rng.integers(3, 500, p_len)],
+        output_token_ids=[int(x) for x in rng.integers(3, 500, o_len)],
+        reward=float(rng.random()) if reward is None else float(reward),
+        weight_version=version,
+        sampler_key=(seed, f"t{i}"),
+    )
+
+
+def _band_reward(prompt, out):
+    return sum(1 for t in out if 3 <= t < 67) / max(1, len(out))
+
+
+# ---------------------------------------------------------------------------
+# trajectory plane: bounded queue
+# ---------------------------------------------------------------------------
+
+
+def test_queue_bytes_bound_drops_oldest_counted():
+    """The byte bound (not just entries) evicts oldest-first and counts
+    every drop — a stalled learner costs trajectories, never memory."""
+    q = TrajectoryQueue(max_entries=10_000, max_bytes=3_000, model_tag="t-qb")
+    for i in range(40):
+        q.put(_traj(i, p_len=16, o_len=8))  # ~392 bytes each
+    assert q.total_bytes() <= 3_000
+    assert q.num_dropped > 0
+    assert q.depth() + q.num_dropped == 40
+    # FIFO of the surviving window: the OLDEST entries were the drops
+    kept = q.take(10_000, timeout_s=0.0)
+    assert [t.request_id for t in kept] == [
+        f"t{i}" for i in range(40 - len(kept), 40)
+    ]
+
+
+def test_queue_oversized_trajectory_dropped_alone():
+    """A single trajectory larger than max_bytes is dropped ITSELF —
+    it must not flush every good entry out of the window first."""
+    q = TrajectoryQueue(max_entries=100, max_bytes=2_000, model_tag="t-qo")
+    for i in range(4):
+        q.put(_traj(i, p_len=16, o_len=8))   # ~392B each: all fit
+    depth_before = q.depth()
+    q.put(_traj(99, p_len=200, o_len=100))   # ~2600B > max_bytes
+    assert q.num_dropped == 1                # the oversized one, alone
+    assert q.depth() == depth_before         # good entries untouched
+    assert all(t.request_id != "t99" for t in q.take(100, timeout_s=0.0))
+
+
+def test_queue_entry_bound_and_bounded_take():
+    q = TrajectoryQueue(max_entries=5, max_bytes=1 << 30, model_tag="t-qe")
+    for i in range(8):
+        q.put(_traj(i))
+    assert q.depth() == 5 and q.num_dropped == 3
+    got = q.take(3, timeout_s=0.0)
+    assert [t.request_id for t in got] == ["t3", "t4", "t5"]
+    # an empty queue parks bounded, then answers empty — never hangs
+    q.take(10, timeout_s=0.0)
+    t0 = time.monotonic()
+    assert q.take(1, timeout_s=0.1) == []
+    assert time.monotonic() - t0 < 2.0
+    # every trajectory carries its provenance stamps
+    t = _traj(99, version=7)
+    assert t.weight_version == 7 and t.sampler_key == (0, "t99")
+
+
+def test_queue_gauge_rejects_out_of_order_snapshot():
+    """Gauge publication is seq-ordered: a put/take snapshot that lost
+    the race to a newer one is discarded, so the depth gauge can never
+    park an older (wrong) value over the current one."""
+    from ray_tpu.rl.post_train import metrics as m
+
+    q = TrajectoryQueue(model_tag="t-qg")
+    for i in range(3):
+        q.put(_traj(i))
+    key = ("t-qg",)
+    assert m.queue_depth_gauge().series()[key] == 3.0
+    # an older snapshot (seq already published past it) must be a no-op
+    q._update_gauges(1, 99, 99_999)
+    assert m.queue_depth_gauge().series()[key] == 3.0
+    assert m.queue_bytes_gauge().series()[key] == q.total_bytes()
+
+
+# ---------------------------------------------------------------------------
+# feeder: staleness contract + replay cache + starvation
+# ---------------------------------------------------------------------------
+
+
+def test_feeder_drops_past_max_staleness_and_audits():
+    q = TrajectoryQueue(model_tag="t-fs")
+    for i in range(3):
+        q.put(_traj(i, version=3, reward=1.0))   # lag 7: dropped (oldest)
+    for i in range(3, 7):
+        q.put(_traj(i, version=10, reward=0.5))
+    for i in range(7, 9):
+        q.put(_traj(i, version=7, reward=1.0))   # lag 3: admitted
+    f = TrajectoryFeeder(
+        q, batch_size=6, max_staleness=4, version_fn=lambda: 10,
+        starvation_timeout_s=0.3, first_batch_timeout_s=0.5,
+        model_tag="t-fs",
+    )
+    batch = f.batch_for_step(0)
+    assert len(batch) == 6
+    assert all(10 - t.weight_version <= 4 for t in batch)
+    assert f.num_stale_dropped == 3
+    assert f.max_trained_staleness == 3  # audited, not asserted
+    # advantages are baseline-centered: they sum to ~0 over the batch
+    assert abs(sum(t.advantage for t in batch)) < 1e-9
+
+
+def test_feeder_down_weight_mode_keeps_but_shrinks():
+    q = TrajectoryQueue(model_tag="t-fd")
+    q.put(_traj(0, version=10, reward=1.0))
+    q.put(_traj(1, version=2, reward=0.0))  # lag 8 = 4 past the bound
+    f = TrajectoryFeeder(
+        q, batch_size=2, max_staleness=4, version_fn=lambda: 10,
+        staleness_mode="down_weight", staleness_decay=0.5,
+        starvation_timeout_s=0.3, first_batch_timeout_s=0.5,
+        model_tag="t-fd",
+    )
+    batch = f.batch_for_step(0)
+    assert len(batch) == 2 and f.num_stale_dropped == 0
+    assert f.num_down_weighted == 1
+    fresh = next(t for t in batch if t.weight_version == 10)
+    stale = next(t for t in batch if t.weight_version == 2)
+    # same |reward - baseline| either side, but the stale one decayed 0.5^4
+    assert abs(stale.advantage) == pytest.approx(
+        abs(fresh.advantage) * 0.5 ** 4)
+
+
+def test_feeder_cache_replay_and_prune():
+    """The purity mechanism: a replayed step returns the IDENTICAL
+    batch (same objects — a recovery retrains on exactly what the first
+    pass trained on), and pruning below the checkpoint horizon drops
+    replay state no restore can reach."""
+    q = TrajectoryQueue(model_tag="t-fc")
+    for i in range(8):
+        q.put(_traj(i, version=0))
+    f = TrajectoryFeeder(
+        q, batch_size=4, max_staleness=4, version_fn=lambda: 0,
+        starvation_timeout_s=0.3, first_batch_timeout_s=0.5,
+        model_tag="t-fc",
+    )
+    b0 = f.batch_for_step(0)
+    b1 = f.batch_for_step(1)
+    assert f.batch_for_step(0) is b0 and f.batch_for_step(1) is b1
+    assert {t.request_id for t in b0}.isdisjoint(
+        {t.request_id for t in b1})
+    assert f.cached_steps() == [0, 1]
+    f.prune_below(1)
+    assert f.cached_steps() == [1]
+
+
+def test_feeder_starvation_reuses_last_round_never_faults():
+    q = TrajectoryQueue(model_tag="t-fv")
+    for i in range(4):
+        q.put(_traj(i, version=0))
+    f = TrajectoryFeeder(
+        q, batch_size=4, max_staleness=4, version_fn=lambda: 0,
+        starvation_timeout_s=0.2, first_batch_timeout_s=0.5,
+        model_tag="t-fv",
+    )
+    b0 = f.batch_for_step(0)
+    t0 = time.monotonic()
+    b1 = f.batch_for_step(1)  # queue is dry: bounded park, then reuse
+    assert time.monotonic() - t0 < 5.0
+    assert b1 is b0
+    assert f.num_reused_rounds == 1
+
+
+def test_feeder_starved_reuse_still_accounts_stale_drops():
+    """A fill that drains ONLY stale trajectories and then starves into
+    the reuse path must still count those drops — the generated ==
+    trained + stale + dropped reconciliation (and the audit surface the
+    bench gates on) cannot lose a whole queue's worth of stale drops to
+    the early return."""
+    q = TrajectoryQueue(model_tag="t-fsr")
+    for i in range(4):
+        q.put(_traj(i, version=10))
+    f = TrajectoryFeeder(
+        q, batch_size=4, max_staleness=4, version_fn=lambda: 10,
+        starvation_timeout_s=0.2, first_batch_timeout_s=0.5,
+        model_tag="t-fsr",
+    )
+    b0 = f.batch_for_step(0)                 # fresh fill seeds _last_batch
+    for i in range(4, 9):
+        q.put(_traj(i, version=1))           # lag 9: all past the bound
+    b1 = f.batch_for_step(1)                 # drains 5 stale, starves, reuses
+    assert b1 is b0 and f.num_reused_rounds == 1
+    assert f.num_stale_dropped == 5          # drained drops still counted
+
+
+# ---------------------------------------------------------------------------
+# weight-version surface
+# ---------------------------------------------------------------------------
+
+
+def test_subscriber_version_surfaces_in_engine_stats(tiny_params):
+    """stats()['weight_version'] (and through it GET /v1/stats) shows
+    the applied publish version — actor/learner skew from one RPC."""
+    engine = LLMEngine(engine_config(), params=tiny_params, seed=0)
+    assert engine.stats()["weight_version"] == 0
+    pub = WeightPublisher(namespace="t-wv")
+    try:
+        tgt = pub.register_rollout("e0", device=engine.kv_cache_device())
+        sub = WeightSubscriber(pub.transport, "e0")
+        p_new = llama.init_params(FP32_TINY, jax.random.key(9))
+        pub.publish(p_new, [tgt], version=5)
+        assert sub.apply_to_engine(engine) == 5
+        assert engine.stats()["weight_version"] == 5
+    finally:
+        pub.close()
+
+
+# ---------------------------------------------------------------------------
+# mutual fault isolation (the tentpole contract)
+# ---------------------------------------------------------------------------
+
+
+def _manual_learner(root, *, gang, namespace, schedule=None, total_steps=10,
+                    publish_every=2):
+    """Deterministic learner-tier harness: a pre-seeded queue (no live
+    rollout thread racing the drain), a real fabric publish plane with a
+    subscribed rollout engine, and the r12 supervisor wired through
+    on_round -> async publisher. Returns (result, rollout_engine,
+    subscriber, publish_worker)."""
+    from ray_tpu.chaos import harness as chaos
+    from ray_tpu.rl.post_train.loop import _PublishWorker
+    from ray_tpu.train.elastic import ElasticConfig, TrainerSupervisor
+
+    q = TrajectoryQueue(model_tag=gang)
+    rng = np.random.default_rng(77)
+    for i in range(300):
+        p = [int(x) for x in rng.integers(3, 500, 12)]
+        o = [int(x) for x in rng.integers(3, 500, 6)]
+        q.put(Trajectory(f"t{i}", p, o, float(rng.random()), 0, (0, f"t{i}")))
+    feeder = TrajectoryFeeder(
+        q, batch_size=8, max_staleness=4, version_fn=lambda: 0,
+        starvation_timeout_s=2.0, first_batch_timeout_s=5.0, model_tag=gang,
+    )
+    init_fn, grad_fn, apply_fn = make_pg_fns(
+        FP32_TINY, learning_rate=1.0, pad_rows=8, pad_len=20)
+    rollout = LLMEngine(engine_config(), params=init_fn(0), seed=0)
+    pub = WeightPublisher(namespace=namespace)
+    tgt = pub.register_rollout("r0", device=rollout.kv_cache_device())
+    sub = WeightSubscriber(pub.transport, "r0")
+    worker = _PublishWorker(pub, [tgt], model_tag=gang)
+
+    def on_round(step, state_fn):
+        if step % publish_every == 0 or step >= total_steps:
+            worker.submit(step, state_fn())
+
+    sup = TrainerSupervisor(
+        init_fn=init_fn, grad_fn=grad_fn, apply_fn=apply_fn,
+        batch_fn=make_batch_fn(feeder), total_steps=total_steps,
+        checkpoint_root=root,
+        config=ElasticConfig(
+            world_size=2, step_timeout_s=6.0, checkpoint_every=3,
+            sharded_checkpoints=False, group_name=gang,
+        ),
+        on_round=on_round,
+    )
+    if schedule is not None:
+        chaos.install(schedule)
+    try:
+        res = sup.fit()
+    finally:
+        if schedule is not None:
+            chaos.uninstall()
+    worker.close(timeout_s=10.0)
+    return res, rollout, sub, worker, pub
+
+
+def test_kill_rank_mid_publish_rollout_keeps_serving_bitwise_resume():
+    """Learner-tier chaos with publishes in flight: KILL_RANK mid-step
+    -> the gang aborts/re-forms/restores/resumes with a BITWISE
+    loss-identical curve (the feeder's cached batches make the replay
+    exact); the rollout engine never sees a torn publish (every applied
+    version verified, zero corrupt) and ends serving the learner's
+    final published weights bitwise."""
+    from ray_tpu.chaos.schedule import FaultSchedule, FaultSpec
+
+    with tempfile.TemporaryDirectory() as root:
+        base, b_roll, b_sub, b_worker, b_pub = _manual_learner(
+            root, gang="t-iso-base", namespace="t-iso-base")
+    assert base.completed and not base.recoveries
+    sched = FaultSchedule(5, [FaultSpec(
+        "kill_rank", site="collective.rendezvous",
+        match={"rank": "1", "group": "t-iso-chaos"},
+        start_after=4, max_fires=1,
+    )])
+    with tempfile.TemporaryDirectory() as root:
+        res, rollout, sub, worker, pub = _manual_learner(
+            root, gang="t-iso-chaos", namespace="t-iso-chaos",
+            schedule=sched)
+    try:
+        assert res.completed
+        assert len(res.recoveries) == 1
+        assert res.recoveries[0].cause == "rank_killed"
+        # bitwise resume: the interrupted curve equals the unbroken one
+        assert res.losses == base.losses
+        # the rollout tier rode it out: publishes applied, none torn
+        applied = sub.apply_to_engine(rollout, timeout_s=0.5)
+        assert applied == 10 or sub.version == 10  # final version landed
+        assert sub.num_corrupt_dropped == 0
+        assert worker.num_failures == 0
+        # ...and the served weights ARE the learner's final state, bitwise
+        for a, b in zip(jax.tree_util.tree_leaves(rollout.params),
+                        jax.tree_util.tree_leaves(res.state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # both runs trained to the same weights, so the two rollout
+        # tiers serve identical greedy continuations
+        prompt = [int(x) for x in np.random.default_rng(2).integers(3, 500, 12)]
+        b_sub.apply_to_engine(b_roll, timeout_s=0.5)
+        assert rollout.generate([prompt], GREEDY) == b_roll.generate(
+            [prompt], GREEDY)
+    finally:
+        pub.close()
+        b_pub.close()
+
+
+def test_rollout_preemption_learner_never_faults_resubscribes():
+    """Rollout-tier chaos through the full loop: seeded PREEMPT_ENGINE
+    kills rollout engines mid-round; the serving recover() ladder rides
+    it out, the learner gang completes with ZERO recoveries, and the
+    recovered engine resubscribes to the newest published version."""
+    from ray_tpu.chaos import harness as chaos
+    from ray_tpu.chaos.schedule import FaultSchedule, FaultSpec
+
+    rng = np.random.default_rng(0)
+    sys_prefix = [int(x) for x in rng.integers(3, 500, 24)]
+    prompts = [sys_prefix + [int(x) for x in rng.integers(3, 500, 4)]
+               for _ in range(3)]
+    cfg = PostTrainConfig(
+        model=FP32_TINY, num_rollout=1, samples_per_prompt=4,
+        max_new_tokens=6, world_size=2, total_steps=8, checkpoint_every=4,
+        publish_every=2, batch_size=12, max_staleness=4, learning_rate=2.0,
+        starvation_timeout_s=4.0, first_batch_timeout_s=60.0,
+        step_timeout_s=10.0, model_tag="t-preempt",
+        namespace="t-preempt",
+    )
+    sched = FaultSchedule(9, [FaultSpec(
+        "preempt_engine", site="llm.engine.step",
+        start_after=20, every_n=40, max_fires=2,
+    )])
+    chaos.install(sched)
+    try:
+        with tempfile.TemporaryDirectory() as root:
+            loop = PostTrainLoop(
+                cfg, engine_config=engine_config(), prompts=prompts,
+                reward_fn=_band_reward, checkpoint_root=root,
+            )
+            res = loop.run()
+    finally:
+        chaos.uninstall()
+    try:
+        assert res.completed and res.error is None
+        assert res.rollout_preemptions >= 1          # chaos actually bit
+        assert len(res.recoveries) == 0              # the gang never faulted
+        assert "preempt_engine" in sched.fired_kinds()
+        # the recovered engine caught up: serving the final version...
+        actor = loop.actors[0]
+        assert actor.engine.weight_version == res.final_version > 0
+        # ...bitwise (resubscribe delivered the learner's state intact)
+        for a, b in zip(jax.tree_util.tree_leaves(actor.engine.params),
+                        jax.tree_util.tree_leaves(res.final_state)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        # staleness contract held under preemption churn
+        assert res.max_trained_staleness <= cfg.max_staleness
+        # publish accounting: one submit per boundary crossing (steps
+        # 2/4/6/8), and run()'s tail resync did NOT re-ship a version
+        # the worker already published (subscribers would drop the
+        # duplicate as stale) — published + coalesced counts every
+        # processed submit exactly once regardless of worker timing
+        assert res.publish_failures == 0
+        assert (loop._pub_worker.num_published
+                + loop._pub_worker.num_coalesced) == 4
+    finally:
+        loop.close()
+
+
+def test_publish_failure_does_not_advance_staleness_clock(tiny_params):
+    """A down fabric counts failures — it must NOT advance the version
+    the feeder judges staleness against, or every fresh rollout would
+    be dropped as stale against a version no engine ever received."""
+    from ray_tpu.rl.post_train.loop import _PublishWorker
+
+    published = []
+    pub = WeightPublisher(namespace="t-pubfail")
+    try:
+        worker = _PublishWorker(
+            pub, [("t-pubfail", "no-such-endpoint")],
+            timeout_s=0.2, model_tag="t-pubfail",
+            on_published=published.append,
+        )
+        worker.submit(4, tiny_params)
+        assert worker.drain(timeout_s=5.0)
+        worker.close(timeout_s=5.0)
+        assert worker.num_failures == 1
+        assert worker.num_published == 0
+        assert published == []          # the staleness clock never ticked
+        assert worker.last_published_version == 0
+    finally:
+        pub.close()
+
+
+# ---------------------------------------------------------------------------
+# the serving stack inside the rollout tier
+# ---------------------------------------------------------------------------
+
+
+def test_spec_rollout_greedy_token_identity(tiny_params):
+    """A spec-decode rollout actor is distribution-preserving: greedy
+    rollouts are token-identical to a plain engine's (the r07 rule,
+    applied to the rollout tier), so drafted trajectories train the
+    same policy."""
+    from ray_tpu.llm.spec import SpecConfig
+
+    prompts = [[7, 8, 9, 7, 8, 9, 7, 8] for _ in range(2)]
+
+    def build(spec):
+        eng = LLMEngine(engine_config(spec=spec), params=tiny_params, seed=0)
+        q = TrajectoryQueue(model_tag="t-spec")
+        sub = type("NullSub", (), {
+            "apply_to_engine": lambda self, e, timeout_s=0.05: None,
+            "version": 0,
+            "stats": lambda self: {},
+        })()
+        actor = RolloutActor(
+            "a0", eng, sub, q, _band_reward,
+            samples_per_prompt=2, max_new_tokens=8, sampling_seed=0,
+            model_tag="t-spec",
+        )
+        actor.run_round(prompts, 0, greedy=True)
+        return {t.request_id: t.output_token_ids
+                for t in q.take(100, timeout_s=0.0)}
+
+    plain = build(None)
+    spec = build(SpecConfig(num_draft_tokens=4, method="prompt_lookup"))
+    # same rids generated (seeded), identical tokens row by row
+    assert plain and plain.keys() == spec.keys()
+    assert plain == spec
+
+
+def test_shared_prompt_rollouts_reuse_prefix_cache(tiny_params):
+    """samples_per_prompt continuations of one prompt re-prefill the
+    shared prefix once: the cached-token ratio the bench gates > 0.5 is
+    visible on a single round."""
+    rng = np.random.default_rng(4)
+    prompts = [[int(x) for x in rng.integers(3, 500, 32)]]
+    eng = LLMEngine(engine_config(), params=tiny_params, seed=0)
+    q = TrajectoryQueue(model_tag="t-pc")
+    sub = type("NullSub", (), {
+        "apply_to_engine": lambda self, e, timeout_s=0.05: None,
+        "version": 0, "stats": lambda self: {},
+    })()
+    actor = RolloutActor("a0", eng, sub, q, _band_reward,
+                         samples_per_prompt=6, max_new_tokens=4,
+                         model_tag="t-pc")
+    rec = actor.run_round(prompts, 0)
+    assert rec["n"] == 6
+    assert rec["cached_token_ratio"] > 0.5
+
+
+def test_run_round_aborts_cleanly_on_stop(tiny_params):
+    """A set stop event ends the round mid-generation: in-flight
+    requests are aborted (the engine is quiescent for the driver's
+    final sync — no thread left inside step()), nothing is scored or
+    pushed, and the round reports None instead of a partial record
+    polluting the reward curve."""
+    import threading
+
+    eng = LLMEngine(engine_config(), params=tiny_params, seed=0)
+    q = TrajectoryQueue(model_tag="t-stop")
+    sub = type("NullSub", (), {
+        "apply_to_engine": lambda self, e, timeout_s=0.05: None,
+        "version": 0, "stats": lambda self: {},
+    })()
+    actor = RolloutActor("a0", eng, sub, q, _band_reward,
+                         samples_per_prompt=2, max_new_tokens=64,
+                         model_tag="t-stop")
+    stop = threading.Event()
+    stop.set()
+    rec = actor.run_round([[7, 8, 9, 10]], 0, stop=stop)
+    assert rec is None
+    assert not eng.has_unfinished()          # aborted, not abandoned
+    assert q.depth() == 0                    # nothing pushed
+    assert actor.num_rounds == 0
+
+
+# ---------------------------------------------------------------------------
+# observability: metrics + `== rl post-train ==` status block
+# ---------------------------------------------------------------------------
+
+
+def test_rl_post_health_and_status_block():
+    from ray_tpu.obs.telemetry import (
+        TelemetryStore,
+        annotated_snapshot,
+        format_status,
+    )
+    from ray_tpu.rl.post_train import metrics as m
+    from ray_tpu.util.metrics import clear_registry
+
+    # version gauges roll up as MAX across every reporting series:
+    # earlier tests' loops must not outbid this test's fixture values
+    clear_registry()
+    tags = {"model": "t-status"}
+    m.weight_version_gauge().set(
+        8.0, tags={**tags, "tier": "learner", "actor": "learner"})
+    # two rollout engines at different versions: the rollup must report
+    # the LAGGARD (min), not let the healthy peer mask it
+    m.weight_version_gauge().set(
+        8.0, tags={**tags, "tier": "rollout", "actor": "a0"})
+    m.weight_version_gauge().set(
+        6.0, tags={**tags, "tier": "rollout", "actor": "a1"})
+    m.queue_depth_gauge().set(12.0, tags=tags)
+    m.trajectories_generated_counter().inc(40.0, tags=tags)
+    m.trajectories_trained_counter().inc(24.0, tags=tags)
+    m.trajectories_dropped_counter().inc(3.0, tags=tags)
+    m.trajectories_stale_counter().inc(2.0, tags=tags)
+    m.publishes_counter().inc(4.0, tags=tags)
+    m.rollout_preemptions_counter().inc(1.0, tags=tags)
+    m.max_trained_staleness_gauge().set(2.0, tags=tags)
+
+    store = TelemetryStore()
+    store.ingest("rl-reporter", annotated_snapshot())
+    health = store.rl_post_health()
+    assert health["version_by_tier"]["learner"] == 8.0
+    assert health["version_by_tier"]["rollout"] == 6.0
+    assert health["queue_depth"] >= 12
+    assert health["dropped_total"] >= 3
+    assert health["stale_dropped_total"] >= 2
+    assert health["rollout_preemptions_total"] >= 1
+    payload = store.status_payload()
+    assert "rl_post" in payload
+    text = format_status({"nodes": [], **payload})
+    assert "== rl post-train ==" in text
+    assert "skew 2" in text
+    assert "rollout preemptions" in text
+    # the whole registry (incl. the rl_post plane) stays lint-clean
+    from ray_tpu.analysis import metrics_registry
+    assert metrics_registry.run_check() == []
+
+
+# ---------------------------------------------------------------------------
+# bench capture gates + smoke
+# ---------------------------------------------------------------------------
+
+
+def test_checked_in_rlhf_capture_gates():
+    """The checked-in chaos capture keeps every r19 gate: completion
+    1.0 with >=1 learner recovery AND >=1 rollout preemption ridden
+    out, reward improved, zero trajectories trained past max_staleness,
+    bitwise publish identity, prefix-cache ratio > 0.5, spec rollouts
+    token-identical."""
+    doc = json.loads(open(
+        os.path.join(REPO, "benchmarks", "RLHF_post_r19.json")
+    ).read())
+    gates = doc["gates"]
+    for name, ok in gates.items():
+        assert ok, f"capture gate failed: {name}"
+    assert doc["all_gates_pass"]
+    assert doc["value"] > 0  # the reward gain itself
+    assert doc["trajectories"]["max_trained_staleness"] <= doc["max_staleness"]
+    assert doc["cached_token_ratio_final"] > 0.5
+    assert doc["spec_rollout"]["token_identical"]
+    assert "speedup" in doc["spec_rollout"]
+    assert doc["learner_recoveries"] and doc["rollout_preemptions"] >= 1
+
+
+@pytest.mark.slow
+def test_rlhf_bench_smoke():
+    """The bench runs end to end as a subprocess (the exact capture
+    path) on a shortened horizon and passes its own gates."""
+    out = os.path.join(tempfile.mkdtemp(), "rlhf.json")
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "rlhf_post_bench.py"),
+         "--steps", "16", "--out", out],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        capture_output=True, text=True, timeout=560,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    doc = json.loads(open(out).read())
+    assert doc["all_gates_pass"]
