@@ -1,0 +1,463 @@
+#!/usr/bin/env python
+"""Serving autoscale capture (r20): the SLO closed loop under a
+diurnal+burst trace -> benchmarks/AUTOSCALE_serving_r20.json.
+
+Three scenarios run the SAME seeded arrival trace through a two-stage
+(prefill -> decode) fluid serving model that observes the REAL SLO
+histograms (llm_ttft/tpot/queue_wait/prefill_span_seconds), ships them
+to a REAL GcsServer over real sockets every tick, and — in the
+autoscaled scenario — closes the loop with the REAL PoolAutoscaler
+fetching ``autoscale_signals`` over the same RPC plane:
+
+ * ``static_underprovisioned``: 1 prefill + 1 decode replica, fixed.
+   The diurnal peak overruns it for hours of sim time — the whole-run
+   SLO grade must come out RED.
+ * ``static_peak``: provisioned for the worst burst (6 prefill +
+   2 decode, fixed). Green, but pays peak replica-seconds around the
+   clock.
+ * ``autoscaled``: starts modest (2+2), the PoolAutoscaler scales each
+   pool independently (TTFT -> prefill, TPOT/queue-wait -> decode),
+   sizes the prefill pool from the measured span distribution, drains
+   idle pools to ZERO in the overnight window, and must end the run
+   green at strictly fewer replica-seconds than ``static_peak``.
+
+Two seeded STALL_GCS blackout windows cover the live
+``autoscale_signals`` RPC mid-run: every blacked-out tick must HOLD
+(zero scale actions during the windows — a blackout is never evidence).
+
+A separate scale-to-zero cycle then runs against a REAL tiny engine:
+the policy drains an idle pool to zero, traffic returns, and
+``cold_start_engine`` brings a replica from nothing to serving over the
+fabric (``WeightPublisher.publish_latest`` — no checkpoint path). The
+capture gates bitwise-identical streamed weights AND first served
+tokens equal to a reference engine holding the published params.
+
+Sim time note: ticks are 1 sim-second but run in compressed wall time,
+so the telemetry store's wall-clock arrival-rate rings would read ~100x
+hot. The signal fetch rescales ONLY ``arrival_rate_per_s`` (and the
+queue-depth gauge, which the sim owns) to sim ground truth; grades,
+hints, span distribution and staleness are the live GCS rollup.
+
+Run: JAX_PLATFORMS=cpu python benchmarks/autoscale_bench.py [--out PATH]
+     [--quick] (short trace — smoke only, not for capture)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import random
+import sys
+import time
+from collections import deque
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MODEL_TAG = "simllm"
+MU_PREFILL = 2.0   # per-replica prefill service rate (req/s)
+MU_DECODE = 4.0    # per-replica decode service rate (req/s)
+SPAN_S = 0.35      # mean prefill service span at healthy load (s)
+TPOT0 = 0.02       # healthy decode time-per-token (s)
+OBS_PER_TICK = 6   # SLO observations per serving tick (uniform weight)
+
+THRESHOLDS = {
+    "ttft_p_s": 1.0,
+    "tpot_p_s": 0.05,
+    "queue_wait_p_s": 0.5,
+    "percentile": 95,
+    "yellow_factor": 2.0,
+    "min_count": 1,
+}
+
+BLACKOUTS = [(100, 110), (170, 180)]
+
+
+def default_trace(quick: bool) -> dict:
+    if quick:
+        return {
+            "kind": "diurnal+burst", "seed": 20, "ticks": 60,
+            "base": 2.0, "amp": 1.6, "period_ticks": 40,
+            "bursts": [[15, 22]], "burst_mult": 1.8, "night_start": 38,
+        }
+    return {
+        "kind": "diurnal+burst", "seed": 20, "ticks": 260,
+        "base": 2.0, "amp": 1.6, "period_ticks": 180,
+        "bursts": [[60, 68], [150, 162]], "burst_mult": 1.8,
+        "night_start": 200,
+    }
+
+
+def arrivals_at(t: int, trace: dict) -> float:
+    """Requests arriving in sim-second t: diurnal sine + burst windows,
+    hard zero in the overnight window."""
+    if t >= trace["night_start"]:
+        return 0.0
+    x = trace["base"] + trace["amp"] * math.sin(
+        2 * math.pi * t / trace["period_ticks"]
+    )
+    x = max(0.0, x)
+    for lo, hi in trace["bursts"]:
+        if lo <= t < hi:
+            x *= trace["burst_mult"]
+    return x
+
+
+class SimCluster:
+    """Two-stage fluid serving model. Replica counts are mutated by the
+    actuator; every tick's served requests observe the real SLO
+    histograms (which is all the GCS — and thus the autoscaler — ever
+    sees)."""
+
+    def __init__(self, n_prefill: int, n_decode: int, seed: int):
+        self.n = {"prefill": n_prefill, "decode": n_decode}
+        self.q_prefill = 0.0
+        self.q_decode = 0.0
+        self.replica_seconds = 0.0
+        self.observations = 0
+        self.rng = random.Random(seed)
+        self._recent = deque(maxlen=5)  # sim-second arrival window
+
+    @property
+    def arrival_rate_per_s(self) -> float:
+        if not self._recent:
+            return 0.0
+        return sum(self._recent) / len(self._recent)
+
+    @property
+    def queue_depth(self) -> float:
+        return self.q_prefill + self.q_decode
+
+    def step(self, arrivals: float, dt: float = 1.0) -> None:
+        from ray_tpu.obs.slo import (
+            prefill_span_histogram,
+            queue_wait_histogram,
+            tpot_histogram,
+            ttft_histogram,
+        )
+
+        self._recent.append(arrivals)
+        n_p, n_d = self.n["prefill"], self.n["decode"]
+        self.replica_seconds += (n_p + n_d) * dt
+
+        cap_p = n_p * MU_PREFILL * dt
+        served_p = min(self.q_prefill + arrivals, cap_p) if cap_p > 0 else 0.0
+        self.q_prefill += arrivals - served_p
+        cap_d = n_d * MU_DECODE * dt
+        served_d = min(self.q_decode + served_p, cap_d) if cap_d > 0 else 0.0
+        self.q_decode += served_p - served_d
+
+        if served_p <= 0:
+            return
+        queue_wait = self.q_prefill / cap_p if cap_p > 0 else 30.0
+        rho_d = (self.q_decode + served_p) / cap_d if cap_d > 0 else 25.0
+        tpot = TPOT0 * max(1.0, rho_d)
+        tags = {"model": MODEL_TAG}
+        for _ in range(OBS_PER_TICK):
+            j = 0.9 + 0.2 * self.rng.random()
+            span = SPAN_S * j
+            queue_wait_histogram().observe(queue_wait * j, tags=tags)
+            ttft_histogram().observe(queue_wait * j + span, tags=tags)
+            tpot_histogram().observe(tpot * j, tags=tags)
+            prefill_span_histogram().observe(span, tags=tags)
+        self.observations += OBS_PER_TICK
+
+
+class SimActuator:
+    """PoolActuator over the sim: targets apply instantly (the sim has
+    no drain latency; the drain path itself is exercised by the chaos
+    tier-1 tests against real replicas)."""
+
+    def __init__(self, sim: SimCluster):
+        self.sim = sim
+        self.cold_starts = 0
+
+    def pool_state(self) -> dict:
+        return {
+            pool: {"replicas_running": n, "replicas_target": n}
+            for pool, n in self.sim.n.items()
+        }
+
+    def apply(self, decision) -> None:
+        if decision.action == "cold_start":
+            self.cold_starts += 1
+        self.sim.n[decision.pool] = int(decision.target)
+
+
+def run_scenario(
+    name: str,
+    trace: dict,
+    n_prefill: int,
+    n_decode: int,
+    autoscaled: bool,
+    blackouts=(),
+) -> dict:
+    from ray_tpu import chaos
+    from ray_tpu.autoscale import AutoscaleConfig, PoolAutoscaler, PoolLimits
+    from ray_tpu.cluster.gcs_service import GcsServer
+    from ray_tpu.cluster.rpc import ReconnectingRpcClient
+    from ray_tpu.obs.telemetry import annotated_snapshot
+    from ray_tpu.util.metrics import clear_registry
+
+    clear_registry()
+    sim = SimCluster(n_prefill, n_decode, seed=trace["seed"])
+    server = GcsServer(port=0, node_death_timeout_s=3600.0)
+    host, port = server.start()
+    push = ReconnectingRpcClient(host, port, timeout=10).connect()
+    sig_client = ReconnectingRpcClient(host, port, timeout=10).connect()
+    auto = None
+    blackout_actions = 0
+    try:
+        if autoscaled:
+            def fetch():
+                payload = sig_client.call(
+                    "autoscale_signals", {"thresholds": THRESHOLDS}, timeout=5
+                )
+                # compressed sim time: rescale the wall-clock-windowed
+                # arrival rate (and the engine-owned queue gauge the sim
+                # stands in for) to sim ground truth; see module docstring
+                payload.setdefault("prefill_span", {})[
+                    "arrival_rate_per_s"] = sim.arrival_rate_per_s
+                payload.setdefault("utilization", {})[
+                    "queue_depth"] = sim.queue_depth
+                return payload
+
+            cfg = AutoscaleConfig(
+                pools={
+                    "prefill": PoolLimits(min_replicas=0, max_replicas=6),
+                    "decode": PoolLimits(min_replicas=0, max_replicas=4),
+                },
+                breach_ticks=2,
+                green_ticks=5,
+                scale_up_cooldown_s=2.0,
+                scale_down_cooldown_s=8.0,
+                idle_to_zero_s=15.0,
+                prefill_target_utilization=0.5,
+                max_step=1,
+            )
+            auto = PoolAutoscaler(cfg, SimActuator(sim), fetch_signals=fetch)
+
+        for t in range(trace["ticks"]):
+            for lo, hi in blackouts:
+                if t == lo:
+                    chaos.install(chaos.FaultSchedule(trace["seed"], [
+                        chaos.FaultSpec(
+                            chaos.STALL_GCS, site="gcs.call",
+                            match={"method": "autoscale_signals"},
+                            max_fires=hi - lo,
+                        ),
+                    ]))
+                elif t == hi:
+                    chaos.uninstall()
+            sim.step(arrivals_at(t, trace))
+            push.call("telemetry_push", {
+                "reporter_id": "sim0", "kind": "engine", "role": "prefill",
+                "snapshot": annotated_snapshot(),
+            }, timeout=10)
+            if auto is not None:
+                auto.tick(now=float(t))
+
+        report = sig_client.call(
+            "autoscale_signals", {"thresholds": THRESHOLDS}, timeout=10
+        )
+        entry = (report.get("slo", {}).get("model_tags") or {}).get(
+            MODEL_TAG, {})
+        out = {
+            "prefill_start": n_prefill,
+            "decode_start": n_decode,
+            "slo_grade": entry.get("grade", "no_data"),
+            "slo": {
+                short: {
+                    "grade": (entry.get(short) or {}).get("grade"),
+                    "p95_s": (entry.get(short) or {}).get("p95_s"),
+                }
+                for short in ("ttft", "tpot", "queue_wait")
+            },
+            "replica_seconds": round(sim.replica_seconds, 1),
+            "observations": sim.observations,
+        }
+        if auto is not None:
+            log = auto.decision_log()
+            mix: dict = {}
+            for e in log:
+                mix[e["action"]] = mix.get(e["action"], 0) + 1
+            for e in log:
+                if e["action"] != "hold" and any(
+                    lo <= e["t"] < hi for lo, hi in blackouts
+                ):
+                    blackout_actions += 1
+            out.update({
+                "scale_ups": mix.get("scale_up", 0),
+                "scale_downs": mix.get("scale_down", 0),
+                "scale_to_zero": mix.get("scale_to_zero", 0),
+                "cold_starts": mix.get("cold_start", 0),
+                "decision_mix": mix,
+                "final_pools": dict(sim.n),
+                "ticks_dark": auto.num_dark_ticks,
+                "scale_actions_during_blackout": blackout_actions,
+            })
+        print(f"  {name}: grade={out['slo_grade']} "
+              f"replica_seconds={out['replica_seconds']}"
+              + (f" ups={out['scale_ups']} downs={out['scale_downs']} "
+                 f"to_zero={out['scale_to_zero']} "
+                 f"dark={out['ticks_dark']}" if auto else ""))
+        return out
+    finally:
+        chaos.uninstall()
+        push.close()
+        sig_client.close()
+        server.stop()
+        clear_registry()
+
+
+def bench_scale_to_zero(seed: int) -> dict:
+    """Policy-driven scale-to-zero, then a fabric cold start against a
+    REAL tiny engine: streamed weights must be bitwise identical to the
+    published bundle and the first served tokens must equal a reference
+    engine already holding those weights."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    from ray_tpu.autoscale import (
+        AutoscaleConfig,
+        PoolLimits,
+        PoolPolicy,
+        PoolSignals,
+        cold_start_engine,
+    )
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine
+    from ray_tpu.llm.sampling import SamplingParams
+    from ray_tpu.models import llama
+    from ray_tpu.train.weight_sync import WeightPublisher, WeightSubscriber
+
+    tiny = dataclasses.replace(llama.LLAMA_TINY, dtype=jnp.float32)
+    ec = EngineConfig(
+        model=tiny, num_blocks=96, block_size=8, max_num_seqs=8,
+        max_prefill_len=64,
+    )
+    learner_params = llama.init_params(tiny, jax.random.key(seed))
+    pub = WeightPublisher(namespace=f"autoscale-bench-{os.getpid()}")
+
+    # the fleet before the trough: one serving replica at published v1
+    ref = LLMEngine(ec, seed=0)
+    tgt = pub.register_rollout("ref0", device=ref.kv_cache_device())
+    pub.publish(learner_params, [tgt], version=1)
+    WeightSubscriber(pub.transport, "ref0").apply_to_engine(ref)
+
+    pol = PoolPolicy(AutoscaleConfig(
+        pools={"decode": PoolLimits(min_replicas=0, max_replicas=4)},
+        idle_to_zero_s=5.0,
+        scale_down_cooldown_s=0.0,
+        scale_up_cooldown_s=0.0,
+    ))
+    idle = PoolSignals(grade="green", running=1, target=1)
+    assert pol.decide("decode", idle, now=0.0).action == "hold"
+    down = pol.decide("decode", idle, now=6.0)
+    assert down.action == "scale_to_zero" and down.target == 0
+
+    # overnight passes; traffic returns to a parked pool
+    wake = pol.decide(
+        "decode", PoolSignals(running=0, target=0, queue_depth=3.0), now=900.0
+    )
+    assert wake.action == "cold_start" and wake.target >= 1
+
+    engine, report = cold_start_engine(
+        lambda: LLMEngine(ec, seed=1), pub, "cold0",
+        pool="decode", reference_params=learner_params,
+    )
+    greedy = SamplingParams(max_tokens=8, temperature=0.0, ignore_eos=True)
+    prompts = [[5, 6, 7, 8], [9, 10, 11]]
+    served = engine.generate(prompts, greedy)
+    reference = ref.generate(prompts, greedy)
+    out = {
+        "cycles": 1,
+        "scale_to_zero_reason": down.reason,
+        "cold_start_reason": wake.reason,
+        "cold_start_s": report.seconds,
+        "weight_version": report.weight_version,
+        "bitwise_identical": report.bitwise_identical,
+        "tokens_match_reference": served == reference,
+        "first_served_tokens": served[0],
+    }
+    print(f"  scale_to_zero: cold_start_s={report.seconds:.3f} "
+          f"v{report.weight_version} bitwise={report.bitwise_identical} "
+          f"tokens_match={out['tokens_match_reference']}")
+    return out
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "AUTOSCALE_serving_r20.json"))
+    p.add_argument("--quick", action="store_true",
+                   help="short trace smoke run (not for capture)")
+    p.add_argument("--skip-engine", action="store_true",
+                   help="skip the real-engine cold-start phase")
+    args = p.parse_args()
+
+    trace = default_trace(args.quick)
+    blackouts = [] if args.quick else BLACKOUTS
+    print(f"autoscale bench: {trace['ticks']} sim-s diurnal+burst trace, "
+          f"blackouts at {blackouts}")
+
+    static_under = run_scenario("static_underprovisioned", trace, 1, 1, False)
+    static_peak = run_scenario("static_peak", trace, 6, 2, False)
+    auto = run_scenario("autoscaled", trace, 2, 2, True, blackouts=blackouts)
+
+    cz = None
+    if not args.skip_engine:
+        cz = bench_scale_to_zero(trace["seed"])
+
+    cap = {
+        "bench": "autoscale_serving",
+        "rev": "r20",
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "trace": trace,
+        "thresholds": THRESHOLDS,
+        "sim": {"mu_prefill": MU_PREFILL, "mu_decode": MU_DECODE,
+                "span_s": SPAN_S, "tpot0_s": TPOT0,
+                "obs_per_tick": OBS_PER_TICK},
+        "static_underprovisioned": static_under,
+        "static_peak": static_peak,
+        "autoscaled": auto,
+        "scale_to_zero": cz,
+        "blackout": {
+            "windows": len(blackouts),
+            "ranges": [list(w) for w in blackouts],
+            "ticks_dark": auto.get("ticks_dark", 0),
+            "scale_actions_during_blackout":
+                auto.get("scale_actions_during_blackout", 0),
+        },
+    }
+    gate = {
+        "static_under_red": static_under["slo_grade"] == "red",
+        "autoscaled_green": auto["slo_grade"] == "green",
+        "autoscaled_cheaper_than_peak":
+            auto["replica_seconds"] < static_peak["replica_seconds"],
+        "scaled_both_ways":
+            auto.get("scale_ups", 0) >= 1 and auto.get("scale_downs", 0) >= 1,
+        "scaled_to_zero": auto.get("scale_to_zero", 0) >= 1,
+        "blackout_never_acted":
+            not blackouts
+            or (auto.get("ticks_dark", 0) >= 1
+                and auto.get("scale_actions_during_blackout", 0) == 0),
+        "cold_start_bitwise":
+            cz is None or (cz["bitwise_identical"]
+                           and cz["tokens_match_reference"]),
+    }
+    cap["gate"] = gate
+    with open(args.out, "w") as f:
+        json.dump(cap, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {args.out}")
+    ok = all(gate.values())
+    print("gate:", "PASS" if ok else f"FAIL {gate}")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,283 @@
+#!/usr/bin/env python
+"""Control-plane throughput capture (r20): batched vs unbatched GCS hot
+paths -> benchmarks/CONTROLPLANE_gcs_r20.json.
+
+What it measures, against a REAL GcsServer over real sockets:
+
+ * heartbeat + telemetry-piggyback ingest at several simulated node
+   counts: N individual ``heartbeat`` RPCs per round vs ONE
+   ``heartbeat_batch`` frame carrying the same N beats (one table-lock
+   acquisition, one telemetry-store lock acquisition per frame) — the
+   r20 gate requires the batched path to sustain strictly more ops/sec
+   at the largest node count;
+ * telemetry convergence under faults: seq gaps (dropped pushes) and a
+   process-epoch restart mid-stream must cost freshness only — the
+   aggregated counter must equal ground truth EXACTLY;
+ * batched lease grants: K ``request_worker_lease`` round-trips vs one
+   ``request_worker_lease_batch`` frame against a real node daemon with
+   a warmed worker pool (measured over grant+release cycles).
+
+Run: JAX_PLATFORMS=cpu python benchmarks/controlplane_bench.py [--out PATH]
+     [--quick] (smaller node counts / rounds — smoke only, not captured)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _snap(node: str, seq: int, total: float, epoch: str = "e1") -> dict:
+    """A minimal valid telemetry snapshot: one summed counter series.
+    Hand-rolled (not snapshot_registry) so every simulated node ships a
+    distinct reporter payload without sharing this process's registry."""
+    return {
+        "epoch": f"{node}-{epoch}", "seq": seq,
+        "ts_monotonic": float(seq), "ts_wall": time.time(),
+        "metrics": [{
+            "name": "ray_tpu_bench_ops_total", "type": "counter",
+            "description": "", "tag_keys": ["node"], "agg": "sum",
+            "series": [{"tags": [node], "value": float(total)}],
+        }],
+    }
+
+
+def _register_nodes(client, n: int, prefix: str) -> list:
+    nodes = [f"{prefix}-{i}" for i in range(n)]
+    for nid in nodes:
+        client.call("register_node", {
+            "node_id": nid, "addr": ("127.0.0.1", 0),
+            "resources": {"CPU": 4}, "labels": {},
+        }, timeout=10)
+    return nodes
+
+
+def bench_ingest(client, node_counts, rounds: int) -> list:
+    """Unbatched vs batched heartbeat+telemetry ingest throughput."""
+    results = []
+    for n in node_counts:
+        nodes = _register_nodes(client, n, f"hb{n}")
+        seq = 0
+
+        # unbatched: N RPCs per round, each a full socket round-trip
+        seq += 1
+        for nid in nodes:  # warm the reporter entries
+            client.call("heartbeat", {
+                "node_id": nid, "telemetry": _snap(nid, seq, seq * 2.0),
+            }, timeout=10)
+        t0 = time.monotonic()
+        for _ in range(rounds):
+            seq += 1
+            for nid in nodes:
+                client.call("heartbeat", {
+                    "node_id": nid, "available": {"CPU": 3.0},
+                    "telemetry": _snap(nid, seq, seq * 2.0),
+                }, timeout=10)
+        unbatched_s = time.monotonic() - t0
+        unbatched_ops = rounds * n
+
+        # batched: one heartbeat_batch frame per round, same beat volume
+        t0 = time.monotonic()
+        for _ in range(rounds):
+            seq += 1
+            out = client.call("heartbeat_batch", {"heartbeats": [
+                {"node_id": nid, "available": {"CPU": 3.0},
+                 "telemetry": _snap(nid, seq, seq * 2.0)}
+                for nid in nodes
+            ]}, timeout=30)
+            assert out["ok"] and all(r.get("ok") for r in out["results"])
+        batched_s = time.monotonic() - t0
+        batched_ops = rounds * n
+
+        results.append({
+            "nodes": n,
+            "rounds": rounds,
+            "unbatched_ops_per_s": round(unbatched_ops / max(unbatched_s, 1e-9), 1),
+            "batched_ops_per_s": round(batched_ops / max(batched_s, 1e-9), 1),
+            "unbatched_wall_s": round(unbatched_s, 4),
+            "batched_wall_s": round(batched_s, 4),
+            "speedup": round(unbatched_s / max(batched_s, 1e-9), 2),
+        })
+        print(f"  ingest nodes={n}: unbatched "
+              f"{results[-1]['unbatched_ops_per_s']:.0f} ops/s, batched "
+              f"{results[-1]['batched_ops_per_s']:.0f} ops/s "
+              f"({results[-1]['speedup']}x)")
+    return results
+
+
+def bench_convergence(client) -> dict:
+    """Drops + an epoch restart through the BATCHED ingest path must
+    leave the aggregated counter exactly at ground truth."""
+    client.call("register_node", {
+        "node_id": "conv0", "addr": ("127.0.0.1", 0),
+        "resources": {"CPU": 1}, "labels": {},
+    }, timeout=10)
+    dropped = 0
+    # epoch e1: counts to 40 over 8 pushes; seqs 3..6 are lost in flight
+    for seq in range(1, 9):
+        if 3 <= seq <= 6:
+            dropped += 1
+            continue
+        client.call("heartbeat_batch", {"heartbeats": [
+            {"node_id": "conv0", "telemetry": _snap("conv0", seq, seq * 5.0)},
+        ]}, timeout=10)
+    # process restart: epoch e2 counts from zero (the store must bank
+    # e1's final 40, not conflate the reset with a decrease)
+    for seq in range(1, 4):
+        client.call("heartbeat_batch", {"heartbeats": [
+            {"node_id": "conv0",
+             "telemetry": _snap("conv0", seq, seq * 7.0, epoch="e2")},
+        ]}, timeout=10)
+    # duplicate delivery of an old frame: must be seq-dropped
+    out = client.call("heartbeat_batch", {"heartbeats": [
+        {"node_id": "conv0",
+         "telemetry": _snap("conv0", 1, 7.0, epoch="e2")},
+    ]}, timeout=10)
+    assert out["results"][0].get("ok")
+
+    ground_truth = 8 * 5.0 + 3 * 7.0  # banked e1 final + live e2 total
+    status = client.call("telemetry_prometheus", {}, timeout=10)
+    aggregated = None
+    for line in status.splitlines():
+        if line.startswith("ray_tpu_bench_ops_total") and 'node="conv0"' in line:
+            aggregated = float(line.rsplit(" ", 1)[1])
+    conv = {
+        "pushes_dropped": dropped,
+        "epoch_restarts": 1,
+        "duplicates_replayed": 1,
+        "counter_aggregated": aggregated,
+        "counter_ground_truth": ground_truth,
+        "exact": aggregated == ground_truth,
+    }
+    print(f"  convergence: aggregated={aggregated} ground={ground_truth} "
+          f"exact={conv['exact']}")
+    return conv
+
+
+def bench_lease_batch(rounds: int, k: int) -> dict:
+    """Grant+release cycles against a real node daemon: K sequential
+    ``request_worker_lease`` calls vs one ``request_worker_lease_batch``
+    frame, over a warmed idle-worker pool (no spawn cost in the loop)."""
+    from ray_tpu.cluster import LocalCluster
+    from ray_tpu.cluster.rpc import ReconnectingRpcClient
+
+    out = {"k": k, "rounds": rounds}
+    with LocalCluster(node_death_timeout_s=5.0) as cluster:
+        cluster.start()
+        node = cluster.add_node(resources={"num_cpus": float(k)})
+        cluster.wait_for_nodes(1)
+        daemon = ReconnectingRpcClient(*node.addr, timeout=30).connect()
+        spec = {"resources": {"num_cpus": 1.0}}
+
+        def release_all(grants):
+            for g in grants:
+                daemon.call("release_lease", {"lease_id": g["lease_id"]},
+                            timeout=10)
+
+        def grant_unbatched():
+            grants = []
+            deadline = time.monotonic() + 60
+            while len(grants) < k and time.monotonic() < deadline:
+                r = daemon.call("request_worker_lease",
+                                {**spec, "queue_timeout": 30.0}, timeout=60)
+                if "grant" in r:
+                    grants.append(r["grant"])
+            return grants
+
+        def grant_batched():
+            grants = []
+            deadline = time.monotonic() + 60
+            while len(grants) < k and time.monotonic() < deadline:
+                r = daemon.call("request_worker_lease_batch", {
+                    "requests": [spec] * (k - len(grants)),
+                }, timeout=60)
+                grants.extend(g["grant"] for g in r["grants"] if "grant" in g)
+                if len(grants) < k:
+                    time.sleep(0.05)
+            return grants
+
+        # warm the idle pool: spawn all K workers once, then return them
+        release_all(grant_unbatched())
+
+        t0 = time.monotonic()
+        for _ in range(rounds):
+            release_all(grant_unbatched())
+        out["unbatched_grants_per_s"] = round(
+            rounds * k / max(time.monotonic() - t0, 1e-9), 1)
+
+        t0 = time.monotonic()
+        for _ in range(rounds):
+            release_all(grant_batched())
+        out["batched_grants_per_s"] = round(
+            rounds * k / max(time.monotonic() - t0, 1e-9), 1)
+        daemon.close()
+    print(f"  lease k={k}: unbatched {out['unbatched_grants_per_s']}/s, "
+          f"batched {out['batched_grants_per_s']}/s")
+    return out
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "CONTROLPLANE_gcs_r20.json"))
+    p.add_argument("--quick", action="store_true",
+                   help="small smoke run (not for capture)")
+    p.add_argument("--rounds", type=int, default=0)
+    p.add_argument("--skip-lease", action="store_true")
+    args = p.parse_args()
+
+    node_counts = [4, 16] if args.quick else [4, 16, 48]
+    rounds = args.rounds or (5 if args.quick else 30)
+
+    from ray_tpu.cluster.gcs_service import GcsServer
+    from ray_tpu.cluster.rpc import ReconnectingRpcClient
+
+    server = GcsServer(port=0, node_death_timeout_s=3600.0)
+    host, port = server.start()
+    try:
+        client = ReconnectingRpcClient(host, port, timeout=30).connect()
+        print(f"control-plane bench: GCS at {host}:{port}, "
+              f"node counts {node_counts}, {rounds} rounds")
+        results = bench_ingest(client, node_counts, rounds)
+        convergence = bench_convergence(client)
+        client.close()
+    finally:
+        server.stop()
+
+    lease = None
+    if not args.skip_lease:
+        lease = bench_lease_batch(rounds=3 if args.quick else 10, k=4)
+
+    largest = max(results, key=lambda r: r["nodes"])
+    cap = {
+        "bench": "controlplane_gcs",
+        "rev": "r20",
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "node_counts": node_counts,
+        "rounds": rounds,
+        "results": results,
+        "convergence": convergence,
+        "lease": lease,
+        "gate": {
+            "batched_beats_unbatched_at_largest":
+                largest["batched_ops_per_s"] > largest["unbatched_ops_per_s"],
+            "convergence_exact": convergence["exact"],
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(cap, f, indent=2, sort_keys=True)
+        f.write("\n")
+    print(f"wrote {args.out}")
+    ok = (cap["gate"]["batched_beats_unbatched_at_largest"]
+          and cap["gate"]["convergence_exact"])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

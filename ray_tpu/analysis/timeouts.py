@@ -85,6 +85,9 @@ SCAN_DIRS = (
     # a wedged publish must park in bounded slices (the learner gang's
     # fault detector must never be the thing that notices)
     "ray_tpu/rl/post_train",
+    # r20: the autoscale controller — signal fetches and actuator calls
+    # cross the RPC plane, so every wait must carry its bound
+    "ray_tpu/autoscale",
 )
 
 

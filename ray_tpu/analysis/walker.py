@@ -37,6 +37,9 @@ DEFAULT_PACKAGES = (
     # feeder batch cache, and the async publish worker are all
     # lock-guarded structures shared across the two tiers' threads
     "ray_tpu/rl/post_train",
+    # r20: the autoscale control loop — a controller thread ticking
+    # against GCS telemetry while actuators mutate shared pool maps
+    "ray_tpu/autoscale",
 )
 
 

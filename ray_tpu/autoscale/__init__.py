@@ -1,0 +1,74 @@
+"""ray_tpu.autoscale — SLO closed-loop pool autoscaler (r20).
+
+Closes ROADMAP item 2's loop: the r11 telemetry plane already grades
+every model tag's TTFT/TPOT/queue-wait and emits ``autoscaler_hints``;
+the serve controller (r10) exposes role-tagged pools with graceful
+drain; the fabric weight plane (r15) can stream current weights to a
+brand-new replica. This package is the controller in the middle:
+
+* ``PoolPolicy`` / ``PoolAutoscaler`` — pure decision ladder + the loop
+  driving it (prefill and decode scale independently; hysteresis +
+  cooldowns; HOLD on a dark GCS; scale-down always via drain).
+* ``size_prefill_pool`` — replica count from the measured prefill-span
+  distribution (Little's law at a target utilization).
+* ``cold_start_engine`` — zero -> serving via fabric weight streaming,
+  bitwise-identical to the publisher, no checkpoint path.
+* ``demand`` — the ONE bin-pack planning core shared with the seed
+  node autoscalers (``ray_tpu.autoscaler``), whose pending-demand feed
+  is one input signal here.
+"""
+
+from ray_tpu.autoscale.actuators import (
+    EnginePoolActuator,
+    PoolActuator,
+    ServePoolActuator,
+)
+from ray_tpu.autoscale.coldstart import (
+    ColdStartReport,
+    cold_start_engine,
+    params_bitwise_equal,
+)
+from ray_tpu.autoscale.config import (
+    POOL_DECODE,
+    POOL_PREFILL,
+    AutoscaleConfig,
+    PoolLimits,
+)
+from ray_tpu.autoscale.controller import PoolAutoscaler, signals_from_payload
+from ray_tpu.autoscale.policy import (
+    ACTION_COLD_START,
+    ACTION_HOLD,
+    ACTION_SCALE_DOWN,
+    ACTION_SCALE_TO_ZERO,
+    ACTION_SCALE_UP,
+    Decision,
+    PoolPolicy,
+    PoolSignals,
+    size_prefill_pool,
+    span_mean_from_histogram,
+)
+
+__all__ = [
+    "ACTION_COLD_START",
+    "ACTION_HOLD",
+    "ACTION_SCALE_DOWN",
+    "ACTION_SCALE_TO_ZERO",
+    "ACTION_SCALE_UP",
+    "AutoscaleConfig",
+    "ColdStartReport",
+    "Decision",
+    "EnginePoolActuator",
+    "POOL_DECODE",
+    "POOL_PREFILL",
+    "PoolActuator",
+    "PoolAutoscaler",
+    "PoolLimits",
+    "PoolPolicy",
+    "PoolSignals",
+    "ServePoolActuator",
+    "cold_start_engine",
+    "params_bitwise_equal",
+    "signals_from_payload",
+    "size_prefill_pool",
+    "span_mean_from_histogram",
+]

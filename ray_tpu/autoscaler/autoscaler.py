@@ -15,6 +15,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ray_tpu.autoscale.demand import fits as _shared_fits, plan_launches
 from ray_tpu.autoscaler.node_provider import NodeProvider
 from ray_tpu.utils.logging import get_logger
 
@@ -35,8 +36,9 @@ class AutoscalerConfig:
     interval_s: float = 1.0
 
 
-def _fits(req: dict, cap: dict) -> bool:
-    return all(cap.get(k, 0.0) >= v for k, v in req.items())
+# bin-pack core lives in ray_tpu.autoscale.demand (r20: one brain);
+# re-exported under the historical name for existing importers
+_fits = _shared_fits
 
 
 class StandardAutoscaler:
@@ -119,31 +121,11 @@ class StandardAutoscaler:
         demand = self.pending_demand()
         if not demand:
             return
-        # first-fit-decreasing bin pack of unmet demand onto new nodes
-        demand.sort(key=lambda d: -sum(d.values()))
-        planned: list[dict] = []  # remaining capacity of nodes we'll launch
-        planned_types: list[str] = []
-        for req in demand:
-            placed = False
-            for cap in planned:
-                if _fits(req, cap):
-                    for k, v in req.items():
-                        cap[k] = cap.get(k, 0.0) - v
-                    placed = True
-                    break
-            if placed:
-                continue
-            for tname, tcfg in self.config.node_types.items():
-                if _fits(req, tcfg.resources) and self._count(tname) + planned_types.count(tname) < tcfg.max_workers:
-                    cap = dict(tcfg.resources)
-                    for k, v in req.items():
-                        cap[k] = cap.get(k, 0.0) - v
-                    planned.append(cap)
-                    planned_types.append(tname)
-                    placed = True
-                    break
-            if not placed:
-                logger.warning("demand %s fits no configured node type", req)
+        planned_types, unplaced = plan_launches(
+            demand, self.config.node_types, self._count
+        )
+        for req in unplaced:
+            logger.warning("demand %s fits no configured node type", req)
         for tname in planned_types:
             self._launch(tname, self.config.node_types[tname])
 

@@ -19,7 +19,8 @@ import threading
 import time
 from typing import Optional
 
-from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, NodeTypeConfig, _fits
+from ray_tpu.autoscale.demand import plan_launches
+from ray_tpu.autoscaler.autoscaler import AutoscalerConfig, NodeTypeConfig
 from ray_tpu.autoscaler.node_provider import NodeProvider
 from ray_tpu.utils.logging import get_logger
 
@@ -172,7 +173,6 @@ class ClusterAutoscaler:
                 if time.time() - v[1] <= self._launch_grace_s
             }
             return
-        demand.sort(key=lambda d: -sum(d.values()))
         # seed the plan with capacity already launched but not yet
         # absorbed, so repeat ticks don't re-buy the same demand
         now = time.time()
@@ -180,33 +180,12 @@ class ClusterAutoscaler:
             k: v for k, v in self._launching.items()
             if now - v[1] <= self._launch_grace_s
         }
-        planned: list[dict] = [dict(res) for res, _ in self._launching.values()]
-        planned_types: list[str] = []
-        for req in demand:
-            placed = False
-            for cap in planned:
-                if _fits(req, cap):
-                    for k, v in req.items():
-                        cap[k] = cap.get(k, 0.0) - v
-                    placed = True
-                    break
-            if placed:
-                continue
-            for tname, tcfg in self.config.node_types.items():
-                if (
-                    _fits(req, tcfg.resources)
-                    and self._count(tname) + planned_types.count(tname)
-                    < tcfg.max_workers
-                ):
-                    cap = dict(tcfg.resources)
-                    for k, v in req.items():
-                        cap[k] = cap.get(k, 0.0) - v
-                    planned.append(cap)
-                    planned_types.append(tname)
-                    placed = True
-                    break
-            if not placed:
-                logger.warning("demand %s fits no configured node type", req)
+        planned_types, unplaced = plan_launches(
+            demand, self.config.node_types, self._count,
+            seed_capacity=[res for res, _ in self._launching.values()],
+        )
+        for req in unplaced:
+            logger.warning("demand %s fits no configured node type", req)
         for tname in planned_types:
             self._launch(tname, self.config.node_types[tname])
 

@@ -467,35 +467,63 @@ class GcsService:
              "num_restarts": a.num_restarts},
         )
 
+    def _heartbeat_locked(self, payload) -> dict:
+        """Table-side of one heartbeat; caller holds ``self._lock``.
+        Telemetry piggybacks are the CALLER's job (outside the table
+        lock: the store has its own) — and only for accepted beats, so a
+        node told to re-register never sneaks metrics in under a stale
+        registration."""
+        e = self._nodes.get(payload["node_id"])
+        if e is None or not e.alive:
+            # unknown/dead node: tell it to re-register (GCS restart or
+            # it was declared dead while partitioned)
+            return {"ok": False, "reregister": True}
+        if e.pending_reconcile:
+            # restored-from-snapshot claim: keep the lease fresh (the
+            # node IS alive — it just proved it) but demand a full
+            # re-registration so its ground-truth report arrives
+            e.last_hb = time.monotonic()
+            return {"ok": False, "reregister": True}
+        e.last_hb = time.monotonic()
+        if "available" in payload:
+            e.available = dict(payload["available"])
+        e.pending = list(payload.get("pending", ()))
+        if payload.get("draining") and not e.draining:
+            e.draining = True
+            self._emit("node_draining", {"node_id": e.node_id})
+        return {"ok": True}
+
     def rpc_heartbeat(self, payload, peer):
         with self._lock:
-            e = self._nodes.get(payload["node_id"])
-            if e is None or not e.alive:
-                # unknown/dead node: tell it to re-register (GCS restart or
-                # it was declared dead while partitioned)
-                return {"ok": False, "reregister": True}
-            if e.pending_reconcile:
-                # restored-from-snapshot claim: keep the lease fresh (the
-                # node IS alive — it just proved it) but demand a full
-                # re-registration so its ground-truth report arrives
-                e.last_hb = time.monotonic()
-                return {"ok": False, "reregister": True}
-            e.last_hb = time.monotonic()
-            if "available" in payload:
-                e.available = dict(payload["available"])
-            e.pending = list(payload.get("pending", ()))
-            if payload.get("draining") and not e.draining:
-                e.draining = True
-                self._emit("node_draining", {"node_id": e.node_id})
+            out = self._heartbeat_locked(payload)
         snap = payload.get("telemetry")
-        if snap:
+        if snap and out.get("ok"):
             # piggybacked metrics snapshot (outside the table lock: the
             # store has its own); a STALL_HEARTBEAT partition shows up as
             # telemetry staleness for exactly the stalled node
             self.telemetry.ingest(
                 payload["node_id"], snap, {"kind": "node"}
             )
-        return {"ok": True}
+        return out
+
+    def rpc_heartbeat_batch(self, payload, peer):
+        """Coalesced heartbeat frame (r20 control-plane batching): N
+        heartbeats under ONE table-lock acquisition, their telemetry
+        piggybacks under ONE store-lock acquisition
+        (TelemetryStore.ingest_batch). Per-beat semantics — reregister
+        demands, draining transitions, stale-seq drops — are identical
+        to N individual ``heartbeat`` calls; results keep frame order."""
+        beats = list(payload.get("heartbeats", ()))
+        with self._lock:
+            results = [self._heartbeat_locked(hb) for hb in beats]
+        telem = [
+            (hb["node_id"], hb["telemetry"], {"kind": "node"})
+            for hb, r in zip(beats, results)
+            if r.get("ok") and hb.get("telemetry")
+        ]
+        if telem:
+            self.telemetry.ingest_batch(telem)
+        return {"ok": True, "results": results}
 
     # -- telemetry plane ------------------------------------------------------
 
@@ -509,8 +537,82 @@ class GcsService:
             {"kind": payload.get("kind", ""), "role": payload.get("role", "")},
         )
 
-    def rpc_telemetry_cluster(self, payload, peer):
-        return self.telemetry.cluster_metrics()
+    def rpc_telemetry_push_batch(self, payload, peer):
+        """Coalesced telemetry frame: N reporter snapshots under one
+        store-lock acquisition. Same drop/stale semantics as N pushes."""
+        items = [
+            (
+                p["reporter_id"], p["snapshot"],
+                {"kind": p.get("kind", ""), "role": p.get("role", "")},
+            )
+            for p in payload.get("pushes", ())
+        ]
+        return {"ok": True, "results": self.telemetry.ingest_batch(items)}
+
+    # ops a coalesced control-plane frame may carry: the high-rate small
+    # RPCs. Long-polls (kv_wait, events_since) and anything that can
+    # park a waiter are excluded — a frame must never block mid-dispatch.
+    _BATCHABLE = frozenset({
+        "heartbeat", "telemetry_push", "kv_put", "kv_get", "kv_del",
+        "kv_keys", "cluster_demand", "kvtier_update", "kvtier_lookup",
+        "locate_object", "add_object_location", "remove_object_location",
+    })
+
+    def rpc_batch(self, payload, peer):
+        """Generic coalesced frame: dispatch N whitelisted ops in one
+        RPC, coalescing the ingest-heavy kinds (heartbeats share one
+        table-lock acquisition, telemetry snapshots one store-lock
+        acquisition). Per-op results keep frame order; an unknown or
+        non-batchable method yields an error entry, never a dropped
+        frame."""
+        ops = list(payload.get("ops", ()))
+        results: list = [None] * len(ops)
+        hb_idx = [
+            i for i, op in enumerate(ops)
+            if op.get("method") == "heartbeat"
+        ]
+        if hb_idx:
+            with self._lock:
+                for i in hb_idx:
+                    results[i] = self._heartbeat_locked(
+                        ops[i].get("payload") or {}
+                    )
+        telem: list = []        # (reporter_id, snapshot, meta) to ingest
+        telem_slot: list = []   # result index to receive the outcome (or None)
+        for i, op in enumerate(ops):
+            method = op.get("method", "")
+            body = op.get("payload") or {}
+            if method == "heartbeat":
+                snap = body.get("telemetry")
+                if snap and results[i].get("ok"):
+                    # piggyback outcome stays folded into the heartbeat
+                    # result, same as the unbatched path
+                    telem.append((body["node_id"], snap, {"kind": "node"}))
+                    telem_slot.append(None)
+                continue
+            if method == "telemetry_push":
+                telem.append((
+                    body["reporter_id"], body["snapshot"],
+                    {"kind": body.get("kind", ""),
+                     "role": body.get("role", "")},
+                ))
+                telem_slot.append(i)
+                continue
+            if method not in self._BATCHABLE:
+                results[i] = {
+                    "ok": False, "error": f"not batchable: {method!r}",
+                }
+                continue
+            try:
+                results[i] = getattr(self, f"rpc_{method}")(body, peer)
+            except Exception as e:  # noqa: BLE001 — per-op isolation
+                results[i] = {"ok": False, "error": f"{type(e).__name__}: {e}"}
+        if telem:
+            for slot, out in zip(telem_slot,
+                                 self.telemetry.ingest_batch(telem)):
+                if slot is not None:
+                    results[slot] = out
+        return {"ok": True, "results": results}
 
     def rpc_telemetry_slo(self, payload, peer):
         th = SLOThresholds.from_dict((payload or {}).get("thresholds"))
@@ -529,6 +631,23 @@ class GcsService:
         out.update(self.telemetry.status_payload(th))
         out["gcs_ft"] = self.rpc_gcs_ft(None, peer)
         out["kvtier_index"] = self.prefix_index.stats()
+        return out
+
+    def rpc_autoscale_signals(self, payload, peer):
+        """ONE RPC with everything the r20 PoolAutoscaler consumes:
+        per-tag SLO grades + autoscaler_hints, pool rollups, queue
+        depth, the measured prefill-span distribution, per-reporter
+        staleness — plus the pending lease demand the seed autoscaler
+        fed on (the surviving input of the retired second brain)."""
+        th = SLOThresholds.from_dict((payload or {}).get("thresholds"))
+        out = self.telemetry.autoscale_signals(th)
+        with self._lock:
+            out["pending_demand"] = sum(
+                1
+                for e in self._nodes.values()
+                if e.alive
+                for _spec in getattr(e, "pending", ())
+            )
         return out
 
     def rpc_kvtier_update(self, payload, peer):

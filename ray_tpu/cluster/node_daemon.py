@@ -1184,6 +1184,38 @@ class NodeDaemon:
         self._grant_queue.put((payload, loop, fut, deadline))
         return await fut
 
+    async def rpc_request_worker_lease_batch(self, payload, peer):
+        """Batched lease grants (r20 control-plane batching): N specs in
+        one RPC, granted in one executor hop instead of N dispatch
+        round-trips. Fast-path only — when waiters are queued, batch
+        arrivals must not steal freed capacity from FIFO waiters, so
+        every spec is answered ``retry_after`` (individually or via the
+        queueing ``request_worker_lease`` path). Results keep order."""
+        requests = list(payload.get("requests", ()))
+
+        def _grant_all() -> list:
+            out = []
+            for spec in requests:
+                if self._grant_queue.qsize() > 0 or self._num_queued > 0:
+                    out.append(
+                        {"retry_after": 0.05, "node_id": self.node_id}
+                    )
+                    continue
+                try:
+                    r = self._try_grant(spec, True)
+                except Exception as e:  # noqa: BLE001 — per-spec isolation
+                    r = {"error": f"{type(e).__name__}: {e}",
+                         "node_id": self.node_id}
+                out.append(
+                    r if r is not None
+                    else {"retry_after": 0.05, "node_id": self.node_id}
+                )
+            return out
+
+        loop = asyncio.get_running_loop()
+        grants = await loop.run_in_executor(None, _grant_all)
+        return {"ok": True, "grants": grants}
+
     def _granter_loop(self) -> None:
         """Server-side lease queue (the ClusterTaskManager role).
 

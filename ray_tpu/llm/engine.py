@@ -1389,6 +1389,11 @@ class LLMEngine:
                     max(0.0, r.t_first_prefill - r.arrival)
                     if r.t_first_prefill is not None else None
                 )
+                prefill_span = (
+                    max(0.0, r.t_first_token - r.t_first_prefill)
+                    if r.t_first_token is not None
+                    and r.t_first_prefill is not None else None
+                )
                 attrs = {
                     "request_id": r.request_id,
                     "finish_reason": r.finish_reason,
@@ -1410,6 +1415,7 @@ class LLMEngine:
                     self.model_tag,
                     ttft_s=ttft, tpot_s=tpot, queue_wait_s=queue_wait,
                     e2e_s=e2e, finish_reason=r.finish_reason or "",
+                    prefill_span_s=prefill_span,
                 )
             except Exception:  # noqa: BLE001
                 pass

@@ -58,6 +58,17 @@ def tpot_histogram() -> Histogram:
     )
 
 
+def prefill_span_histogram() -> Histogram:
+    return Histogram(
+        "llm_prefill_span_seconds",
+        description="prefill service span (first prefill dispatch -> "
+        "first sampled token), seconds — the per-request prefill cost "
+        "the r20 autoscaler sizes the prefill pool from",
+        boundaries=_TTFT_BOUNDARIES,
+        tag_keys=("model",),
+    )
+
+
 def queue_wait_histogram() -> Histogram:
     return Histogram(
         "llm_queue_wait_seconds",
@@ -120,6 +131,7 @@ def register_all() -> None:
     lazy construction would otherwise hide them from the static pass)."""
     ttft_histogram()
     tpot_histogram()
+    prefill_span_histogram()
     queue_wait_histogram()
     e2e_histogram()
     router_dispatch_histogram()
@@ -135,6 +147,7 @@ def record_request_slo(
     queue_wait_s: Optional[float],
     e2e_s: float,
     finish_reason: str,
+    prefill_span_s: Optional[float] = None,
 ) -> None:
     """One finished request's SLO observations. Observability must never
     break serving: failures are swallowed."""
@@ -146,6 +159,8 @@ def record_request_slo(
             tpot_histogram().observe(tpot_s, tags=tags)
         if queue_wait_s is not None:
             queue_wait_histogram().observe(queue_wait_s, tags=tags)
+        if prefill_span_s is not None:
+            prefill_span_histogram().observe(prefill_span_s, tags=tags)
         e2e_histogram().observe(
             e2e_s, tags={"model": model, "finish_reason": finish_reason or ""}
         )

@@ -70,6 +70,9 @@ AGGREGATED_PREFIXES = (
     # r19: RL post-training actor/learner plane (rl/post_train) — the
     # version-skew/trajectory-lag series behind `== rl post-train ==`
     "ray_tpu_rl_post_",
+    # r20: SLO closed-loop pool autoscaler (autoscale) — decisions,
+    # scale events, cold-start timings behind `== autoscaler ==`
+    "ray_tpu_autoscale_",
 )
 
 _AGGREGATIONS: dict[str, str] = {}
@@ -485,48 +488,74 @@ class TelemetryStore:
     def ingest(self, reporter_id: str, snapshot: dict,
                meta: Optional[dict] = None) -> dict:
         now_m, now_w = time.monotonic(), time.time()
+        with self._lock:
+            out = self._ingest_one_locked(reporter_id, snapshot, meta,
+                                          now_m, now_w)
+            self._reap(now_m)
+        return out
+
+    def ingest_batch(self, items: list) -> list:
+        """Coalesced ingest (r20 control-plane batching): N snapshots —
+        ``(reporter_id, snapshot, meta)`` tuples — under ONE lock
+        acquisition and ONE reap sweep, for the GCS's batched heartbeat/
+        telemetry frames. Per-item epoch/seq guards are identical to
+        ``ingest``; results are returned in order."""
+        now_m, now_w = time.monotonic(), time.time()
+        out: list = []
+        with self._lock:
+            for reporter_id, snapshot, meta in items:
+                out.append(
+                    self._ingest_one_locked(reporter_id, snapshot, meta,
+                                            now_m, now_w)
+                )
+            self._reap(now_m)
+        return out
+
+    def _ingest_one_locked(self, reporter_id: str, snapshot: dict,
+                           meta: Optional[dict], now_m: float,
+                           now_w: float) -> dict:
+        """One snapshot's epoch/seq-guarded ingest; caller holds
+        ``self._lock`` (and runs ``_reap`` once per lock acquisition)."""
         epoch = str(snapshot.get("epoch", ""))
         seq = int(snapshot.get("seq", 0))
-        with self._lock:
-            rep = self._reporters.get(reporter_id)
-            if rep is not None:
-                if rep["epoch"] == epoch and seq <= rep["seq"]:
-                    # a delayed/duplicated push landing after a newer one:
-                    # ignoring it is what "monotonic re-send, never
-                    # double-count" means on the receive side
-                    self.num_ignored_stale += 1
-                    return {"ok": True, "ignored": "stale_seq"}
-                if epoch in rep["dead_epochs"]:
-                    # a delayed pre-restart push landing after the new
-                    # epoch already reported: accepting it would re-bank
-                    # the live epoch's totals under the dead epoch's —
-                    # a PERMANENT double count. Its tail delta is lost,
-                    # which is staleness at the restart boundary, not
-                    # corruption.
-                    self.num_ignored_stale += 1
-                    return {"ok": True, "ignored": "stale_epoch"}
-            if rep is None:
-                rep = self._reporters[reporter_id] = {
-                    "kind": "", "role": "", "pushes": 0,
-                    "dead_epochs": deque(maxlen=16),
-                }
-            if rep.get("epoch") not in (None, epoch):
-                rep["dead_epochs"].append(rep["epoch"])
-            rep["epoch"] = epoch
-            rep["seq"] = seq
-            rep["last_push_monotonic"] = now_m
-            rep["last_push_wall"] = now_w
-            rep["reporter_ts_wall"] = float(snapshot.get("ts_wall", now_w))
-            rep["pushes"] += 1
-            m = meta or {}
-            if m.get("kind"):
-                rep["kind"] = m["kind"]
-            if m.get("role"):
-                rep["role"] = m["role"]
-            for entry in snapshot.get("metrics", ()):
-                self._ingest_metric(reporter_id, epoch, now_w, entry)
-            self.num_ingested += 1
-            self._reap(now_m)
+        rep = self._reporters.get(reporter_id)
+        if rep is not None:
+            if rep["epoch"] == epoch and seq <= rep["seq"]:
+                # a delayed/duplicated push landing after a newer one:
+                # ignoring it is what "monotonic re-send, never
+                # double-count" means on the receive side
+                self.num_ignored_stale += 1
+                return {"ok": True, "ignored": "stale_seq"}
+            if epoch in rep["dead_epochs"]:
+                # a delayed pre-restart push landing after the new
+                # epoch already reported: accepting it would re-bank
+                # the live epoch's totals under the dead epoch's —
+                # a PERMANENT double count. Its tail delta is lost,
+                # which is staleness at the restart boundary, not
+                # corruption.
+                self.num_ignored_stale += 1
+                return {"ok": True, "ignored": "stale_epoch"}
+        if rep is None:
+            rep = self._reporters[reporter_id] = {
+                "kind": "", "role": "", "pushes": 0,
+                "dead_epochs": deque(maxlen=16),
+            }
+        if rep.get("epoch") not in (None, epoch):
+            rep["dead_epochs"].append(rep["epoch"])
+        rep["epoch"] = epoch
+        rep["seq"] = seq
+        rep["last_push_monotonic"] = now_m
+        rep["last_push_wall"] = now_w
+        rep["reporter_ts_wall"] = float(snapshot.get("ts_wall", now_w))
+        rep["pushes"] += 1
+        m = meta or {}
+        if m.get("kind"):
+            rep["kind"] = m["kind"]
+        if m.get("role"):
+            rep["role"] = m["role"]
+        for entry in snapshot.get("metrics", ()):
+            self._ingest_metric(reporter_id, epoch, now_w, entry)
+        self.num_ingested += 1
         return {"ok": True}
 
     def _reap(self, now_m: float) -> None:
@@ -1102,6 +1131,105 @@ class TelemetryStore:
                 "ray_tpu_rl_post_max_trained_staleness"),
         }
 
+    def prefill_span_summary(self, agg: Optional[dict] = None) -> dict:
+        """The measured prefill-span distribution + arrival rate the r20
+        autoscaler sizes the prefill pool from. Mean comes from the
+        merged histogram sum/count; the arrival rate is the per-second
+        rate of the same histogram's cumulative count rings (every
+        request that produced a first token counts exactly once)."""
+        if agg is None:
+            agg = self.cluster_metrics()
+        name = _fq("llm_prefill_span_seconds")
+        now_w = time.time()
+        rate = 0.0
+        with self._lock:
+            for (_rid, nm, _tags), st in self._series.items():
+                if nm == name:
+                    rate += self._rate(st["ring"], now_w)
+        count, total = 0, 0.0
+        p95 = None
+        acc = agg["histograms"].get(name)
+        if acc:
+            for merged in acc["series"].values():
+                count += int(merged.get("count", 0))
+                total += float(merged.get("sum", 0.0))
+                p = merged.get("p95")
+                if p is not None:
+                    p95 = max(p95, p) if p95 is not None else p
+        return {
+            "count": count,
+            "mean_s": round(total / count, 6) if count else None,
+            "p95_s": p95,
+            "arrival_rate_per_s": round(rate, 6),
+        }
+
+    def autoscale_signals(
+        self, thresholds: Optional[SLOThresholds] = None
+    ) -> dict:
+        """Everything the PoolAutoscaler consumes, from ONE aggregation
+        pass: per-tag grades + autoscaler_hints, pool rollups, queue
+        depth, the prefill-span distribution, per-reporter staleness.
+        Pending lease demand is GCS-side state and is layered on by
+        ``gcs_service.rpc_autoscale_signals``."""
+        agg = self.cluster_metrics()
+        return {
+            "ts_wall": agg["ts_wall"],
+            "staleness": agg["staleness"],
+            "slo": self.slo_report(thresholds, agg),
+            "pools": self.pool_rollups(agg),
+            "utilization": self.utilization(agg),
+            "prefill_span": self.prefill_span_summary(agg),
+        }
+
+    def autoscale_health(self, agg: Optional[dict] = None) -> dict:
+        """Controller health for `ray_tpu status`: decision mix, scale
+        events, cold-start timings, current pool targets, and whether
+        the controller is holding on a dark GCS. All None/empty when no
+        controller is reporting."""
+        if agg is None:
+            agg = self.cluster_metrics()
+
+        def counter_total(name):
+            c = agg["counters"].get(_fq(name))
+            return int(c["total"]) if c else None
+
+        by_action: dict = {}
+        acc = agg["counters"].get(_fq("ray_tpu_autoscale_decisions_total"))
+        if acc:
+            for skey, v in acc["series"].items():
+                action = self._parse_tags_key(skey).get("action", "")
+                by_action[action] = by_action.get(action, 0) + int(v)
+        targets: dict = {}
+        g = agg["gauges"].get(_fq("ray_tpu_autoscale_pool_target"))
+        if g:
+            for skey, v in g["series"].items():
+                pool = self._parse_tags_key(skey).get("pool", "")
+                targets[pool] = targets.get(pool, 0) + int(v)
+        cold = {"count": 0, "p50_s": None, "p95_s": None}
+        h = agg["histograms"].get(_fq("ray_tpu_autoscale_cold_start_seconds"))
+        if h:
+            for merged in h["series"].values():
+                cold["count"] += int(merged.get("count", 0))
+                for q in ("p50", "p95"):
+                    p = merged.get(q)
+                    if p is not None:
+                        key = f"{q}_s"
+                        cold[key] = (
+                            max(cold[key], p) if cold[key] is not None else p
+                        )
+        dark = agg["gauges"].get(_fq("ray_tpu_autoscale_gcs_dark"))
+        return {
+            "decisions_total": counter_total("ray_tpu_autoscale_decisions_total"),
+            "decisions_by_action": by_action,
+            "scale_ups_total": counter_total("ray_tpu_autoscale_scale_ups_total"),
+            "scale_downs_total": counter_total(
+                "ray_tpu_autoscale_scale_downs_total"),
+            "holds_total": counter_total("ray_tpu_autoscale_holds_total"),
+            "pool_targets": targets,
+            "cold_starts": cold,
+            "gcs_dark": dark["value"] if dark else None,
+        }
+
     def status_payload(self, thresholds: Optional[SLOThresholds] = None) -> dict:
         """Everything `ray_tpu status` needs beyond the node table — the
         GCS assembles this so the CLI is ONE RPC. The full aggregation
@@ -1118,6 +1246,7 @@ class TelemetryStore:
             "fabric": self.fabric_health(agg),
             "kvtier": self.kvtier_health(agg),
             "rl_post": self.rl_post_health(agg),
+            "autoscale": self.autoscale_health(agg),
         }
 
 
@@ -1319,6 +1448,38 @@ def format_status(report: dict) -> str:
                 f"  publishes {int(pub or 0)}"
                 f"  rollout preemptions {int(pre or 0)}"
             )
+    asc = report.get("autoscale") or {}
+    if asc.get("decisions_total"):
+        lines.append("== autoscaler ==")
+        by = asc.get("decisions_by_action") or {}
+        lines.append(
+            f"  decisions {int(asc['decisions_total'])}"
+            f"  up {int(asc.get('scale_ups_total') or 0)}"
+            f"  down {int(asc.get('scale_downs_total') or 0)}"
+            f"  hold {int(asc.get('holds_total') or 0)}"
+            + (
+                "  (" + " ".join(
+                    f"{a}={n}" for a, n in sorted(by.items()) if n
+                ) + ")" if by else ""
+            )
+        )
+        line = "  targets " + (
+            " ".join(
+                f"{p}={n}" for p, n in sorted(
+                    (asc.get("pool_targets") or {}).items())
+            ) or "-"
+        )
+        cold = asc.get("cold_starts") or {}
+        if cold.get("count"):
+            line += (
+                f"  cold starts {int(cold['count'])}"
+                f" (p50 {_fmt_s(cold.get('p50_s'))},"
+                f" p95 {_fmt_s(cold.get('p95_s'))})"
+            )
+        dark = asc.get("gcs_dark")
+        if dark:
+            line += "  GCS DARK (holding)"
+        lines.append(line)
     u = report.get("utilization", {})
     occ = u.get("kv_page_occupancy")
     lines.append("== utilization ==")

@@ -57,6 +57,11 @@ class WeightPublisher:
         self.transport = transport or DeviceTransport(namespace=namespace)
         self._version = 0
         self.num_published = 0
+        # most recent successfully-published params tree, retained for
+        # late joiners (r20 autoscale cold start): a replica scaled up
+        # from zero streams THESE weights at the same version — no
+        # checkpoint path, no learner round-trip
+        self._latest_params: Any = None
 
     def register_rollout(self, endpoint_id: str, device: Any = None) -> tuple:
         """Bind one rollout engine's receive endpoint (pass the engine's
@@ -89,7 +94,28 @@ class WeightPublisher:
                     f"weight publish v{version} to {target!r} failed: {e}"
                 ) from e
         self.num_published += 1
+        self._latest_params = params
         return int(version)
+
+    @property
+    def latest_version(self) -> int:
+        return self._version
+
+    def publish_latest(self, target, timeout_s: float = 30.0) -> int:
+        """Re-publish the most recent bundle to ONE late-joining endpoint
+        at the SAME version (a cold-started replica catching up to the
+        fleet). Raises WeightSyncError before any publish has happened —
+        a cold start with nothing to stream is a deployment bug, not a
+        silent fresh-weights replica."""
+        if self._latest_params is None:
+            raise WeightSyncError(
+                "publish_latest: no publish retained yet — nothing to "
+                "stream to a late joiner"
+            )
+        return self.publish(
+            self._latest_params, [target],
+            version=self._version, timeout_s=timeout_s,
+        )
 
     def close(self) -> None:
         if self._owns_transport:

@@ -1,0 +1,816 @@
+"""SLO closed-loop pool autoscaler (r20): the pure decision ladder,
+the controller loop, the actuator drain/re-target contract, chaos
+(STALL_GCS mid-decision, a preemption landing mid-scale-down), the
+control-plane batch frames, and the two checked-in capture gates.
+
+The ladder tests are deterministic and clusterless: the policy is a
+pure function of (signals, config, clock), so every hysteresis window,
+cooldown and sizing rule is driven with a hand-rolled ``now``.
+"""
+
+import json
+import os
+
+import pytest
+
+from ray_tpu import chaos
+from ray_tpu.autoscale import (
+    ACTION_COLD_START,
+    ACTION_HOLD,
+    ACTION_SCALE_DOWN,
+    ACTION_SCALE_TO_ZERO,
+    ACTION_SCALE_UP,
+    AutoscaleConfig,
+    Decision,
+    EnginePoolActuator,
+    POOL_DECODE,
+    POOL_PREFILL,
+    PoolAutoscaler,
+    PoolLimits,
+    PoolPolicy,
+    PoolSignals,
+    signals_from_payload,
+    size_prefill_pool,
+    span_mean_from_histogram,
+)
+
+pytestmark = [pytest.mark.autoscale]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    yield
+    chaos.uninstall()
+
+
+def _cfg(**kw):
+    kw.setdefault("pools", {
+        POOL_PREFILL: PoolLimits(min_replicas=0, max_replicas=4),
+        POOL_DECODE: PoolLimits(min_replicas=0, max_replicas=4),
+    })
+    kw.setdefault("breach_ticks", 2)
+    kw.setdefault("green_ticks", 3)
+    kw.setdefault("scale_up_cooldown_s", 0.0)
+    kw.setdefault("scale_down_cooldown_s", 0.0)
+    kw.setdefault("idle_to_zero_s", 10.0)
+    return AutoscaleConfig(**kw)
+
+
+def _sig(**kw):
+    return PoolSignals(**kw)
+
+
+# ---------------------------------------------------------------------------
+# hint -> pool mapping (r11 autoscaler_hints, applied verbatim)
+# ---------------------------------------------------------------------------
+
+
+def _slo_entry(ttft="green", tpot="green", qw="green"):
+    def _hint(g):
+        return g in ("yellow", "red")
+
+    return {
+        "ttft": {"grade": ttft},
+        "tpot": {"grade": tpot},
+        "queue_wait": {"grade": qw},
+        "autoscaler_hints": {
+            "scale_prefill": _hint(ttft),
+            "scale_decode": _hint(tpot),
+            "shed_or_add_capacity": _hint(qw),
+        },
+    }
+
+
+def _payload(ttft="green", tpot="green", qw="green", **kw):
+    out = {
+        "staleness": {"n1": 0.01},
+        "slo": {"model_tags": {"m": _slo_entry(ttft, tpot, qw)}},
+        "pools": {},
+        "utilization": {"queue_depth": kw.pop("queue_depth", 0.0)},
+        "prefill_span": {
+            "mean_s": kw.pop("span_mean_s", None),
+            "arrival_rate_per_s": kw.pop("arrival", 0.0),
+        },
+        "pending_demand": kw.pop("pending_demand", 0),
+    }
+    out.update(kw)
+    return out
+
+
+def test_hint_mapping_ttft_prices_prefill():
+    sigs = signals_from_payload(_payload(ttft="red"))
+    assert sigs[POOL_PREFILL].breach is True
+    assert sigs[POOL_PREFILL].grade == "red"
+    assert sigs[POOL_DECODE].breach is False
+
+
+def test_hint_mapping_tpot_and_queue_wait_price_decode():
+    for kw in ({"tpot": "yellow"}, {"qw": "red"}):
+        sigs = signals_from_payload(_payload(**kw))
+        assert sigs[POOL_DECODE].breach is True, kw
+        assert sigs[POOL_PREFILL].breach is False, kw
+
+
+def test_hint_mapping_worst_grade_across_tags_wins():
+    p = _payload()
+    p["slo"]["model_tags"]["m2"] = _slo_entry(ttft="yellow", tpot="red")
+    sigs = signals_from_payload(p)
+    assert sigs[POOL_PREFILL].grade == "yellow"
+    assert sigs[POOL_PREFILL].breach is True
+    assert sigs[POOL_DECODE].grade == "red"
+
+
+def test_span_and_demand_ride_the_signals():
+    p = _payload(span_mean_s=0.5, arrival=2.0, pending_demand=3,
+                 queue_depth=7.0)
+    sigs = signals_from_payload(p)
+    assert sigs[POOL_PREFILL].span_mean_s == 0.5
+    assert sigs[POOL_DECODE].span_mean_s is None  # span prices prefill only
+    for s in sigs.values():
+        assert s.arrival_rate_per_s == 2.0
+        assert s.pending_demand == 3
+        assert s.queue_depth == 7.0
+        assert s.has_traffic
+
+
+# ---------------------------------------------------------------------------
+# hysteresis / cooldown windows
+# ---------------------------------------------------------------------------
+
+
+def test_breach_streak_gates_scale_up():
+    """One yellow blip never scales; breach_ticks consecutive ones do."""
+    pol = PoolPolicy(_cfg())
+    sig = _sig(grade="yellow", breach=True, running=1, target=1,
+               arrival_rate_per_s=1.0)
+    d1 = pol.decide(POOL_DECODE, sig, now=0.0)
+    assert d1.action == ACTION_HOLD and "1/2" in d1.reason
+    # blip ends: a green tick resets the streak
+    d2 = pol.decide(POOL_DECODE, _sig(grade="green", running=1, target=1,
+                                      arrival_rate_per_s=1.0), now=1.0)
+    assert d2.action == ACTION_HOLD
+    # a fresh breach must re-earn both ticks
+    d3 = pol.decide(POOL_DECODE, sig, now=2.0)
+    assert d3.action == ACTION_HOLD
+    d4 = pol.decide(POOL_DECODE, sig, now=3.0)
+    assert d4.action == ACTION_SCALE_UP and d4.target == 2
+
+
+def test_scale_up_cooldown_spaces_actions():
+    pol = PoolPolicy(_cfg(scale_up_cooldown_s=5.0))
+    sig = _sig(grade="red", breach=True, running=1, target=1,
+               arrival_rate_per_s=1.0)
+    assert pol.decide(POOL_DECODE, sig, now=0.0).action == ACTION_HOLD
+    d = pol.decide(POOL_DECODE, sig, now=1.0)
+    assert d.action == ACTION_SCALE_UP
+    # still breached, streak re-earned — but inside the cooldown
+    sig2 = _sig(grade="red", breach=True, running=2, target=2,
+                arrival_rate_per_s=1.0)
+    pol.decide(POOL_DECODE, sig2, now=2.0)
+    d2 = pol.decide(POOL_DECODE, sig2, now=3.0)
+    assert d2.action == ACTION_HOLD and "cooldown" in d2.reason
+    # cooldown expired -> the held breach fires
+    d3 = pol.decide(POOL_DECODE, sig2, now=6.5)
+    assert d3.action == ACTION_SCALE_UP and d3.target == 3
+
+
+def test_green_streak_gates_scale_down_and_respects_floor():
+    pol = PoolPolicy(_cfg(green_ticks=3))
+    sig = _sig(grade="green", running=3, target=3, arrival_rate_per_s=1.0)
+    assert pol.decide(POOL_DECODE, sig, now=0.0).action == ACTION_HOLD
+    assert pol.decide(POOL_DECODE, sig, now=1.0).action == ACTION_HOLD
+    d = pol.decide(POOL_DECODE, sig, now=2.0)
+    assert d.action == ACTION_SCALE_DOWN and d.target == 2
+    # while traffic flows the pool never drains below one replica
+    sig1 = _sig(grade="green", running=1, target=1, arrival_rate_per_s=1.0)
+    for t in range(3, 9):
+        assert pol.decide(POOL_DECODE, sig1, now=float(t)).action == ACTION_HOLD
+
+
+def test_min_replicas_floor_blocks_scale_down():
+    cfg = _cfg(pools={POOL_DECODE: PoolLimits(min_replicas=2, max_replicas=4)},
+               green_ticks=1)
+    pol = PoolPolicy(cfg)
+    sig = _sig(grade="green", running=2, target=2)
+    assert pol.decide(POOL_DECODE, sig, now=0.0).action == ACTION_HOLD
+
+
+# ---------------------------------------------------------------------------
+# prefill sizing from the measured span distribution
+# ---------------------------------------------------------------------------
+
+
+def test_size_prefill_pool_littles_law():
+    # 4 req/s x 0.9 s span = 3.6 busy servers; at 60% target -> 6
+    assert size_prefill_pool(4.0, 0.9, 0.6) == 6
+    assert size_prefill_pool(4.0, 0.9, 0.6, max_replicas=4) == 4
+    assert size_prefill_pool(0.1, 0.1, 0.6) == 1        # floor at one
+    assert size_prefill_pool(0.0, 0.9, 0.6) is None     # no arrivals
+    assert size_prefill_pool(4.0, None, 0.6) is None    # no distribution
+
+
+def test_span_mean_from_histogram():
+    assert span_mean_from_histogram({"sum": 4.5, "count": 9}) == 0.5
+    assert span_mean_from_histogram({"sum": 0.0, "count": 0}) is None
+    assert span_mean_from_histogram(None) is None
+
+
+def test_prefill_scale_up_jumps_to_sized_target():
+    """A breached prefill pool scales to the span-sized count in one
+    step, not one replica at a time."""
+    pol = PoolPolicy(_cfg())
+    sig = _sig(grade="red", breach=True, running=1, target=1,
+               arrival_rate_per_s=2.0, span_mean_s=0.9)
+    pol.decide(POOL_PREFILL, sig, now=0.0)
+    d = pol.decide(POOL_PREFILL, sig, now=1.0)
+    # ceil(2.0 * 0.9 / 0.6) = 3
+    assert d.action == ACTION_SCALE_UP and d.target == 3
+
+
+def test_prefill_feedforward_scales_to_sized_without_breach():
+    """The sizing rule is a feedforward term: a span distribution that
+    says the pool is under-provisioned scales it BEFORE the cumulative
+    SLO p95 (whose detection lag grows with history) ever degrades."""
+    pol = PoolPolicy(_cfg())
+    sig = _sig(grade="green", running=1, target=1,
+               arrival_rate_per_s=4.0, span_mean_s=0.9)
+    d1 = pol.decide(POOL_PREFILL, sig, now=0.0)
+    assert d1.action == ACTION_HOLD          # one sized tick is noise
+    d2 = pol.decide(POOL_PREFILL, sig, now=1.0)
+    # ceil(4.0 * 0.9 / 0.6) = 6 -> capped at the pool max (4)
+    assert d2.action == ACTION_SCALE_UP and d2.target == 4
+    assert "feedforward" in d2.reason
+
+
+def test_prefill_sized_floor_blocks_over_drain():
+    """Green ticks can't drain the prefill pool below what the measured
+    span distribution says the load needs."""
+    pol = PoolPolicy(_cfg(green_ticks=1))
+    sig = _sig(grade="green", running=3, target=3,
+               arrival_rate_per_s=2.0, span_mean_s=0.9)
+    # sized floor = 3 -> no scale-down despite the green streak
+    assert pol.decide(POOL_PREFILL, sig, now=0.0).action == ACTION_HOLD
+
+
+# ---------------------------------------------------------------------------
+# scale-to-zero eligibility + cold start
+# ---------------------------------------------------------------------------
+
+
+def test_scale_to_zero_requires_idle_window():
+    pol = PoolPolicy(_cfg(idle_to_zero_s=10.0))
+    idle = _sig(grade="no_data", running=1, target=1)
+    assert pol.decide(POOL_PREFILL, idle, now=0.0).action == ACTION_HOLD
+    assert pol.decide(POOL_PREFILL, idle, now=5.0).action == ACTION_HOLD
+    d = pol.decide(POOL_PREFILL, idle, now=10.0)
+    assert d.action == ACTION_SCALE_TO_ZERO and d.target == 0
+
+
+def test_traffic_resets_idle_clock():
+    pol = PoolPolicy(_cfg(idle_to_zero_s=10.0))
+    idle = _sig(grade="no_data", running=1, target=1)
+    busy = _sig(grade="no_data", running=1, target=1, queue_depth=2.0)
+    pol.decide(POOL_PREFILL, idle, now=0.0)
+    pol.decide(POOL_PREFILL, busy, now=9.0)   # a request arrives
+    d = pol.decide(POOL_PREFILL, idle, now=12.0)
+    assert d.action == ACTION_HOLD            # clock restarted at 12
+    d2 = pol.decide(POOL_PREFILL, idle, now=22.0)
+    assert d2.action == ACTION_SCALE_TO_ZERO
+
+
+def test_nonzero_min_never_scales_to_zero():
+    cfg = _cfg(pools={POOL_DECODE: PoolLimits(min_replicas=1, max_replicas=4)},
+               idle_to_zero_s=1.0)
+    pol = PoolPolicy(cfg)
+    idle = _sig(grade="no_data", running=1, target=1)
+    for t in range(0, 20, 2):
+        assert pol.decide(POOL_DECODE, idle, now=float(t)).action == ACTION_HOLD
+
+
+def test_cold_start_fires_on_traffic_at_zero():
+    pol = PoolPolicy(_cfg())
+    d = pol.decide(POOL_PREFILL, _sig(running=0, target=0, queue_depth=1.0),
+                   now=0.0)
+    assert d.action == ACTION_COLD_START and d.target == 1
+    # with a span distribution the cold start sizes the pool directly
+    pol2 = PoolPolicy(_cfg())
+    d2 = pol2.decide(
+        POOL_PREFILL,
+        _sig(running=0, target=0, arrival_rate_per_s=2.0, span_mean_s=0.9),
+        now=0.0,
+    )
+    assert d2.action == ACTION_COLD_START and d2.target == 3
+
+
+def test_pending_demand_counts_as_traffic():
+    """The retired seed autoscaler's input — parked lease demand — wakes
+    a zero pool through the ONE remaining brain."""
+    pol = PoolPolicy(_cfg())
+    d = pol.decide(POOL_DECODE, _sig(running=0, target=0, pending_demand=2),
+                   now=0.0)
+    assert d.action == ACTION_COLD_START
+
+
+# ---------------------------------------------------------------------------
+# dark GCS: blackout is never evidence
+# ---------------------------------------------------------------------------
+
+
+def test_gcs_dark_holds_and_resets_streaks():
+    pol = PoolPolicy(_cfg())
+    breach = _sig(grade="red", breach=True, running=1, target=1,
+                  arrival_rate_per_s=1.0)
+    pol.decide(POOL_DECODE, breach, now=0.0)            # streak 1
+    d = pol.decide(POOL_DECODE, breach, now=1.0, gcs_dark=True)
+    assert d.action == ACTION_HOLD and "gcs-dark" in d.reason
+    # recovery must re-earn the full window: the pre-blackout tick is gone
+    d2 = pol.decide(POOL_DECODE, breach, now=2.0)
+    assert d2.action == ACTION_HOLD
+    d3 = pol.decide(POOL_DECODE, breach, now=3.0)
+    assert d3.action == ACTION_SCALE_UP
+
+
+def test_gcs_dark_freezes_idle_clock():
+    pol = PoolPolicy(_cfg(idle_to_zero_s=5.0))
+    idle = _sig(grade="no_data", running=1, target=1)
+    pol.decide(POOL_PREFILL, idle, now=0.0)
+    pol.decide(POOL_PREFILL, idle, now=4.0, gcs_dark=True)
+    # the blackout reset the clock: 6s after recovery-start, not 11s idle
+    assert pol.decide(POOL_PREFILL, idle, now=6.0).action == ACTION_HOLD
+    assert pol.decide(POOL_PREFILL, idle, now=11.5).action == ACTION_SCALE_TO_ZERO
+
+
+# ---------------------------------------------------------------------------
+# controller: signals -> decisions -> actuator
+# ---------------------------------------------------------------------------
+
+
+class RecordingActuator:
+    def __init__(self, state=None):
+        self.applied = []
+        self.state = state if state is not None else {}
+
+    def apply(self, decision):
+        self.applied.append(decision)
+
+    def pool_state(self):
+        return self.state
+
+
+def test_controller_tick_scales_prefill_independently():
+    act = RecordingActuator({
+        POOL_PREFILL: {"replicas_running": 1, "replicas_target": 1},
+        POOL_DECODE: {"replicas_running": 1, "replicas_target": 1},
+    })
+    auto = PoolAutoscaler(
+        _cfg(), act, fetch_signals=lambda: _payload(ttft="red", arrival=1.0)
+    )
+    auto.tick(now=0.0)
+    d = auto.tick(now=1.0)
+    assert d[POOL_PREFILL].action == ACTION_SCALE_UP
+    assert d[POOL_DECODE].action == ACTION_HOLD
+    assert [a.pool for a in act.applied] == [POOL_PREFILL]
+    assert auto.num_scale_actions == 1
+
+
+def test_controller_fetch_failure_degrades_to_hold():
+    def boom():
+        raise ConnectionError("gcs is gone")
+
+    act = RecordingActuator()
+    auto = PoolAutoscaler(_cfg(), act, fetch_signals=boom)
+    d = auto.tick(now=0.0)
+    assert all(x.action == ACTION_HOLD for x in d.values())
+    assert all("gcs-dark" in x.reason for x in d.values())
+    assert auto.gcs_dark and auto.num_dark_ticks == 1
+    assert act.applied == []
+
+
+def test_controller_stale_signals_are_dark():
+    p = _payload(ttft="red")
+    p["staleness"] = {"n1": 99.0, "n2": 120.0}   # whole fleet stale
+    act = RecordingActuator()
+    auto = PoolAutoscaler(_cfg(max_signal_age_s=30.0), act,
+                          fetch_signals=lambda: p)
+    d = auto.tick(now=0.0)
+    assert all(x.action == ACTION_HOLD for x in d.values())
+    assert auto.gcs_dark
+    # ONE fresh reporter is enough to trust the rollup again
+    p["staleness"]["n1"] = 0.5
+    auto.tick(now=1.0)
+    assert not auto.gcs_dark
+
+
+def test_controller_decision_log_and_status():
+    act = RecordingActuator()
+    auto = PoolAutoscaler(_cfg(), act, fetch_signals=lambda: _payload())
+    auto.tick(now=0.0)
+    log = auto.decision_log()
+    assert len(log) == 2 and {e["pool"] for e in log} == {POOL_PREFILL,
+                                                          POOL_DECODE}
+    st = auto.status()
+    assert st["num_ticks"] == 1 and st["num_scale_actions"] == 0
+
+
+# ---------------------------------------------------------------------------
+# EnginePoolActuator: graceful drain, re-target, zero lost
+# ---------------------------------------------------------------------------
+
+
+class FakeReplica:
+    """A replica for drain tests: holds queued items, completes them on a
+    graceful drain, surrenders them when dead."""
+
+    def __init__(self, name):
+        self.name = name
+        self.items = []
+        self.done = []
+        self.dead = False
+        self.closed = False
+        self.on_drain = None
+
+    def submit(self, item):
+        if self.dead or self.closed:
+            raise RuntimeError(f"{self.name} is dead")
+        self.items.append(item)
+
+    def pending(self):
+        left, self.items = self.items, []
+        return left
+
+    def drain(self, timeout_s):
+        if self.on_drain is not None:
+            cb, self.on_drain = self.on_drain, None
+            cb(self)
+        if self.dead:
+            # preempted mid-drain: unfinished work goes back to the pool
+            return self.pending()
+        self.done.extend(self.items)
+        self.items = []
+        return []
+
+    def kill(self):
+        self.dead = True
+
+    def close(self):
+        self.closed = True
+
+
+def _grow(act, pool, n):
+    act.apply(Decision(pool, ACTION_SCALE_UP, target=n))
+    return act.replicas(pool)
+
+
+def test_actuator_graceful_drain_completes_work():
+    act = EnginePoolActuator(spawn=FakeReplica)
+    reps = _grow(act, POOL_DECODE, 2)
+    reps[1].submit("a")
+    reps[1].submit("b")
+    act.apply(Decision(POOL_DECODE, ACTION_SCALE_DOWN, target=1))
+    assert act.pool_state()[POOL_DECODE]["replicas_running"] == 1
+    assert reps[1].done == ["a", "b"] and reps[1].closed
+    assert act.num_drained == 1 and act.num_retargeted == 0
+
+
+@pytest.mark.chaos
+def test_chaos_drain_kill_retargets_pending():
+    """The in-process autoscale.drain chaos site: KILL_REPLICA lands on
+    the drain victim; its pending work re-targets to a survivor — zero
+    lost requests."""
+    chaos.install(chaos.FaultSchedule(3, [
+        chaos.FaultSpec(chaos.KILL_REPLICA, site="autoscale.drain",
+                        max_fires=1),
+    ]))
+    act = EnginePoolActuator(spawn=FakeReplica)
+    reps = _grow(act, POOL_DECODE, 2)
+    for item in ("a", "b", "c"):
+        reps[1].submit(item)
+    act.apply(Decision(POOL_DECODE, ACTION_SCALE_DOWN, target=1))
+    assert act.num_drain_killed == 1
+    assert act.num_retargeted == 3
+    assert reps[0].items == ["a", "b", "c"]   # survivor took the work
+    chaos.uninstall()
+
+
+@pytest.mark.chaos
+def test_chaos_preempt_node_mid_scale_down_zero_lost():
+    """Seeded PREEMPT_NODE while a scale-down drains two replicas: the
+    preemption is orchestrated (fire() must ignore it), lands on one
+    draining replica mid-drain, and every queued request either
+    completes on its drain or re-targets to the survivor — zero lost."""
+    sched = chaos.FaultSchedule(7, [
+        chaos.FaultSpec(chaos.PREEMPT_NODE, at_s=0.0, target="decode"),
+    ])
+    orch = sched.orchestrated()
+    assert len(orch) == 1
+    # orchestrated kinds never fire in-process, even at a matching site
+    assert sched.fire("autoscale.drain", kinds=(chaos.PREEMPT_NODE,)) == []
+
+    act = EnginePoolActuator(spawn=FakeReplica)
+    reps = _grow(act, POOL_DECODE, 3)
+    submitted = []
+    for i, r in enumerate(reps):
+        for j in range(2):
+            item = f"req-{i}-{j}"
+            r.submit(item)
+            submitted.append(item)
+    # mini-runner: the seeded schedule picks which draining replica the
+    # preemption lands on; it dies mid-drain
+    idx, _spec = orch[0]
+    victim = sched.pick(idx, reps[1:])        # retire order: reps[2], reps[1]
+    victim.on_drain = lambda rep: rep.kill()
+
+    act.apply(Decision(POOL_DECODE, ACTION_SCALE_TO_ZERO, target=1))
+    survivor = reps[0]
+    completed = [x for r in reps for x in r.done]
+    assert sorted(completed + survivor.items) == sorted(submitted)
+    assert act.num_retargeted == 2            # the preempted replica's queue
+    vi = reps.index(victim)
+    assert survivor.items[-2:] == [f"req-{vi}-0", f"req-{vi}-1"]
+
+
+# ---------------------------------------------------------------------------
+# chaos: STALL_GCS mid-decision over a real GCS
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+@pytest.mark.gcs_chaos
+def test_chaos_stall_gcs_mid_decision_holds_then_no_flap():
+    """A STALL_GCS window over the live autoscale_signals RPC: every
+    blackout tick HOLDs (zero scale actions), and recovery re-earns the
+    breach window before acting — the loop never flaps on the bounce."""
+    from ray_tpu.cluster.gcs_service import GcsServer
+    from ray_tpu.cluster.rpc import ReconnectingRpcClient
+    from ray_tpu.obs.slo import ttft_histogram
+    from ray_tpu.obs.telemetry import annotated_snapshot, cluster_gauge
+    from ray_tpu.util.metrics import clear_registry
+
+    clear_registry()
+    server = GcsServer(port=0)
+    host, port = server.start()
+    try:
+        push = ReconnectingRpcClient(host, port, timeout=5).connect()
+        # a breached fleet: observed TTFT far beyond the test threshold,
+        # with requests still queued (breaches only count under load)
+        for _ in range(4):
+            ttft_histogram().observe(0.5, tags={"model": "m"})
+        cluster_gauge("llm_queue_depth", tag_keys=("model",)).set(
+            3.0, tags={"model": "m"})
+        push.call("telemetry_push", {
+            "reporter_id": "host0", "kind": "engine", "role": "prefill",
+            "snapshot": annotated_snapshot(),
+        }, timeout=5)
+
+        act = RecordingActuator({
+            POOL_PREFILL: {"replicas_running": 1, "replicas_target": 1},
+            POOL_DECODE: {"replicas_running": 1, "replicas_target": 1},
+        })
+        gcs = ReconnectingRpcClient(host, port, timeout=5).connect()
+        auto = PoolAutoscaler(
+            _cfg(), act, gcs=gcs,
+            thresholds={"ttft_p_s": 0.01, "min_count": 1},
+        )
+        d = auto.tick(now=0.0)                      # breach tick 1 of 2
+        assert d[POOL_PREFILL].action == ACTION_HOLD and not auto.gcs_dark
+
+        chaos.install(chaos.FaultSchedule(11, [
+            chaos.FaultSpec(chaos.STALL_GCS, site="gcs.call",
+                            match={"method": "autoscale_signals"},
+                            max_fires=3),
+        ]))
+        for t in (1.0, 2.0, 3.0):                   # the blackout window
+            d = auto.tick(now=t)
+            assert all(x.action == ACTION_HOLD for x in d.values())
+            assert auto.gcs_dark
+        assert auto.num_dark_ticks == 3
+        assert auto.num_scale_actions == 0 and act.applied == []
+        chaos.uninstall()
+
+        d = auto.tick(now=4.0)                      # recovered: re-earn
+        assert not auto.gcs_dark
+        assert d[POOL_PREFILL].action == ACTION_HOLD
+        d = auto.tick(now=5.0)                      # window re-earned
+        assert d[POOL_PREFILL].action == ACTION_SCALE_UP
+        assert [a.pool for a in act.applied] == [POOL_PREFILL]
+        push.close()
+        gcs.close()
+    finally:
+        server.stop()
+        clear_registry()
+
+
+# ---------------------------------------------------------------------------
+# metrics + status surface
+# ---------------------------------------------------------------------------
+
+
+def test_autoscale_metrics_and_status_block():
+    """Controller decisions land in declared ray_tpu_autoscale_* series;
+    the GCS store rolls them into autoscale_health and `ray_tpu status`
+    grows an `== autoscaler ==` block."""
+    from ray_tpu.obs.telemetry import (
+        TelemetryStore, annotated_snapshot, format_status,
+    )
+    from ray_tpu.util.metrics import clear_registry
+
+    clear_registry()
+    try:
+        act = RecordingActuator({
+            POOL_PREFILL: {"replicas_running": 1, "replicas_target": 1},
+            POOL_DECODE: {"replicas_running": 1, "replicas_target": 1},
+        })
+        auto = PoolAutoscaler(
+            _cfg(), act, fetch_signals=lambda: _payload(tpot="red",
+                                                        arrival=1.0),
+        )
+        for t in range(3):
+            auto.tick(now=float(t))
+        store = TelemetryStore()
+        store.ingest("ctl", annotated_snapshot(), {"kind": "controller"})
+        health = store.autoscale_health()
+        assert health["decisions_total"] >= 6      # 2 pools x 3 ticks
+        assert health["scale_ups_total"] == 1
+        assert health["decisions_by_action"].get("scale_up") == 1
+        assert health["pool_targets"].get(POOL_DECODE) == 2
+        assert health["gcs_dark"] == 0.0
+        text = format_status(store.status_payload())
+        assert "== autoscaler ==" in text
+        assert "up 1" in text
+    finally:
+        clear_registry()
+
+
+def test_register_metrics_declares_aggregations():
+    from ray_tpu.autoscale import metrics as m
+    from ray_tpu.obs.telemetry import aggregation_kind
+
+    m.register_metrics()
+    assert aggregation_kind("ray_tpu_autoscale_pool_target", "gauge") is not None
+    assert aggregation_kind("ray_tpu_autoscale_gcs_dark", "gauge") is not None
+
+
+# ---------------------------------------------------------------------------
+# control-plane batch frames (GCS hot paths)
+# ---------------------------------------------------------------------------
+
+
+def _register(svc, node_id):
+    svc.rpc_register_node({
+        "node_id": node_id, "addr": ("127.0.0.1", 0),
+        "resources": {"CPU": 4}, "labels": {},
+    }, None)
+
+
+def _snap(node, seq, total, epoch="e1"):
+    return {
+        "epoch": f"{node}-{epoch}", "seq": seq,
+        "ts_monotonic": float(seq), "ts_wall": float(seq),
+        "metrics": [{
+            "name": "ray_tpu_bench_ops_total", "type": "counter",
+            "description": "", "tag_keys": ["node"], "agg": "sum",
+            "series": [{"tags": [node], "value": float(total)}],
+        }],
+    }
+
+
+@pytest.fixture
+def gcs():
+    from ray_tpu.cluster.gcs_service import GcsService
+
+    return GcsService()
+
+
+def test_heartbeat_batch_matches_individual_semantics(gcs):
+    _register(gcs, "n0")
+    _register(gcs, "n1")
+    out = gcs.rpc_heartbeat_batch({"heartbeats": [
+        {"node_id": "n0", "available": {"CPU": 3},
+         "telemetry": _snap("n0", 1, 10)},
+        {"node_id": "n1", "available": {"CPU": 4},
+         "telemetry": _snap("n1", 1, 5)},
+        {"node_id": "ghost"},                       # unknown -> reregister
+    ]}, None)
+    assert out["ok"]
+    assert [r.get("ok") for r in out["results"]] == [True, True, False]
+    assert out["results"][2].get("reregister") is True
+    agg = gcs.telemetry.cluster_metrics()
+    c = agg["counters"]["ray_tpu_bench_ops_total"]
+    assert c["total"] == 15.0
+    assert set(agg["reporters"]) == {"n0", "n1"}
+
+
+def test_rpc_batch_dispatches_and_isolates(gcs):
+    _register(gcs, "n0")
+    out = gcs.rpc_batch({"ops": [
+        {"method": "kv_put", "payload": {"key": "k", "value": 1}},
+        {"method": "heartbeat", "payload": {
+            "node_id": "n0", "telemetry": _snap("n0", 1, 7)}},
+        {"method": "telemetry_push", "payload": {
+            "reporter_id": "svc0", "kind": "engine",
+            "snapshot": _snap("svc0", 1, 3)}},
+        {"method": "kv_get", "payload": {"key": "k"}},
+        {"method": "kv_wait", "payload": {"key": "k"}},   # long-poll: refused
+    ]}, None)
+    assert out["ok"]
+    res = out["results"]
+    assert res[1]["ok"] is True                    # heartbeat accepted
+    assert res[2]["ok"] is True                    # push ingested
+    assert "not batchable" in res[4]["error"]
+    agg = gcs.telemetry.cluster_metrics()
+    assert agg["counters"]["ray_tpu_bench_ops_total"]["total"] == 10.0
+    # the kv ops really dispatched
+    assert gcs.rpc_kv_get({"key": "k"}, None) == res[3]
+
+
+def test_batch_rejected_heartbeat_never_ingests_telemetry(gcs):
+    """A beat from an unknown node is told to re-register AND its
+    piggyback is dropped — same rule as the unbatched path."""
+    out = gcs.rpc_heartbeat_batch({"heartbeats": [
+        {"node_id": "ghost", "telemetry": _snap("ghost", 1, 99)},
+    ]}, None)
+    assert out["results"][0].get("reregister") is True
+    assert "ghost" not in gcs.telemetry.cluster_metrics()["reporters"]
+
+
+def test_ingest_batch_converges_exactly_after_drops_and_restart():
+    """Batched ingest keeps the epoch-banked convergence contract:
+    dropped snapshots cost freshness only, an epoch restart banks the
+    dead epoch's totals, and re-sent frames are seq-dropped — the
+    aggregate equals ground truth exactly."""
+    from ray_tpu.obs.telemetry import TelemetryStore
+
+    a, b = TelemetryStore(), TelemetryStore()
+    # store a: one-by-one; store b: the same snapshots in batch frames
+    frames = [
+        _snap("n0", 1, 5), _snap("n0", 2, 9),      # seq 3 dropped in flight
+        _snap("n0", 4, 20),
+        _snap("n0", 1, 4, epoch="e2"),             # restart: totals reset
+        _snap("n0", 1, 4, epoch="e2"),             # duplicate delivery
+        _snap("n0", 2, 6, epoch="e2"),
+    ]
+    for f in frames:
+        a.ingest("n0", f, {"kind": "node"})
+    results = b.ingest_batch([("n0", f, {"kind": "node"}) for f in frames])
+    assert results[4].get("ignored") == "stale_seq"
+    ground_truth = 20 + 6                          # banked e1 final + live e2
+    for store in (a, b):
+        agg = store.cluster_metrics()
+        assert agg["counters"]["ray_tpu_bench_ops_total"]["total"] == ground_truth
+
+
+# ---------------------------------------------------------------------------
+# capture gates (tier-1): the checked-in r20 benchmark results
+# ---------------------------------------------------------------------------
+
+
+def _load_capture(name):
+    path = os.path.join(REPO, "benchmarks", name)
+    assert os.path.exists(path), f"{name} capture missing"
+    with open(path) as f:
+        return json.load(f)
+
+
+def test_controlplane_capture_gate_r20():
+    """Batched heartbeat/telemetry ingest must sustain higher ops/sec
+    than unbatched at the largest node count, with exact telemetry
+    convergence under drops and an epoch restart."""
+    cap = _load_capture("CONTROLPLANE_gcs_r20.json")
+    assert cap["bench"] == "controlplane_gcs"
+    results = cap["results"]
+    assert len(results) >= 2
+    largest = max(results, key=lambda r: r["nodes"])
+    assert largest["nodes"] >= 16
+    assert largest["batched_ops_per_s"] > largest["unbatched_ops_per_s"], largest
+    conv = cap["convergence"]
+    assert conv["pushes_dropped"] >= 1
+    assert conv["epoch_restarts"] >= 1
+    assert conv["counter_aggregated"] == conv["counter_ground_truth"], conv
+    assert conv["exact"] is True
+
+
+def test_autoscale_capture_gate_r20():
+    """The serving A/B gate: autoscaled stays green where the static
+    underprovisioned pool goes red, at lower replica-seconds than the
+    peak-provisioned static pool; at least one scale-to-zero +
+    fabric cold-start cycle served bitwise-identical weights; zero
+    scale actions inside the injected GCS blackout windows."""
+    cap = _load_capture("AUTOSCALE_serving_r20.json")
+    assert cap["bench"] == "autoscale_serving"
+    assert cap["trace"]["kind"] == "diurnal+burst"
+    assert cap["static_underprovisioned"]["slo_grade"] == "red"
+    assert cap["autoscaled"]["slo_grade"] == "green"
+    assert (cap["autoscaled"]["replica_seconds"]
+            < cap["static_peak"]["replica_seconds"]), cap
+    assert cap["autoscaled"]["scale_ups"] >= 1
+    assert cap["autoscaled"]["scale_downs"] >= 1
+    cz = cap["scale_to_zero"]
+    assert cz["cycles"] >= 1
+    assert cz["bitwise_identical"] is True
+    assert cz["tokens_match_reference"] is True
+    assert cz["cold_start_s"] > 0
+    bo = cap["blackout"]
+    assert bo["windows"] >= 1 and bo["ticks_dark"] >= 1
+    assert bo["scale_actions_during_blackout"] == 0
