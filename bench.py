@@ -361,6 +361,27 @@ def main():
         print(json.dumps(result))
         sys.exit(0 if rc == 0 else 1)
 
+    # --fleet: delegate to the multi-tenant fleet benchmark
+    # (benchmarks/fleet_bench.py) in a subprocess — noisy-neighbor A/B,
+    # fleet-vs-static-partition goodput, and the canary ladder under
+    # seeded PREEMPT_ENGINE, writing benchmarks/FLEET_serving_r21.json.
+    # Extra args pass through (--seed, --out).
+    if "--fleet" in sys.argv[1:]:
+        repo = os.path.dirname(os.path.abspath(__file__))
+        child = os.path.join(repo, "benchmarks", "fleet_bench.py")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        argv = [a for a in sys.argv[1:] if a != "--fleet"]
+        rc, out, err = _run_sub(
+            [sys.executable, child] + argv, env, FALLBACK_TIMEOUT_S,
+        )
+        result = _extract_json_line(out)
+        if result is None:
+            fail("fleet benchmark produced no JSON line",
+                 error_tail=(err or out).strip()[-800:])
+        print(json.dumps(result))
+        sys.exit(0 if rc == 0 else 1)
+
     # --profile: the timed capture also runs the ray_tpu.profiler
     # roofline attribution and writes benchmarks/PROFILE_trainstep_r06.json
     if "--profile" in sys.argv[1:]:

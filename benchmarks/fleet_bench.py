@@ -1,0 +1,447 @@
+#!/usr/bin/env python
+"""Multi-tenant fleet capture: the r21 acceptance numbers ->
+benchmarks/FLEET_serving_r21.json.
+
+Three measured claims (``ray_tpu.fleet``):
+
+ * **noisy neighbor** — the same batch-tenant flood is thrown at the
+   fleet twice. With the QoS plane on (weighted-fair shares + priority
+   preemption) the paying tenant's queue-wait SLO grades GREEN while the
+   batch tenant sheds; with it off (flat priorities, open budget) the
+   identical paying traffic grades RED. Isolation is the delta, not the
+   absolute numbers.
+ * **goodput vs static partitioning** — a skewed two-adapter workload
+   (90% hot) over the same replica count: the multiplexed fleet loads
+   the hot adapter wherever there is capacity; the static partition
+   strands the cold adapter's replica. Gate: fleet goodput >= static.
+ * **canary ladder** — a green canary (one replica takes the candidate,
+   grading sees only post-canary traffic) promotes BITWISE-identically
+   across the pool while a seeded PREEMPT_ENGINE kills an engine
+   mid-canary (zero lost requests); a red canary (impossible
+   thresholds) rolls back BITWISE to the retained version.
+
+Run: JAX_PLATFORMS=cpu python benchmarks/fleet_bench.py [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import concurrent.futures
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+PROMPT = [5, 9, 17, 3]
+
+
+def _build(jax_mods):
+    """Late imports so --help works without jax."""
+    from ray_tpu.fleet import (
+        FleetAdmissionRejected,
+        FleetManager,
+        FleetSpec,
+        ModelSpec,
+        TenantSpec,
+        bitwise_equal,
+        local_slo_histograms,
+    )
+    from ray_tpu.llm import EngineConfig, SamplingParams
+    from ray_tpu.models import llama
+    from ray_tpu.obs.telemetry import SLOThresholds, evaluate_slo
+
+    jax_mods.update(locals())
+    return jax_mods
+
+
+def _cfg(M, **kw):
+    kw.setdefault("model", M["llama"].LLAMA_TINY)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_num_seqs", 2)
+    kw.setdefault("max_loras", 2)
+    kw.setdefault("lora_rank", 4)
+    return M["EngineConfig"](**kw)
+
+
+def _adapters(M, seed, scale=0.5, rank=4):
+    m = M["llama"].LLAMA_TINY
+    rng = np.random.RandomState(seed)
+    mk = lambda *shape: (rng.randn(*shape) * scale).astype(np.float32)
+    return {
+        "wq": (mk(m.n_layers, m.d_model, rank),
+               mk(m.n_layers, rank, m.n_heads * m.head_dim)),
+        "wv": (mk(m.n_layers, m.d_model, rank),
+               mk(m.n_layers, rank, m.n_kv_heads * m.head_dim)),
+    }
+
+
+def _p95(hists, name, tag):
+    """p95 from a delta histogram dict (reporting only; grading is
+    evaluate_slo's job)."""
+    series = hists.get(name, {}).get(tag)
+    if not series or series["count"] <= 0:
+        return None
+    want = 0.95 * series["count"]
+    acc = 0.0
+    for edge, n in zip(series["boundaries"], series["buckets"]):
+        acc += n
+        if acc >= want:
+            return round(float(edge), 4)
+    return round(float(series["boundaries"][-1]), 4)
+
+
+QW = "ray_tpu_llm_queue_wait_seconds"
+
+
+def _grade(M, baseline, thresholds, tag="tenant:gold"):
+    hists = M["local_slo_histograms"](baseline=baseline)
+    report = M["evaluate_slo"](hists, thresholds)
+    entry = report["model_tags"].get(tag)
+    return (entry["grade"] if entry else "no_data",
+            _p95(hists, QW, tag))
+
+
+def _flood_arm(M, spec, thresholds, n_gold=4, n_threads=8,
+               flood_tokens=192, seed=7):
+    """One noisy-neighbor arm: flood the batch tenant from threads,
+    send paced paying-tenant requests, grade the paying tenant's own
+    post-warmup SLO series. Returns the arm's capture row."""
+    from ray_tpu.llm.engine import preemption_counter
+
+    mgr = M["FleetManager"](spec, engine_config=_cfg(M), seed=seed)
+    greedy = M["SamplingParams"](max_tokens=6, temperature=0.0)
+    shed = [0]
+    pre0 = dict(preemption_counter().series())
+    try:
+        # warm (compile) before any grading
+        mgr.collect(mgr.submit("gold", "tiny", PROMPT, greedy), timeout_s=300)
+        baseline = M["local_slo_histograms"]()
+        stop = threading.Event()
+
+        def flood():
+            while not stop.is_set():
+                try:
+                    t = mgr.submit("batch", "tiny", PROMPT,
+                                   M["SamplingParams"](max_tokens=flood_tokens))
+                except M["FleetAdmissionRejected"]:
+                    shed[0] += 1
+                    time.sleep(0.002)
+                    continue
+                except Exception:
+                    return
+                try:
+                    mgr.collect(t, timeout_s=300)
+                except Exception:
+                    pass
+
+        threads = [threading.Thread(target=flood, daemon=True)
+                   for _ in range(n_threads)]
+        for th in threads:
+            th.start()
+        time.sleep(1.0)  # let the flood saturate the decode batch + queue
+        done = 0
+        try:
+            for _ in range(n_gold):
+                out = mgr.collect(
+                    mgr.submit("gold", "tiny", PROMPT, greedy), timeout_s=300
+                )
+                done += int(out.finished)
+                time.sleep(0.05)
+        finally:
+            stop.set()
+            for th in threads:
+                th.join(timeout=300)
+        grade, qw_p95 = _grade(M, baseline, thresholds)
+        pre1 = preemption_counter().series()
+        prio = sum(
+            v - pre0.get(k, 0.0)
+            for k, v in pre1.items() if k[2] == "priority"
+        )
+        return {
+            "paying_grade": grade,
+            "paying_queue_wait_p95_s": qw_p95,
+            "gold_completed": done,
+            "batch_shed": shed[0],
+            "priority_preemptions": int(prio),
+        }
+    finally:
+        mgr.close()
+
+
+def phase_noisy_neighbor(M):
+    S = M["SLOThresholds"](ttft_p_s=30.0, tpot_p_s=5.0, queue_wait_p_s=0.3)
+    isolated_spec = M["FleetSpec"](
+        models=(M["ModelSpec"]("tiny", replicas=1),),
+        tenants=(M["TenantSpec"]("gold", priority=2, weight=3.0),
+                 M["TenantSpec"]("batch", priority=0, weight=1.0)),
+        total_queue_budget=8,
+    )
+    # isolation OFF: flat priorities, open budget — nothing sheds,
+    # nothing preempts, the paying tenant waits its FCFS turn
+    flat_spec = M["FleetSpec"](
+        models=(M["ModelSpec"]("tiny", replicas=1),),
+        tenants=(M["TenantSpec"]("gold", priority=0, weight=1.0),
+                 M["TenantSpec"]("batch", priority=0, weight=1.0)),
+        total_queue_budget=64,
+    )
+    isolated = _flood_arm(M, isolated_spec, S)
+    flat = _flood_arm(M, flat_spec, S)
+    return {
+        "isolated": isolated,
+        "no_isolation": flat,
+        "thresholds": {"queue_wait_p95_s": S.queue_wait_p_s,
+                       "ttft_p95_s": S.ttft_p_s, "tpot_p95_s": S.tpot_p_s,
+                       "yellow_factor": S.yellow_factor},
+    }
+
+
+def _drive(mgr, M, reqs, workers=8, max_tokens=24):
+    """Run (tenant, model_ref) requests through a pool; returns
+    (completed, wall_s)."""
+    greedy = M["SamplingParams"](max_tokens=max_tokens, temperature=0.0)
+
+    def one(item):
+        tenant, ref = item
+        return mgr.collect(mgr.submit(tenant, ref, PROMPT, greedy),
+                           timeout_s=300).finished
+
+    t0 = time.monotonic()
+    with concurrent.futures.ThreadPoolExecutor(workers) as ex:
+        done = sum(bool(x) for x in ex.map(one, reqs))
+    return done, time.monotonic() - t0
+
+
+def phase_goodput(M, n_requests=48, hot_fraction=0.9, seed=7):
+    """Same skewed workload, same total replica count (2): multiplexed
+    fleet vs a static one-replica-per-adapter partition."""
+    rng = np.random.RandomState(seed)
+    reqs = [
+        ("gold", "tiny:hot" if rng.rand() < hot_fraction else "tiny:cold")
+        for _ in range(n_requests)
+    ]
+    tenants = (M["TenantSpec"]("gold", priority=1, weight=1.0),)
+
+    def fleet_spec(replicas):
+        return M["FleetSpec"](
+            models=(M["ModelSpec"]("tiny", replicas=replicas),),
+            tenants=tenants, total_queue_budget=64,
+        )
+
+    # multiplexed: both replicas can host both adapters (max_loras=2)
+    mgr = M["FleetManager"](fleet_spec(2), engine_config=_cfg(M), seed=seed)
+    try:
+        mgr.register_adapter("tiny", "hot", _adapters(M, 1))
+        mgr.register_adapter("tiny", "cold", _adapters(M, 2))
+        # warm BOTH replicas on both adapters (compile + residency)
+        _drive(mgr, M, [("gold", "tiny:hot"), ("gold", "tiny:cold")] * 2,
+               max_tokens=4)
+        fleet_done, fleet_wall = _drive(mgr, M, reqs)
+    finally:
+        mgr.close()
+
+    # static partition: one dedicated replica per adapter — the hot
+    # adapter cannot spill onto the cold adapter's idle replica
+    part = {}
+    try:
+        for name in ("hot", "cold"):
+            part[name] = M["FleetManager"](
+                fleet_spec(1), engine_config=_cfg(M), seed=seed
+            )
+            part[name].register_adapter("tiny", name, _adapters(
+                M, 1 if name == "hot" else 2))
+            _drive(part[name], M, [("gold", f"tiny:{name}")] * 2,
+                   max_tokens=4)
+        t0 = time.monotonic()
+        with concurrent.futures.ThreadPoolExecutor(8) as ex:
+            futs = [
+                ex.submit(
+                    lambda r=ref: part[r.split(":")[1]].collect(
+                        part[r.split(":")[1]].submit(
+                            "gold", r, PROMPT,
+                            M["SamplingParams"](max_tokens=24,
+                                                temperature=0.0),
+                        ),
+                        timeout_s=300,
+                    ).finished
+                )
+                for _, ref in reqs
+            ]
+            static_done = sum(bool(f.result()) for f in futs)
+        static_wall = time.monotonic() - t0
+    finally:
+        for m in part.values():
+            m.close()
+
+    return {
+        "requests": n_requests,
+        "hot_fraction": hot_fraction,
+        "fleet_completed": fleet_done,
+        "fleet_wall_s": round(fleet_wall, 3),
+        "fleet_goodput_rps": round(fleet_done / max(fleet_wall, 1e-9), 3),
+        "static_completed": static_done,
+        "static_wall_s": round(static_wall, 3),
+        "static_goodput_rps": round(static_done / max(static_wall, 1e-9), 3),
+    }
+
+
+def phase_canary(M, seed=7):
+    """Green canary under seeded PREEMPT_ENGINE (promote, bitwise, zero
+    lost), then a red canary (rollback, bitwise)."""
+    import jax
+    from ray_tpu.chaos import harness as chaos
+    from ray_tpu.chaos.schedule import FaultSchedule, FaultSpec
+
+    def perturbed(params, factor):
+        return jax.tree_util.tree_map(
+            lambda x: np.asarray(x) * np.asarray(factor, np.asarray(x).dtype),
+            params,
+        )
+
+    spec = M["FleetSpec"](
+        models=(M["ModelSpec"]("tiny", replicas=2),),
+        tenants=(M["TenantSpec"]("gold", priority=1, weight=1.0),),
+        total_queue_budget=64,
+    )
+    # generous grading for the GREEN arm: mid-canary engine preemption
+    # re-prefills in-flight requests, which inflates TTFT — that is
+    # recovery cost, not a bad candidate
+    green_thresholds = M["SLOThresholds"](
+        ttft_p_s=120, tpot_p_s=120, queue_wait_p_s=120
+    )
+    timeline = []
+    mgr = M["FleetManager"](spec, engine_config=_cfg(M, max_num_seqs=4),
+                            seed=seed, thresholds=green_thresholds)
+    sched = chaos.install(FaultSchedule(13, [
+        FaultSpec(chaos.PREEMPT_ENGINE, site="llm.engine.step",
+                  start_after=8, every_n=30, max_fires=2),
+    ]))
+    try:
+        reps = mgr.replicas("tiny")
+        new = perturbed(reps[0].engine.params, 1.001)
+        info = mgr.weights.begin_canary("tiny", params=new)
+
+        def one(i):
+            t = mgr.submit("gold", "tiny", PROMPT + [i],
+                           M["SamplingParams"](max_tokens=8, temperature=0.0))
+            return mgr.collect(t, timeout_s=300)
+
+        n = 10
+        with concurrent.futures.ThreadPoolExecutor(8) as ex:
+            outs = list(ex.map(one, range(n)))
+        completed = sum(1 for o in outs if o.finished)
+        fired = sched.fired_kinds().count(chaos.PREEMPT_ENGINE)
+        g = mgr.weights.canary_grade()
+        rep = mgr.weights.decide(g["grade"])
+        promoted_bitwise = (
+            rep.get("outcome") == "promoted"
+            and all(M["bitwise_equal"](r.engine.params, new)
+                    for r in mgr.replicas("tiny"))
+        )
+        promote_row = {
+            "grade": g["grade"],
+            "bitwise_identical": bool(promoted_bitwise),
+            "version": info["version"],
+            "canary_replica": info["replica"],
+        }
+        timeline.extend(mgr.weights.timeline)
+    finally:
+        chaos.uninstall()
+        mgr.close()
+
+    # red arm: impossible thresholds — the grade ladder rejects the
+    # candidate and rollback must restore the retained bytes bitwise
+    mgr = M["FleetManager"](
+        spec, engine_config=_cfg(M, max_num_seqs=4), seed=seed,
+        thresholds=M["SLOThresholds"](ttft_p_s=1e-9, tpot_p_s=1e-9,
+                                      queue_wait_p_s=1e-9, yellow_factor=1.0),
+    )
+    try:
+        reps = mgr.replicas("tiny")
+        old = jax.tree_util.tree_map(np.asarray, reps[0].engine.params)
+        mgr.weights.begin_canary("tiny", params=perturbed(old, 1.5))
+        for i in range(3):
+            mgr.collect(
+                mgr.submit("gold", "tiny", PROMPT + [i],
+                           M["SamplingParams"](max_tokens=6, temperature=0.0)),
+                timeout_s=300,
+            )
+        g = mgr.weights.canary_grade()
+        rep = mgr.weights.decide(g["grade"])
+        rolled_bitwise = (
+            rep.get("outcome") == "rolled_back"
+            and all(M["bitwise_equal"](r.engine.params, old)
+                    for r in mgr.replicas("tiny"))
+        )
+        rollback_row = {"grade": g["grade"],
+                        "bitwise_identical": bool(rolled_bitwise)}
+        timeline.extend(mgr.weights.timeline)
+    finally:
+        mgr.close()
+
+    return {
+        "promote": promote_row,
+        "rollback": rollback_row,
+        "requests_completed": completed,
+        "requests_lost": n - completed,
+        "preemptions_fired": fired,
+        "timeline": timeline,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=None,
+                    help="output path (default benchmarks/FLEET_serving_r21.json)")
+    ap.add_argument("--seed", type=int, default=7)
+    args, _ = ap.parse_known_args()
+
+    os.environ.setdefault("RAY_TPU_NUM_CPUS", "8")
+    import jax
+
+    M = _build({})
+    t0 = time.monotonic()
+
+    nn = phase_noisy_neighbor(M)
+    gp = phase_goodput(M, seed=args.seed)
+    can = phase_canary(M, seed=args.seed)
+
+    gates = {
+        "paying_green_with_isolation": nn["isolated"]["paying_grade"] == "green",
+        "paying_red_without_isolation": nn["no_isolation"]["paying_grade"] == "red",
+        "goodput_beats_static":
+            gp["fleet_goodput_rps"] >= gp["static_goodput_rps"],
+        "canary_promote_bitwise": can["promote"]["bitwise_identical"],
+        "canary_rollback_bitwise": can["rollback"]["bitwise_identical"],
+        "zero_lost_requests": can["requests_lost"] == 0,
+        "preemption_fired_mid_canary": can["preemptions_fired"] >= 1,
+    }
+    result = {
+        "bench": "fleet_serving",
+        "platform": jax.devices()[0].platform,
+        "elapsed_s": round(time.monotonic() - t0, 2),
+        "noisy_neighbor": nn,
+        "goodput": gp,
+        "canary": can,
+        "gates": gates,
+    }
+    if not all(gates.values()):
+        result["metric"] = "benchmark_error"
+        result["failed_gates"] = [k for k, v in gates.items() if not v]
+
+    out = args.out or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "FLEET_serving_r21.json"
+    )
+    if all(gates.values()):
+        with open(out, "w") as f:
+            f.write(json.dumps(result, indent=2) + "\n")
+    print(json.dumps(result))
+    return 0 if all(gates.values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

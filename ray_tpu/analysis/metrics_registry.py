@@ -35,6 +35,7 @@ INSTRUMENTED = [
     ("ray_tpu.llm.kvfetch.metrics", "register_metrics"),
     ("ray_tpu.rl.post_train.metrics", "register_metrics"),
     ("ray_tpu.autoscale.metrics", "register_metrics"),
+    ("ray_tpu.fleet.metrics", "register_metrics"),
 ]
 
 _NAME_RE = re.compile(r"^(ray_tpu|llm)_[a-z0-9][a-z0-9_]*$")

@@ -88,6 +88,10 @@ SCAN_DIRS = (
     # r20: the autoscale controller — signal fetches and actuator calls
     # cross the RPC plane, so every wait must carry its bound
     "ray_tpu/autoscale",
+    # r21: the fleet plane — request submission crosses replica runner
+    # queues and the canary ladder polls SLO grades; both must park in
+    # bounded slices
+    "ray_tpu/fleet",
 )
 
 

@@ -40,6 +40,10 @@ DEFAULT_PACKAGES = (
     # r20: the autoscale control loop — a controller thread ticking
     # against GCS telemetry while actuators mutate shared pool maps
     "ray_tpu/autoscale",
+    # r21: the multi-tenant fleet plane — replica runner threads, the
+    # QoS admission tables, and the canary weight plane share state
+    # between the ingress and every replica's engine loop
+    "ray_tpu/fleet",
 )
 
 

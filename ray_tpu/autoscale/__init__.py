@@ -20,6 +20,7 @@ brand-new replica. This package is the controller in the middle:
 
 from ray_tpu.autoscale.actuators import (
     EnginePoolActuator,
+    FleetPoolActuator,
     PoolActuator,
     ServePoolActuator,
 )
@@ -58,6 +59,7 @@ __all__ = [
     "ColdStartReport",
     "Decision",
     "EnginePoolActuator",
+    "FleetPoolActuator",
     "POOL_DECODE",
     "POOL_PREFILL",
     "PoolActuator",

@@ -76,6 +76,33 @@ class ServePoolActuator(PoolActuator):
         return self._call("pool_state", None)
 
 
+class FleetPoolActuator(PoolActuator):
+    """Drive a FleetManager's per-model replica pools (r21): pools are
+    base model ids, targets converge via ``FleetManager.set_pool_target``
+    (spawned replicas stream the fleet's current weight version from the
+    weight plane; scale-down retires only replicas that drain idle — the
+    same never-hard-kill invariant as the other actuators)."""
+
+    def __init__(self, manager: Any, drain_timeout_s: float = 5.0):
+        self._manager = manager
+        self._drain_timeout_s = drain_timeout_s
+
+    def apply(self, decision: Decision) -> None:
+        if not decision.is_scale_action or decision.target is None:
+            return
+        target = max(1, int(decision.target))  # a fleet model never parks at 0
+        got = self._manager.set_pool_target(
+            decision.pool, target, drain_timeout_s=self._drain_timeout_s
+        )
+        logger.info(
+            "fleet pool %s -> %d (%s): now %d replica(s)",
+            decision.pool, target, decision.action, got,
+        )
+
+    def pool_state(self) -> Dict[str, dict]:
+        return self._manager.pool_state()
+
+
 class EnginePoolActuator(PoolActuator):
     """In-process pools of replica workers.
 

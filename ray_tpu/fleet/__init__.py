@@ -1,0 +1,52 @@
+"""ray_tpu.fleet — multi-tenant model fleet (ROADMAP item 3, r21).
+
+Maps {base models x LoRA adapters x tenants} onto replica pools:
+model-aware prefix/residency routing, dynamic adapter load/evict
+against each engine's slot budget, per-tenant weighted-fair admission
+with priority preemption, and a versioned canary weight-rollout plane
+over the fabric (promote-on-green / rollback-on-red, bitwise-gated).
+"""
+
+from ray_tpu.fleet.config import (
+    AdapterSpec,
+    CanaryStateError,
+    FleetError,
+    FleetSpec,
+    ModelSpec,
+    TenantSpec,
+    UnknownModelError,
+    UnknownTenantError,
+)
+from ray_tpu.fleet.ingress import FleetServer
+from ray_tpu.fleet.manager import (
+    FleetAdmissionRejected,
+    FleetManager,
+    FleetReplica,
+    FleetTicket,
+)
+from ray_tpu.fleet.qos import TenantQoSController
+from ray_tpu.fleet.weights import (
+    FleetWeightPlane,
+    bitwise_equal,
+    local_slo_histograms,
+)
+
+__all__ = [
+    "AdapterSpec",
+    "CanaryStateError",
+    "FleetAdmissionRejected",
+    "FleetError",
+    "FleetManager",
+    "FleetReplica",
+    "FleetServer",
+    "FleetSpec",
+    "FleetTicket",
+    "FleetWeightPlane",
+    "ModelSpec",
+    "TenantSpec",
+    "TenantQoSController",
+    "UnknownModelError",
+    "UnknownTenantError",
+    "bitwise_equal",
+    "local_slo_histograms",
+]

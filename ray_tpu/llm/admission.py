@@ -18,7 +18,8 @@ per finished request *prices* both the shedding decision and the
 
 Draining (SIGTERM / maintenance) turns every new request into a 503
 with ``Retry-After`` while in-flight requests finish. Rejections are
-counted in ``llm_admission_rejected_total{model,code}``.
+counted in ``llm_admission_rejected_total{model,code,tenant}`` (tenant
+empty outside a multi-tenant fleet).
 """
 
 from __future__ import annotations
@@ -56,8 +57,9 @@ def rejected_counter() -> Counter:
     return Counter(
         "llm_admission_rejected_total",
         description="serving admission control: requests shed with 429 "
-        "(overload) or 503 (draining)",
-        tag_keys=("model", "code"),
+        "(overload) or 503 (draining), attributable per tenant (empty "
+        "tenant = pre-fleet single-tenant serving)",
+        tag_keys=("model", "code", "tenant"),
     )
 
 
@@ -70,11 +72,14 @@ class AdmissionController:
     """Per-LLMServer admission decisions; thread-safe, observability-fed."""
 
     def __init__(self, config: Optional[AdmissionConfig] = None,
-                 model_tag: str = "engine"):
+                 model_tag: str = "engine", tenant: str = ""):
         from collections import deque
 
         self.config = config or AdmissionConfig()
         self.model_tag = model_tag
+        # fleet QoS: one controller per tenant labels its shed counters;
+        # the pre-fleet single-tenant server leaves this empty
+        self.tenant = tenant
         self.draining = False
         self._lock = threading.Lock()
         # (t, cum_sum, cum_count) snapshots of the queue_wait histogram,
@@ -205,7 +210,8 @@ class AdmissionController:
     def _count(self, code: str) -> None:
         try:
             rejected_counter().inc(
-                tags={"model": self.model_tag, "code": code}
+                tags={"model": self.model_tag, "code": code,
+                      "tenant": self.tenant}
             )
         except Exception:  # noqa: BLE001
             pass

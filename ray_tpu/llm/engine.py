@@ -74,6 +74,24 @@ def prefix_cache_lookup_counter():
     )
 
 
+def preemption_counter():
+    """Requests kicked out of a running batch, attributable per tenant:
+    `reason` separates KV-pressure preemptions (pressure), priority
+    preemptions where a paying tenant displaced a batch tenant
+    (priority), and crash-recovery re-enqueues (recover). The tenant
+    label is what makes a fleet's noisy-neighbor story auditable — the
+    batch tenant's preempt rate should rise while the paying tenant's
+    stays flat."""
+    from ray_tpu.obs.telemetry import cluster_counter
+
+    return cluster_counter(
+        "llm_preemptions_total",
+        description="requests preempted out of the decode batch, by "
+        "model, tenant, and reason (pressure/priority/recover)",
+        tag_keys=("model", "tenant", "reason"),
+    )
+
+
 def utilization_gauges() -> dict:
     """Per-engine utilization gauges for the cluster telemetry plane
     (obs/telemetry.py): the fleet view the SLO-driven autoscaler sizes
@@ -116,7 +134,17 @@ def register_metrics() -> None:
     """scripts/check_metrics.py hook: force lazy metrics to register."""
     prefix_cache_hit_counter()
     prefix_cache_lookup_counter()
+    preemption_counter()
     utilization_gauges()
+
+
+class AdapterSlotsExhausted(ValueError):
+    """Every LoRA adapter slot is loaded and none can be evicted (all
+    referenced by in-flight requests, or eviction was not requested).
+    Subclasses ValueError so pre-r21 callers matching on the generic
+    add_lora failure keep working; fleet routing catches THIS type to
+    fall back to another replica instead of treating it as a bad
+    request."""
 
 
 @dataclasses.dataclass
@@ -270,6 +298,14 @@ class Request:
     cumulative_logprob: float = 0.0
     token_logprobs: list = dataclasses.field(default_factory=list)
     lora_slot: int = 0
+    # multi-tenant QoS (ray_tpu.fleet): higher priority admits first and
+    # may preempt lower-priority running requests; tenant labels the
+    # preempt/shed counters; slo_tag (when set) records this request's
+    # SLO observations under an EXTRA series beyond the engine's
+    # model_tag — the fleet grades canary replicas and tenants from it
+    priority: int = 0
+    tenant: str = ""
+    slo_tag: Optional[str] = None
     _key: Any = None
     # request tracing (ray_tpu.obs): the submitter's TraceContext; every
     # lifecycle span below records as its child. Timestamps: queue_start
@@ -357,6 +393,10 @@ class LLMEngine:
         # LoRA adapter stacks: slot 0 is the zero adapter ("no lora");
         # per-target A [L, n_slots, d_in, r], B [L, n_slots, r, d_out]
         self._lora_slots: dict[str, int] = {}
+        # lora_id -> last time a request selected it (monotonic): the
+        # LRU order evict_lru_lora / add_lora(evict=True) walk when the
+        # slot budget is exhausted
+        self._lora_last_used: dict[str, float] = {}
         self._lora = None
         if c.max_loras > 0:
             m = c.model
@@ -572,16 +612,25 @@ class LLMEngine:
 
     # -- LoRA multiplexing ----------------------------------------------------
 
-    def add_lora(self, lora_id: str, adapters: dict) -> None:
+    def add_lora(self, lora_id: str, adapters: dict,
+                 evict: bool = False) -> None:
         """Register an adapter: {"wq": (A [L,d,r], B [L,r,out]), ...} for
-        the configured lora_targets. Requests select it by lora_id."""
+        the configured lora_targets. Requests select it by lora_id.
+
+        With ``evict`` a full slot budget evicts the least-recently-used
+        resident adapter first (refusing any with in-flight requests);
+        without it — or when nothing is evictable — raises
+        :class:`AdapterSlotsExhausted`."""
         c = self.config
         if c.max_loras <= 0:
             raise ValueError("EngineConfig.max_loras is 0: LoRA disabled")
         if lora_id in self._lora_slots:
             raise ValueError(f"lora {lora_id!r} already loaded")
         if len(self._lora_slots) >= c.max_loras:
-            raise ValueError(f"all {c.max_loras} adapter slots in use")
+            if not evict or not self.evict_lru_lora():
+                raise AdapterSlotsExhausted(
+                    f"all {c.max_loras} adapter slots in use"
+                )
         # validate EVERYTHING before mutating: a partial write would leave
         # stale weights in a slot still marked free
         for t, (A, B) in adapters.items():
@@ -606,6 +655,7 @@ class LLMEngine:
                 jnp.asarray(B, self.config.model.dtype)
             )
         self._lora_slots[lora_id] = slot
+        self._lora_last_used[lora_id] = time.monotonic()
 
     def remove_lora(self, lora_id: str) -> None:
         slot = self._lora_slots.get(lora_id)
@@ -623,11 +673,33 @@ class LLMEngine:
                 "abort or drain them first"
             )
         self._lora_slots.pop(lora_id)
+        self._lora_last_used.pop(lora_id, None)
         for k in list(self._lora):
             self._lora[k] = self._lora[k].at[:, slot].set(0.0)
         # cached prefixes salted with this slot would serve the NEXT
-        # adapter assigned to it stale K/V
-        self.allocator.drop_prefix_cache()
+        # adapter assigned to it stale K/V — but only THIS slot's chains:
+        # other adapters' cached prefixes (and their deep-tier copies)
+        # are still correct and survive the swap
+        self.allocator.drop_prefix_cache(salt=slot)
+
+    def evict_lru_lora(self) -> Optional[str]:
+        """Evict the least-recently-used resident adapter that has no
+        in-flight requests referencing its slot. Returns the evicted
+        lora_id, or None when every resident adapter is pinned by
+        in-flight work (the caller decides whether that is
+        AdapterSlotsExhausted or a retry)."""
+        busy = {r.lora_slot for r in list(self.waiting) + self.running}
+        candidates = sorted(
+            (lid for lid, slot in self._lora_slots.items()
+             if slot not in busy),
+            key=lambda lid: self._lora_last_used.get(lid, 0.0),
+        )
+        if not candidates:
+            return None
+        victim = candidates[0]
+        self.remove_lora(victim)
+        logger.info("evicted LRU adapter %r", victim)
+        return victim
 
     def _lora_slot(self, lora_id) -> int:
         if lora_id is None:
@@ -652,10 +724,15 @@ class LLMEngine:
         request_id: Optional[str] = None,
         lora_id: Optional[str] = None,
         trace: Optional[trace_context.TraceContext] = None,
+        priority: int = 0,
+        tenant: str = "",
+        slo_tag: Optional[str] = None,
     ) -> str:
         sp = sampling_params or SamplingParams()
         rid = request_id or f"req-{next(self._counter)}"
         lora_slot = self._lora_slot(lora_id)
+        if lora_id is not None:
+            self._lora_last_used[lora_id] = time.monotonic()
         if len(prompt_token_ids) > self.config.max_prefill_len:
             raise ValueError(
                 f"prompt length {len(prompt_token_ids)} exceeds "
@@ -680,6 +757,9 @@ class LLMEngine:
             )
         req = Request(rid, list(map(int, prompt_token_ids)), sp)
         req.lora_slot = lora_slot
+        req.priority = int(priority)
+        req.tenant = tenant
+        req.slo_tag = slo_tag
         # every request is traced: explicit ctx from the serving layer, the
         # ambient contextvar (submitter thread), or a fresh root — the
         # flight recorder is bounded, so always-on costs a dict per request
@@ -792,6 +872,34 @@ class LLMEngine:
             # the queue head's match_prefix finds its prefix resident
             # and _admission_need discounts the live-shared blocks
             self.kvfetch.tick()
+        if self.waiting:
+            # QoS admission order: the highest-priority waiting request
+            # is admitted first (stable — strictly FIFO when priorities
+            # are uniform, i.e. every pre-fleet deployment)
+            self._promote_priority()
+            head = self.waiting[0]
+            if head.priority > 0 and self.running and (
+                len(self.running) >= self.config.max_num_seqs
+                or self._admission_need(head) > self.allocator.num_free
+            ):
+                # priority preemption: a paying tenant's request blocked
+                # on batch-slot or KV pressure displaces the lowest-
+                # priority running request (a batch tenant's decode /
+                # prefill) through the normal preempt/recover ladder —
+                # the victim recomputes, nothing is lost
+                victim = min(
+                    self.running, key=lambda r: (r.priority, -r.arrival)
+                )
+                if victim.priority < head.priority:
+                    flushed = self._pipe_flush()
+                    if flushed:
+                        return flushed
+                    self._preempt_one(
+                        below_priority=head.priority, reason="priority"
+                    )
+                    # the victim re-queued at the head: restore QoS order
+                    # so the admission check below sees the paying tenant
+                    self._promote_priority()
         if (
             self.waiting
             and len(self.running) < self.config.max_num_seqs
@@ -902,6 +1010,14 @@ class LLMEngine:
             r.status = RequestStatus.WAITING
             r.num_preemptions += 1
             self.num_preemptions += 1
+            try:
+                preemption_counter().inc(
+                    1, tags={"model": self.model_tag,
+                             "tenant": r.tenant or "",
+                             "reason": "recover"}
+                )
+            except Exception:  # noqa: BLE001
+                pass
             r.t_queue_start = now
             r.t_span_cursor = None
             self.waiting.appendleft(r)  # reversed-arrival: oldest ends up first
@@ -956,15 +1072,17 @@ class LLMEngine:
         return {"n_tokens": n, "discounted": float(n),
                 "by_tier": ({"hbm": n} if n else {})}
 
-    def drop_prefix_cache(self) -> None:
+    def drop_prefix_cache(self, salt: Optional[int] = None) -> None:
         """Invalidate the prefix cache across EVERY tier: the HBM
         allocator's reuse pool, the host-DRAM and object-store spill
         tiers, and this engine's rows in the cluster prefix index (an
-        empty snapshot ships immediately). The one entry point a weight
+        empty snapshot ships immediately). ``salt`` scopes the drop to
+        one adapter's chains (fleet canary swap) — other tenants' cached
+        prefixes survive. The one entry point a weight
         swap must call — dropping HBM alone would leave deeper tiers
         serving K/V computed with the OLD weights."""
         # the allocator's drop_listener cascades into the tier manager
-        self.allocator.drop_prefix_cache()
+        self.allocator.drop_prefix_cache(salt=salt)
 
     def export_request(self, request_id: str, keep_on_device: bool = False):
         """Export a RUNNING request as a KVHandoff and drop local
@@ -1417,6 +1535,16 @@ class LLMEngine:
                     e2e_s=e2e, finish_reason=r.finish_reason or "",
                     prefill_span_s=prefill_span,
                 )
+                if r.slo_tag and r.slo_tag != self.model_tag:
+                    # fleet QoS/canary plane: the same observation under
+                    # the request's own tag (a tenant or a canary
+                    # replica) so evaluate_slo can grade it in isolation
+                    slo.record_request_slo(
+                        r.slo_tag,
+                        ttft_s=ttft, tpot_s=tpot, queue_wait_s=queue_wait,
+                        e2e_s=e2e, finish_reason=r.finish_reason or "",
+                        prefill_span_s=prefill_span,
+                    )
             except Exception:  # noqa: BLE001
                 pass
 
@@ -1657,11 +1785,45 @@ class LLMEngine:
                 mgr.count_resurrected(tier, n)
         return blocks, len(entries) * bs, parent, tier_counts
 
-    def _preempt_one(self) -> bool:
-        """Kick the newest running request back to waiting (recompute)."""
-        if len(self.running) <= 1:
+    def _promote_priority(self) -> None:
+        """Move the highest-priority waiting request to the queue head.
+        Stable: FIFO within a priority class, and a no-op when
+        priorities are uniform — the pre-fleet engine stays strictly
+        FIFO."""
+        w = self.waiting
+        if len(w) < 2:
+            return
+        best_i = max(range(len(w)), key=lambda i: (w[i].priority, -i))
+        if best_i:
+            req = w[best_i]
+            del w[best_i]
+            w.appendleft(req)
+
+    def _preempt_one(self, below_priority: Optional[int] = None,
+                     reason: str = "pressure") -> bool:
+        """Kick a running request back to waiting (recompute). The
+        victim is the lowest-priority, newest-arrival request —
+        identical to the historical newest-arrival pick when priorities
+        are uniform. ``below_priority`` (the priority-preemption path)
+        only preempts a victim strictly below it, and may empty the
+        batch (the displacing request admits next round); the KV-
+        pressure path keeps the >=2 guard so a batch of one can always
+        make progress."""
+        if not self.running:
             return False
-        victim = max(self.running, key=lambda r: r.arrival)
+        if below_priority is None and len(self.running) <= 1:
+            return False
+        victim = min(self.running, key=lambda r: (r.priority, -r.arrival))
+        if below_priority is not None and victim.priority >= below_priority:
+            return False
+        try:
+            preemption_counter().inc(
+                1, tags={"model": self.model_tag,
+                         "tenant": victim.tenant or "",
+                         "reason": reason}
+            )
+        except Exception:  # noqa: BLE001 — accounting, not correctness
+            pass
         self.running.remove(victim)
         victim.seq.release()
         victim.seq = None
@@ -1672,7 +1834,8 @@ class LLMEngine:
         self.waiting.appendleft(victim)
         now = time.time()
         self._obs_span(victim, "engine.preempt", now, now,
-                       {"num_preemptions": victim.num_preemptions})
+                       {"num_preemptions": victim.num_preemptions,
+                        "reason": reason})
         victim.t_queue_start = now  # next queue_wait span starts here
         victim.t_span_cursor = None
         if self.drafter is not None:

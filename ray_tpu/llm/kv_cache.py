@@ -70,6 +70,14 @@ class BlockAllocator:
         # only the cache holds it)
         self._hash_to_block: dict[int, int] = {}
         self._block_hash: dict[int, int] = {}
+        # content_hash -> root salt of its chain (the first block's
+        # parent_hash IS the salt, so roots are derived incrementally at
+        # seal time). Survives block eviction — it is chain metadata,
+        # not residency — so a resurrected chain still resolves; cleared
+        # only by a full drop. Lets drop_prefix_cache(salt=...) scope an
+        # invalidation to exactly one adapter's chains (fleet canary /
+        # LoRA slot reuse) instead of nuking every tenant's cache.
+        self._hash_salt: dict[int, int] = {}
         # LRU order of zero-ref cached blocks (eviction candidates)
         self._zero_ref_lru: list[int] = []
         # tiered-cache hooks (llm/kvtier): seal_listener(block_id, hash,
@@ -146,24 +154,40 @@ class BlockAllocator:
     def chain_hash(parent_hash: int, block_tokens: tuple) -> int:
         return hash((parent_hash, block_tokens))
 
-    def drop_prefix_cache(self) -> None:
-        """Invalidate ALL cached prefixes: zero-ref cached blocks return to
+    def drop_prefix_cache(self, salt: Optional[int] = None) -> None:
+        """Invalidate cached prefixes: zero-ref cached blocks return to
         the free list, live blocks lose their hashes (they stay private to
         their sequences). Needed when cached K/V may no longer match what
         a salt would recompute — e.g. a LoRA slot being reused by a new
-        adapter."""
-        for b in self._zero_ref_lru:
-            self._block_hash.pop(b, None)
-            self._free.append(b)
-        self._zero_ref_lru.clear()
-        self._hash_to_block.clear()
-        self._block_hash.clear()
+        adapter.
+
+        With ``salt`` the drop is SCOPED to chains rooted at that salt
+        (one adapter's prefixes): other tenants' cached chains — and the
+        deep-tier copies behind them — survive the swap."""
+        if salt is None:
+            for b in self._zero_ref_lru:
+                self._block_hash.pop(b, None)
+                self._free.append(b)
+            self._zero_ref_lru.clear()
+            self._hash_to_block.clear()
+            self._block_hash.clear()
+            self._hash_salt.clear()
+        else:
+            for h in [h for h, s in self._hash_salt.items() if s == salt]:
+                self._hash_salt.pop(h, None)
+                b = self._hash_to_block.pop(h, None)
+                if b is None:
+                    continue
+                self._block_hash.pop(b, None)
+                if b in self._zero_ref_lru:
+                    self._zero_ref_lru.remove(b)
+                    self._free.append(b)
         if self.drop_listener is not None:
             # cascade: deeper tiers (llm/kvtier) hold K/V computed with
             # the same now-stale weights/adapters — invalidation, not
             # spill, and it must reach every tier plus the prefix index
             try:
-                self.drop_listener()
+                self.drop_listener(salt)
             except Exception:  # noqa: BLE001
                 pass
 
@@ -180,6 +204,10 @@ class BlockAllocator:
             return  # another copy already canonical; keep ours private
         self._hash_to_block[content_hash] = block_id
         self._block_hash[block_id] = content_hash
+        # root-salt derivation: a chain's first block has parent_hash ==
+        # its salt, so the root propagates hash-to-hash with one lookup
+        parent = parent_hash if parent_hash is not None else 0
+        self._hash_salt[content_hash] = self._hash_salt.get(parent, parent)
         if self.seal_listener is not None and tokens is not None:
             try:
                 self.seal_listener(block_id, content_hash,

@@ -120,6 +120,12 @@ class KVTierManager:
         # path needs (parent, tokens, prefix length) the allocator's
         # hash->block map doesn't carry. Bounded by the HBM block count.
         self._meta: dict[int, tuple] = {}  # h -> (parent, tokens, n_prefix)
+        # h -> root salt of its chain (first block's parent IS the salt;
+        # propagated hash-to-hash at seal time, same derivation as the
+        # allocator's). Chain metadata, not residency: entries survive
+        # spill/evict so a scoped invalidation (one adapter swapped)
+        # finds every tier's copies; cleared only by invalidate_all.
+        self._root: dict[int, int] = {}
         # host DRAM tier: bounded LRU of SpilledBlocks
         self._host: "OrderedDict[int, SpilledBlock]" = OrderedDict()
         self._host_bytes = 0
@@ -189,7 +195,7 @@ class KVTierManager:
         alloc = self.engine.allocator
         alloc.seal_listener = self.on_seal
         alloc.evict_listener = self.on_evict
-        alloc.drop_listener = self.on_drop_all
+        alloc.drop_listener = self.on_drop
 
     def rebind_allocator(self) -> None:
         """The engine rebuilt its allocator/KV cache (recover(rebuild_kv)):
@@ -207,6 +213,7 @@ class KVTierManager:
         with self._lock:
             self._meta[content_hash] = (parent_hash, tuple(tokens),
                                         int(n_prefix_tokens))
+            self._root[content_hash] = self._root.get(parent_hash, parent_hash)
             self._index_dirty = True
 
     def on_evict(self, block_id: int, content_hash: int) -> None:
@@ -254,10 +261,19 @@ class KVTierManager:
             with self._lock:
                 self.spill_wall_ms.append((time.perf_counter() - t0) * 1e3)
 
+    def on_drop(self, salt: Optional[int] = None) -> None:
+        """The allocator invalidated its prefix cache (weight swap /
+        LoRA slot reuse): cached K/V no longer matches what the current
+        weights would compute, in EVERY tier. Cascade — scoped to one
+        chain root's salt when the allocator scoped its drop (a single
+        adapter swapped under a fleet canary), everything otherwise."""
+        if salt is None:
+            self.invalidate_all()
+        else:
+            self.invalidate_salt(salt)
+
+    # back-compat alias (pre-r21 binding name)
     def on_drop_all(self) -> None:
-        """The allocator invalidated its whole prefix cache (weight
-        swap / LoRA slot reuse): cached K/V no longer matches what the
-        current weights would compute, in EVERY tier. Cascade."""
         self.invalidate_all()
 
     # -- spill path ------------------------------------------------------------
@@ -750,12 +766,46 @@ class KVTierManager:
                     pass
             self._obj.clear()
             self._obj_bytes = 0
+            self._root.clear()
             self._index_dirty = True
         kvf = getattr(self.engine, "kvfetch", None)
         if kvf is not None:
             # staged prefetch chains and reservations reference pre-swap
             # KV: drop them (and free the reservation refs) NOW, before
             # the engine-thread tick could scatter stale pages
+            kvf.reset()
+        self.flush_index(force=True)
+
+    def invalidate_salt(self, salt: int) -> None:
+        """One adapter swapped (fleet canary / LoRA slot reuse): only
+        chains rooted at ``salt`` are stale. Drops those chains' host +
+        object + pending entries; every other tenant's tiers survive.
+        The generation still bumps — an in-flight gather or fetch has no
+        salt attached, so in-flight inserts are (conservatively) dropped
+        regardless of chain — and staged prefetches reset for the same
+        reason. Resident entries of other salts are what the scoping
+        saves, and they are the expensive part."""
+        with self._lock:
+            self.generation += 1
+            doomed = [h for h, r in self._root.items() if r == salt]
+            for h in doomed:
+                self._root.pop(h, None)
+                self._meta.pop(h, None)
+                self._pending.pop(h, None)
+                sb = self._host.pop(h, None)
+                if sb is not None:
+                    self._host_bytes -= sb.nbytes
+                rec = self._obj.pop(h, None)
+                if rec is not None:
+                    oid, nbytes, _p, _np_ = rec
+                    self._obj_bytes -= nbytes
+                    try:
+                        self._store.remove_ref(oid)
+                    except Exception:  # noqa: BLE001
+                        pass
+            self._index_dirty = True
+        kvf = getattr(self.engine, "kvfetch", None)
+        if kvf is not None:
             kvf.reset()
         self.flush_index(force=True)
 

@@ -125,7 +125,11 @@ class _EngineRunner:
         sp: SamplingParams,
         request_id: Optional[str] = None,
         trace=None,
+        **add_kwargs,
     ) -> tuple[str, queue.Queue]:
+        """``add_kwargs`` pass through to ``engine.add_request`` (the
+        fleet plane rides lora_id / priority / tenant / slo_tag here)
+        and are replayed by the full-rebuild recovery rung."""
         q: queue.Queue = queue.Queue()
         with self.lock:
             # checked under the lock: the death handler drains _queues under
@@ -135,7 +139,8 @@ class _EngineRunner:
                     f"engine loop died: {self._dead!r}"
                 ) from self._dead
             rid = self.engine.add_request(
-                prompt_ids, sp, request_id=request_id, trace=trace
+                prompt_ids, sp, request_id=request_id, trace=trace,
+                **add_kwargs,
             )
             self._queues[rid] = q
             # "tokens" holds the DELIVERED output prefix (not just a
@@ -144,7 +149,7 @@ class _EngineRunner:
             # never splice two different continuations
             self._inflight[rid] = {
                 "prompt_ids": list(prompt_ids), "sp": sp, "trace": trace,
-                "tokens": [],
+                "tokens": [], "kwargs": dict(add_kwargs),
             }
         self._wake.set()
         return rid, q
@@ -248,7 +253,7 @@ class _EngineRunner:
                     for rid, rec in self._inflight.items():
                         self.engine.add_request(
                             rec["prompt_ids"], rec["sp"], request_id=rid,
-                            trace=rec["trace"],
+                            trace=rec["trace"], **rec.get("kwargs", {}),
                         )
                         self.engine.requests[rid].output_token_ids = list(
                             rec["tokens"]

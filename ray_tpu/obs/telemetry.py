@@ -73,6 +73,9 @@ AGGREGATED_PREFIXES = (
     # r20: SLO closed-loop pool autoscaler (autoscale) — decisions,
     # scale events, cold-start timings behind `== autoscaler ==`
     "ray_tpu_autoscale_",
+    # r21: multi-tenant model fleet (fleet) — adapter residency churn,
+    # canary outcomes, per-tenant routing volume behind `== fleet ==`
+    "ray_tpu_fleet_",
 )
 
 _AGGREGATIONS: dict[str, str] = {}
@@ -1230,6 +1233,55 @@ class TelemetryStore:
             "gcs_dark": dark["value"] if dark else None,
         }
 
+    def fleet_health(self, agg: Optional[dict] = None) -> dict:
+        """Multi-tenant fleet rollup for `ray_tpu status` (r21): per-
+        tenant request and shed counts (whether QoS isolation is pricing
+        the right tenant), adapter slot churn (loads/evictions +
+        residency per base model), canary rollout outcomes, and the
+        preemption mix by reason (a paying tenant's priority preemptions
+        show up here, not buried in engine pressure preemptions). All
+        None/empty when no fleet is reporting."""
+        if agg is None:
+            agg = self.cluster_metrics()
+
+        def counter_total(name):
+            c = agg["counters"].get(_fq(name))
+            return int(c["total"]) if c else None
+
+        def by_tag(name, tag_name):
+            acc = agg["counters"].get(_fq(name))
+            out: dict = {}
+            if acc:
+                for skey, v in acc["series"].items():
+                    key = self._parse_tags_key(skey).get(tag_name, "")
+                    out[key] = out.get(key, 0) + int(v)
+            return out
+
+        resident: dict = {}
+        g = agg["gauges"].get(_fq("ray_tpu_fleet_resident_adapters"))
+        if g:
+            for skey, v in g["series"].items():
+                model = self._parse_tags_key(skey).get("model", "")
+                resident[model] = resident.get(model, 0) + int(v)
+        return {
+            "tenant_requests": by_tag(
+                "ray_tpu_fleet_tenant_requests_total", "tenant"),
+            "rejections_by_tenant": {
+                t: n for t, n in by_tag(
+                    "ray_tpu_llm_admission_rejected_total", "tenant"
+                ).items() if t
+            },
+            "adapter_loads_total": counter_total(
+                "ray_tpu_fleet_adapter_loads_total"),
+            "adapter_evictions_total": counter_total(
+                "ray_tpu_fleet_adapter_evictions_total"),
+            "resident_adapters_by_model": resident,
+            "canary_by_outcome": by_tag(
+                "ray_tpu_fleet_canary_rollouts_total", "outcome"),
+            "preemptions_by_reason": by_tag(
+                "ray_tpu_llm_preemptions_total", "reason"),
+        }
+
     def status_payload(self, thresholds: Optional[SLOThresholds] = None) -> dict:
         """Everything `ray_tpu status` needs beyond the node table — the
         GCS assembles this so the CLI is ONE RPC. The full aggregation
@@ -1247,6 +1299,7 @@ class TelemetryStore:
             "kvtier": self.kvtier_health(agg),
             "rl_post": self.rl_post_health(agg),
             "autoscale": self.autoscale_health(agg),
+            "fleet": self.fleet_health(agg),
         }
 
 
@@ -1448,6 +1501,44 @@ def format_status(report: dict) -> str:
                 f"  publishes {int(pub or 0)}"
                 f"  rollout preemptions {int(pre or 0)}"
             )
+    fl = report.get("fleet") or {}
+    if fl.get("tenant_requests") or fl.get("adapter_loads_total"):
+        # tenant isolation must SHOW here: who is actually being served,
+        # who is being shed, who is being preempted for whom — plus the
+        # adapter slot churn and canary rollout scoreboard
+        lines.append("== fleet ==")
+        tr = fl.get("tenant_requests") or {}
+        rj = fl.get("rejections_by_tenant") or {}
+        lines.append(
+            "  tenants "
+            + (" ".join(
+                f"{t}={int(n)}"
+                + (f"(-{int(rj[t])})" if rj.get(t) else "")
+                for t, n in sorted(tr.items())
+            ) or "-")
+        )
+        line = (
+            f"  adapters loaded {int(fl.get('adapter_loads_total') or 0)}"
+            f" / evicted {int(fl.get('adapter_evictions_total') or 0)}"
+        )
+        res = fl.get("resident_adapters_by_model") or {}
+        if res:
+            line += "  resident " + " ".join(
+                f"{m}={int(n)}" for m, n in sorted(res.items())
+            )
+        lines.append(line)
+        can = fl.get("canary_by_outcome") or {}
+        pre = fl.get("preemptions_by_reason") or {}
+        if can or pre:
+            line = "  canary " + (
+                " ".join(f"{o}={int(n)}" for o, n in sorted(can.items())
+                         if n) or "-"
+            )
+            if pre:
+                line += "  preemptions " + " ".join(
+                    f"{r}={int(n)}" for r, n in sorted(pre.items()) if n
+                )
+            lines.append(line)
     asc = report.get("autoscale") or {}
     if asc.get("decisions_total"):
         lines.append("== autoscaler ==")
