@@ -450,9 +450,9 @@ def main() -> int:
                            and cz["tokens_match_reference"]),
     }
     cap["gate"] = gate
-    with open(args.out, "w") as f:
-        json.dump(cap, f, indent=2, sort_keys=True)
-        f.write("\n")
+    from ray_tpu.obs.perfwatch import save_capture
+
+    save_capture(args.out, cap)
     print(f"wrote {args.out}")
     ok = all(gate.values())
     print("gate:", "PASS" if ok else f"FAIL {gate}")

@@ -270,9 +270,11 @@ def main() -> int:
             "convergence_exact": convergence["exact"],
         },
     }
-    with open(args.out, "w") as f:
-        json.dump(cap, f, indent=2, sort_keys=True)
-        f.write("\n")
+    # capture-ledger discipline: envelope (fingerprint + tolerance
+    # bands) so check_perf can gate future runs against this one
+    from ray_tpu.obs.perfwatch import save_capture
+
+    save_capture(args.out, cap)
     print(f"wrote {args.out}")
     ok = (cap["gate"]["batched_beats_unbatched_at_largest"]
           and cap["gate"]["convergence_exact"])

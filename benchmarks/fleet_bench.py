@@ -437,8 +437,9 @@ def main() -> int:
         os.path.dirname(os.path.abspath(__file__)), "FLEET_serving_r21.json"
     )
     if all(gates.values()):
-        with open(out, "w") as f:
-            f.write(json.dumps(result, indent=2) + "\n")
+        from ray_tpu.obs.perfwatch import save_capture
+
+        save_capture(out, result)
     print(json.dumps(result))
     return 0 if all(gates.values()) else 1
 

@@ -79,6 +79,15 @@ _KVFETCH_OUT = _os.path.join(
 )
 
 
+def _write_capture(path: str, payload: dict) -> None:
+    """Capture-ledger discipline (obs.perfwatch): every capture ships
+    inside the envelope — fingerprint + tolerance bands — so
+    scripts/check_perf.py can gate future runs against it."""
+    from ray_tpu.obs.perfwatch import save_capture
+
+    save_capture(path, payload)
+
+
 def _dist(vals: list) -> dict:
     vals = sorted(float(v) for v in vals)
     if not vals:
@@ -249,8 +258,7 @@ def run_spec_bench(args) -> dict:
             s.name: s.ms for s in prof.segments if s.in_step
         }
         result["spec_profile_coverage_pct"] = prof.coverage_pct
-    with open(args.spec_out, "w") as f:
-        f.write(json.dumps(result, indent=2) + "\n")
+    _write_capture(args.spec_out, result)
     result["spec_out"] = args.spec_out
     return result
 
@@ -474,8 +482,7 @@ def run_disagg_bench(args) -> dict:
             "capture carries is the RELATIVE degradation (disagg must not "
             "degrade more than colocated) and the >=90% span coverage"
         )
-    with open(args.disagg_out, "w") as f:
-        f.write(json.dumps(result, indent=2) + "\n")
+    _write_capture(args.disagg_out, result)
     result["disagg_out"] = args.disagg_out
     return result
 
@@ -604,8 +611,7 @@ def run_pipeline_bench(args) -> dict:
             "numpy rebuild / key restack) + the all-done early-out; the "
             "TPU capture is where hidden host latency dominates"
         )
-    with open(args.pipeline_out, "w") as f:
-        f.write(json.dumps(result, indent=2) + "\n")
+    _write_capture(args.pipeline_out, result)
     result["pipeline_out"] = args.pipeline_out
     return result
 
@@ -694,8 +700,7 @@ def run_chaos_bench(args) -> dict:
         "model_params": cfg.num_params(),
         "device": getattr(jax.devices()[0], "device_kind", "cpu"),
     }
-    with open(args.chaos_out, "w") as f:
-        f.write(json.dumps(result, indent=2) + "\n")
+    _write_capture(args.chaos_out, result)
     result["chaos_out"] = args.chaos_out
     return result
 
@@ -885,8 +890,7 @@ def run_kvtier_bench(args) -> dict:
                 > routing_ab["blind"]["cached_token_ratio"],
         },
     }
-    with open(args.kvtier_out, "w") as f:
-        json.dump(doc, f, indent=1)
+    _write_capture(args.kvtier_out, doc)
     return doc
 
 
@@ -1123,8 +1127,7 @@ def run_kvfetch_bench(args) -> dict:
                 < spill["blocking"]["wall_p99_ms"],
         },
     }
-    with open(args.kvfetch_out, "w") as f:
-        json.dump(doc, f, indent=1)
+    _write_capture(args.kvfetch_out, doc)
     return doc
 
 
@@ -1291,8 +1294,7 @@ def main():
             "device": getattr(jax.devices()[0], "device_kind", "cpu"),
             **build_trace_report(get_recorder()),
         }
-        with open(args.trace_out, "w") as f:
-            f.write(json.dumps(report, indent=2) + "\n")
+        _write_capture(args.trace_out, report)
         result["trace_out"] = args.trace_out
         result["trace_coverage_pct_mean"] = report["coverage_pct_mean"]
         if report["phases_ms"]:
@@ -1309,7 +1311,7 @@ def main():
             context_len=min(prompt_len + max_new, cfg.max_seq - 1),
             iters=8 if on_tpu else 6,
         )
-        prof.save(args.profile_out)
+        _write_capture(args.profile_out, prof.to_dict())
         result["profile_out"] = args.profile_out
         result["profile_coverage_pct"] = prof.coverage_pct
         result["profile_top_segment"] = max(

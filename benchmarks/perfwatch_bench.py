@@ -1,0 +1,222 @@
+#!/usr/bin/env python
+"""Perfwatch sampler capture (r22): always-on sampled profiling against
+a live tiny trainer + engine -> benchmarks/PERFWATCH_obs_r22.json.
+
+What it measures:
+
+ * **sampler overhead**, in-capture: N uninstrumented train steps are
+   timed, then the same N steps re-run while the sampler is actively
+   probing on its background thread (the worst case — steady state the
+   probe is live at most ``max_duty`` of the time). The capture records
+   the raw concurrent-probe slowdown AND the duty-amortized figure
+   ``raw x max_duty`` the <2% acceptance gate applies to: that is the
+   sampler's long-run cost to the hot path at its configured budget.
+ * **the sampled series**: the background loop must land at least one
+   sample on its own (the always-on path), and both probes — the
+   train-step ladder (split backward rungs + all-reduce overlap) and
+   the engine decode ladder over a scratch KV cache — must export
+   ``ray_tpu_perf_*`` series that round-trip through a TelemetryStore
+   into a graded ``== perf (sampled) ==`` status block.
+
+Run: JAX_PLATFORMS=cpu python benchmarks/perfwatch_bench.py
+     [--out PATH] [--quick] [--steps N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+MAX_DUTY = 0.01
+SAMPLE_DEADLINE_S = 420.0
+
+
+def _train_fixture():
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models import llama
+
+    cfg = dataclasses.replace(llama.LLAMA_TINY, dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.key(0))
+    tokens = jax.random.randint(
+        jax.random.key(1), (4, 65), 0, cfg.vocab_size, jnp.int32
+    )
+    batch = {"tokens": tokens[:, :-1], "targets": tokens[:, 1:]}
+    return cfg, params, batch, optax.adamw(3e-4)
+
+
+def _make_engine(cfg):
+    import jax
+
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine
+    from ray_tpu.models import llama
+
+    return LLMEngine(
+        EngineConfig(model=cfg, num_blocks=64, block_size=8,
+                     max_num_seqs=4, max_prefill_len=64),
+        params=llama.init_params(cfg, jax.random.key(0)),
+        seed=0,
+    )
+
+
+def _step_window(step, state, batch, n: int):
+    """Time n sequential train steps (jit-warmed), returning (state,
+    wall_s)."""
+    import jax
+
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, _ = step(state, batch)
+    jax.block_until_ready(state.params)
+    return state, time.perf_counter() - t0
+
+
+def run_bench(steps: int, quick: bool) -> dict:
+    import jax
+
+    from ray_tpu.models import llama
+    from ray_tpu.obs.perfwatch import PerfSampler
+    from ray_tpu.train.step import TrainState, make_train_step
+
+    cfg, params, batch, opt = _train_fixture()
+    step = make_train_step(lambda p, b: llama.loss_fn(p, b, cfg), opt)
+    state = TrainState.create(params, opt)
+    for _ in range(3):  # compile + settle
+        state, _ = step(state, batch)
+    jax.block_until_ready(state.params)
+
+    # -- uninstrumented control window ------------------------------------
+    state, base_s = _step_window(step, state, batch, steps)
+    base_step_ms = 1e3 * base_s / steps
+    print(f"perfwatch bench: {steps} uninstrumented steps in "
+          f"{base_s:.2f}s ({base_step_ms:.2f} ms/step)")
+
+    engine = _make_engine(cfg)
+    holder = {"state": state}  # the probe reads LIVE params (post-window)
+    sampler = PerfSampler(interval_s=0.05, max_duty=MAX_DUTY)
+    sampler.attach_train_probe(cfg, lambda: holder["state"].params,
+                               batch, opt, iters=2, warmup=1)
+    sampler.attach_engine(engine, iters=3, warmup=1)
+    sampler.start()
+    try:
+        # -- instrumented window: the probe thread is live (its first
+        # probe compiles + measures for far longer than the window, so
+        # this IS the probe-active worst case) ---------------------------
+        state, with_s = _step_window(step, state, batch, steps)
+        holder["state"] = state
+        with_step_ms = 1e3 * with_s / steps
+        raw_pct = max(0.0, 100.0 * (with_s - base_s) / base_s)
+        amortized_pct = raw_pct * MAX_DUTY
+        print(f"  probe-active window: {with_step_ms:.2f} ms/step "
+              f"(raw slowdown {raw_pct:.2f}%, duty-amortized "
+              f"{amortized_pct:.4f}%)")
+
+        # -- the always-on path must land a sample by itself -------------
+        deadline = time.monotonic() + SAMPLE_DEADLINE_S
+        loop_sampled = {}
+        while time.monotonic() < deadline:
+            loop_sampled = sampler.summary()["last"]
+            if loop_sampled:
+                break
+            time.sleep(1.0)
+        # deterministic coverage of BOTH probes for the capture (the
+        # loop's duty budget spaces natural samples far apart)
+        for name in ("train_step", "decode_step"):
+            if name not in {v["step"] for v in loop_sampled.values()}:
+                sampler.sample_once(name)
+        summary = sampler.summary()
+        duty_pct = sampler.duty_pct()
+    finally:
+        sampler.stop()
+
+    # -- the series must survive the telemetry plane into status ----------
+    from ray_tpu.obs.telemetry import (
+        TelemetryStore,
+        annotated_snapshot,
+        format_status,
+    )
+
+    store = TelemetryStore()
+    store.ingest("perfwatch-bench", annotated_snapshot())
+    perf = store.perf_health()
+    status = format_status({**store.status_payload(), "nodes": []})
+    status_ok = "== perf (sampled) ==" in status
+    sampled_steps = set(perf.get("steps", {}))
+
+    return {
+        "steps_per_window": steps,
+        "base_step_ms": round(base_step_ms, 4),
+        "probe_active_step_ms": round(with_step_ms, 4),
+        "sampler_raw_slowdown_pct": round(raw_pct, 4),
+        "sampler_overhead_pct": round(amortized_pct, 4),
+        "max_duty": MAX_DUTY,
+        "in_capture_duty_pct": round(duty_pct, 2),
+        "loop_sampled": bool(loop_sampled),
+        "samples": summary["last"],
+        "probe_errors": summary["errors"],
+        "perf_health": perf,
+        "status_block_ok": status_ok,
+        "gate": {
+            # acceptance: sampler overhead < 2% of uninstrumented wall
+            "overhead_under_2pct": amortized_pct < 2.0,
+            # the background loop sampled on its own (always-on works)
+            "loop_sampled": bool(loop_sampled),
+            # both ladders exported series that survived aggregation
+            "both_probes_sampled":
+                {"train_step", "decode_step"} <= sampled_steps,
+            "status_block_rendered": status_ok,
+        },
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "PERFWATCH_obs_r22.json"))
+    p.add_argument("--quick", action="store_true",
+                   help="small smoke run (not for capture)")
+    p.add_argument("--steps", type=int, default=0,
+                   help="train steps per measurement window")
+    args = p.parse_args()
+
+    steps = args.steps or (60 if args.quick else 400)
+    r = run_bench(steps, args.quick)
+
+    cap = {
+        "bench": "perfwatch_obs",
+        "rev": "r22",
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "metric": "perfwatch_sampler_overhead_pct",
+        "value": r["sampler_overhead_pct"],
+        "unit": "%",
+        **r,
+    }
+
+    from ray_tpu.obs.perfwatch import metric, save_capture
+    from ray_tpu.obs.perfwatch.migrate import derive_metrics
+
+    metrics = derive_metrics(cap)
+    # the headline is an overhead: LOWER is better (the generic headline
+    # derivation assumes throughput-like higher-better)
+    metrics["perfwatch_sampler_overhead_pct"] = metric(
+        cap["value"], "%", better="lower", rel_tol=1.0, abs_tol=0.5)
+    save_capture(args.out, cap, metrics=metrics)
+    print(f"wrote {args.out}")
+    print(json.dumps({"metric": "perfwatch_sampler_overhead_pct",
+                      "value": cap["value"], "unit": "%",
+                      "gate": cap["gate"]}))
+    return 0 if all(cap["gate"].values()) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -278,8 +278,9 @@ def main() -> int:
         "actor_stats": res.actor_stats,
         "device": getattr(jax.devices()[0], "device_kind", "cpu"),
     }
-    with open(args.out, "w") as f:
-        f.write(json.dumps(result, indent=2) + "\n")
+    from ray_tpu.obs.perfwatch import save_capture
+
+    save_capture(args.out, result)
     result["out"] = args.out
     print(json.dumps(result))
     return 0 if result["all_gates_pass"] else 1

@@ -36,6 +36,8 @@ INSTRUMENTED = [
     ("ray_tpu.rl.post_train.metrics", "register_metrics"),
     ("ray_tpu.autoscale.metrics", "register_metrics"),
     ("ray_tpu.fleet.metrics", "register_metrics"),
+    ("ray_tpu.obs.perfwatch.metrics", "register_metrics"),
+    ("ray_tpu.cluster.lockstats", "register_metrics"),
 ]
 
 _NAME_RE = re.compile(r"^(ray_tpu|llm)_[a-z0-9][a-z0-9_]*$")

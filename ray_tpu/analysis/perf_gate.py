@@ -1,0 +1,206 @@
+"""Perf regression gate over the capture ledger (``check_perf``).
+
+Three verdicts when a fresh capture meets the ledger:
+
+ * **fail** — a metric regressed past its tolerance band vs the most
+   recent SAME-FINGERPRINT entry of the same bench family. The failure
+   names the metric, both values, and the band (a perf gate that just
+   says "regressed" is a perf gate people disable).
+ * **record (fingerprint mismatch)** — no same-fingerprint baseline
+   exists. The first TPU capture of a family never fights a CPU
+   baseline; it records as the new baseline for its own hardware.
+ * **record (missing baseline)** — the family has no ledger entry at
+   all; the capture records.
+
+Tier-1 / lint mode (``run_check``): validates the whole ledger — every
+capture file enveloped, every envelope schema-valid, every capture's
+band math self-consistent (a capture must PASS when gated against
+itself; a NaN value or an inverted band surfaces here, not in the first
+real comparison months later).
+
+CLI shim: ``python scripts/check_perf.py`` (ledger check), or
+``python scripts/check_perf.py --capture fresh.json`` to gate fresh
+captures against the ledger (exit 1 on regression).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+from ray_tpu.obs.perfwatch.ledger import (
+    BETTER_HIGHER,
+    CaptureLedger,
+    MetricSpec,
+    envelope_of,
+    fingerprints_match,
+    load_capture,
+    validate_envelope,
+)
+
+PASS = "pass"
+FAIL = "fail"
+RECORD = "record"
+
+
+@dataclasses.dataclass
+class GateResult:
+    status: str                 # PASS | FAIL | RECORD
+    bench: str
+    reason: str
+    failures: list[str] = dataclasses.field(default_factory=list)
+    baseline_path: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.status != FAIL
+
+
+def compare_metric(name: str, fresh: MetricSpec,
+                   base: MetricSpec) -> Optional[str]:
+    """One band comparison; returns a failure string or None.
+
+    The BASELINE's band applies (the checked-in capture owns its own
+    noise model); direction comes from the baseline too — a fresh
+    capture cannot relax a gate by flipping ``better``."""
+    fv, bv = float(fresh.value), float(base.value)
+    if base.better == BETTER_HIGHER:
+        floor = bv * (1.0 - base.rel_tol) - base.abs_tol
+        if fv < floor:
+            return (
+                f"{name}: {fv:g}{base.unit and ' ' + base.unit} regressed "
+                f"below band floor {floor:g} (baseline {bv:g}, "
+                f"rel_tol {base.rel_tol:g})"
+            )
+    else:
+        ceil = bv * (1.0 + base.rel_tol) + base.abs_tol
+        if fv > ceil:
+            return (
+                f"{name}: {fv:g}{base.unit and ' ' + base.unit} regressed "
+                f"above band ceiling {ceil:g} (baseline {bv:g}, "
+                f"rel_tol {base.rel_tol:g})"
+            )
+    return None
+
+
+def evaluate_capture(fresh_doc: dict, baseline_doc: dict,
+                     baseline_path: Optional[str] = None) -> GateResult:
+    """Band math between two enveloped captures of the same family.
+    Metrics only the baseline has are ignored (a bench may drop a
+    number); metrics only the fresh capture has record silently (new
+    numbers start their own history)."""
+    fresh_env = envelope_of(fresh_doc) or {}
+    base_env = envelope_of(baseline_doc) or {}
+    bench = fresh_env.get("bench", "?")
+    failures = []
+    compared = 0
+    base_metrics = base_env.get("metrics") or {}
+    for name, spec in (fresh_env.get("metrics") or {}).items():
+        base_spec = base_metrics.get(name)
+        if base_spec is None:
+            continue
+        compared += 1
+        problem = compare_metric(
+            name, MetricSpec.from_dict(spec), MetricSpec.from_dict(base_spec))
+        if problem:
+            failures.append(f"{bench}: {problem}")
+    if failures:
+        return GateResult(FAIL, bench,
+                          f"{len(failures)} metric(s) regressed past band",
+                          failures, baseline_path)
+    return GateResult(PASS, bench, f"{compared} metric(s) within band",
+                      baseline_path=baseline_path)
+
+
+def gate_capture(fresh_doc: dict, ledger: Optional[CaptureLedger] = None, *,
+                 exclude_path: Optional[str] = None) -> GateResult:
+    """Gate one fresh capture against the ledger: find the most recent
+    same-bench same-fingerprint entry; compare, or record."""
+    ledger = ledger or CaptureLedger()
+    env = envelope_of(fresh_doc)
+    if env is None:
+        return GateResult(FAIL, "?", "capture has no perfwatch envelope",
+                          ["capture has no perfwatch envelope"])
+    bench = env.get("bench", "?")
+    fp = env.get("fingerprint")
+    entries = ledger.entries(bench)
+    if exclude_path is not None:
+        ex = os.path.abspath(exclude_path)
+        entries = [(p, d) for p, d in entries if os.path.abspath(p) != ex]
+    if not entries:
+        return GateResult(RECORD, bench,
+                          "no baseline for this bench family — recording")
+    for path, doc in entries:
+        if fingerprints_match(envelope_of(doc).get("fingerprint"), fp):
+            return evaluate_capture(fresh_doc, doc, path)
+    return GateResult(
+        RECORD, bench,
+        "fingerprint mismatch vs every ledger entry (new hardware "
+        "supersedes, it does not compare) — recording",
+    )
+
+
+def run_check(root: Optional[str] = None) -> list[str]:
+    """Ledger-integrity pass (tier-1 + lint_all): every capture file
+    enveloped, schema-valid, and self-consistent under the band math."""
+    ledger = CaptureLedger(root)
+    problems = []
+    for path in ledger.unenveloped():
+        problems.append(
+            f"{os.path.basename(path)}: capture without a perfwatch "
+            "envelope (run python -m ray_tpu.obs.perfwatch.migrate)"
+        )
+    for path, doc in ledger.entries():
+        name = os.path.basename(path)
+        for p in validate_envelope(doc):
+            problems.append(f"{name}: {p}")
+        # self-gate: a capture must sit inside its own band. Catches
+        # NaN/negative-band corruption where it happened, and proves the
+        # compare path runs over every migrated entry.
+        result = evaluate_capture(doc, doc, path)
+        if not result.ok:
+            problems.extend(f"{name}: self-gate {f}" for f in result.failures)
+    return problems
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--capture", action="append", default=[],
+                    help="fresh capture file(s) to gate against the ledger")
+    ap.add_argument("--ledger", default=None,
+                    help="ledger directory (default: benchmarks/)")
+    args = ap.parse_args(argv)
+
+    ledger = CaptureLedger(args.ledger)
+    rc = 0
+    if args.capture:
+        for path in args.capture:
+            try:
+                doc = load_capture(path)
+            except (OSError, json.JSONDecodeError) as e:
+                print(f"check_perf: {path}: unreadable: {e}")
+                rc = 1
+                continue
+            result = gate_capture(doc, ledger, exclude_path=path)
+            print(f"check_perf: {path}: {result.status} — {result.reason}"
+                  + (f" (baseline {result.baseline_path})"
+                     if result.baseline_path else ""))
+            for f in result.failures:
+                print(f"  - {f}")
+            if not result.ok:
+                rc = 1
+        return rc
+
+    problems = run_check(args.ledger)
+    if problems:
+        print(f"check_perf: {len(problems)} problem(s):")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    n = len(ledger.entries())
+    print(f"check_perf: ok ({n} enveloped captures, bands self-consistent)")
+    return 0

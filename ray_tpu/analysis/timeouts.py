@@ -92,6 +92,10 @@ SCAN_DIRS = (
     # queues and the canary ladder polls SLO grades; both must park in
     # bounded slices
     "ray_tpu/fleet",
+    # r22: the perfwatch sampler — its probe loop parks between ladder
+    # runs and its stop() joins the thread; both must carry bounds (an
+    # observability plane must never be the thing that hangs shutdown)
+    "ray_tpu/obs/perfwatch",
 )
 
 

@@ -25,6 +25,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
+from ray_tpu.cluster.lockstats import TimedRLock
 from ray_tpu.cluster.rpc import RpcServer
 from ray_tpu.obs.telemetry import SLOThresholds, TelemetryStore
 from ray_tpu.utils.logging import get_logger
@@ -76,7 +77,10 @@ class GcsService:
 
     def __init__(self, node_death_timeout_s: float = 5.0,
                  persist_path: Optional[str] = None):
-        self._lock = threading.RLock()
+        # one RLock domain serializes every table (the sharding roadmap's
+        # bottleneck); TimedRLock feeds hold/wait histograms when
+        # lockstats.enable_lock_timing() is on, raw-RLock cost otherwise
+        self._lock = TimedRLock("gcs")
         self._nodes: dict[str, NodeEntry] = {}
         self._actors: dict[bytes, ActorEntry] = {}
         self._named: dict[tuple, bytes] = {}  # (ns, name) -> actor_id
@@ -620,6 +624,12 @@ class GcsService:
 
     def rpc_telemetry_prometheus(self, payload, peer):
         return self.telemetry.prometheus_text()
+
+    def rpc_telemetry_perf(self, payload, peer):
+        """Sampled-profiling rollup (obs.perfwatch): per-step times,
+        coverage, MFU, overlap, regression grades — the dashboard
+        /api/perf surface."""
+        return self.telemetry.perf_health()
 
     def rpc_telemetry_status(self, payload, peer):
         """One-query cluster status (scripts/ray_tpu_status.py): node

@@ -78,6 +78,12 @@ class RpcServer:
         self._thread: Optional[threading.Thread] = None
         self._started = threading.Event()
         self.address: Optional[tuple[str, int]] = None
+        # per-method server latency histogram (obs.perfwatch): built once
+        # here, observed per dispatch — the sharding work needs to know
+        # WHICH control-plane methods pay before partitioning anything
+        from ray_tpu.cluster.lockstats import rpc_latency_histogram
+
+        self._latency_hist = rpc_latency_histogram()
 
     def route(self, method: str, fn: Callable) -> None:
         self._routes[method] = fn
@@ -184,6 +190,7 @@ class RpcServer:
     async def _dispatch(
         self, msg_id, method, payload, peer, writer, write_lock
     ) -> None:
+        t0 = time.perf_counter()
         try:
             fn = self._routes.get(method) or getattr(self._handler, f"rpc_{method}")
             if asyncio.iscoroutinefunction(fn):
@@ -201,6 +208,11 @@ class RpcServer:
                 body = _dump((msg_id, False, e))
             except Exception:
                 body = _dump((msg_id, False, RpcError(repr(e))))
+        # handler latency including executor queueing (that queue IS part
+        # of what a caller experiences), excluding the response write
+        self._latency_hist.observe(
+            (time.perf_counter() - t0) * 1e3, {"method": str(method)}
+        )
         async with write_lock:
             try:
                 writer.write(_LEN.pack(len(body)) + body)

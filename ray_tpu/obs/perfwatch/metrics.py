@@ -1,0 +1,133 @@
+"""Perf-sampler telemetry series (``ray_tpu_perf_*``).
+
+The always-on sampler (sampler.py) periodically re-runs the chained-
+probe ladders on live trainer steps and engine decode and exports what
+it measures here, so a slow regression shows up on `ray_tpu status` and
+the dashboard ``/api/perf`` route BETWEEN bench captures — not three
+weeks later when someone re-runs bench.py.
+
+Aggregation contract (scripts/check_metrics.py gate): step-level
+gauges roll up MAX across reporters — a fleet's "step time" is its
+worst profiled step, a summed step time is meaningless — and the
+per-segment histogram bucket-merges.
+"""
+
+from __future__ import annotations
+
+# same ladder as profiler/trace.py: micro-segments on CPU smoke models
+# sit well under 1 ms; a wedged segment on a real device reaches 100s ms
+_SEGMENT_MS_BOUNDARIES = [
+    0.01, 0.05, 0.1, 0.5, 1, 2, 5, 10, 25, 50, 100, 250, 500, 1000,
+]
+
+
+def perf_segment_histogram():
+    """Attributed wall time per sampled step segment, by (step,
+    segment) — the distribution over samples, not one capture's point
+    estimate."""
+    from ray_tpu.obs.telemetry import cluster_histogram
+
+    return cluster_histogram(
+        "perf_segment_ms",
+        description="perf sampler: attributed wall time per step "
+        "segment across samples (ms)",
+        boundaries=_SEGMENT_MS_BOUNDARIES,
+        tag_keys=("step", "segment"),
+    )
+
+
+def perf_step_ms_gauge():
+    from ray_tpu.obs.telemetry import AGG_MAX, cluster_gauge
+
+    return cluster_gauge(
+        "perf_step_ms",
+        description="perf sampler: latest sampled whole-step wall time "
+        "(ms), by step",
+        tag_keys=("step",),
+        agg=AGG_MAX,
+    )
+
+
+def perf_coverage_gauge():
+    from ray_tpu.obs.telemetry import AGG_MAX, cluster_gauge
+
+    return cluster_gauge(
+        "perf_coverage_pct",
+        description="perf sampler: % of the sampled step attributed to "
+        "segments (probe honesty), by step",
+        tag_keys=("step",),
+        agg=AGG_MAX,
+    )
+
+
+def perf_mfu_gauge():
+    from ray_tpu.obs.telemetry import AGG_MAX, cluster_gauge
+
+    return cluster_gauge(
+        "perf_mfu_pct",
+        description="perf sampler: model FLOPs utilization of the "
+        "sampled step (%), by step",
+        tag_keys=("step",),
+        agg=AGG_MAX,
+    )
+
+
+def perf_overlap_gauge():
+    from ray_tpu.obs.telemetry import AGG_MAX, cluster_gauge
+
+    return cluster_gauge(
+        "perf_overlap_ratio",
+        description="perf sampler: gradient all-reduce compute-overlap "
+        "ratio (1.0 = fully hidden), by step",
+        tag_keys=("step",),
+        agg=AGG_MAX,
+    )
+
+
+def perf_regression_gauge():
+    """current step_ms / best-seen step_ms, by step: 1.0 = at the best
+    this process ever sampled; the perf_health grader reads this."""
+    from ray_tpu.obs.telemetry import AGG_MAX, cluster_gauge
+
+    return cluster_gauge(
+        "perf_step_regression_ratio",
+        description="perf sampler: latest sampled step time over the "
+        "best-seen step time (1.0 = no regression), by step",
+        tag_keys=("step",),
+        agg=AGG_MAX,
+    )
+
+
+def perf_samples_counter():
+    from ray_tpu.obs.telemetry import cluster_counter
+
+    return cluster_counter(
+        "perf_samples_total",
+        description="perf sampler: profile samples taken, by step",
+        tag_keys=("step",),
+    )
+
+
+def perf_duty_gauge():
+    """Fraction of wall-clock the sampler actually spent probing (its
+    overhead budget is max_duty; this gauge is the receipt)."""
+    from ray_tpu.obs.telemetry import AGG_MAX, cluster_gauge
+
+    return cluster_gauge(
+        "perf_sampler_duty_pct",
+        description="perf sampler: % of wall-clock spent inside probes "
+        "over the trailing window (budgeted by max_duty)",
+        agg=AGG_MAX,
+    )
+
+
+def register_metrics() -> None:
+    """scripts/check_metrics.py hook: force lazy metrics to register."""
+    perf_segment_histogram()
+    perf_step_ms_gauge()
+    perf_coverage_gauge()
+    perf_mfu_gauge()
+    perf_overlap_gauge()
+    perf_regression_gauge()
+    perf_samples_counter()
+    perf_duty_gauge()

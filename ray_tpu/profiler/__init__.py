@@ -26,6 +26,7 @@ from ray_tpu.profiler.roofline import SegmentProfile, StepProfile
 from ray_tpu.profiler.segments import (
     FnPart,
     SegmentTiming,
+    allreduce_overlap_segments,
     chained_seconds,
     decode_step_segments,
     profile_segments,
@@ -43,6 +44,7 @@ __all__ = [
     "SegmentProfile",
     "SegmentTiming",
     "StepProfile",
+    "allreduce_overlap_segments",
     "chained_seconds",
     "chip_peaks",
     "compiled_cost",
@@ -71,20 +73,35 @@ def profile_train_step(
     warmup: int = 2,
     with_costs: bool = True,
     export_observability: bool = True,
+    with_allreduce_probe: bool = True,
     meta: Optional[dict] = None,
 ) -> StepProfile:
     """Roofline-attributed profile of one llama train step.
 
     Segments: embed / ln_residual / attention / mlp / lm_head_loss /
-    backward / optimizer_update. The whole-step reference is the real
+    ce_bwd / mlp_bwd / attention_bwd / optimizer_update (the backward is
+    split with stop_gradient-scoped rungs — identical primal, telescoped
+    grad scopes), plus standalone allreduce / allreduce_exposed probes
+    (``in_step=False``) pricing how much of a DP gradient all-reduce
+    hides behind the backward; the overlap ratio lands in
+    ``meta["allreduce_overlap_ratio"]`` (None below the timing noise
+    floor, e.g. single-device). The whole-step reference is the real
     jitted train.step program measured with the same chained runner.
     """
+    import jax
+
     parts, whole_fn = train_step_segments(
         config, params, batch, optimizer, iters=iters, warmup=warmup
     )
     segments = profile_segments(
         parts, iters=iters, warmup=warmup, with_costs=with_costs
     )
+    ar_ratio = None
+    if with_allreduce_probe:
+        ar_segments, ar_ratio = allreduce_overlap_segments(
+            config, params, batch, iters=iters, warmup=warmup
+        )
+        segments.extend(ar_segments)
     whole_ms = whole_fn()
     profile = StepProfile.build(
         "train_step", segments, whole_ms,
@@ -93,6 +110,8 @@ def profile_train_step(
             "seq": int(batch["tokens"].shape[1]),
             "model_params": config.num_params(),
             "attention_impl": config.attention_impl,
+            "allreduce_overlap_ratio": ar_ratio,
+            "allreduce_devices": jax.device_count(),
             **(meta or {}),
         },
     )

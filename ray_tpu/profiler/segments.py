@@ -341,7 +341,13 @@ def train_step_segments(
     import optax
 
     from ray_tpu.models import llama
-    from ray_tpu.nn.layers import apply_rope, rms_norm, rope_frequencies, swiglu
+    from ray_tpu.nn.layers import (
+        apply_rope,
+        fused_cross_entropy_loss,
+        rms_norm,
+        rope_frequencies,
+        swiglu,
+    )
     from ray_tpu.ops.attention import attention
     from ray_tpu.train.step import TrainState, make_train_step
 
@@ -420,11 +426,93 @@ def train_step_segments(
     def loss_for_grad(p):
         return llama.loss_and_weight_fn(p, batch, c)
 
-    def l5_backward(p):
-        (loss, _), grads = jax.value_and_grad(loss_for_grad, has_aux=True)(p)
-        # global_norm consumes every grad leaf (keeps the full backward
-        # alive) and is work the real step does too
-        return _inject(p, loss + optax.global_norm(grads))
+    # -- backward split: three cumulative grad rungs -------------------------
+    # stop_gradient changes d/dp and never the primal, so every rung below
+    # runs the identical forward and each rung's backward is a strict
+    # superset of the previous one's. Telescoping then prices ce_bwd
+    # (lm-head + fused-CE backward), +mlp_bwd (MLP/norm/residual/embed
+    # backward), +attention_bwd (qkv/rope/attention/wo backward — the rest).
+    seg_ids = batch.get("segment_ids")
+    bwd_positions = llama.packed_positions(seg_ids, S)
+
+    def _scoped_loss(p, h):
+        return fused_cross_entropy_loss(
+            h, llama.output_weight(p), batch["targets"], batch.get("mask")
+        )
+
+    def _grad_rung(scoped_loss):
+        def rung(p):
+            (loss, _), grads = jax.value_and_grad(scoped_loss, has_aux=True)(p)
+            # global_norm consumes every grad leaf (keeps the scoped
+            # backward alive) and is work the real step does too
+            return _inject(p, loss + optax.global_norm(grads))
+        return rung
+
+    def loss_ce_scope(p):
+        # gradient reaches only the lm-head/CE (tied embedding included
+        # via output_weight); the trunk forward still runs, detached
+        h = jax.lax.stop_gradient(
+            llama.hidden_states(p, tokens, c, segment_ids=seg_ids)
+        )
+        return _scoped_loss(p, h)
+
+    def _block_mlp_scope(h, lp):
+        # mirrors llama._block exactly (identical primal) with the
+        # attention branch detached after the wo projection: gradient
+        # reaches the MLP, ln2, residual spine and embedding — not
+        # qkv/rope/attention/wo (those price into attention_bwd)
+        x = rms_norm(h, lp["ln1"], c.rms_eps)
+        hd = c.head_dim
+        q = jnp.einsum("bsd,dh->bsh", x, lp["wq"].astype(x.dtype)).reshape(
+            B, S, c.n_heads, hd
+        )
+        k = jnp.einsum("bsd,dh->bsh", x, lp["wk"].astype(x.dtype)).reshape(
+            B, S, c.n_kv_heads, hd
+        )
+        v = jnp.einsum("bsd,dh->bsh", x, lp["wv"].astype(x.dtype)).reshape(
+            B, S, c.n_kv_heads, hd
+        )
+        q = apply_rope(q, cos, sin, bwd_positions)
+        k = apply_rope(k, cos, sin, bwd_positions)
+        o = attention(
+            q, k, v, causal=True, segment_ids=seg_ids, impl=c.attention_impl
+        )
+        o = jax.ad_checkpoint.checkpoint_name(o, "attn_out")
+        o = jnp.einsum(
+            "bsh,hd->bsd", o.reshape(B, S, c.n_heads * hd),
+            lp["wo"].astype(x.dtype),
+        )
+        h = h + jax.lax.stop_gradient(o)
+        x2 = rms_norm(h, lp["ln2"], c.rms_eps)
+        return h + swiglu(x2, lp["w_gate"], lp["w_up"], lp["w_down"])
+
+    def loss_mlp_scope(p):
+        h = p["embed"].astype(c.dtype)[tokens]
+        blk = _block_mlp_scope
+        if c.remat:
+            # mirror hidden_states' remat wrapping so this rung prices the
+            # same rematerialized backward the real step runs
+            if c.remat_policy == "dots":
+                blk = jax.checkpoint(
+                    blk,
+                    policy=jax.checkpoint_policies.save_from_both_policies(
+                        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                        jax.checkpoint_policies.save_only_these_names(
+                            "attn_out", "attn_lse"
+                        ),
+                    ),
+                )
+            else:
+                blk = jax.checkpoint(blk)
+        h, _ = jax.lax.scan(
+            lambda carry, lp: (blk(carry, lp), None), h, p["layers"]
+        )
+        h = rms_norm(h, p["final_norm"], c.rms_eps)
+        return _scoped_loss(p, h)
+
+    l5a_ce_bwd = _grad_rung(loss_ce_scope)
+    l5b_mlp_bwd = _grad_rung(loss_mlp_scope)
+    l5c_attention_bwd = _grad_rung(loss_for_grad)
 
     def mk_state():
         return TrainState.create(mk_params(), optimizer)
@@ -448,7 +536,9 @@ def train_step_segments(
         FnPart("attention", l2_attention, mk_params),
         FnPart("mlp", l3_mlp, mk_params),
         FnPart("lm_head_loss", l4_loss, mk_params),
-        FnPart("backward", l5_backward, mk_params),
+        FnPart("ce_bwd", l5a_ce_bwd, mk_params),
+        FnPart("mlp_bwd", l5b_mlp_bwd, mk_params),
+        FnPart("attention_bwd", l5c_attention_bwd, mk_params),
         FnPart("optimizer_update", l6_optimizer, mk_state, donate=True),
     ]
 
@@ -464,6 +554,113 @@ def train_step_segments(
         )
 
     return parts, whole_fn
+
+
+# -- allreduce-overlap probe -------------------------------------------------
+
+
+def allreduce_overlap_segments(
+    config,
+    params,
+    batch: dict,
+    *,
+    iters: int = 6,
+    warmup: int = 2,
+    repeats: int = 3,
+) -> tuple[list[SegmentTiming], Optional[float]]:
+    """Standalone probe: how much of the gradient all-reduce hides behind
+    the backward pass it is scheduled with?
+
+    Three chained measurements — t_bwd (backward alone), t_bwd_ar
+    (backward + psum of every grad leaf over a ``dp`` mesh of all local
+    devices, one program so XLA may overlap), t_ar (the psum alone on
+    grad-shaped buffers). What the schedule failed to hide is
+    ``exposed = max(0, t_bwd_ar - t_bwd)``; the overlap ratio is
+    ``(t_ar - exposed) / t_ar``.
+
+    Honesty: with one device (tier-1 CPU) the psum lowers to ~a copy and
+    t_ar sits at the timing noise floor — the ratio is then reported as
+    None, not a fabricated 1.0. The number only means something on a
+    multi-chip mesh.
+
+    Returns ``(segments, overlap_ratio)``: two ``in_step=False``
+    SegmentTimings ("allreduce" = t_ar, "allreduce_exposed" = exposed)
+    that never count toward step coverage.
+    """
+    import numpy as np
+    import optax
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from ray_tpu.models import llama
+    from ray_tpu.parallel.sharding import shard_map_compat
+
+    c = config
+    devices = jax.devices()
+    n_dev = len(devices)
+    mesh = Mesh(np.asarray(devices), ("dp",))
+
+    def allreduce(grads):
+        def body(g):
+            # mean-allreduce, the DP gradient exchange: psum then scale
+            return jax.tree.map(
+                lambda x: jax.lax.psum(x, "dp") / n_dev, g
+            )
+
+        return shard_map_compat(
+            body, mesh=mesh, in_specs=P(), out_specs=P()
+        )(grads)
+
+    def mk_params():
+        return jax.tree.map(jnp.copy, params)
+
+    def loss_for_grad(p):
+        return llama.loss_and_weight_fn(p, batch, c)
+
+    def bwd(p):
+        (loss, _), grads = jax.value_and_grad(loss_for_grad, has_aux=True)(p)
+        return _inject(p, loss + optax.global_norm(grads))
+
+    def bwd_ar(p):
+        (loss, _), grads = jax.value_and_grad(loss_for_grad, has_aux=True)(p)
+        grads = allreduce(grads)
+        return _inject(p, loss + optax.global_norm(grads))
+
+    def ar_only(g):
+        # grads share params' pytree/shapes, so param buffers stand in;
+        # chaining through the first leaf keeps every psum live
+        g2 = allreduce(g)
+        return _inject_first_leaf(g2, _token(jax.tree.leaves(g2)[0]))
+
+    t_bwd = 1e3 * chained_seconds(
+        bwd, mk_params, iters=iters, warmup=warmup, repeats=repeats
+    )
+    t_bwd_ar = 1e3 * chained_seconds(
+        bwd_ar, mk_params, iters=iters, warmup=warmup, repeats=repeats
+    )
+    t_ar = 1e3 * chained_seconds(
+        ar_only, mk_params, iters=iters, warmup=warmup, repeats=repeats
+    )
+
+    exposed = max(0.0, t_bwd_ar - t_bwd)
+    # ~10us: below the chained-timer's resolving power the psum cost is
+    # indistinguishable from noise and any ratio would be an invention;
+    # likewise a single device has no communication to overlap — the
+    # one-device psum prices the grad-scaling copy, not an exchange
+    noise_floor_ms = 0.01
+    if n_dev < 2 or t_ar <= noise_floor_ms:
+        ratio: Optional[float] = None
+    else:
+        ratio = max(0.0, min(1.0, (t_ar - exposed) / t_ar))
+
+    segments = [
+        SegmentTiming(name="allreduce", ms=t_ar, cum_ms=t_ar, in_step=False),
+        SegmentTiming(
+            name="allreduce_exposed", ms=exposed, cum_ms=t_bwd_ar,
+            in_step=False,
+        ),
+    ]
+    return segments, ratio
 
 
 # -- decode-step ladder ------------------------------------------------------

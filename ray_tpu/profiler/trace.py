@@ -1,10 +1,17 @@
 """Observability export for StepProfiles.
 
-Two sinks, both already wired to user-visible surfaces:
+Three sinks, all already wired to user-visible surfaces:
 
   * core/events.py TaskEventBuffer — segment spans become Chrome-trace
-    "X" events (kind="profile"), so the dashboard /timeline route and
-    util.state.timeline() show the step breakdown next to task spans;
+    "X" events (kind="profile"), so the legacy dashboard /timeline
+    route and util.state.timeline() show the step breakdown next to
+    task spans;
+  * obs/recorder.py SpanRecorder — the same strip lands in the flight
+    recorder as one bounded trace (root ``profile:{step}`` + one child
+    span per segment), which is the AUTHORITATIVE profile stream for
+    the unified /api/trace export: the recorder's drop-oldest caps
+    (max_traces / max_spans_per_trace) bound it, and /api/trace filters
+    the duplicate task-buffer copy out of its timeline half;
   * util/metrics.py Histograms/Gauges — per-segment wall time and
     step-level coverage/attainment land on the dashboard /metrics
     Prometheus endpoint for free.
@@ -119,7 +126,61 @@ def emit_spans(profile: StepProfile, buffer=None, *,
     return n
 
 
+def emit_recorder_spans(profile: StepProfile, recorder=None, *,
+                        t_end: Optional[float] = None) -> str:
+    """Mirror the profiled step into the obs flight recorder as ONE
+    bounded trace: a root span ``profile:{step}`` covering the whole
+    strip plus a child span per segment (same back-to-back layout as
+    :func:`emit_spans`, standalone segments stacked before the strip).
+    The recorder's drop-oldest caps make this the bounded profile
+    stream /api/trace serves. Returns the trace id."""
+    if recorder is None:
+        from ray_tpu.obs.recorder import get_recorder
+
+        recorder = get_recorder()
+    from ray_tpu.obs.recorder import Span
+
+    end = time.time() if t_end is None else t_end
+    total_s = sum(s.ms for s in profile.segments if s.in_step) / 1e3
+    standalone_s = max(
+        (s.ms / 1e3 for s in profile.segments if not s.in_step), default=0.0
+    )
+    start = end - total_s
+    trace_id = f"profile-{profile.step}-{next(_span_counter)}"
+    root_id = f"{trace_id}-root"
+    recorder.add(Span(
+        trace_id=trace_id, span_id=root_id, parent_id=None,
+        name=f"profile:{profile.step}",
+        start=start - standalone_s, end=end,
+        attrs={
+            "step": profile.step,
+            "measured_step_ms": profile.measured_step_ms,
+            "coverage_pct": profile.coverage_pct,
+        },
+    ))
+    cursor = start
+    for seg in profile.segments:
+        dur = seg.ms / 1e3
+        if seg.in_step:
+            t0, t1 = cursor, cursor + dur
+            cursor = t1
+        else:
+            t0, t1 = start - dur, start
+        recorder.add(Span(
+            trace_id=trace_id,
+            span_id=f"{trace_id}-{seg.name}",
+            parent_id=root_id,
+            name=f"profile:{profile.step}:{seg.name}",
+            start=t0, end=t1,
+            attrs={"ms": seg.ms, "bound": seg.bound,
+                   "in_step": seg.in_step},
+        ))
+    return trace_id
+
+
 def export(profile: StepProfile, buffer=None) -> None:
-    """Both sinks in one call — what the train/serve hooks use."""
+    """All sinks in one call — what the train/serve hooks use."""
     export_metrics(profile)
-    emit_spans(profile, buffer)
+    t_end = time.time()
+    emit_spans(profile, buffer, t_end=t_end)
+    emit_recorder_spans(profile, t_end=t_end)

@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""Perf regression gate over the capture ledger (CI gate, imported as a
+tier-1 test). Thin CLI shim — the framework lives in
+ray_tpu/analysis/perf_gate.py.
+
+    python scripts/check_perf.py                       # ledger integrity
+    python scripts/check_perf.py --capture fresh.json  # gate a fresh capture
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from ray_tpu.analysis.perf_gate import (  # noqa: E402,F401 — re-exported API
+    GateResult,
+    evaluate_capture,
+    gate_capture,
+    main,
+    run_check,
+)
+
+if __name__ == "__main__":
+    sys.exit(main())

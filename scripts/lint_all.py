@@ -41,6 +41,7 @@ def _passes():
         ("check_chaos_hooks", chaos_coverage.collect_violations),
         ("check_thread_hygiene", thread_hygiene.collect_violations),
         ("check_metrics", _run_metrics),
+        ("check_perf", _run_perf),
     ]
 
 
@@ -48,6 +49,12 @@ def _run_metrics() -> list[str]:
     from ray_tpu.analysis import metrics_registry
 
     return metrics_registry.run_check()
+
+
+def _run_perf() -> list[str]:
+    from ray_tpu.analysis import perf_gate
+
+    return perf_gate.run_check()
 
 
 def main(argv=None) -> int:

@@ -660,7 +660,7 @@ def test_lint_all_umbrella_runner(capsys):
     assert set(doc["passes"]) == {
         "check_timeouts", "check_lock_guards", "check_lock_order",
         "check_blocking_under_lock", "check_chaos_hooks",
-        "check_thread_hygiene", "check_metrics",
+        "check_thread_hygiene", "check_metrics", "check_perf",
     }
     assert all(p["ok"] for p in doc["passes"].values())
 
