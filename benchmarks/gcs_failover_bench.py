@@ -1,0 +1,591 @@
+#!/usr/bin/env python
+"""Hot-standby failover availability capture: seeded KILL_GCS_PRIMARY
+(NO restart — the warm standby IS the recovery) against a standby-paired
+LocalCluster -> benchmarks/GCS_failover_r23.json.
+
+The r23 acceptance gate, end to end, against a REAL deployment (primary
+GCS process + standby GCS process tailing its replication log + node
+daemon + worker processes):
+
+ * serve-shaped traffic (named replica actors driven by a driver-side
+   request loop) runs ACROSS the primary kill — per-request paths ride
+   cached worker addresses and the node-local object store, and control
+   RPCs fail over to the promoted standby: gate completion_rate == 1.0;
+ * a cluster-backend training gang (allreduce over the GCS KV — the
+   plane the kill cuts) is supervised with a control-plane probe over
+   BOTH endpoints: the promotion window is classified as a blackout
+   (wait -> re-form -> resume), never as rank death: gate trainer
+   recoveries == 0 and the loss curve bitwise equal to the
+   uninterrupted baseline;
+ * an availability sampler polls the pair at 20 Hz for the whole run:
+   the serving gap (longest window with NO endpoint answering the data
+   plane) must come in strictly under the r13 restart blackout floor
+   (GCS_outage_r13.json's scheduled restart_after_s) — a control-plane
+   death costs one lease timeout, not a blackout;
+ * after promotion the standby runs the same reconcile discipline a
+   restarted primary would: gate zero duplicate or lost actors and
+   exact telemetry counter convergence, with gcs_restarts_total == 0
+   and gcs_failovers_total >= 1 (nobody restarted anything).
+
+Run: JAX_PLATFORMS=cpu python benchmarks/gcs_failover_bench.py [--out PATH]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def req_counter_name(run_tag: str) -> str:
+    # per-run metric name: the registry is process-global, so a shared
+    # name would carry the baseline run's total into the chaos run and
+    # break the exact-convergence comparison
+    return f"ray_tpu_bench_failover_requests_{run_tag}_total"
+
+
+# -- the serve plane (replica actors + driver request loop) -------------------
+
+
+class BenchReplica:
+    def __init__(self, idx):
+        self.idx = idx
+        self.count = 0
+
+    def serve_one(self, x):
+        self.count += 1
+        return (self.idx, self.count)
+
+    def stats(self):
+        return {"idx": self.idx, "count": self.count}
+
+
+# -- the training problem (same shape as gcs_outage_bench) --------------------
+
+W_TRUE = np.asarray([1.0, -2.0, 3.0, 0.5])
+
+
+def init_fn(seed):
+    return {"w": np.zeros(4, np.float64)}
+
+
+def grad_fn(state, batch):
+    x, y = batch
+    err = x @ state["w"] - y
+    return float(np.mean(err ** 2)), {"w": 2 * x.T @ err / len(y)}
+
+
+def apply_fn(state, grads):
+    return {"w": state["w"] - 0.1 * grads["w"]}
+
+
+def batch_fn(seed, step, world, rank):
+    import time as _t
+
+    from ray_tpu.train.elastic import rng_for
+
+    _t.sleep(0.03)  # pace the gang so the horizon spans the failover
+    rng = rng_for(seed, step, rank)
+    x = rng.normal(size=(8, 4))
+    return x, x @ W_TRUE
+
+
+# -- pair-aware probes ---------------------------------------------------------
+
+
+def _serving_endpoint(endpoints, timeout=1.0):
+    """First endpoint currently serving the data plane as an unfenced
+    primary, or None. The standby answers ha_status before promotion —
+    role gates it out until it actually owns the tables."""
+    from ray_tpu.cluster.rpc import RpcClient
+
+    for ep in endpoints:
+        try:
+            c = RpcClient(ep[0], ep[1], timeout=timeout).connect(retries=0)
+            try:
+                st = c.call("ha_status", {}, timeout=timeout)
+                if st["role"] == "primary" and not st["fenced"]:
+                    return ep
+            finally:
+                c.close()
+        except Exception:  # noqa: BLE001 — dead/dark endpoint
+            continue
+    return None
+
+
+def make_probe(endpoints):
+    def probe() -> bool:
+        from ray_tpu.cluster.rpc import RpcClient
+
+        ep = _serving_endpoint(endpoints, timeout=2.0)
+        if ep is None:
+            return False
+        try:
+            c = RpcClient(ep[0], ep[1], timeout=2.0).connect(retries=0)
+            try:
+                c.call("list_nodes", None, timeout=2.0)
+            finally:
+                c.close()
+            return True
+        except Exception:  # noqa: BLE001 — dark is dark
+            return False
+
+    return probe
+
+
+def make_epoch(endpoints):
+    """Failover detector for the supervisor: restarts + failovers of the
+    currently serving primary. A kill with promotion bumps failovers (at
+    zero restarts), so a round spanning the window sees the epoch move
+    exactly like r13's restart counter did."""
+    def epoch():
+        from ray_tpu.cluster.rpc import RpcClient
+
+        ep = _serving_endpoint(endpoints, timeout=2.0)
+        if ep is None:
+            raise RuntimeError("no serving GCS primary")
+        c = RpcClient(ep[0], ep[1], timeout=2.0).connect(retries=0)
+        try:
+            ft = c.call("gcs_ft", {}, timeout=2.0)
+            return (ft["gcs_restarts_total"], ft["gcs_failovers_total"])
+        finally:
+            c.close()
+
+    return epoch
+
+
+class AvailabilitySampler:
+    """20 Hz data-plane availability poll across the pair. A sample is
+    UP when some endpoint serves list_nodes as an unfenced primary; the
+    gap is the longest down-window, measured from the last up-sample
+    before it to the first up-sample after (i.e. what a client saw)."""
+
+    def __init__(self, endpoints, interval_s: float = 0.05):
+        self.endpoints = tuple(endpoints)
+        self.interval_s = interval_s
+        self.samples: list[tuple[float, bool]] = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="availability-sampler", daemon=True)
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=30)
+
+    def _sample_once(self) -> bool:
+        from ray_tpu.cluster.rpc import RpcClient
+
+        ep = _serving_endpoint(self.endpoints, timeout=0.75)
+        if ep is None:
+            return False
+        try:
+            c = RpcClient(ep[0], ep[1], timeout=0.75).connect(retries=0)
+            try:
+                c.call("list_nodes", None, timeout=0.75)
+            finally:
+                c.close()
+            return True
+        except Exception:  # noqa: BLE001
+            return False
+
+    def _run(self):
+        while not self._stop.is_set():
+            t = time.monotonic()
+            ok = self._sample_once()
+            self.samples.append((t, ok, time.monotonic() - t))
+            self._stop.wait(self.interval_s)
+
+    def report(self) -> dict:
+        gap_windows: list[float] = []
+        last_up = None
+        down_since = None
+        for t, ok, _lat in self.samples:
+            if ok:
+                if down_since is not None:
+                    gap_windows.append(t - (last_up if last_up is not None
+                                            else down_since))
+                    down_since = None
+                last_up = t
+            elif down_since is None:
+                down_since = t
+        if down_since is not None and self.samples:
+            # still dark at the end: count the open window
+            end = self.samples[-1][0]
+            gap_windows.append(end - (last_up if last_up is not None
+                                      else down_since))
+        lat_ms = sorted(lat * 1000.0 for _, _, lat in self.samples)
+
+        def pct(xs, q):
+            if not xs:
+                return 0.0
+            return xs[min(len(xs) - 1, int(q * (len(xs) - 1) + 0.5))]
+
+        gaps_sorted = sorted(gap_windows)
+        return {
+            "gap_s": round(max(gap_windows, default=0.0), 3),
+            "gap_p50_s": round(pct(gaps_sorted, 0.50), 3),
+            "gap_p99_s": round(pct(gaps_sorted, 0.99), 3),
+            "gaps": len(gap_windows),
+            "samples": len(self.samples),
+            "down_samples": sum(1 for _, ok, _ in self.samples if not ok),
+            "probe_p50_ms": round(pct(lat_ms, 0.50), 3),
+            "probe_p99_ms": round(pct(lat_ms, 0.99), 3),
+        }
+
+
+def _run_once(steps: int, world: int, schedule=None, run_tag: str = "run",
+              traffic_s: float = 12.0, lease_timeout_s: float = 1.0) -> dict:
+    from ray_tpu import chaos
+    from ray_tpu.chaos.runner import ChaosRunner
+    from ray_tpu.cluster import LocalCluster
+    from ray_tpu.core import api
+    from ray_tpu.obs.telemetry import TelemetryReporter, cluster_counter
+    from ray_tpu.train.elastic import ElasticConfig, TrainerSupervisor
+
+    out: dict = {}
+    with tempfile.TemporaryDirectory() as ckpt_root:
+        with LocalCluster(node_death_timeout_s=2.0, standby=True,
+                          gcs_lease_timeout_s=lease_timeout_s) as c:
+            c.start()
+            c.add_node({"num_cpus": 8}, node_id="head")
+            c.wait_for_nodes(1)
+            endpoints = c.gcs_endpoints
+            client = c.client()
+            api.init(address=c.address, ignore_reinit_error=True)
+            sampler = None
+            try:
+                replicas = [
+                    client.create_actor(
+                        BenchReplica, (i,), name=f"replica-{i}",
+                        max_restarts=1,
+                    )
+                    for i in range(2)
+                ]
+                counter_name = req_counter_name(run_tag)
+                req_counter = cluster_counter(
+                    counter_name,
+                    description="failover bench: completed serve requests",
+                )
+                reporter = TelemetryReporter(
+                    gcs_addr=endpoints, reporter_id="bench-driver",
+                    kind="bench", interval_s=0.25, timeout_s=2.0,
+                    series_filter=lambda name, tags: name.startswith(
+                        "ray_tpu_bench_"
+                    ),
+                ).start()
+
+                sent = [0]
+                completed = [0]
+                failures: list = []
+                stop_traffic = threading.Event()
+
+                def traffic():
+                    i = 0
+                    # hard cap well past any plausible run; the stop
+                    # event (set when the trainer finishes) is the real
+                    # terminator, so traffic is GUARANTEED to span the
+                    # whole promotion window
+                    deadline = time.monotonic() + traffic_s + 240
+                    while time.monotonic() < deadline \
+                            and not stop_traffic.is_set():
+                        h = replicas[i % len(replicas)]
+                        i += 1
+                        sent[0] += 1
+                        try:
+                            client.get(h.serve_one.remote(i), timeout=60)
+                            completed[0] += 1
+                            req_counter.inc()
+                        except Exception as e:  # noqa: BLE001
+                            failures.append(repr(e))
+                        time.sleep(0.01)
+
+                sup = TrainerSupervisor(
+                    init_fn=init_fn, grad_fn=grad_fn, apply_fn=apply_fn,
+                    batch_fn=batch_fn, total_steps=steps,
+                    checkpoint_root=ckpt_root,
+                    config=ElasticConfig(
+                        world_size=world, backend="cluster",
+                        group_name="failover_gang", seed=7,
+                        step_timeout_s=2.0, checkpoint_every=4,
+                        sharded_checkpoints=False,
+                        control_plane_probe=make_probe(endpoints),
+                        control_plane_epoch=make_epoch(endpoints),
+                        blackout_wait_s=30.0,
+                    ),
+                )
+                train_res: list = [None]
+
+                def train():
+                    train_res[0] = sup.fit()
+
+                t0 = time.monotonic()
+                tt = threading.Thread(target=traffic, daemon=True)
+                tr = threading.Thread(target=train, daemon=True)
+                tt.start()
+                tr.start()
+
+                # arm the kill only once the gang is formed, the standby
+                # has synced, and traffic is warm — a kill that lands
+                # before the standby bootstraps tests the r13 path, not
+                # the failover
+                runner = None
+                deadline = time.monotonic() + 60
+                while time.monotonic() < deadline:
+                    try:
+                        infos = client.gcs.call(
+                            "list_actors", None, timeout=5
+                        )
+                        alive = [
+                            a for a in infos if a["state"] == "ALIVE"
+                        ]
+                        st = client.gcs.call("ha_status", {}, timeout=5)
+                        if len(alive) >= 2 + world \
+                                and completed[0] >= 20 \
+                                and st.get("replication_lag_s") is not None:
+                            break
+                    except Exception:  # noqa: BLE001
+                        pass
+                    time.sleep(0.1)
+                sampler = AvailabilitySampler(endpoints).start()
+                if schedule is not None:
+                    chaos.install(schedule)
+                    runner = ChaosRunner(schedule, cluster=c).start()
+
+                tr.join(timeout=300)
+                stop_traffic.set()
+                tt.join(timeout=120)
+                wall_s = time.monotonic() - t0
+                if runner is not None:
+                    runner.join(timeout=60)
+                sampler.stop()
+
+                # -- post-promotion reconcile + convergence --------------
+                ft = {}
+                deadline = time.monotonic() + 20
+                while time.monotonic() < deadline:
+                    try:
+                        ft = client.gcs.call("gcs_ft", {}, timeout=5)
+                        if schedule is None or (
+                            ft.get("reconcile_nodes_reregistered", 0) >= 1
+                            and ft.get("actors_pending_confirm", 0) == 0
+                        ):
+                            break
+                    except Exception:  # noqa: BLE001
+                        pass
+                    time.sleep(0.25)
+
+                local_total = float(completed[0])
+                converged = False
+                remote_total = None
+                deadline = time.monotonic() + 30
+                while time.monotonic() < deadline:
+                    try:
+                        agg = client.cluster_metrics()
+                        acc = agg.get("counters", {}).get(counter_name)
+                        remote_total = (
+                            float(acc["total"]) if acc is not None else None
+                        )
+                        if remote_total == local_total:
+                            converged = True
+                            break
+                    except Exception:  # noqa: BLE001
+                        pass
+                    time.sleep(0.25)
+
+                infos = client.gcs.call("list_actors", None, timeout=10)
+                alive = [a for a in infos if a["state"] == "ALIVE"]
+                ids = [a["actor_id"] for a in infos]
+                replica_counts = [
+                    client.get(h.stats.remote(), timeout=30)["count"]
+                    for h in replicas
+                ]
+                ha = client.gcs.call("ha_status", {}, timeout=10)
+                res = train_res[0]
+                reporter.stop(final_push=True)
+
+                out = {
+                    "wall_s": round(wall_s, 3),
+                    "serve": {
+                        "sent": sent[0],
+                        "completed": completed[0],
+                        "completion_rate": (
+                            completed[0] / sent[0] if sent[0] else 0.0
+                        ),
+                        "failures": failures[:10],
+                        "replica_counts": replica_counts,
+                        "replica_total": sum(replica_counts),
+                    },
+                    "actors": {
+                        "created": 2 + (res.final_world_size if res else 0),
+                        "alive": len(alive),
+                        "duplicate_ids": len(ids) - len(set(ids)),
+                        "replicas_alive": sum(
+                            1 for a in alive
+                            if (a.get("name") or "").startswith("replica-")
+                        ),
+                    },
+                    "trainer": None if res is None else {
+                        "completed": res.completed,
+                        "steps": len(res.losses),
+                        "losses": res.losses,
+                        "recoveries": len(res.recoveries),
+                        "blackouts": len(res.blackouts),
+                        "blackout_log": [
+                            dataclasses.asdict(r) for r in res.blackouts
+                        ],
+                        "final_gen": res.final_gen,
+                    },
+                    "telemetry": {
+                        "local_total": local_total,
+                        "remote_total": remote_total,
+                        "convergent": converged,
+                    },
+                    "availability": sampler.report(),
+                    "ha": ha,
+                    "gcs_ft": ft,
+                }
+            finally:
+                if sampler is not None:
+                    sampler.stop()
+                api.shutdown()
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=120)
+    ap.add_argument("--world", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=23)
+    # measured from runner arming (which waits for the gang to form, the
+    # standby to sync, and traffic to warm), so a small offset reliably
+    # lands mid-training
+    ap.add_argument("--kill-at-s", type=float, default=1.5)
+    ap.add_argument("--lease-timeout-s", type=float, default=1.0)
+    ap.add_argument("--traffic-s", type=float, default=12.0)
+    ap.add_argument(
+        "--out",
+        default=os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "GCS_failover_r23.json"),
+    )
+    args = ap.parse_args()
+
+    from ray_tpu.chaos import KILL_GCS_PRIMARY, FaultSchedule, FaultSpec
+
+    base = _run_once(args.steps, args.world, schedule=None,
+                     run_tag="baseline", traffic_s=args.traffic_s,
+                     lease_timeout_s=args.lease_timeout_s)
+    if not base["trainer"]["completed"] or \
+            base["serve"]["completion_rate"] != 1.0:
+        print("baseline failed", file=sys.stderr)
+        print(json.dumps(base, indent=2, default=str), file=sys.stderr)
+        return 1
+
+    schedule = FaultSchedule(args.seed, [
+        FaultSpec(kind=KILL_GCS_PRIMARY, at_s=args.kill_at_s),
+    ])
+    chaos_run = _run_once(args.steps, args.world, schedule=schedule,
+                          run_tag="chaos", traffic_s=args.traffic_s,
+                          lease_timeout_s=args.lease_timeout_s)
+    fired = [{"kind": f.kind, "site": f.site, "seq": f.seq}
+             for f in schedule.log]
+
+    base_losses = base["trainer"]["losses"]
+    chaos_losses = chaos_run["trainer"]["losses"]
+    identical = (
+        len(base_losses) == len(chaos_losses)
+        and all(a == b for a, b in zip(base_losses, chaos_losses))
+    )
+    for run in (base, chaos_run):
+        run["trainer"].pop("losses", None)
+
+    # the r13 restart blackout is the floor the failover must beat: its
+    # scheduled dark window is a hard lower bound on what the restart
+    # path could ever deliver
+    r13_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "GCS_outage_r13.json")
+    with open(r13_path) as f:
+        r13_floor = float(json.load(f)["config"]["restart_after_s"])
+    gap = chaos_run["availability"]["gap_s"]
+
+    out = {
+        "bench": "gcs_failover",
+        "rev": "r23",
+        "platform": "cpu",
+        "config": {
+            "steps": args.steps,
+            "world_size": args.world,
+            "seed": args.seed,
+            "kill_at_s": args.kill_at_s,
+            "lease_timeout_s": args.lease_timeout_s,
+            "traffic_s": args.traffic_s,
+            "r13_blackout_floor_s": r13_floor,
+        },
+        "baseline": base,
+        "chaos": chaos_run,
+        "loss_identical": identical,
+        "faults_fired": fired,
+    }
+
+    from ray_tpu.obs.perfwatch import ledger
+
+    ledger.write_capture(
+        args.out, out, bench="gcs_failover", rev="r23",
+        metrics={
+            "availability_gap_s": ledger.metric(
+                gap, unit="s", better=ledger.BETTER_LOWER, abs_tol=0.5),
+            "serve_completion_rate": ledger.metric(
+                chaos_run["serve"]["completion_rate"], unit="ratio",
+                better=ledger.BETTER_HIGHER, rel_tol=0.0),
+            "gcs_failovers_total": ledger.metric(
+                chaos_run["gcs_ft"].get("gcs_failovers_total", 0),
+                unit="count", better=ledger.BETTER_LOWER, abs_tol=1.0),
+        },
+    )
+    print(json.dumps({
+        "serve_completion": chaos_run["serve"]["completion_rate"],
+        "trainer_recoveries": chaos_run["trainer"]["recoveries"],
+        "trainer_blackouts": chaos_run["trainer"]["blackouts"],
+        "loss_identical": identical,
+        "telemetry_convergent": chaos_run["telemetry"]["convergent"],
+        "availability": chaos_run["availability"],
+        "r13_blackout_floor_s": r13_floor,
+        "ha": chaos_run["ha"],
+        "gcs_ft": chaos_run["gcs_ft"],
+    }, indent=2, default=str))
+    print(f"\nwrote {args.out}")
+
+    failed = (
+        chaos_run["serve"]["completion_rate"] != 1.0
+        or not chaos_run["trainer"]["completed"]
+        or chaos_run["trainer"]["recoveries"] != 0
+        or not identical
+        or chaos_run["actors"]["duplicate_ids"] != 0
+        or chaos_run["actors"]["replicas_alive"] != 2
+        or chaos_run["serve"]["replica_total"]
+        != chaos_run["serve"]["completed"]
+        or not chaos_run["telemetry"]["convergent"]
+        or chaos_run["gcs_ft"].get("gcs_failovers_total", 0) < 1
+        or chaos_run["gcs_ft"].get("gcs_restarts_total", 0) != 0
+        or chaos_run["ha"].get("role") != "primary"
+        or chaos_run["ha"].get("term", 0) < 1
+        or gap >= r13_floor
+        or "kill_gcs_primary" not in {e["kind"] for e in fired}
+    )
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

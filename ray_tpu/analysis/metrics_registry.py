@@ -28,6 +28,7 @@ INSTRUMENTED = [
     ("ray_tpu.llm.admission", "register_metrics"),
     ("ray_tpu.llm.engine", "register_metrics"),
     ("ray_tpu.cluster.node_daemon", "register_metrics"),
+    ("ray_tpu.cluster.gcs_service", "register_metrics"),
     ("ray_tpu.serve.controller", "register_metrics"),
     ("ray_tpu.train.elastic", "register_metrics"),
     ("ray_tpu.fabric.metrics", "register_metrics"),

@@ -48,11 +48,13 @@ from ray_tpu.chaos.schedule import (
     DROP_COLLECTIVE,
     DROP_RPC,
     KILL_GCS,
+    KILL_GCS_PRIMARY,
     KILL_RANK,
     KILL_REPLICA,
     KILL_WORKER,
     KINDS,
     PARTIAL_PARTITION,
+    PARTITION_GCS_PAIR,
     PREEMPT_ENGINE,
     PREEMPT_NODE,
     STALL_CHANNEL,
@@ -83,8 +85,9 @@ def __getattr__(name):
 
 __all__ = [
     "CORRUPT_FRAME", "DELAY_RPC", "DROP_CHANNEL", "DROP_COLLECTIVE",
-    "DROP_RPC", "KILL_GCS", "KILL_RANK",
+    "DROP_RPC", "KILL_GCS", "KILL_GCS_PRIMARY", "KILL_RANK",
     "KILL_REPLICA", "KILL_WORKER", "KINDS", "PARTIAL_PARTITION",
+    "PARTITION_GCS_PAIR",
     "PREEMPT_ENGINE", "PREEMPT_NODE", "STALL_CHANNEL", "STALL_COLLECTIVE",
     "STALL_GCS", "STALL_HEARTBEAT",
     "Fault", "FaultSchedule", "FaultSpec", "FaultInjected", "RankKilled",

@@ -37,10 +37,12 @@ from ray_tpu.chaos.schedule import (  # noqa: F401 — re-exported for hook site
     DROP_KV_TRANSFER,
     DROP_RPC,
     KILL_GCS,
+    KILL_GCS_PRIMARY,
     KILL_RANK,
     KILL_REPLICA,
     KILL_WORKER,
     PARTIAL_PARTITION,
+    PARTITION_GCS_PAIR,
     PREEMPT_ENGINE,
     PREEMPT_NODE,
     STALL_CHANNEL,
@@ -57,6 +59,14 @@ ENV_VAR = "RAY_TPU_CHAOS"
 # THE fast-path guard: hook sites read this attribute and skip everything
 # when it is None. Installed schedules are process-wide.
 ACTIVE: Optional[FaultSchedule] = None
+
+# PARTITION_GCS_PAIR support: endpoints in this set are unreachable from
+# THIS process (the chaos runner models a one-sided network partition by
+# blocking the driver's view of the primary while the standby keeps its
+# own partition window server-side). Multi-endpoint clients consult it
+# on dial and before each call; guarded by truthiness, so the production
+# path pays one falsy set check.
+BLOCKED_PEERS: set[tuple[str, int]] = set()
 
 
 class FaultInjected(Exception):
@@ -92,6 +102,7 @@ def install(schedule: FaultSchedule, *, propagate_env: bool = False) -> FaultSch
 def uninstall() -> None:
     global ACTIVE
     ACTIVE = None
+    BLOCKED_PEERS.clear()
     os.environ.pop(ENV_VAR, None)
 
 

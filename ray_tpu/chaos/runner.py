@@ -26,8 +26,10 @@ from typing import Optional
 
 from ray_tpu.chaos.schedule import (
     KILL_GCS,
+    KILL_GCS_PRIMARY,
     KILL_REPLICA,
     KILL_WORKER,
+    PARTITION_GCS_PAIR,
     PREEMPT_NODE,
     Fault,
     FaultSchedule,
@@ -100,6 +102,10 @@ class ChaosRunner:
             attrs = self._kill_replica(idx, spec)
         elif spec.kind == KILL_GCS:
             attrs = self._kill_gcs(idx, spec)
+        elif spec.kind == KILL_GCS_PRIMARY:
+            attrs = self._kill_gcs_primary(idx, spec)
+        elif spec.kind == PARTITION_GCS_PAIR:
+            attrs = self._partition_gcs_pair(idx, spec)
         else:
             return
         with self.schedule._lock:
@@ -178,6 +184,82 @@ class ChaosRunner:
             t.start()
             self._restart_threads.append(t)
         return attrs
+
+    def _kill_gcs_primary(self, idx, spec) -> dict:
+        """SIGKILL the primary GCS with NO restart (KILL_GCS_PRIMARY):
+        the warm standby's lease expires and it promotes in place — the
+        failover path, as opposed to _kill_gcs's blackout-then-restart.
+        The promotion itself is asynchronous (lease-driven inside the
+        standby); callers observe it through ha_status / the
+        gcs_failovers_total counter."""
+        if self.cluster is None:
+            raise RuntimeError("KILL_GCS_PRIMARY needs a cluster")
+        standby = getattr(self.cluster, "standby_addr", None)
+        if standby is None:
+            raise RuntimeError(
+                "KILL_GCS_PRIMARY needs a standby GCS "
+                "(LocalCluster(standby=True))"
+            )
+        t_kill = time.time()
+        self.cluster.kill_gcs_primary()
+        try:
+            from ray_tpu.obs import recorder as _recorder
+
+            _recorder.get_recorder().record(
+                "gcs.failover", t_kill, time.time(),
+                attrs={"standby": f"{standby[0]}:{standby[1]}"},
+                status="error",
+            )
+        except Exception:  # noqa: BLE001
+            pass
+        return {"standby": tuple(standby), "restart": False}
+
+    def _partition_gcs_pair(self, idx, spec) -> dict:
+        """Open a split-brain window (PARTITION_GCS_PAIR): the standby
+        stops seeing the primary for ``window_s`` (server-side
+        ha_partition hook), so its lease expires and it promotes WHILE
+        the primary is still alive. This process blocks its own view of
+        the old primary for the same window (harness.BLOCKED_PEERS), so
+        multi-endpoint clients here discover the promoted standby and
+        its bumped term — after heal, the first fenced call the old
+        primary sees retires it. Exactly one term wins."""
+        if self.cluster is None:
+            raise RuntimeError("PARTITION_GCS_PAIR needs a cluster")
+        standby = getattr(self.cluster, "standby_addr", None)
+        if standby is None:
+            raise RuntimeError(
+                "PARTITION_GCS_PAIR needs a standby GCS "
+                "(LocalCluster(standby=True))"
+            )
+        from ray_tpu.chaos import harness as _harness
+        from ray_tpu.cluster.rpc import RpcClient
+
+        window = spec.window_s
+        primary = tuple(self.cluster.gcs_addr)
+        c = RpcClient(*standby, timeout=10.0).connect(retries=3)
+        try:
+            c.call("ha_partition", {"window_s": window}, timeout=10.0)
+        finally:
+            c.close()
+        _harness.BLOCKED_PEERS.add(primary)
+
+        def _heal():
+            # heal even when stopped early: a blocked peer must never
+            # outlive the chaos run
+            self._stop.wait(window)
+            _harness.BLOCKED_PEERS.discard(primary)
+            logger.warning("chaos: GCS pair partition healed")
+
+        t = threading.Thread(
+            target=_heal, name="chaos-partition-heal", daemon=True
+        )
+        t.start()
+        self._restart_threads.append(t)
+        return {
+            "window_s": window,
+            "primary": primary,
+            "standby": tuple(standby),
+        }
 
     def _kill_replica(self, idx, spec) -> dict:
         if self.controller is None:

@@ -69,6 +69,17 @@ PARTIAL_PARTITION = "partial_partition"  # heartbeats reach GCS, peers don't
 # with DROP_RPC-style transport loss while the process stays up.
 KILL_GCS = "kill_gcs"                    # SIGKILL the control plane
 STALL_GCS = "stall_gcs"                  # GCS-bound RPCs get transport loss
+# control-plane HA (r23, cluster/ha.py): KILL_GCS_PRIMARY SIGKILLs the
+# primary GCS with NO restart ever scheduled — survival now means the
+# warm standby promotes within its lease bound and clients fail over,
+# not that the dead process comes back. PARTITION_GCS_PAIR opens a
+# split-brain window of window_s seconds: the standby stops seeing the
+# primary (server-side partition clock) while the driver's clients are
+# blocked from the primary (harness.BLOCKED_PEERS) — the standby
+# promotes, both "primaries" are alive, and epoch fencing must leave
+# exactly one term winner with every zombie write counted and rejected.
+KILL_GCS_PRIMARY = "kill_gcs_primary"    # SIGKILL primary; standby promotes
+PARTITION_GCS_PAIR = "partition_gcs_pair"  # split-brain window (window_s)
 # compiled-DAG channel plane (dag/channels.py send/recv + the
 # dag/compiled.py exec loops): a value lost in flight (receiver's
 # bounded read raises ChannelTimeoutError) vs a late writer (delay_s) —
@@ -91,12 +102,18 @@ KINDS = frozenset({
     KILL_RANK, STALL_COLLECTIVE, DROP_COLLECTIVE, PARTIAL_PARTITION,
     KILL_GCS, STALL_GCS, DROP_CHANNEL, STALL_CHANNEL,
     DROP_DEVICE_TRANSFER, CORRUPT_DEVICE_TRANSFER,
+    KILL_GCS_PRIMARY, PARTITION_GCS_PAIR,
 })
 
 # kinds the in-process hook ignores (a runner executes them instead)
-ORCHESTRATED = frozenset({PREEMPT_NODE, KILL_GCS})
+ORCHESTRATED = frozenset({
+    PREEMPT_NODE, KILL_GCS, KILL_GCS_PRIMARY, PARTITION_GCS_PAIR,
+})
 # kinds ChaosRunner knows how to execute on an at_s timeline
-RUNNER_KINDS = frozenset({PREEMPT_NODE, KILL_WORKER, KILL_REPLICA, KILL_GCS})
+RUNNER_KINDS = frozenset({
+    PREEMPT_NODE, KILL_WORKER, KILL_REPLICA, KILL_GCS,
+    KILL_GCS_PRIMARY, PARTITION_GCS_PAIR,
+})
 
 
 @dataclasses.dataclass
@@ -122,7 +139,12 @@ class FaultSpec:
     # KILL_GCS only: restart the control plane this many seconds after
     # the kill (0 = no scheduled restart; the test restarts it itself).
     # The window [at_s, at_s + restart_after_s] IS the blackout.
+    # (KILL_GCS_PRIMARY deliberately rejects it: HA survival must come
+    # from standby promotion, never from the dead primary coming back.)
     restart_after_s: float = 0.0
+    # PARTITION_GCS_PAIR only: how long the split-brain window stays
+    # open before the partition heals.
+    window_s: float = 0.0
 
     def __post_init__(self):
         if self.kind not in KINDS:
@@ -137,6 +159,18 @@ class FaultSpec:
             raise ValueError(
                 f"restart_after_s is only valid for {KILL_GCS!r}, "
                 f"not {self.kind!r}"
+            )
+        if self.window_s < 0.0:
+            raise ValueError("window_s must be >= 0")
+        if self.window_s > 0.0 and self.kind != PARTITION_GCS_PAIR:
+            raise ValueError(
+                f"window_s is only valid for {PARTITION_GCS_PAIR!r}, "
+                f"not {self.kind!r}"
+            )
+        if self.kind == PARTITION_GCS_PAIR and self.window_s <= 0.0:
+            raise ValueError(
+                f"{PARTITION_GCS_PAIR!r} requires window_s > 0 "
+                "(the split-brain window must eventually heal)"
             )
         if self.at_s > 0.0 and self.kind not in RUNNER_KINDS:
             # at_s routes the spec to ChaosRunner, which only executes

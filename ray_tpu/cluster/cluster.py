@@ -18,6 +18,7 @@ import time
 from typing import Optional
 
 from ray_tpu.cluster.client import ClusterClient
+from ray_tpu.cluster.rpc import format_gcs_addr
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("ray_tpu.cluster.cluster")
@@ -87,11 +88,17 @@ class LocalCluster:
     """Spawn a GCS + N node-daemon processes on this machine."""
 
     def __init__(self, node_death_timeout_s: float = 2.0,
-                 gcs_persist_path: Optional[str] = None):
+                 gcs_persist_path: Optional[str] = None,
+                 standby: bool = False,
+                 gcs_lease_timeout_s: float = 2.0):
         self._death_timeout = node_death_timeout_s
         self._persist_path = gcs_persist_path
+        self._standby_requested = standby
+        self._lease_timeout = gcs_lease_timeout_s
         self.gcs_proc: Optional[subprocess.Popen] = None
         self.gcs_addr: Optional[tuple] = None
+        self.standby_proc: Optional[subprocess.Popen] = None
+        self.standby_addr: Optional[tuple] = None
         self.nodes: dict[str, NodeProc] = {}
         self._client: Optional[ClusterClient] = None
         self._head: Optional[NodeProc] = None
@@ -114,9 +121,39 @@ class LocalCluster:
         host, port_s = host_port.rsplit(":", 1)
         self.gcs_addr = (host, int(port_s))
 
+    def _spawn_standby(self) -> None:
+        assert self.gcs_addr is not None, "spawn the primary first"
+        cmd = [
+            sys.executable, "-m", "ray_tpu.cluster.ha",
+            "--primary", f"{self.gcs_addr[0]}:{self.gcs_addr[1]}",
+            "--death-timeout", str(self._death_timeout),
+            "--lease-timeout", str(self._lease_timeout),
+            "--port", "0",
+        ]
+        if self._persist_path:
+            cmd += ["--persist", self._persist_path + ".standby"]
+        self.standby_proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, text=True, env=self._child_env(),
+            start_new_session=True,
+        )
+        host_port = _read_banner(self.standby_proc, "GCS_ADDRESS")[0]
+        host, port_s = host_port.rsplit(":", 1)
+        self.standby_addr = (host, int(port_s))
+
     def start(self) -> "LocalCluster":
         self._spawn_gcs()
+        if self._standby_requested:
+            self._spawn_standby()
         return self
+
+    @property
+    def gcs_endpoints(self) -> tuple:
+        """Ordered endpoint list for multi-endpoint clients: primary
+        first, standby second (when deployed)."""
+        assert self.gcs_addr is not None, "start() first"
+        if self.standby_addr is not None:
+            return (self.gcs_addr, self.standby_addr)
+        return (self.gcs_addr,)
 
     def kill_gcs(self) -> None:
         """SIGKILL the control plane (FT testing)."""
@@ -131,6 +168,15 @@ class LocalCluster:
                 except Exception:
                     pass
             self.gcs_proc = None
+
+    def kill_gcs_primary(self) -> None:
+        """SIGKILL the primary with NO restart (KILL_GCS_PRIMARY): the
+        standby's lease expires and it promotes in place — the failover
+        path, as opposed to restart_gcs's blackout-then-replay path."""
+        assert self.standby_addr is not None, (
+            "kill_gcs_primary requires standby=True"
+        )
+        self.kill_gcs()
 
     def restart_gcs(self) -> None:
         """Restart the GCS at the SAME address; with a persist path it
@@ -164,7 +210,7 @@ class LocalCluster:
         res_s = ",".join(f"{k}={v}" for k, v in resources.items())
         cmd = [
             sys.executable, "-m", "ray_tpu.cluster.node_daemon",
-            "--gcs", f"{self.gcs_addr[0]}:{self.gcs_addr[1]}",
+            "--gcs", format_gcs_addr(self.gcs_endpoints),
             "--resources", res_s,
         ]
         if object_capacity_bytes is not None:
@@ -198,14 +244,15 @@ class LocalCluster:
 
     @property
     def address(self) -> str:
-        """GCS address for ray_tpu.init(address=...)."""
+        """GCS address for ray_tpu.init(address=...) — "h:p" or
+        "h1:p1,h2:p2" when a standby is deployed."""
         assert self.gcs_addr is not None, "start() first"
-        return f"{self.gcs_addr[0]}:{self.gcs_addr[1]}"
+        return format_gcs_addr(self.gcs_endpoints)
 
     def client(self) -> ClusterClient:
         if self._client is None:
             assert self.gcs_addr is not None and self._head is not None
-            self._client = ClusterClient(self.gcs_addr, self._head.addr)
+            self._client = ClusterClient(self.gcs_endpoints, self._head.addr)
         return self._client
 
     def kill_node(self, node_id: str) -> None:
@@ -240,17 +287,19 @@ class LocalCluster:
         for node in list(self.nodes.values()):
             node.kill()
         self.nodes.clear()
-        if self.gcs_proc is not None:
-            try:
-                import signal
-
-                os.killpg(os.getpgid(self.gcs_proc.pid), signal.SIGKILL)
-            except Exception:
+        for attr in ("gcs_proc", "standby_proc"):
+            proc = getattr(self, attr)
+            if proc is not None:
                 try:
-                    self.gcs_proc.kill()
+                    import signal
+
+                    os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
                 except Exception:
-                    pass
-            self.gcs_proc = None
+                    try:
+                        proc.kill()
+                    except Exception:
+                        pass
+                setattr(self, attr, None)
 
     def __enter__(self) -> "LocalCluster":
         return self

@@ -26,11 +26,40 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from ray_tpu.cluster.lockstats import TimedRLock
-from ray_tpu.cluster.rpc import RpcServer
+from ray_tpu.cluster.rpc import NotPrimaryError, RpcServer
 from ray_tpu.obs.telemetry import SLOThresholds, TelemetryStore
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("ray_tpu.cluster.gcs")
+
+_ha_metrics_cache: Optional[tuple] = None
+
+
+def register_metrics() -> tuple:
+    """Control-plane HA series (scripts/check_metrics.py hook).
+
+    Plain process-registry metrics, NOT telemetry-plane aggregated: each
+    GCS process (primary or standby) exports its own view — summing
+    replication lag across roles would be meaningless."""
+    global _ha_metrics_cache
+    if _ha_metrics_cache is None:
+        from ray_tpu.util.metrics import Counter, Gauge
+
+        _ha_metrics_cache = (
+            Gauge(
+                "ray_tpu_gcs_replication_lag_seconds",
+                description="how far the standby's replication-log tail "
+                "trails the primary's mutation head (0 = fully caught up; "
+                "measured at the long-poll ack on the primary and at the "
+                "tail loop on the standby)",
+            ),
+            Counter(
+                "ray_tpu_gcs_failovers_total",
+                description="control-plane failovers: standby promotions "
+                "to primary after the primary's lease expired",
+            ),
+        )
+    return _ha_metrics_cache
 
 
 @dataclass
@@ -76,11 +105,37 @@ class GcsService:
     """RPC handler. All methods take (payload, peer)."""
 
     def __init__(self, node_death_timeout_s: float = 5.0,
-                 persist_path: Optional[str] = None):
+                 persist_path: Optional[str] = None,
+                 role: str = "primary"):
         # one RLock domain serializes every table (the sharding roadmap's
         # bottleneck); TimedRLock feeds hold/wait histograms when
         # lockstats.enable_lock_timing() is on, raw-RLock cost otherwise
         self._lock = TimedRLock("gcs")
+        # -- HA identity (cluster/ha.py) ----------------------------------
+        # role/term/fenced under their own small lock so the RPC layer's
+        # per-request ha_fence/ha_term checks never contend on the table
+        # lock. Lock order: table lock OUTER, _ha_lock INNER — never the
+        # reverse.
+        self._ha_lock = threading.Lock()
+        self._ha = {
+            "role": role,
+            "term": 0,
+            "fenced": False,
+            "failovers_total": 0,
+            "fenced_writes_total": 0,
+            "fenced_persists_total": 0,
+        }
+        # replication log: every critical mutation as (seq, term, op,
+        # data), tailed by the warm standby over repl_since. Bounded like
+        # the event ring; a tailer that falls off the retained window is
+        # told to resync from a snapshot.
+        self._repl: list[tuple[int, int, str, dict]] = []
+        self._repl_seq = itertools.count(1)
+        self._repl_head = 0
+        self._repl_dropped = 0    # highest seq trimmed out of the log
+        self._repl_acked = 0      # highest seq any tailer has consumed
+        self._repl_synced_ts: Optional[float] = None
+        self._events_dropped = -1  # highest event seq trimmed from the ring
         self._nodes: dict[str, NodeEntry] = {}
         self._actors: dict[bytes, ActorEntry] = {}
         self._named: dict[tuple, bytes] = {}  # (ns, name) -> actor_id
@@ -171,6 +226,12 @@ class GcsService:
         self._pgs = snap.get("pgs", {})
         self._kv = snap.get("kv", {})
         self.ft["gcs_restarts_total"] = int(snap.get("restarts_total", 0)) + 1
+        with self._ha_lock:
+            # the fencing term is durable: a restarted primary must come
+            # back AT its old term (still fenceable by a promoted standby),
+            # never at term 0 where every zombie check would pass
+            self._ha["term"] = max(self._ha["term"],
+                                   int(snap.get("ha_term", 0)))
         # restored nodes are CLAIMS until they re-register: keep them
         # visible (their daemons are usually still alive and serving) but
         # answer their first heartbeat with `reregister` so the node
@@ -188,6 +249,7 @@ class GcsService:
         self._needs_confirm = {
             a.actor_id for a in self._actors.values() if a.state == "ALIVE"
         }
+        self._reserve_placed_bundles_locked()
         self._restore_t = time.monotonic()
         logger.info(
             "GCS restored from snapshot (restart #%d): %d actors, %d pgs, "
@@ -209,6 +271,29 @@ class GcsService:
             )
         except Exception:  # noqa: BLE001 — tracing must never break restore
             pass
+
+    def _reserve_placed_bundles_locked(self) -> None:
+        """Re-deduct placed PG bundles from restored nodes' availability.
+
+        Restored/replicated nodes come back as reconcile claims with
+        ``available = resources`` — the daemon's next full report is the
+        ground truth that overwrites it. But until that report lands,
+        placement would see inflated capacity and could double-book a
+        fresh PG against bundles a CREATED group already holds on the
+        node. Rebuild ``available`` as resources minus every placed
+        bundle of a live group; the heartbeat's wholesale ``available``
+        report converges any remaining drift."""
+        for e in self._nodes.values():
+            e.available = dict(e.resources)
+        for pg in self._pgs.values():
+            if pg["state"] not in ("CREATED", "RESCHEDULING"):
+                continue
+            for b in pg["bundles"]:
+                node = self._nodes.get(b.get("node_id"))
+                if node is None:
+                    continue
+                for k, v in b["resources"].items():
+                    node.available[k] = node.available.get(k, 0.0) - v
 
     def _snapshot_state_locked(self) -> tuple[int, dict]:
         """(generation, shallow-copied durable tables). Caller holds the
@@ -239,6 +324,7 @@ class GcsService:
                 for e in self._nodes.values() if e.alive
             },
             "restarts_total": self.ft["gcs_restarts_total"],
+            "ha_term": self.ha_term(),
         }
 
     def _write_snapshot(self, gen: int, doc: dict) -> None:
@@ -257,11 +343,40 @@ class GcsService:
         with self._persist_io:
             if gen <= self._persisted:
                 return
+            with self._ha_lock:
+                if self._ha["fenced"]:
+                    # a deposed zombie must NOT install snapshots: the
+                    # promoted primary owns the durable state now, and a
+                    # late persist here would resurrect pre-failover
+                    # tables on the next restart (split-brain on disk)
+                    self._ha["fenced_persists_total"] += 1
+                    logger.warning(
+                        "GCS fenced at term %d: snapshot persist rejected",
+                        self._ha["term"],
+                    )
+                    return
             tmp = self._persist_path + ".tmp"
             try:
                 with open(tmp, "wb") as f:
                     f.write(snap)
+                    f.flush()
+                    # fsync BEFORE the rename: os.replace is atomic in the
+                    # namespace but says nothing about the DATA being on
+                    # disk — without this, a power loss after the rename
+                    # can leave the new name pointing at zero-length/torn
+                    # content, which is exactly the loss the write-ahead
+                    # ack (persist_critical) promised could not happen
+                    os.fsync(f.fileno())
                 os.replace(tmp, self._persist_path)
+                # then fsync the directory so the rename itself is durable
+                dfd = os.open(
+                    os.path.dirname(os.path.abspath(self._persist_path)),
+                    os.O_RDONLY,
+                )
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
                 self._persisted = gen
             except OSError:
                 logger.exception("GCS snapshot write failed")
@@ -290,12 +405,340 @@ class GcsService:
             gen, doc = self._snapshot_state_locked()
         self._write_snapshot(gen, doc)
 
+    # -- HA: fencing term + replication log (cluster/ha.py) -------------------
+
+    # methods a fenced/standby GCS still answers: diagnostics and the
+    # replication plane itself (the standby must be able to tail a
+    # primary that was just fenced, and status must stay queryable)
+    _FENCE_EXEMPT = frozenset({
+        "ha_status", "repl_since", "repl_snapshot", "gcs_ft",
+        "telemetry_status", "telemetry_prometheus", "events_since",
+    })
+    # read-only methods: rejected when fenced (stale data) but not
+    # counted as fenced WRITES — the split-brain acceptance gate counts
+    # rejected mutations, not rejected reads
+    _FENCE_READS = frozenset({
+        "get_actor", "get_named_actor", "list_actors", "list_nodes",
+        "list_pgs", "kv_get", "kv_keys", "kv_wait", "locate_object",
+        "locate_many", "telemetry_slo", "telemetry_perf",
+        "kvtier_lookup", "kvtier_stats", "cluster_demand",
+        "autoscale_signals",
+    })
+
+    def ha_term(self) -> int:
+        """Current fencing term — stamped into every RPC response by
+        RpcServer._dispatch."""
+        with self._ha_lock:
+            return self._ha["term"]
+
+    def ha_fence(self, hterm: int, method: str):
+        """Envelope-level fencing check, called by RpcServer BEFORE the
+        handler runs. A request carrying a term above ours proves a
+        standby was promoted while we were alive: we are the zombie half
+        of a split brain and must stop mutating. Returns None to admit
+        the call, or the exception to answer with."""
+        with self._ha_lock:
+            if hterm > self._ha["term"]:
+                if not self._ha["fenced"]:
+                    logger.warning(
+                        "GCS fenced: request carries term %d > own term %d "
+                        "— a standby promoted; this process is a zombie",
+                        hterm, self._ha["term"],
+                    )
+                self._ha["fenced"] = True
+            if not self._ha["fenced"] or method in self._FENCE_EXEMPT:
+                return None
+            if method not in self._FENCE_READS:
+                self._ha["fenced_writes_total"] += 1
+            term = self._ha["term"]
+        return NotPrimaryError(
+            f"GCS fenced at term {term}: {method!r} rejected "
+            f"(a newer primary holds term >= {hterm})",
+            term=term,
+        )
+
+    def _repl_append_locked(self, op: str, data: dict) -> None:
+        """Append one mutation to the replication log (caller holds the
+        table lock) and wake long-polling tailers."""
+        seq = next(self._repl_seq)
+        with self._ha_lock:
+            term = self._ha["term"]
+        self._repl.append((seq, term, op, data))
+        self._repl_head = seq
+        if len(self._repl) > 20000:
+            self._repl_dropped = self._repl[9999][0]
+            del self._repl[:10000]
+        self._events_cv.notify_all()
+
+    def _repl_from_event_locked(self, kind: str, data: dict) -> None:
+        """Translate an emitted event into a replication-log entry. The
+        event stream says *something changed*; the log entry carries the
+        full row so the standby can apply it without a read-back."""
+        if kind == "actor_update":
+            a = self._actors.get(data["actor_id"])
+            if a is not None:
+                self._repl_append_locked("actor_put", self._actor_info(a))
+        elif kind == "node_added":
+            e = self._nodes.get(data["node_id"])
+            if e is not None:
+                self._repl_append_locked("node_put", {
+                    "node_id": e.node_id,
+                    "addr": tuple(e.addr),
+                    "resources": dict(e.resources),
+                    "labels": dict(e.labels),
+                })
+        elif kind in ("node_dead", "node_draining"):
+            self._repl_append_locked(kind, dict(data))
+        elif kind == "pg_update":
+            pg = self._pgs.get(data["pg_id"])
+            if pg is None or pg["state"] == "REMOVED":
+                self._repl_append_locked(
+                    "pg_remove", {"pg_id": data["pg_id"]}
+                )
+            else:
+                self._repl_append_locked("pg_put", self._pg_repl(pg))
+
+    def _pg_repl(self, pg: dict) -> dict:
+        """PG row as shipped on the replication log: the client-facing
+        info plus the reserve bookkeeping a promoted standby needs to
+        keep running the pg_reserve_sweep."""
+        info = self._pg_info(pg)
+        info["needs_reserve"] = bool(pg.get("needs_reserve"))
+        info["reserve_gen"] = int(pg.get("reserve_gen", 0))
+        return info
+
+    def rpc_repl_since(self, payload, peer):
+        """Replication-log long-poll: the standby's tail. Same cursor
+        contract as events_since, plus the resync verdict — a tailer
+        whose cursor fell off the retained window must re-bootstrap from
+        repl_snapshot instead of silently skipping the gap."""
+        cursor = int(payload["cursor"])
+        wait = min(float(payload.get("wait", 0.0)), 10.0)
+        deadline = time.monotonic() + wait
+        with self._lock:
+            if cursor <= self._repl_dropped:
+                return {
+                    "entries": [], "cursor": self._repl_head + 1,
+                    "resync": True, "term": self.ha_term(),
+                    "head": self._repl_head,
+                }
+            while True:
+                out = [e for e in self._repl if e[0] >= cursor]
+                if out or wait <= 0:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self._events_cv.wait(remaining)
+            next_cursor = out[-1][0] + 1 if out else cursor
+            self._repl_acked = max(self._repl_acked, next_cursor - 1)
+            if self._repl_acked >= self._repl_head:
+                self._repl_synced_ts = time.monotonic()
+            head = self._repl_head
+        self._set_lag_metric_locked_free()
+        return {
+            "entries": out, "cursor": next_cursor, "resync": False,
+            "term": self.ha_term(), "head": head,
+        }
+
+    def rpc_repl_snapshot(self, payload, peer):
+        """Snapshot bootstrap/resync for a standby tailer: full durable
+        tables + the cursor at which the log continues them."""
+        with self._lock:
+            _gen, doc = self._snapshot_state_locked()
+            cursor = self._repl_head + 1
+            self._repl_acked = self._repl_head
+            self._repl_synced_ts = time.monotonic()
+        return {"doc": doc, "cursor": cursor, "term": self.ha_term()}
+
+    def _replication_lag_s(self) -> Optional[float]:
+        """None = no tailer has ever synced; 0.0 = caught up; else the
+        age of the last moment the tail was at head."""
+        with self._lock:
+            if self._repl_synced_ts is None:
+                return None
+            if self._repl_acked >= self._repl_head:
+                return 0.0
+            return time.monotonic() - self._repl_synced_ts
+
+    def _set_lag_metric_locked_free(self) -> None:
+        lag = self._replication_lag_s()
+        if lag is not None:
+            register_metrics()[0].set(lag)
+
+    def rpc_ha_status(self, payload, peer):
+        """Role/term/replication view for `ray_tpu status` and the HA
+        tests: who is primary, at what term, how far any tailer trails."""
+        with self._lock:
+            head = self._repl_head
+            acked = self._repl_acked
+        with self._ha_lock:
+            out = {
+                "role": self._ha["role"],
+                "term": self._ha["term"],
+                "fenced": self._ha["fenced"],
+                "failovers_total": self._ha["failovers_total"],
+                "fenced_writes_total": self._ha["fenced_writes_total"],
+                "fenced_persists_total": self._ha["fenced_persists_total"],
+            }
+        out["replication_lag_s"] = self._replication_lag_s()
+        out["repl_head"] = head
+        out["repl_acked"] = acked
+        return out
+
+    # -- HA: standby-side application + promotion -----------------------------
+
+    def repl_install_snapshot(self, doc: dict, cursor: int, term: int) -> None:
+        """Install a primary's snapshot wholesale (standby bootstrap or
+        post-gap resync). Nodes come in as reconcile CLAIMS, exactly like
+        a restart restore — on promotion their daemons re-register and
+        ground truth converges."""
+        with self._lock:
+            self._actors = dict(doc.get("actors", {}))
+            self._named = dict(doc.get("named", {}))
+            self._pgs = {k: dict(v) for k, v in doc.get("pgs", {}).items()}
+            self._kv = {ns: dict(kv) for ns, kv in doc.get("kv", {}).items()}
+            self.ft["gcs_restarts_total"] = int(doc.get("restarts_total", 0))
+            self._nodes = {}
+            for node_id, rec in doc.get("nodes", {}).items():
+                self._nodes[node_id] = NodeEntry(
+                    node_id=node_id,
+                    addr=tuple(rec["addr"]),
+                    resources=dict(rec["resources"]),
+                    available=dict(rec["resources"]),
+                    labels=dict(rec.get("labels", {})),
+                    pending_reconcile=True,
+                )
+            with self._ha_lock:
+                self._ha["term"] = max(
+                    self._ha["term"], int(term), int(doc.get("ha_term", 0))
+                )
+            self._mark_dirty()
+
+    def repl_apply(self, entries) -> int:
+        """Apply tailed log entries in order; observes each entry's term
+        so the standby's own term never trails the primary's."""
+        applied = 0
+        with self._lock:
+            for _seq, term, op, data in entries:
+                self._repl_apply_one_locked(op, data)
+                with self._ha_lock:
+                    if term > self._ha["term"]:
+                        self._ha["term"] = int(term)
+                applied += 1
+            if applied:
+                self._mark_dirty()
+        return applied
+
+    def _repl_apply_one_locked(self, op: str, data: dict) -> None:
+        if op == "actor_put":
+            aid = data["actor_id"]
+            a = ActorEntry(
+                actor_id=aid,
+                name=data.get("name"),
+                namespace=data.get("namespace", "default"),
+                node_id=data.get("node_id"),
+                worker_addr=tuple(data["worker_addr"])
+                if data.get("worker_addr") else None,
+                state=data.get("state", "PENDING"),
+                max_restarts=int(data.get("max_restarts", 0)),
+                num_restarts=int(data.get("num_restarts", 0)),
+                creation_spec=data.get("creation_spec"),
+                owner_addr=tuple(data["owner_addr"])
+                if data.get("owner_addr") else None,
+                lease_resources=dict(
+                    data.get("lease_resources") or {"num_cpus": 1}
+                ),
+                lease_id=data.get("lease_id"),
+                node_addr=tuple(data["node_addr"])
+                if data.get("node_addr") else None,
+            )
+            self._actors[aid] = a
+            if a.name:
+                self._named[(a.namespace, a.name)] = aid
+        elif op == "node_put":
+            self._nodes[data["node_id"]] = NodeEntry(
+                node_id=data["node_id"],
+                addr=tuple(data["addr"]),
+                resources=dict(data["resources"]),
+                available=dict(data["resources"]),
+                labels=dict(data.get("labels", {})),
+                pending_reconcile=True,
+            )
+        elif op == "node_dead":
+            e = self._nodes.get(data["node_id"])
+            if e is not None:
+                e.alive = False
+        elif op == "node_draining":
+            e = self._nodes.get(data["node_id"])
+            if e is not None:
+                e.draining = True
+        elif op == "pg_put":
+            self._pgs[data["pg_id"]] = {
+                "pg_id": data["pg_id"],
+                "bundles": [dict(b) for b in data["bundles"]],
+                "strategy": data["strategy"],
+                "state": data["state"],
+                "name": data.get("name"),
+                "needs_reserve": bool(data.get("needs_reserve")),
+                "reserve_gen": int(data.get("reserve_gen", 0)),
+            }
+        elif op == "pg_remove":
+            self._pgs.pop(data["pg_id"], None)
+        elif op == "kv_put":
+            self._kv.setdefault(data["ns"], {})[data["key"]] = data["value"]
+            self._events_cv.notify_all()
+        elif op == "kv_del":
+            self._kv.get(data["ns"], {}).pop(data["key"], None)
+        # unknown ops are skipped: forward compatibility with a newer
+        # primary shipping ops this standby build doesn't know
+
+    def promote(self, term: Optional[int] = None) -> int:
+        """Standby -> primary. Bumps the fencing term past everything
+        seen, then runs the r13 restart-restore discipline over the
+        replicated tables: every node becomes a reconcile claim with a
+        fresh heartbeat lease, every ALIVE actor awaits confirmation, and
+        the grace clock starts — the reconcile sweep converges whatever
+        the log missed. Persists critically so the new term is durable
+        before the first client is acked at it."""
+        with self._lock:
+            with self._ha_lock:
+                new_term = max(self._ha["term"] + 1, int(term or 0))
+                self._ha["term"] = new_term
+                self._ha["role"] = "primary"
+                self._ha["fenced"] = False
+                self._ha["failovers_total"] += 1
+            now = time.monotonic()
+            for e in self._nodes.values():
+                e.pending_reconcile = True
+                e.last_hb = now  # fresh lease: death clock starts NOW
+            self._needs_confirm = {
+                a.actor_id for a in self._actors.values()
+                if a.state == "ALIVE"
+            }
+            self._reserve_placed_bundles_locked()
+            self._restore_t = now
+            self._mark_dirty()
+            self._events_cv.notify_all()
+        self.persist_critical()
+        register_metrics()[1].inc()
+        logger.warning(
+            "GCS standby PROMOTED to primary at term %d (%d nodes pending "
+            "reconcile, %d actors pending confirm)",
+            new_term, len(self._nodes), len(self._needs_confirm),
+        )
+        return new_term
+
     # -- events ---------------------------------------------------------------
 
     def _emit(self, kind: str, data: dict) -> None:
         self._events.append((next(self._event_seq), kind, data))
         if len(self._events) > 10000:
+            self._events_dropped = self._events[4999][0]
             del self._events[:5000]
+        # critical mutations surface as events; mirror them onto the
+        # replication log (full-row entries) before waking subscribers
+        self._repl_from_event_locked(kind, data)
         self._events_cv.notify_all()
 
     def rpc_events_since(self, payload, peer):
@@ -303,13 +746,24 @@ class GcsService:
         handler thread parks until an event at/after `cursor` lands or
         the wait budget expires — push-latency delivery without a
         persistent subscriber channel (reference: GCS pubsub long-poll,
-        src/ray/pubsub/publisher.h)."""
+        src/ray/pubsub/publisher.h).
+
+        A `resync: true` verdict means the cursor fell below the oldest
+        retained event (the ring trimmed past it): events were LOST to
+        this subscriber, and anything mirroring state off the feed must
+        rebuild from a full read instead of continuing the tail."""
         cursor = payload["cursor"]
         # cap well below RpcClient's 30s default call timeout: a quiet
         # feed must answer (empty) before the client gives up on the RPC
         wait = min(float(payload.get("wait", 0.0)), 10.0)
         deadline = time.monotonic() + wait
         with self._lock:
+            if cursor <= self._events_dropped:
+                next_cursor = (
+                    self._events[0][0] if self._events
+                    else self._events_dropped + 1
+                )
+                return {"events": [], "cursor": next_cursor, "resync": True}
             while True:
                 out = [e for e in self._events if e[0] >= cursor]
                 if out or wait <= 0:
@@ -319,7 +773,7 @@ class GcsService:
                     break
                 self._events_cv.wait(remaining)
             next_cursor = self._events[-1][0] + 1 if self._events else cursor
-        return {"events": out, "cursor": next_cursor}
+        return {"events": out, "cursor": next_cursor, "resync": False}
 
     # -- nodes ----------------------------------------------------------------
 
@@ -424,6 +878,9 @@ class GcsService:
                     a.lease_id = rec["lease_id"]
                 a.node_addr = e.addr
                 self.ft["reconcile_actors_confirmed"] += 1
+                # no event fires for a silent confirm, but the binding
+                # (node/worker/lease) may have changed: replicate it
+                self._repl_append_locked("actor_put", self._actor_info(a))
             self._needs_confirm.discard(aid)
         # snapshot-ALIVE actors homed on THIS node that it did not report
         # are gone with the outage: normal node-death treatment, now
@@ -452,6 +909,7 @@ class GcsService:
             b = pg["bundles"][idx]
             b["node_id"] = e.node_id  # daemon-held reservation wins
             self.ft["reconcile_bundles_adopted"] += 1
+            self._repl_append_locked("pg_put", self._pg_repl(pg))
         self.ft["reconcile_leases_reported"] += len(payload.get("leases", ()))
 
     def _bury_or_restart_locked(self, a: ActorEntry) -> None:
@@ -618,6 +1076,13 @@ class GcsService:
                     results[slot] = out
         return {"ok": True, "results": results}
 
+    def rpc_telemetry_cluster(self, payload, peer):
+        """GCS-aggregated cluster metrics (ClusterClient.cluster_metrics
+        and the dashboard's /api/metrics). Dropped by accident when the
+        r20 batching rework reshuffled the telemetry handlers — the
+        store-side aggregation was always there, the RPC surface wasn't."""
+        return self.telemetry.cluster_metrics()
+
     def rpc_telemetry_slo(self, payload, peer):
         th = SLOThresholds.from_dict((payload or {}).get("thresholds"))
         return self.telemetry.slo_report(th)
@@ -640,6 +1105,7 @@ class GcsService:
         out = {"nodes": self.rpc_list_nodes(None, peer)}
         out.update(self.telemetry.status_payload(th))
         out["gcs_ft"] = self.rpc_gcs_ft(None, peer)
+        out["gcs_ha"] = self.rpc_ha_status(None, peer)
         out["kvtier_index"] = self.prefix_index.stats()
         return out
 
@@ -684,10 +1150,15 @@ class GcsService:
 
     def rpc_gcs_ft(self, payload, peer):
         """Control-plane FT counters: restarts + reconcile deltas (the
-        bench's duplicate/lost-actor gate reads these)."""
+        bench's duplicate/lost-actor gate reads these), plus the HA
+        failover/fence counters."""
         with self._lock:
             out = dict(self.ft)
             out["actors_pending_confirm"] = len(self._needs_confirm)
+        with self._ha_lock:
+            out["gcs_failovers_total"] = self._ha["failovers_total"]
+            out["gcs_fenced_writes_total"] = self._ha["fenced_writes_total"]
+            out["gcs_fenced_persists_total"] = self._ha["fenced_persists_total"]
         return out
 
     def rpc_cluster_demand(self, payload, peer):
@@ -980,7 +1451,8 @@ class GcsService:
 
     def rpc_kv_put(self, payload, peer):
         with self._lock:
-            ns = self._kv.setdefault(payload.get("ns", "default"), {})
+            ns_name = payload.get("ns", "default")
+            ns = self._kv.setdefault(ns_name, {})
             if payload.get("nx") and payload["key"] in ns:
                 # set-if-absent: atomic claim primitive (job submission
                 # ids, leader election) — check-then-put at the caller
@@ -988,6 +1460,14 @@ class GcsService:
                 return {"ok": False}
             ns[payload["key"]] = payload["value"]
             self._mark_dirty()
+            if ns_name != "__collective__":
+                # the collective rendezvous namespace is ephemeral and
+                # multi-MB (see _snapshot_state_locked) — everything else
+                # replicates so a promoted standby serves the same KV
+                self._repl_append_locked("kv_put", {
+                    "ns": ns_name, "key": payload["key"],
+                    "value": payload["value"],
+                })
             self._events_cv.notify_all()  # wake kv_wait long-pollers
         return {"ok": True}
 
@@ -1017,8 +1497,13 @@ class GcsService:
 
     def rpc_kv_del(self, payload, peer):
         with self._lock:
-            self._kv.get(payload.get("ns", "default"), {}).pop(payload["key"], None)
+            ns_name = payload.get("ns", "default")
+            self._kv.get(ns_name, {}).pop(payload["key"], None)
             self._mark_dirty()
+            if ns_name != "__collective__":
+                self._repl_append_locked(
+                    "kv_del", {"ns": ns_name, "key": payload["key"]}
+                )
         return {"ok": True}
 
     def rpc_kv_keys(self, payload, peer):
@@ -1074,9 +1559,17 @@ class GcsService:
     def rpc_register_actor(self, payload, peer):
         with self._lock:
             name, ns = payload.get("name"), payload.get("namespace", "default")
+            prior = self._actors.get(payload["actor_id"])
+            if prior is not None and prior.state != "DEAD":
+                # duplicate delivery: the client retried after losing the
+                # ack (GCS failover/timeout) but the registration already
+                # took. Ack idempotently — re-creating the entry would
+                # reset restart bookkeeping, and the name check below
+                # would bounce our OWN registration as "taken"
+                return {"ok": True, "duplicate": True}
             if name:
                 existing = self._named.get((ns, name))
-                if existing is not None:
+                if existing is not None and existing != payload["actor_id"]:
                     a = self._actors.get(existing)
                     if a is not None and a.state != "DEAD":
                         return {"ok": False, "error": f"name {name!r} taken"}
@@ -1100,6 +1593,7 @@ class GcsService:
             if name:
                 self._named[(ns, name)] = a.actor_id
             self._mark_dirty()
+            self._repl_append_locked("actor_put", self._actor_info(a))
         # write-ahead ack: the registration must be durable BEFORE the
         # client sees ok — killing the GCS between this ack and the next
         # debounced sweep used to silently lose the actor
@@ -1143,6 +1637,7 @@ class GcsService:
             "owner_addr": a.owner_addr,
             "lease_id": a.lease_id,
             "node_addr": a.node_addr,
+            "lease_resources": dict(a.lease_resources),
         }
 
     def rpc_get_actor(self, payload, peer):
@@ -1168,6 +1663,12 @@ class GcsService:
         """Place bundles against the resource view. Returns the placement
         (bundle index -> node) or state=PENDING when it doesn't fit."""
         with self._lock:
+            prior = self._pgs.get(payload["pg_id"])
+            if prior is not None and prior["state"] != "REMOVED":
+                # duplicate delivery (retry across a failover/timeout):
+                # re-placing would deduct node availability a SECOND time
+                # for the same bundles — return the existing placement
+                return self._pg_info(prior)
             pg = {
                 "pg_id": payload["pg_id"],
                 "bundles": [
@@ -1181,6 +1682,7 @@ class GcsService:
             self._pgs[pg["pg_id"]] = pg
             self._try_place_pg(pg)
             self._mark_dirty()
+            self._repl_append_locked("pg_put", self._pg_repl(pg))
             info = self._pg_info(pg)
         # write-ahead ack (same contract as register_actor): the
         # reservation the client is about to make against this placement
@@ -1301,7 +1803,13 @@ class GcsService:
         with self._lock:
             pg = self._pgs.get(payload["pg_id"])
             if pg is not None and pg["state"] in ("PENDING", "RESCHEDULING"):
+                prev = pg["state"]
                 self._try_place_pg(pg)  # retry on demand (nodes may have joined)
+                if pg["state"] != prev:
+                    # an on-demand placement is the same durable mutation
+                    # a create is: persist (debounced) and replicate it
+                    self._mark_dirty()
+                    self._repl_append_locked("pg_put", self._pg_repl(pg))
             return self._pg_info(pg) if pg else None
 
     def rpc_list_pgs(self, payload, peer):
@@ -1316,6 +1824,34 @@ class GcsService:
             "state": pg["state"],
             "name": pg.get("name"),
         }
+
+
+def start_sweeper(service: GcsService, stop: threading.Event,
+                  pool=None, period_s: float = 0.25) -> threading.Thread:
+    """The serving primary's background loop: health leases, reconcile
+    convergence, actor restarts, PG re-reservation, debounced persist.
+    Shared by GcsServer and by a promoted standby (cluster/ha.py) — a
+    promotion must start EXACTLY this loop or the r13 fault-tolerance
+    sweeps silently stop running on the new primary."""
+    from ray_tpu.cluster.rpc import ClientPool
+
+    if pool is None:
+        pool = ClientPool(timeout=120.0)
+
+    def sweep():
+        while not stop.wait(period_s):
+            try:
+                service.health_sweep()
+                service.reconcile_sweep(pool)
+                service.restart_sweep(pool)
+                service.pg_reserve_sweep(pool)
+                service.persist_if_dirty()
+            except Exception:
+                logger.exception("health sweep failed")
+
+    t = threading.Thread(target=sweep, name="gcs-health", daemon=True)
+    t.start()
+    return t
 
 
 class GcsServer:
@@ -1333,24 +1869,8 @@ class GcsServer:
         self._stop = threading.Event()
 
     def start(self) -> tuple[str, int]:
-        from ray_tpu.cluster.rpc import ClientPool
-
         addr = self.rpc.start()
-        pool = ClientPool(timeout=120.0)
-
-        def sweep():
-            while not self._stop.wait(0.25):
-                try:
-                    self.service.health_sweep()
-                    self.service.reconcile_sweep(pool)
-                    self.service.restart_sweep(pool)
-                    self.service.pg_reserve_sweep(pool)
-                    self.service.persist_if_dirty()
-                except Exception:
-                    logger.exception("health sweep failed")
-
-        self._sweeper = threading.Thread(target=sweep, name="gcs-health", daemon=True)
-        self._sweeper.start()
+        self._sweeper = start_sweeper(self.service, self._stop)
         return addr
 
     def stop(self) -> None:

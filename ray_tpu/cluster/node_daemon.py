@@ -41,6 +41,8 @@ from ray_tpu.cluster.rpc import (
     RpcClient,
     RpcError,
     RpcServer,
+    format_gcs_addr,
+    parse_gcs_addr,
 )
 from ray_tpu.utils.logging import get_logger
 
@@ -946,7 +948,7 @@ class NodeDaemon:
                 sys.executable, "-m", "ray_tpu.cluster.worker_main",
                 "--daemon", f"{self.addr[0]}:{self.addr[1]}",
                 "--worker-id", worker_id,
-                "--gcs", f"{self.gcs_addr[0]}:{self.gcs_addr[1]}",
+                "--gcs", format_gcs_addr(self.gcs_addr),
             ],
             env=env,
             cwd=cwd,
@@ -1507,7 +1509,7 @@ def main() -> None:
                    help="units of the slice:<id> resource to advertise "
                         "(chips of this slice hosted here)")
     args = p.parse_args()
-    host, port = args.gcs.rsplit(":", 1)
+    gcs_addr = parse_gcs_addr(args.gcs)  # "h:p" or HA pair "h1:p1,h2:p2"
     resources: dict[str, float] = {}
     for kv in args.resources.split(","):
         if kv:
@@ -1525,7 +1527,7 @@ def main() -> None:
             worker_env[k] = v
     _chaos.install_from_env()  # adopt a driver-propagated fault schedule
     daemon = NodeDaemon(
-        (host, int(port)), resources, node_id=args.node_id, worker_env=worker_env,
+        gcs_addr, resources, node_id=args.node_id, worker_env=worker_env,
         object_capacity_bytes=args.object_capacity,
         worker_rss_limit_mb=args.worker_rss_limit_mb,
         memory_usage_threshold=args.memory_usage_threshold,

@@ -7,8 +7,17 @@ toolchain in the loop, and the payloads are plain Python structures the
 rest of the runtime already uses. The wire format:
 
     frame    := uint32 length | pickled body
-    request  := (msg_id, method: str, payload)
-    response := (msg_id, ok: bool, payload | exception)
+    request  := (msg_id, method: str, payload[, hterm])
+    response := (msg_id, ok: bool, payload | exception[, term])
+
+The optional 4th element is the HA fencing-term envelope (cluster/ha.py):
+GCS-bound requests carry the highest fencing term the client has seen;
+GCS responses carry the server's current term. A server whose handler
+exposes ``ha_fence``/``ha_term`` rejects mutations carrying a newer term
+than its own (it is a deposed zombie primary), and a client that sees a
+response term below its own high-water mark discards the ack (it came
+from a stale primary). Non-HA servers and old peers simply omit the
+element — 3-tuples remain fully valid on both sides.
 
 Servers run an asyncio loop on a dedicated thread and dispatch to a
 handler object's `rpc_<method>` coroutines/functions. Clients are
@@ -43,12 +52,90 @@ class RpcError(Exception):
     """Transport-level failure (peer died, connection refused)."""
 
 
+class NotPrimaryError(RpcError):
+    """The peer is not the serving GCS primary: an unpromoted standby, or
+    a deposed (fenced) primary whose term is stale. Callers holding an
+    endpoint list fail over to the next endpoint instead of surfacing
+    this (ReconnectingRpcClient does that internally)."""
+
+    def __init__(self, message: str, term: int = 0):
+        super().__init__(message)
+        self.term = int(term)
+
+
+class StaleTermError(RpcError):
+    """A response arrived stamped with a fencing term below this client's
+    high-water mark — the ack came from a zombie primary and must not be
+    trusted (its state is doomed to be discarded at reconciliation)."""
+
+
 class RemoteError(Exception):
     """The remote handler raised; carries the original exception."""
 
     def __init__(self, cause: BaseException):
         super().__init__(repr(cause))
         self.cause = cause
+
+
+class TermTracker:
+    """Highest GCS fencing term this client has observed. Shared across
+    the clients of one control plane so a term learned from the promoted
+    standby immediately fences requests sent to the old primary."""
+
+    def __init__(self) -> None:
+        self._term = 0
+        self._lock = threading.Lock()
+
+    @property
+    def current(self) -> int:
+        return self._term
+
+    def observe(self, term) -> int:
+        if term is None:
+            return self._term
+        with self._lock:
+            if term > self._term:
+                self._term = int(term)
+            return self._term
+
+
+def _normalize_endpoints(host, port=None, extra=()) -> list[tuple[str, int]]:
+    """Accept every shape a GCS address travels in: ("h", p) pairs,
+    a single (h, p) tuple, or an ordered endpoint list ((h1, p1),
+    (h2, p2), ...) — the two-endpoint HA deployment splats through the
+    same ``Client(*gcs_addr)`` call sites the single-address form uses."""
+    if isinstance(host, str):
+        if port is None:
+            raise ValueError(f"endpoint {host!r} needs a port")
+        eps = [(host, int(port))]
+        eps.extend((h, int(p)) for h, p in extra)
+        return eps
+    first = tuple(host)
+    if len(first) == 2 and isinstance(first[0], str):
+        eps = [(first[0], int(first[1]))]
+    else:
+        eps = [(h, int(p)) for h, p in first]
+    if port is not None:
+        eps.append((port[0], int(port[1])))
+    eps.extend((h, int(p)) for h, p in extra)
+    return eps
+
+
+def format_gcs_addr(addr) -> str:
+    """'h1:p1[,h2:p2...]' — the --gcs flag form of a (possibly
+    multi-endpoint) GCS address."""
+    return ",".join(f"{h}:{p}" for h, p in _normalize_endpoints(addr))
+
+
+def parse_gcs_addr(s: str):
+    """Inverse of format_gcs_addr. A single endpoint parses to the legacy
+    (host, port) tuple so existing addr[0]/addr[1] consumers keep
+    working; multiple parse to an ordered endpoint tuple."""
+    eps = []
+    for part in s.split(","):
+        h, p = part.rsplit(":", 1)
+        eps.append((h, int(p)))
+    return eps[0] if len(eps) == 1 else tuple(eps)
 
 
 def _dump(obj: Any) -> bytes:
@@ -164,7 +251,9 @@ class RpcServer:
                     raise RpcError(f"frame too large: {n}")
                 body = await reader.readexactly(n)
                 try:
-                    msg_id, method, payload = pickle.loads(body)
+                    rec = pickle.loads(body)
+                    msg_id, method, payload = rec[0], rec[1], rec[2]
+                    hterm = rec[3] if len(rec) > 3 else None
                 except Exception as e:  # noqa: BLE001 — torn/corrupted frame
                     # a corrupted frame (bit flip, truncated writer) poisons
                     # the whole stream (framing offsets are gone): drop the
@@ -177,7 +266,9 @@ class RpcServer:
                 # concurrent dispatch: a slow handler must not block the
                 # connection (the reference runs handlers on thread pools)
                 asyncio.ensure_future(
-                    self._dispatch(msg_id, method, payload, peer, writer, write_lock)
+                    self._dispatch(
+                        msg_id, method, payload, hterm, peer, writer, write_lock
+                    )
                 )
         except (asyncio.IncompleteReadError, ConnectionError):
             pass
@@ -188,10 +279,33 @@ class RpcServer:
                 pass
 
     async def _dispatch(
-        self, msg_id, method, payload, peer, writer, write_lock
+        self, msg_id, method, payload, hterm, peer, writer, write_lock
     ) -> None:
         t0 = time.perf_counter()
+        term_of = getattr(self._handler, "ha_term", None)
+
+        def _respond(ok, result):
+            # stamp the server's CURRENT term (post-handler: a promotion
+            # mid-call must not be masked by a stale pre-read)
+            t = None
+            if term_of is not None:
+                try:
+                    t = term_of()
+                except Exception:  # noqa: BLE001
+                    t = None
+            rec = (msg_id, ok, result) if t is None else (msg_id, ok, result, t)
+            return _dump(rec)
+
         try:
+            fence = getattr(self._handler, "ha_fence", None)
+            if fence is not None and hterm is not None:
+                # fencing-term check BEFORE the handler runs: a request
+                # carrying a newer term proves this server was deposed —
+                # it must reject the mutation, not execute it (the
+                # split-brain guard; cluster/ha.py)
+                verdict = fence(hterm, method)
+                if verdict is not None:
+                    raise verdict
             fn = self._routes.get(method) or getattr(self._handler, f"rpc_{method}")
             if asyncio.iscoroutinefunction(fn):
                 result = await fn(payload, peer)
@@ -202,12 +316,12 @@ class RpcServer:
                 )
                 if asyncio.iscoroutine(result):
                     result = await result
-            body = _dump((msg_id, True, result))
+            body = _respond(True, result)
         except BaseException as e:  # noqa: BLE001 - serialized to caller
             try:
-                body = _dump((msg_id, False, e))
+                body = _respond(False, e)
             except Exception:
-                body = _dump((msg_id, False, RpcError(repr(e))))
+                body = _respond(False, RpcError(repr(e)))
         # handler latency including executor queueing (that queue IS part
         # of what a caller experiences), excluding the response write
         self._latency_hist.observe(
@@ -240,6 +354,9 @@ class RpcClient:
         self._reader: Optional[threading.Thread] = None
         self._closed = False
         self._dead = False  # reader saw the peer vanish
+        # HA term observer: set by ReconnectingRpcClient so every stamped
+        # response feeds the shared TermTracker high-water mark
+        self.on_term: Optional[Callable[[int], Any]] = None
 
     # -- connection -----------------------------------------------------------
 
@@ -298,7 +415,9 @@ class RpcClient:
 
     # -- calls ----------------------------------------------------------------
 
-    def call(self, method: str, payload: Any = None, timeout: Optional[float] = None) -> Any:
+    def call(self, method: str, payload: Any = None,
+             timeout: Optional[float] = None,
+             hterm: Optional[int] = None) -> Any:
         if self._sock is None:
             raise RpcError("not connected")
         if self._dead:
@@ -335,7 +454,10 @@ class RpcClient:
             self._next_id += 1
             ev: tuple[threading.Event, list] = (threading.Event(), [])
             self._pending[msg_id] = ev
-        body = _dump((msg_id, method, payload))
+        body = _dump(
+            (msg_id, method, payload) if hterm is None
+            else (msg_id, method, payload, hterm)
+        )
         if len(body) > MAX_FRAME:
             # mirror the server's read-side limit BEFORE the uint32 length
             # prefix overflows: disaggregated KV handoffs make multi-MB
@@ -374,16 +496,32 @@ class RpcClient:
         except OSError as e:
             with self._plock:
                 self._pending.pop(msg_id, None)
+            # a failed send never delivered a complete frame (length-
+            # prefixed framing: partial writes are never executed), so
+            # this connection is DEAD and the call is safe to retry on a
+            # fresh dial — don't wait for the reader to notice the EOF
+            self._dead = True
             raise RpcError(f"send to {self.addr} failed: {e}") from e
         if not ev[0].wait(timeout if timeout is not None else self._timeout):
             with self._plock:
                 self._pending.pop(msg_id, None)
             raise RpcError(f"rpc {method} to {self.addr} timed out")
-        ok, result = ev[1]
+        ok, result, term = ev[1]
+        if term is not None and self.on_term is not None:
+            self.on_term(term)
         if isinstance(result, RpcError) and not ok:
             raise result
         if not ok:
             raise RemoteError(result)
+        if hterm is not None and term is not None and term < hterm:
+            # success ack from a server whose term is below our high-water
+            # mark: a zombie primary's late ack. Its table write is doomed
+            # (the promoted standby's reconcile discards it) — surfacing
+            # the ack as success would invent state the cluster never sees
+            raise StaleTermError(
+                f"rpc {method}: ack from {self.addr} at stale term "
+                f"{term} < {hterm}"
+            )
         return result
 
     def _read_loop(self) -> None:
@@ -447,11 +585,12 @@ class RpcClient:
                         buf += _recv_more(mid_frame=True)
                     body = buf[_LEN.size : _LEN.size + n]
                     buf = buf[_LEN.size + n :]
-                msg_id, ok, result = pickle.loads(body)
+                rec = pickle.loads(body)
+                msg_id, ok, result = rec[0], rec[1], rec[2]
                 with self._plock:
                     ev = self._pending.pop(msg_id, None)
                 if ev is not None:
-                    ev[1][:] = [ok, result]
+                    ev[1][:] = [ok, result, rec[3] if len(rec) > 3 else None]
                     ev[0].set()
         except (ConnectionError, OSError, MemoryError) as e:
             self._fail_all(RpcError(f"connection to {self.addr} lost: {e}"))
@@ -462,18 +601,32 @@ class RpcClient:
             pending = list(self._pending.values())
             self._pending.clear()
         for ev, slot in pending:
-            slot[:] = [False, err]
+            slot[:] = [False, err, None]
             ev.set()
 
 
 class ReconnectingRpcClient:
     """RpcClient that re-dials on a dead connection — the peer (e.g. a
     restarted GCS) may come back at the same address (reference: raylets
-    reconnect to a Redis-restored GCS, gcs_redis_failure_detector.cc)."""
+    reconnect to a Redis-restored GCS, gcs_redis_failure_detector.cc).
 
-    def __init__(self, host: str, port: int, timeout: float = 30.0,
-                 retries: int = 20, redial_attempts: int = 3):
-        self.addr = (host, port)
+    HA extension (cluster/ha.py): accepts an ORDERED endpoint list —
+    ``ReconnectingRpcClient(("h1", p1), ("h2", p2))`` or the splatted
+    ``*gcs_addr`` form where gcs_addr is a tuple of endpoints — and
+    fails over on connect errors, dead peers, and NotPrimaryError /
+    StaleTermError rejections. Every call carries the highest fencing
+    term seen (shared TermTracker) and every stamped response feeds it,
+    so one client learning of a promotion fences the whole process's
+    view of the old primary.
+    """
+
+    def __init__(self, host, port=None, *extra, timeout: float = 30.0,
+                 retries: int = 20, redial_attempts: int = 3,
+                 failover_attempts: int = 10,
+                 term_tracker: Optional[TermTracker] = None):
+        self._endpoints = _normalize_endpoints(host, port, extra)
+        self._active = 0
+        self.addr = self._endpoints[0]
         self._timeout = timeout
         self._retries = retries
         # dead-peer calls get up to this many fresh-dial retries (each
@@ -481,23 +634,27 @@ class ReconnectingRpcClient:
         # a GCS that takes a few seconds to restart no longer fails the
         # caller on the single old immediate retry
         self._redial_attempts = max(1, int(redial_attempts))
+        # not-primary hops are bounded separately: with backoff these
+        # cover a full lease-expiry promotion window (~seconds) before
+        # the rejection surfaces to the caller
+        self._failover_attempts = max(1, int(failover_attempts))
+        self.term = term_tracker if term_tracker is not None else TermTracker()
         self._lock = threading.Lock()
         self._client: Optional[RpcClient] = None
         self._closed = False
 
-    def _get(self) -> RpcClient:
-        with self._lock:
-            if self._closed:
-                raise RpcError(f"client to {self.addr} closed")
-            c = self._client
-            if c is not None and c.connected:
-                return c
-        # dial OUTSIDE the lock (same discipline as ClientPool.get):
-        # holding _lock through a connect timeout x retries would wedge
-        # every concurrent caller behind one dead peer
-        c = RpcClient(*self.addr, timeout=self._timeout).connect(
-            retries=self._retries
+    @property
+    def endpoints(self) -> tuple[tuple[str, int], ...]:
+        return tuple(self._endpoints)
+
+    def _dial_one(self, ep: tuple[str, int], retries: int) -> RpcClient:
+        c = RpcClient(ep[0], ep[1], timeout=self._timeout).connect(
+            retries=retries
         )
+        c.on_term = self.term.observe
+        return c
+
+    def _commit(self, c: RpcClient, idx: int) -> RpcClient:
         with self._lock:
             if self._closed:
                 c.close()
@@ -508,7 +665,61 @@ class ReconnectingRpcClient:
                 c.close()
                 return existing
             self._client = c
+            self._active = idx
+            self.addr = self._endpoints[idx]
             return c
+
+    def _get(self) -> RpcClient:
+        with self._lock:
+            if self._closed:
+                raise RpcError(f"client to {self.addr} closed")
+            c = self._client
+            if c is not None and c.connected:
+                return c
+            start = self._active
+        # dial OUTSIDE the lock (same discipline as ClientPool.get):
+        # holding _lock through a connect timeout x retries would wedge
+        # every concurrent caller behind one dead peer
+        if len(self._endpoints) == 1:
+            c = self._dial_one(self._endpoints[0], self._retries)
+            return self._commit(c, 0)
+        # multi-endpoint: sweep the ordered list round-robin from the
+        # last-good endpoint. Each endpoint gets ONE dial per round (a
+        # dead primary costs one refused connect, not retries x backoff);
+        # rounds are bounded by the configured retry budget.
+        last: Optional[BaseException] = None
+        backoff = ExponentialBackoff(base=0.05, cap=1.0)
+        for _round in range(self._retries + 1):
+            for k in range(len(self._endpoints)):
+                idx = (start + k) % len(self._endpoints)
+                ep = self._endpoints[idx]
+                if _chaos.BLOCKED_PEERS and tuple(ep) in _chaos.BLOCKED_PEERS:
+                    # chaos partition (PARTITION_GCS_PAIR): this peer is
+                    # unreachable from here; try the others
+                    last = RpcError(f"chaos: peer {ep} partitioned")
+                    continue
+                try:
+                    c = self._dial_one(ep, 0)
+                except RpcError as e:
+                    last = e
+                    continue
+                return self._commit(c, idx)
+            backoff.sleep()
+        raise RpcError(f"cannot connect to any of {self._endpoints}: {last}")
+
+    def _rotate(self, dead: RpcClient) -> None:
+        """Drop a dead/rejected connection and advance to the next
+        endpoint so the following _get() dials somewhere else first."""
+        with self._lock:
+            if self._client is dead:
+                self._client = None
+                if len(self._endpoints) > 1:
+                    self._active = (self._active + 1) % len(self._endpoints)
+                    self.addr = self._endpoints[self._active]
+        try:
+            dead.close()
+        except Exception:  # noqa: BLE001
+            pass
 
     def connect(self, retries: Optional[int] = None,
                 delay: float = 0.1) -> "ReconnectingRpcClient":
@@ -535,21 +746,60 @@ class ReconnectingRpcClient:
                         f"chaos: GCS stalled — {method!r} to {self.addr} "
                         "lost in the outage window"
                     )
+        multi = len(self._endpoints) > 1
         backoff = None
-        for attempt in range(self._redial_attempts + 1):
+        redials = 0
+        hops = 0
+        while True:
             c = self._get()
+            if _chaos.BLOCKED_PEERS and tuple(c.addr) in _chaos.BLOCKED_PEERS:
+                # the endpoint got partitioned AFTER we connected: the
+                # cached connection is unusable, rotate off it
+                self._rotate(c)
+                if redials >= self._redial_attempts:
+                    raise RpcError(f"chaos: peer {c.addr} partitioned")
+                redials += 1
+                if backoff is None:
+                    backoff = ExponentialBackoff(base=0.05, cap=1.0)
+                backoff.sleep()
+                continue
             try:
-                return c.call(method, payload, timeout)
+                return c.call(method, payload, timeout,
+                              hterm=self.term.current)
+            except (NotPrimaryError, StaleTermError):
+                # wrong peer for this plane: an unpromoted standby, or a
+                # deposed zombie whose ack we must discard. With an
+                # endpoint list, hop to the next endpoint — bounded hops
+                # with backoff ride out the promotion window.
+                if not multi or hops >= self._failover_attempts:
+                    raise
+                hops += 1
+                self._rotate(c)
+                if backoff is None:
+                    backoff = ExponentialBackoff(base=0.05, cap=1.0)
+                backoff.sleep()
             except RpcError:
                 if c.connected:
                     # plain timeout on a live connection: the request may
                     # still execute — resending would make mutations
-                    # at-least-once
+                    # at-least-once, so surface the error. But the
+                    # connection itself is now suspect (a wedged or
+                    # half-dead primary times out forever without EOF):
+                    # with an endpoint list, drop it so the CALLER's
+                    # retry dials the next endpoint instead of timing
+                    # out against the same socket indefinitely.
+                    if multi:
+                        self._rotate(c)
                     raise
                 # dead peer (e.g. restarted GCS): bounded fresh-dial
-                # retries with jittered backoff (capped), not one shot
-                if attempt >= self._redial_attempts:
+                # retries with jittered backoff (capped), not one shot.
+                # With an endpoint list the retry dials the NEXT endpoint
+                # first — this is the connect/timeout failover path.
+                if redials >= self._redial_attempts:
                     raise
+                redials += 1
+                if multi:
+                    self._rotate(c)
                 if backoff is None:
                     backoff = ExponentialBackoff(base=0.05, cap=1.0)
                 backoff.sleep()

@@ -27,7 +27,7 @@ from typing import Any, Optional
 
 import cloudpickle
 
-from ray_tpu.cluster.rpc import RpcClient, RpcServer
+from ray_tpu.cluster.rpc import RpcClient, RpcServer, parse_gcs_addr
 from ray_tpu.utils.logging import get_logger
 
 logger = get_logger("ray_tpu.cluster.worker")
@@ -404,8 +404,7 @@ def main() -> None:
     host, port = args.daemon.rsplit(":", 1)
     gcs = None
     if args.gcs:
-        gh, gp = args.gcs.rsplit(":", 1)
-        gcs = (gh, int(gp))
+        gcs = parse_gcs_addr(args.gcs)  # "h:p" or HA pair "h1:p1,h2:p2"
     rt = WorkerRuntime((host, int(port)), args.worker_id, gcs_addr=gcs)
     rt.start()
     try:

@@ -114,14 +114,17 @@ class ClusterBackend:
         return self
 
     def __init__(self, address: str, namespace: str = "default"):
-        host, port = address.rsplit(":", 1)
-        gcs_addr = (host, int(port))
+        # "h:p" (single GCS) or "h1:p1,h2:p2" (HA pair: primary first,
+        # standby second — calls fail over on primary death)
+        from ray_tpu.cluster.rpc import ReconnectingRpcClient, parse_gcs_addr
+
+        gcs_addr = parse_gcs_addr(address)
         # the driver leases from / fetches through a colocated daemon; on
         # a LocalCluster every daemon is local, so attach to the first
         # alive node (reference: ray.init picks up the local raylet)
-        from ray_tpu.cluster.rpc import RpcClient
-
-        gcs = RpcClient(*gcs_addr, timeout=60.0).connect(retries=20)
+        gcs = ReconnectingRpcClient(
+            *gcs_addr, timeout=60.0
+        ).connect(retries=20)
         nodes = [n for n in gcs.call("list_nodes", None) if n["alive"]]
         gcs.close()
         if not nodes:

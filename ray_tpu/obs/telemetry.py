@@ -1439,21 +1439,36 @@ def format_status(report: dict) -> str:
     else:
         lines.append("  (no serve pools reporting)")
     ft = report.get("gcs_ft") or {}
-    if ft.get("gcs_restarts_total"):
+    ha = report.get("gcs_ha") or {}
+    if ft.get("gcs_restarts_total") or ha:
         # the blackout must SHOW here: a restarted control plane renders
-        # as a counted restart + reconcile deltas, not phantom-zero rows
+        # as a counted restart + reconcile deltas, not phantom-zero rows;
+        # an HA pair renders its role/term/replication posture the same
+        # way (a promoted standby is a counted failover, not a mystery)
         lines.append("== control plane ==")
-        lines.append(
-            f"  gcs restarts {ft['gcs_restarts_total']}"
-            f"  reconcile: {ft.get('reconcile_nodes_reregistered', 0)} nodes"
-            f", actors +{ft.get('reconcile_actors_confirmed', 0)} confirmed"
-            f" +{ft.get('reconcile_actors_resurrected', 0)} resurrected"
-            f" -{ft.get('reconcile_actors_lost', 0)} lost"
-            f", bundles {ft.get('reconcile_bundles_adopted', 0)} adopted"
-            f"/{ft.get('reconcile_bundles_orphaned', 0)} released"
-            + (f"  [{ft['actors_pending_confirm']} awaiting confirm]"
-               if ft.get("actors_pending_confirm") else "")
-        )
+        if ha:
+            lag = ha.get("replication_lag_s")
+            lines.append(
+                f"  role {ha.get('role', '?')}  term {ha.get('term', 0)}"
+                f"  replication lag "
+                f"{f'{lag:.3f}s' if lag is not None else '-'}"
+                f"  failovers {ha.get('failovers_total', 0)}"
+                + (f"  fenced writes {ha['fenced_writes_total']}"
+                   if ha.get("fenced_writes_total") else "")
+                + ("  [FENCED]" if ha.get("fenced") else "")
+            )
+        if ft.get("gcs_restarts_total"):
+            lines.append(
+                f"  gcs restarts {ft['gcs_restarts_total']}"
+                f"  reconcile: {ft.get('reconcile_nodes_reregistered', 0)} nodes"
+                f", actors +{ft.get('reconcile_actors_confirmed', 0)} confirmed"
+                f" +{ft.get('reconcile_actors_resurrected', 0)} resurrected"
+                f" -{ft.get('reconcile_actors_lost', 0)} lost"
+                f", bundles {ft.get('reconcile_bundles_adopted', 0)} adopted"
+                f"/{ft.get('reconcile_bundles_orphaned', 0)} released"
+                + (f"  [{ft['actors_pending_confirm']} awaiting confirm]"
+                   if ft.get("actors_pending_confirm") else "")
+            )
     trainer = report.get("trainer") or {}
     if any(v is not None for v in trainer.values()):
         ge = trainer.get("gang_epoch")
