@@ -4,8 +4,20 @@ Continuous-batching decode throughput (tokens/s) for the paged-KV
 engine at a fixed concurrency — the serving-side counterpart of
 bench.py's training MFU. Prints one JSON line. --profile additionally
 runs the engine's roofline-attributed decode profile
-(ray_tpu.profiler) and writes it to benchmarks/PROFILE_decode_r16.json
+(ray_tpu.profiler) and writes it to benchmarks/PROFILE_decode_r24.json
 — the serving analog of PROFILE_taskplane_r05.md the roadmap lacked.
+(r24 adds the ragged_attention / mixed_step probe rungs to the ladder.)
+
+--mixed runs the SPLIT-vs-MIXED dispatch A/B: the same decode-heavy
+workload with long prefills arriving mid-flight is served by a split
+engine (separate prefill and decode programs — every admission stalls
+the decode batch behind a bucket-padded prefill) and a mixed engine
+(EngineConfig(mixed_batch=True): ONE ragged dispatch per step serves
+prompt chunks AND every decode row, ops/ragged.py). Reports tok/s,
+decode TPOT p99, padding-waste ratio, and greedy token identity
+(bitwise — the split path is the identity oracle); writes
+benchmarks/MIXED_serving_r24.json (tier-1 gates mixed tok/s >= split
+and token_identical on the checked-in capture).
 
 --pipeline runs the sync-vs-pipelined decode A/B instead
 (ray_tpu.llm.pipeline: device-resident batch state, on-device stop
@@ -54,7 +66,10 @@ import os as _os
 import time
 
 _PROFILE_OUT = _os.path.join(
-    _os.path.dirname(_os.path.abspath(__file__)), "PROFILE_decode_r16.json"
+    _os.path.dirname(_os.path.abspath(__file__)), "PROFILE_decode_r24.json"
+)
+_MIXED_OUT = _os.path.join(
+    _os.path.dirname(_os.path.abspath(__file__)), "MIXED_serving_r24.json"
 )
 _PIPELINE_OUT = _os.path.join(
     _os.path.dirname(_os.path.abspath(__file__)), "PIPELINE_decode_r16.json"
@@ -617,6 +632,197 @@ def run_pipeline_bench(args) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# --mixed: split vs mixed ragged dispatch (ray_tpu.llm.mixed)
+# ---------------------------------------------------------------------------
+
+
+def run_mixed_bench(args) -> dict:
+    """Split vs MIXED dispatch A/B under the interference load the
+    mixed path exists for: a decode-heavy running batch with long
+    prefills arriving mid-flight. The split engine serves each arrival
+    as its own bucket-padded prefill program (the decode batch stalls
+    behind it); the mixed engine packs the prompt chunks and every
+    decode row into ONE ragged dispatch per step (ops/ragged.py), so
+    decode advances every step. Greedy token identity vs the split
+    baseline is the correctness contract; tok/s >= split and
+    token_identical are tier-1 gated on the checked-in capture."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ray_tpu.llm.engine import EngineConfig, LLMEngine
+    from ray_tpu.llm.sampling import SamplingParams
+    from ray_tpu.models import llama
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if on_tpu:
+        cfg = llama.LLAMA_400M
+        n_decode, n_prefill = 12, 8
+        short_len, long_len, max_new = 16, 384, 96
+        num_blocks = 1024
+    else:
+        cfg = dataclasses.replace(llama.LLAMA_TINY, dtype=jnp.float32)
+        n_decode, n_prefill = 10, 10
+        short_len, long_len, max_new = 16, 48, 48
+        num_blocks = 512
+    # per-step prefill budget = the full prompt: each arrival is served
+    # by ONE ragged dispatch (T comparable to split's bucket-padded
+    # prefill program) with every decode row riding in it for free.
+    # Chunking below the prompt length trades per-arrival latency for
+    # decode TPOT — tests cover it; the A/B measures the 1:1 swap.
+    chunk = long_len
+    params = llama.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    shorts = [
+        [int(x) for x in rng.integers(3, cfg.vocab_size - 1, short_len)]
+        for _ in range(n_decode)
+    ]
+    longs = [
+        [int(x) for x in rng.integers(3, cfg.vocab_size - 1, long_len)]
+        for _ in range(n_prefill)
+    ]
+    sp = SamplingParams(max_tokens=max_new, temperature=0.0, ignore_eos=True)
+    sp_long = SamplingParams(max_tokens=max_new // 4, temperature=0.0,
+                             ignore_eos=True)
+
+    def build(mixed: bool) -> LLMEngine:
+        return LLMEngine(
+            EngineConfig(
+                model=cfg, num_blocks=num_blocks, block_size=8,
+                max_num_seqs=n_decode + n_prefill, max_prefill_len=long_len,
+                # one-token-per-round decode on BOTH sides: the A/B
+                # isolates the dispatch STRUCTURE (split programs vs one
+                # ragged program). Multi-token pipelined chunks are an
+                # orthogonal axis (PIPELINE_decode_r16 measures it) and
+                # compose with mixed only in decode-only phases.
+                decode_chunk=1, pipeline_decode=False, mixed_batch=mixed,
+                mixed_prefill_chunk=chunk,
+                # the warmup drive replays the same prompts; with prefix
+                # caching on, the timed drive's prefills would be cache
+                # hits and the A/B would measure nothing.
+                enable_prefix_caching=False,
+            ),
+            params=params, seed=0,
+        )
+
+    _drive_seq = [0]
+
+    def drive(engine) -> dict:
+        """Decode-heavy load with long prefills arriving MID-flight:
+        the short requests enter first; each long prompt arrives after
+        a fixed number of engine steps (deterministic — identity must
+        not depend on wall-clock). Client-side TPOT stamps cover the
+        decode rows the arrivals interfere with."""
+        import time as _t
+
+        _drive_seq[0] += 1
+        tag = f"mx{id(engine)}-{_drive_seq[0]}"
+        recs = {}
+        t0 = _t.perf_counter()
+        for i, p in enumerate(shorts):
+            rid = engine.add_request(p, sp, request_id=f"{tag}-d{i}")
+            recs[rid] = {"order": i}
+        arrivals = {2 + 2 * j: (j, p) for j, p in enumerate(longs)}
+        steps = 0
+        generated = 0
+        while engine.has_unfinished() or arrivals:
+            got = arrivals.pop(steps, None)
+            if got is not None:
+                j, p = got
+                rid = engine.add_request(
+                    p, sp_long, request_id=f"{tag}-p{j}"
+                )
+                recs[rid] = {"order": n_decode + j}
+            for o in engine.step():
+                now = _t.perf_counter()
+                rec = recs[o.request_id]
+                if o.new_token_ids and "first" not in rec:
+                    rec["first"] = now
+                if o.finished:
+                    rec["last"] = now
+                    rec["n"] = len(o.output_token_ids)
+                    rec["tokens"] = list(o.output_token_ids)
+                generated += len(o.new_token_ids)
+            steps += 1
+        dt = _t.perf_counter() - t0
+        tpots = [
+            (r["last"] - r["first"]) / (r["n"] - 1)
+            for r in recs.values() if "last" in r and r.get("n", 0) > 1
+        ]
+        outs = [r["tokens"] for r in
+                sorted(recs.values(), key=lambda r: r["order"])
+                if "tokens" in r]
+        return {
+            "tok_s": round(generated / dt, 1),
+            "generated_tokens": generated,
+            "wall_s": round(dt, 3),
+            "tpot_p99_s": round(_pct(tpots, 0.99), 5),
+            "engine_steps": steps,
+            "outputs": outs,
+        }
+
+    # the CPU smoke's per-arrival margin is a few ms on a shared
+    # machine, so a single timed pass is hostage to load drift.
+    # INTERLEAVE the A/B (drift hits both sides of a trial equally)
+    # and gate on the median per-trial ratio; token identity must hold
+    # on every trial, not just one.
+    split_eng, mixed_eng = build(False), build(True)
+    drive(split_eng)             # warmup: compile every shape
+    drive(mixed_eng)
+    n_trials = 7
+    split_runs, mixed_runs, ratios = [], [], []
+    identical = True
+    for _ in range(n_trials):
+        s_run = drive(split_eng)
+        m_run = drive(mixed_eng)
+        identical = identical and (s_run["outputs"] == m_run["outputs"])
+        split_runs.append(s_run)
+        mixed_runs.append(m_run)
+        ratios.append(m_run["tok_s"] / s_run["tok_s"]
+                      if s_run["tok_s"] else 0.0)
+    order = sorted(range(n_trials), key=lambda i: ratios[i])
+    mid = order[n_trials // 2]
+    split, mixed = split_runs[mid], mixed_runs[mid]
+    for r in split_runs + mixed_runs:
+        r.pop("outputs")
+    mixed_row = mixed_eng.stats().get("mixed", {})
+
+    result = {
+        "metric": "llm_mixed_dispatch_speedup" if on_tpu
+        else "llm_mixed_dispatch_speedup_smoke",
+        "value": round(sorted(ratios)[n_trials // 2], 3),
+        "unit": "mixed tok/s over split tok/s, median of "
+        f"{n_trials} interleaved trials (>= 1 gated in tier-1)",
+        "trial_ratios": [round(r, 3) for r in ratios],
+        "split": split,
+        "mixed": mixed,
+        "token_identical": identical,
+        "mixed_stats": mixed_row,
+        "padding_waste_ratio": mixed_row.get("padding_waste_ratio"),
+        "n_decode": n_decode,
+        "n_prefill": n_prefill,
+        "long_len": long_len,
+        "mixed_prefill_chunk": chunk,
+        "model_params": cfg.num_params(),
+        "device": getattr(jax.devices()[0], "device_kind", "cpu"),
+    }
+    if not identical:
+        result["warning"] = "mixed output diverged from split baseline"
+    if not on_tpu:
+        result["note"] = (
+            "CPU smoke: the mixed win here is fewer total dispatches "
+            "(decode rows ride the prefill chunks' program) + no "
+            "bucket-padded standalone prefill; the TPU capture is where "
+            "the dispatch-gap elimination dominates"
+        )
+    _write_capture(args.mixed_out, result)
+    result["mixed_out"] = args.mixed_out
+    return result
+
+
+# ---------------------------------------------------------------------------
 # --chaos: availability SLO under seeded engine preemption
 # ---------------------------------------------------------------------------
 
@@ -1161,6 +1367,10 @@ def main():
                     help="run the sync-vs-pipelined decode A/B "
                     "(ray_tpu.llm.pipeline) instead")
     ap.add_argument("--pipeline-out", default=_PIPELINE_OUT)
+    ap.add_argument("--mixed", action="store_true",
+                    help="split-vs-mixed ragged dispatch A/B "
+                         "(EngineConfig.mixed_batch, ray_tpu.llm.mixed)")
+    ap.add_argument("--mixed-out", default=_MIXED_OUT)
     ap.add_argument("--chaos", action="store_true",
                     help="run the availability-SLO benchmark under seeded "
                     "engine preemption instead")
@@ -1199,6 +1409,9 @@ def main():
         return
     if args.disagg:
         print(json.dumps(run_disagg_bench(args)))
+        return
+    if args.mixed:
+        print(json.dumps(run_mixed_bench(args)))
         return
     if args.chaos:
         print(json.dumps(run_chaos_bench(args)))

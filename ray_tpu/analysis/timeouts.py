@@ -96,6 +96,12 @@ SCAN_DIRS = (
     # runs and its stop() joins the thread; both must carry bounds (an
     # observability plane must never be the thing that hangs shutdown)
     "ray_tpu/obs/perfwatch",
+    # r24: the kernel tier (pure jax/pallas — no parks today, but ops
+    # code grows host callbacks and test harnesses; scanning from day
+    # one keeps the floor in place) and the mixed-batch planner, which
+    # sits directly on the engine's step path
+    "ray_tpu/ops",
+    "ray_tpu/llm/mixed.py",
 )
 
 
@@ -197,15 +203,23 @@ def collect_violations(repo_root_: str | None = None) -> list[str]:
     used: set = set()
     for scan in SCAN_DIRS:
         base = os.path.join(root, scan)
-        for dirpath, _dirs, files in os.walk(base):
-            for f in sorted(files):
-                if not f.endswith(".py"):
-                    continue
-                path = os.path.join(dirpath, f)
-                rel = os.path.relpath(path, root).replace(os.sep, "/")
-                rel = rel.removeprefix("ray_tpu/")
-                with open(path, encoding="utf-8") as fh:
-                    out.extend(lint_source(fh.read(), rel, used))
+        if os.path.isfile(base):
+            # single-file entries (e.g. ray_tpu/llm/mixed.py) — os.walk
+            # on a file path yields nothing and would silently scan zero
+            # lines
+            paths = [base]
+        else:
+            paths = [
+                os.path.join(dirpath, f)
+                for dirpath, _dirs, files in os.walk(base)
+                for f in sorted(files)
+                if f.endswith(".py")
+            ]
+        for path in paths:
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            rel = rel.removeprefix("ray_tpu/")
+            with open(path, encoding="utf-8") as fh:
+                out.extend(lint_source(fh.read(), rel, used))
     # the shared allowlist self-audit: unjustified entries + stale
     # entries (an audited exception that no longer matches any code is a
     # lie waiting to mask the next unbounded call under the same key)

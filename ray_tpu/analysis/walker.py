@@ -44,6 +44,11 @@ DEFAULT_PACKAGES = (
     # QoS admission tables, and the canary weight plane share state
     # between the ingress and every replica's engine loop
     "ray_tpu/fleet",
+    # r24: the kernel tier (ragged/paged/flash attention) — pure jax
+    # today, but it feeds the engine's hot path; scanned so any future
+    # host-side state (capture caches, interpreter shims) inherits the
+    # discipline from day one
+    "ray_tpu/ops",
 )
 
 

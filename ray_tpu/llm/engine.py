@@ -205,6 +205,16 @@ class EngineConfig:
     # them with a verified scatter (zero recompute). True / a dict / a
     # KVTierConfig enables it; None keeps the HBM-only cache.
     kvtier: Any = None
+    # mixed ragged batching (llm/mixed.py over ops/ragged.py): pack
+    # in-flight prefill chunks AND the running decode batch into ONE
+    # ragged dispatch per step instead of separate prefill/decode
+    # programs — prompts stream mixed_prefill_chunk tokens/step so a
+    # long prefill never stalls decode rows. Token streams stay bitwise
+    # identical to the split path (retained as the identity oracle);
+    # spec verify also routes through the packed ragged program,
+    # deleting the rectangular verify's per-row pad-column waste.
+    mixed_batch: bool = False
+    mixed_prefill_chunk: int = 256
 
     def __post_init__(self):
         if isinstance(self.model, str):
@@ -231,6 +241,11 @@ class EngineConfig:
         from ray_tpu.llm.pipeline import CHUNK_BUCKETS
 
         self.decode_chunk = min(self.decode_chunk, CHUNK_BUCKETS[-1])
+        # the ragged kernel's static max_q_len compiles per value: one
+        # clamped budget keeps the mixed program count at exactly one
+        self.mixed_prefill_chunk = max(
+            1, min(self.mixed_prefill_chunk, self.max_prefill_len)
+        )
         if self.spec is not None:
             from ray_tpu.llm.spec import SpecConfig
 
@@ -484,6 +499,31 @@ class LLMEngine:
             self.drafter = c.spec.build_drafter(c.model)
             self.spec_stats = SpecStats()
 
+        # mixed ragged batching (llm/mixed.py): prefill cursors
+        # (request_id -> next un-prefilled absolute token index — a
+        # request in here is RUNNING but mid-prompt), the ONE jitted
+        # ragged dispatch, the lazily-built ragged spec verifier, and
+        # padding-waste stats. The cursor dict exists unconditionally so
+        # the preempt/abort/recover hooks never need a mode check.
+        self._mixed_prefills: dict[str, int] = {}
+        self._mixed_fn = None
+        self._mixed_stats = None
+        self._verify_ragged = None
+        if c.mixed_batch:
+            from ray_tpu.llm.mixed import MixedStats
+            from ray_tpu.models.llama_decode import mixed_step
+
+            maxq = c.mixed_prefill_chunk
+            self._mixed_fn = jax.jit(
+                lambda params, t, p, sl, bt, cu, cl, cache, lora: mixed_step(
+                    params, t, p, sl, bt, cu, cl, cache, c.model,
+                    block_size=c.block_size, max_q_len=maxq,
+                    attn_impl=c.attn_impl, lora=lora,
+                ),
+                donate_argnums=(7,),
+            )
+            self._mixed_stats = MixedStats()
+
     def _init_kv_cache(self):
         """Fresh paged KV cache with the engine's sharding (also the
         crash-recovery rebuild path: recover(rebuild_kv=True))."""
@@ -583,6 +623,27 @@ class LLMEngine:
             )
             self._verify_fns[width] = fn
         return fn
+
+    def _verify_ragged_fn(self):
+        """Jitted PACKED spec verifier (llama_decode.verify_tokens_ragged):
+        rows carry exactly 1 + draft_len tokens instead of a [B, K+1]
+        rectangle — jax.jit re-specializes per packed-token bucket, so
+        one entry covers every (T_pad, B_pad) shape."""
+        if self._verify_ragged is None:
+            c = self.config
+            from ray_tpu.models.llama_decode import verify_tokens_ragged
+
+            maxq = c.spec.num_draft_tokens + 1
+            self._verify_ragged = jax.jit(
+                lambda params, t, p, sl, bt, cu, cl, gi, cache, lora:
+                verify_tokens_ragged(
+                    params, t, p, sl, bt, cu, cl, gi, cache, c.model,
+                    block_size=c.block_size, max_q_len=maxq,
+                    attn_impl=c.attn_impl, lora=lora,
+                ),
+                donate_argnums=(8,),
+            )
+        return self._verify_ragged
 
     @staticmethod
     def _sample_mode(batch) -> str:
@@ -797,6 +858,7 @@ class LLMEngine:
             self.running.remove(req)
         if req in self.waiting:
             self.waiting.remove(req)
+        self._mixed_prefills.pop(request_id, None)
         if req.seq is not None:
             req.seq.release()
         req.status = RequestStatus.ABORTED
@@ -900,6 +962,12 @@ class LLMEngine:
                     # the victim re-queued at the head: restore QoS order
                     # so the admission check below sees the paying tenant
                     self._promote_priority()
+        if self.config.mixed_batch:
+            # unified dispatch: admission + in-flight prefill chunks +
+            # every decode row in ONE ragged program (llm/mixed.py);
+            # steps with no prefill work fall through to the regular
+            # decode ladder inside _mixed_step
+            return self._mixed_step()
         if (
             self.waiting
             and len(self.running) < self.config.max_num_seqs
@@ -967,6 +1035,9 @@ class LLMEngine:
         # un-synced (its tokens were never booked, so the re-admission
         # recompute covers exactly the delivered prefix)
         self._pipe_drop()
+        # mid-prefill mixed cursors die with the batch: re-admission
+        # recomputes each prompt from scratch (or its cached prefix)
+        self._mixed_prefills.clear()
         now = time.time()
         victims = sorted(self.running, key=lambda r: r.arrival, reverse=True)
         self.running.clear()
@@ -1104,6 +1175,13 @@ class LLMEngine:
             raise ValueError(
                 f"request {request_id!r} is not RUNNING on this engine "
                 "(only admitted, in-flight requests can be exported)"
+            )
+        if request_id in self._mixed_prefills:
+            # mid-prompt mixed row: KV exists only up to the cursor, not
+            # the num_tokens-1 positions the handoff invariant promises
+            raise ValueError(
+                f"request {request_id!r} is mid-prefill in a mixed batch; "
+                "export after its prompt chunks complete"
             )
         c = self.config
         n_kv = req.num_tokens - 1  # positions with KV written
@@ -1371,6 +1449,10 @@ class LLMEngine:
             # the `pipeline` row of /v1/stats: chunk-size distribution,
             # host/device split, overlap ratio, early-exit savings
             out["pipeline"] = self._pipe_stats.to_dict()
+        if self._mixed_stats is not None and self._mixed_stats.dispatches:
+            # the mixed ragged dispatch's padding-waste accounting (the
+            # --mixed bench's padding_waste_ratio reads this row)
+            out["mixed"] = self._mixed_stats.to_dict()
         return out
 
     def profile_decode(
@@ -1567,10 +1649,14 @@ class LLMEngine:
                 return b
         return buckets[-1]
 
-    def _prefill_one(self):
-        """Prefill the head of the waiting queue: DISPATCH only, no host
-        sync. Returns (req, last-token logits [1, V] device array), or
-        None when the cache has no room (caller falls through to decode)."""
+    def _admit_one(self):
+        """Admit the head of the waiting queue: prefix match (+ tiered
+        resurrection), capacity reservation for the FULL recompute
+        prompt, queue/hit bookkeeping — everything up to (but not
+        including) dispatch, shared by the split prefill path
+        (_prefill_one) and mixed admission (_mixed_admit). Returns
+        (req, seq, prompt, matched) past the commit point, or None when
+        the cache has no room (caller falls through to decode)."""
         c = self.config
         req = self.waiting[0]
         seq = SequenceBlocks(self.allocator)
@@ -1665,6 +1751,17 @@ class LLMEngine:
         if req.t_first_prefill is None:
             req.t_first_prefill = t_admit
         req._prefill_cached = matched
+        return req, seq, prompt, matched
+
+    def _prefill_one(self):
+        """Prefill the head of the waiting queue: DISPATCH only, no host
+        sync. Returns (req, last-token logits [1, V] device array), or
+        None when the cache has no room (caller falls through to decode)."""
+        got = self._admit_one()
+        if got is None:
+            return None
+        req, seq, prompt, matched = got
+        c = self.config
 
         num_slots = c.num_blocks * c.block_size
         bt = np.zeros((1, self._bt_width([len(seq.blocks)])), np.int32)
@@ -1706,6 +1803,146 @@ class LLMEngine:
             # reservation and book the lead time
             self.kvfetch.consumed(req.request_id)
         return req, logits
+
+    # -- mixed ragged batching (ray_tpu.llm.mixed) ---------------------------
+    # One ragged program per step serves in-flight prefill chunks AND
+    # every decode row (llm/mixed.MixedBatchPlan over
+    # llama_decode.mixed_step over ops/ragged). Prompts stream
+    # mixed_prefill_chunk tokens per step, so decode rows advance every
+    # step regardless of prompt length. The split path stays the
+    # identity oracle: token streams must match it bitwise.
+
+    def _mixed_admit(self):
+        """Admit the queue head WITHOUT dispatching its prompt: the
+        mixed dispatch feeds it chunk-by-chunk from the cursor this
+        records. Returns the request or None (no cache room)."""
+        got = self._admit_one()
+        if got is None:
+            return None
+        req, seq, prompt, matched = got
+        # seq.num_tokens tracks positions with K/V WRITTEN — exactly the
+        # matched prefix until chunks land (the cursor advances it)
+        seq.num_tokens = matched
+        req.seq = seq
+        req.status = RequestStatus.RUNNING
+        self.running.append(req)
+        self._mixed_prefills[req.request_id] = matched
+        if self.kvfetch is not None:
+            self.kvfetch.consumed(req.request_id)
+        return req
+
+    def _mixed_step(self) -> list[RequestOutput]:
+        """One mixed-batch iteration (EngineConfig.mixed_batch): admit
+        waiting requests, then serve every in-flight prefill chunk plus
+        every decode row in ONE ragged dispatch. Steps with no prefill
+        work route to the regular decode ladder — the degenerate
+        all-q_len=1 case costs exactly the split path's decode step
+        (including spec rounds and the pipelined chunk overlap)."""
+        c = self.config
+        if (
+            self.waiting
+            and len(self.running) < c.max_num_seqs
+            # same read-only precheck as the split path: see step()
+            and self._admission_need(self.waiting[0])
+            <= self.allocator.num_free
+        ):
+            # admission is a membership change for the pipelined decode
+            # carry: land the in-flight chunk first
+            flushed = self._pipe_flush()
+            if flushed:
+                return flushed
+            while self.waiting and len(self.running) < c.max_num_seqs:
+                if self._mixed_admit() is None:
+                    break  # no cache room: decode to free blocks
+        if not self._mixed_prefills:
+            return self._decode_step() if self.running else []
+        # prefill chunks in flight: the unified dispatch replaces the
+        # decode ladder this step, so the pipelined carry (dispatched
+        # for the old all-decode batch) must land first
+        flushed = self._pipe_flush()
+        if flushed:
+            return flushed
+        wall0 = time.time()
+        # KV for this step's writes: mid-prompt rows reserved their full
+        # recompute prompt at admission; decode rows grow one position
+        while True:
+            try:
+                for r in self.running:
+                    if r.request_id not in self._mixed_prefills:
+                        r.seq.ensure_capacity(r.num_tokens + 1)
+                break
+            except NoFreeBlocksError:
+                if not self._preempt_one():
+                    raise  # single running request can't fit: cache too small
+        from ray_tpu.llm.mixed import MixedBatchPlan
+
+        plan = MixedBatchPlan.build(self)
+        logits, self.cache = self._mixed_fn(
+            self.params,
+            jnp.asarray(plan.tokens),
+            jnp.asarray(plan.positions),
+            jnp.asarray(plan.slots),
+            jnp.asarray(plan.bt),
+            jnp.asarray(plan.cu_q_lens),
+            jnp.asarray(plan.context_lens),
+            self.cache,
+            self._lora_arg(plan.lora_ids),
+        )
+        plan.note(self._mixed_stats)
+
+        # advance prefill cursors; a finishing prompt seals its full
+        # blocks (the _prefill_one contract) and becomes a decode row
+        done_set = set(plan.completes)
+        prompt_done: list = []
+        for row in range(plan.B):
+            if plan.kinds[row] != "prefill":
+                continue
+            r = plan.reqs[row]
+            end = plan.starts[row] + plan.chunk_lens[row]
+            r.seq.num_tokens = end
+            if row in done_set:
+                if c.enable_prefix_caching:
+                    r.seq.seal_full_blocks(
+                        r.prompt_token_ids + r.output_token_ids
+                    )
+                del self._mixed_prefills[r.request_id]
+                prompt_done.append(r)
+            else:
+                self._mixed_prefills[r.request_id] = end
+
+        outputs: list[RequestOutput] = []
+        if plan.emit_rows:
+            emit_reqs = [plan.reqs[i] for i in plan.emit_rows]
+            tok, logprob = self._sample_batch(
+                logits[np.asarray(plan.emit_rows)], emit_reqs
+            )
+            t1 = time.time()  # host sync done
+            outputs = self._append_tokens(emit_reqs, tok, logprob)
+            for r in prompt_done:
+                self._obs_span(
+                    r, "engine.prefill",
+                    r.t_prefill_start if r.t_prefill_start is not None else t1,
+                    t1,
+                    {"prompt_tokens": len(r.prompt_token_ids),
+                     "cached_tokens": r._prefill_cached,
+                     "recompute": r.num_preemptions > 0,
+                     "mixed": True},
+                )
+                if r.t_first_token is None:
+                    r.t_first_token = t1
+                r.t_span_cursor = t1
+            if prompt_done:
+                self._obs_finalize(prompt_done, t1)
+            dec = [
+                j for j, i in enumerate(plan.emit_rows)
+                if plan.kinds[i] == "decode"
+            ]
+            if dec:
+                self._obs_decode_round(
+                    [emit_reqs[j] for j in dec], [outputs[j] for j in dec],
+                    wall0, "engine.mixed_round", 1,
+                )
+        return outputs
 
     def _resurrect_tiers(self, prompt: list, matched: int, chain: int,
                          salt: int) -> tuple:
@@ -1825,6 +2062,9 @@ class LLMEngine:
         except Exception:  # noqa: BLE001 — accounting, not correctness
             pass
         self.running.remove(victim)
+        # a mid-prefill mixed row re-queues like any victim: drop the
+        # cursor; re-admission recomputes prompt+outputs from scratch
+        self._mixed_prefills.pop(victim.request_id, None)
         victim.seq.release()
         victim.seq = None
         # outputs are kept; re-admission prefills prompt+outputs (recompute)
@@ -2133,45 +2373,97 @@ class LLMEngine:
         K1 = k + 1
         num_slots = c.num_blocks * c.block_size
 
-        tokens = np.zeros((B_pad, K1), np.int32)
-        positions = np.zeros((B_pad, K1), np.int32)
-        slots = np.full((B_pad, K1), num_slots, np.int32)  # trash by default
         context_lens = np.zeros(B_pad, np.int32)
         draft_tokens = np.zeros((B_pad, k), np.int32)
         draft_lens = np.zeros(B_pad, np.int32)
-        lora_ids = np.zeros(B_pad, np.int32)
         bt = np.zeros(
             (B_pad, self._bt_width([len(r.seq.blocks) for r in batch])),
             np.int32,
         )
         for i, r in enumerate(batch):
             d = drafts[i]
-            last_tok = (
-                r.output_token_ids[-1] if r.output_token_ids
-                else r.prompt_token_ids[-1]
-            )
-            pos0 = r.num_tokens - 1  # position of the token being fed
-            row = [last_tok] + d
-            tokens[i, : len(row)] = row
-            positions[i, : len(row)] = np.arange(pos0, pos0 + len(row))
-            for j in range(len(row)):
-                slots[i, j] = r.seq.slot(pos0 + j)
             context_lens[i] = r.num_tokens + len(d)
             draft_tokens[i, : len(d)] = d
             draft_lens[i] = len(d)
-            lora_ids[i] = r.lora_slot
             bt[i, : len(r.seq.blocks)] = r.seq.blocks
 
-        logits, self.cache = self._verify_fn(K1)(
-            self.params,
-            jnp.asarray(tokens),
-            jnp.asarray(positions),
-            jnp.asarray(slots),
-            jnp.asarray(bt),
-            jnp.asarray(context_lens),
-            self.cache,
-            self._lora_arg(lora_ids),
-        )
+        if c.mixed_batch:
+            # ragged verify (ops/ragged via verify_tokens_ragged): pack
+            # only the REAL 1 + draft_len tokens per row instead of
+            # padding every row to a k+1 trash-slot rectangle — the
+            # per-row bucket waste ROADMAP item 1 named. gather_idx
+            # recovers the [B, K+1] logits layout accept_draft expects;
+            # positions past a row's draft clamp to its last token and
+            # are masked by draft_lens, so duplicated logits are never
+            # consumed. Acceptance math downstream is unchanged.
+            from ray_tpu.llm.mixed import token_bucket
+
+            T_pad = token_bucket(sum(1 + len(d) for d in drafts))
+            p_tokens = np.zeros(T_pad, np.int32)
+            p_positions = np.zeros(T_pad, np.int32)
+            p_slots = np.full(T_pad, num_slots, np.int32)
+            p_lora = np.zeros(T_pad, np.int32)  # per-TOKEN adapter slots
+            cu = np.zeros(B_pad + 1, np.int32)
+            gather = np.zeros((B_pad, K1), np.int32)
+            t = 0
+            for i, r in enumerate(batch):
+                row = [
+                    r.output_token_ids[-1] if r.output_token_ids
+                    else r.prompt_token_ids[-1]
+                ] + drafts[i]
+                pos0 = r.num_tokens - 1  # position of the token being fed
+                p_tokens[t : t + len(row)] = row
+                p_positions[t : t + len(row)] = np.arange(
+                    pos0, pos0 + len(row)
+                )
+                for j in range(len(row)):
+                    p_slots[t + j] = r.seq.slot(pos0 + j)
+                p_lora[t : t + len(row)] = r.lora_slot
+                gather[i] = t + np.minimum(np.arange(K1), len(row) - 1)
+                t += len(row)
+                cu[i + 1] = t
+            cu[B + 1 :] = t  # pad sequences: q_len 0
+            logits, self.cache = self._verify_ragged_fn()(
+                self.params,
+                jnp.asarray(p_tokens),
+                jnp.asarray(p_positions),
+                jnp.asarray(p_slots),
+                jnp.asarray(bt),
+                jnp.asarray(cu),
+                jnp.asarray(context_lens),
+                jnp.asarray(gather),
+                self.cache,
+                self._lora_arg(p_lora),
+            )
+        else:
+            tokens = np.zeros((B_pad, K1), np.int32)
+            positions = np.zeros((B_pad, K1), np.int32)
+            slots = np.full((B_pad, K1), num_slots, np.int32)  # trash default
+            lora_ids = np.zeros(B_pad, np.int32)
+            for i, r in enumerate(batch):
+                d = drafts[i]
+                last_tok = (
+                    r.output_token_ids[-1] if r.output_token_ids
+                    else r.prompt_token_ids[-1]
+                )
+                pos0 = r.num_tokens - 1  # position of the token being fed
+                row = [last_tok] + d
+                tokens[i, : len(row)] = row
+                positions[i, : len(row)] = np.arange(pos0, pos0 + len(row))
+                for j in range(len(row)):
+                    slots[i, j] = r.seq.slot(pos0 + j)
+                lora_ids[i] = r.lora_slot
+
+            logits, self.cache = self._verify_fn(K1)(
+                self.params,
+                jnp.asarray(tokens),
+                jnp.asarray(positions),
+                jnp.asarray(slots),
+                jnp.asarray(bt),
+                jnp.asarray(context_lens),
+                self.cache,
+                self._lora_arg(lora_ids),
+            )
 
         from ray_tpu.llm.spec.accept import accept_draft
 

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from ray_tpu.models.llama import LlamaConfig, Params
 from ray_tpu.nn.layers import apply_rope, rms_norm, rope_frequencies, swiglu
 from ray_tpu.ops.paged_attention import paged_attention
+from ray_tpu.ops.ragged import ragged_attention
 
 Cache = dict[str, jax.Array]
 
@@ -267,6 +268,180 @@ def _page_attend_prefill(
     probs = jnp.where(jnp.isnan(probs), 0.0, probs)  # fully-masked pad rows
     out = jnp.einsum("bhgst,hbtd->bshgd", probs, v.astype(jnp.float32))
     return out.reshape(B, S, H, D).astype(q.dtype)
+
+
+def _apply_lora_packed(q, k, v, x, lora_l, ids, c: LlamaConfig):
+    """Per-TOKEN LoRA deltas for packed ragged rows: x [1, T, D],
+    ids [T] (slot 0 = zero adapter). The packed token axis is viewed as
+    the batch axis [T, 1, D] so every packed row selects its own
+    adapter — a mixed batch interleaves rows of different requests."""
+    T = x.shape[1]
+    xt = x[0][:, None]  # [T, 1, D]
+    hd = c.head_dim
+    if "wq_A" in lora_l:
+        q = q + _lora_delta(xt, lora_l["wq_A"], lora_l["wq_B"], ids).reshape(
+            1, T, c.n_heads, hd
+        )
+    if "wk_A" in lora_l:
+        k = k + _lora_delta(xt, lora_l["wk_A"], lora_l["wk_B"], ids).reshape(
+            1, T, c.n_kv_heads, hd
+        )
+    if "wv_A" in lora_l:
+        v = v + _lora_delta(xt, lora_l["wv_A"], lora_l["wv_B"], ids).reshape(
+            1, T, c.n_kv_heads, hd
+        )
+    return q, k, v
+
+
+def ragged_forward(
+    params: Params,
+    tokens: jax.Array,       # [T] packed tokens (pad rows trail)
+    positions: jax.Array,    # [T] absolute positions (pad = 0)
+    slot_mapping: jax.Array, # [T] cache slots (pad -> trash slot)
+    block_tables: jax.Array, # [B, MB]
+    cu_q_lens: jax.Array,    # [B+1] exclusive prefix sums of row lengths
+    context_lens: jax.Array, # [B] prefix + suffix length (pad seq = 0)
+    cache: Cache,
+    config: LlamaConfig,
+    *,
+    block_size: int,
+    max_q_len: int,
+    attn_impl: str = "auto",
+    lora: "dict | None" = None,  # {"ids": [T] per-TOKEN, "<t>_A": ..., "<t>_B": ...}
+) -> tuple[jax.Array, Cache]:
+    """Packed ragged transformer body over the paged cache: the ONE
+    program a mixed batch runs — prefill chunks and decode rows
+    concatenated along a single token axis, each sequence delimited by
+    `cu_q_lens`, attention via `ops/ragged.py`. Scatters the packed
+    K/V into pages, returns final hidden states [T, D] + updated
+    cache. `mixed_step` (last-row logits) and `verify_tokens_ragged`
+    (per-row all-position logits) sit on top."""
+    c = config
+    T = tokens.shape[0]
+    if max_q_len > c.max_seq:
+        raise ValueError(
+            f"max_q_len {max_q_len} > max_seq={c.max_seq}; RoPE tables "
+            "only cover max_seq positions"
+        )
+    cos, sin = rope_frequencies(c.head_dim, c.max_seq, c.rope_theta)
+    h = params["embed"].astype(c.dtype)[tokens][None]  # [1, T, D]
+    pos2 = positions[None]  # [1, T]
+
+    lora_ids = lora["ids"] if lora is not None else None
+    lora_stacks = (
+        {k_: v_ for k_, v_ in lora.items() if k_ != "ids"} if lora is not None else None
+    )
+
+    def layer_step(carry, xs):
+        h, = carry
+        if lora_stacks is not None:
+            lp, k_cache_l, v_cache_l, lora_l = xs
+        else:
+            lp, k_cache_l, v_cache_l = xs
+        x = rms_norm(h, lp["ln1"], c.rms_eps)
+        q, k, v = _qkv(x, lp, c)
+        if lora_stacks is not None:
+            q, k, v = _apply_lora_packed(q, k, v, x, lora_l, lora_ids, c)
+        q = apply_rope(q, cos, sin, pos2)
+        k = apply_rope(k, cos, sin, pos2)
+        # scatter packed K/V into this layer's pages (pad rows -> trash
+        # slot); cache is head-major [KVH, slots, D]
+        k_cache_l = k_cache_l.at[:, slot_mapping].set(
+            k[0].swapaxes(0, 1).astype(k_cache_l.dtype)
+        )
+        v_cache_l = v_cache_l.at[:, slot_mapping].set(
+            v[0].swapaxes(0, 1).astype(v_cache_l.dtype)
+        )
+        o = ragged_attention(
+            q[0],
+            k_cache_l,
+            v_cache_l,
+            block_tables,
+            cu_q_lens,
+            context_lens,
+            block_size=block_size,
+            max_q_len=max_q_len,
+            impl=attn_impl,
+        )[None]  # [1, T, H, D]
+        h = h + _out_proj(o, lp, 1, T, c)
+        x = rms_norm(h, lp["ln2"], c.rms_eps)
+        h = h + swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+        return (h,), (k_cache_l, v_cache_l)
+
+    xs = (params["layers"], cache["k"], cache["v"])
+    if lora_stacks is not None:
+        xs = xs + (lora_stacks,)
+    (h,), (new_k, new_v) = jax.lax.scan(layer_step, (h,), xs)
+    h = rms_norm(h[0], params["final_norm"], c.rms_eps)  # [T, D]
+    return h, {"k": new_k, "v": new_v}
+
+
+def mixed_step(
+    params: Params,
+    tokens: jax.Array,       # [T] packed tokens
+    positions: jax.Array,    # [T]
+    slot_mapping: jax.Array, # [T]
+    block_tables: jax.Array, # [B, MB]
+    cu_q_lens: jax.Array,    # [B+1]
+    context_lens: jax.Array, # [B]
+    cache: Cache,
+    config: LlamaConfig,
+    *,
+    block_size: int,
+    max_q_len: int,
+    attn_impl: str = "auto",
+    lora: "dict | None" = None,
+) -> tuple[jax.Array, Cache]:
+    """One mixed prefill+decode step -> (last-row logits [B, V], cache).
+
+    Row b's logits condition on its full context including the packed
+    suffix — for a decode row that is the next-token distribution, for
+    a finishing prefill chunk the first-token distribution, and for a
+    mid-prompt chunk they are computed-and-ignored (the planner only
+    samples emitting rows). Pad sequences (q_len 0) alias a neighbour's
+    last row; their logits are discarded host-side."""
+    h, new_cache = ragged_forward(
+        params, tokens, positions, slot_mapping, block_tables, cu_q_lens,
+        context_lens, cache, config, block_size=block_size,
+        max_q_len=max_q_len, attn_impl=attn_impl, lora=lora,
+    )
+    T = tokens.shape[0]
+    last = jnp.clip(cu_q_lens[1:] - 1, 0, T - 1)  # [B]
+    return _lm_head(params, h[last], config), new_cache
+
+
+def verify_tokens_ragged(
+    params: Params,
+    tokens: jax.Array,       # [T] packed (current token + draft) rows
+    positions: jax.Array,    # [T]
+    slot_mapping: jax.Array, # [T]
+    block_tables: jax.Array, # [B, MB]
+    cu_q_lens: jax.Array,    # [B+1]
+    context_lens: jax.Array, # [B]
+    gather_idx: jax.Array,   # [B, K+1] packed row index per draft position
+    cache: Cache,
+    config: LlamaConfig,
+    *,
+    block_size: int,
+    max_q_len: int,
+    attn_impl: str = "auto",
+    lora: "dict | None" = None,
+) -> tuple[jax.Array, Cache]:
+    """Ragged speculative verification -> (logits [B, K+1, V], cache).
+
+    The packed replacement for `verify_tokens`: each row contributes
+    exactly 1 + draft_len tokens instead of a [B, K+1] rectangle padded
+    with trash-slot columns — the per-row bucket waste the ragged path
+    deletes. `gather_idx[b, j]` maps draft position j back to its
+    packed row (hosts clamp it to the row's last token for positions
+    past the row's draft; `accept_draft` masks those by draft_lens, so
+    duplicated logits are never consumed)."""
+    h, new_cache = ragged_forward(
+        params, tokens, positions, slot_mapping, block_tables, cu_q_lens,
+        context_lens, cache, config, block_size=block_size,
+        max_q_len=max_q_len, attn_impl=attn_impl, lora=lora,
+    )
+    return _lm_head(params, h[gather_idx], config), new_cache
 
 
 def decode_step(

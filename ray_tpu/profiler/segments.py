@@ -879,6 +879,75 @@ def decode_step_segments(
                    in_step=False)
         )
 
+    # mixed ragged probes (r24, in_step=False like the prefill probe):
+    # a packed batch where half the rows serve a Qm-token prefill chunk
+    # and half decode — the ONE-dispatch mixed step the mixed_batch
+    # engine runs. `ragged_attention` prices the kernel alone;
+    # `mixed_step` the full packed program (llama_decode.mixed_step).
+    Qm = min(16, max(2, ctx))
+    _q_lens = [Qm if i < (B + 1) // 2 else 1 for i in range(B)]
+    Tm = sum(_q_lens)
+    _cu = [0]
+    for ql in _q_lens:
+        _cu.append(_cu[-1] + ql)
+    mx_cu = jnp.asarray(_cu, jnp.int32)
+    _pos = []
+    for ql in _q_lens:
+        _pos.extend(range(ctx + 1 - ql, ctx + 1))
+    mx_positions = jnp.asarray(_pos, jnp.int32)
+    _row = []
+    for i, ql in enumerate(_q_lens):
+        _row.extend([i] * ql)
+    _row = jnp.asarray(_row, jnp.int32)
+    mx_slots = (
+        block_tables[_row, mx_positions // block_size] * block_size
+        + mx_positions % block_size
+    )
+    mx_tokens = jnp.ones((Tm,), jnp.int32)
+    mx_q = jax.random.normal(
+        jax.random.key(7), (Tm, c.n_heads, hd), c.dtype
+    )
+
+    def mk_mx_carry():
+        return init_cache(c, num_slots, trash_slots=block_size)
+
+    def ra_fn(cache):
+        from ray_tpu.ops.ragged import ragged_attention
+
+        o = ragged_attention(
+            mx_q, cache["k"][0], cache["v"][0], block_tables, mx_cu,
+            context_lens, block_size=block_size, max_q_len=Qm,
+            impl=attn_impl,
+        )
+        k = cache["k"]
+        return {
+            **cache,
+            "k": k.at[0, 0, 0, 0].add((_token(o) * 0).astype(k.dtype)),
+        }
+
+    def mx_fn(cache):
+        from ray_tpu.models.llama_decode import mixed_step
+
+        logits, new_cache = mixed_step(
+            params, mx_tokens, mx_positions, mx_slots, block_tables,
+            mx_cu, context_lens, cache, c, block_size=block_size,
+            max_q_len=Qm, attn_impl=attn_impl,
+        )
+        k = new_cache["k"]
+        return {
+            **new_cache,
+            "k": k.at[0, 0, 0, 0].add((_token(logits) * 0).astype(k.dtype)),
+        }
+
+    parts.append(
+        FnPart("ragged_attention", ra_fn, mk_mx_carry, donate=True,
+               in_step=False)
+    )
+    parts.append(
+        FnPart("mixed_step", mx_fn, mk_mx_carry, donate=True,
+               in_step=False)
+    )
+
     def real_step(carry):
         """The REFERENCE program: llama_decode.decode_step + the jitted
         sampler + the pipelined stop-mask epilogue — the same per-step

@@ -221,7 +221,7 @@ def test_checked_in_captures_keep_coverage():
                              "benchmarks")
     for name, step in [
         ("PROFILE_trainstep_r06.json", "train_step"),
-        ("PROFILE_decode_r16.json", "decode_step"),
+        ("PROFILE_decode_r24.json", "decode_step"),
     ]:
         path = os.path.join(bench_dir, name)
         assert os.path.exists(path), f"missing checked-in capture {name}"
